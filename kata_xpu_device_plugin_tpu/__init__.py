@@ -1,0 +1,32 @@
+"""kata-xpu-device-plugin-tpu: a TPU-native Kubernetes device plugin for Kata Containers.
+
+A brand-new framework with the capabilities of ``Apokleos/kata-xpu-device-plugin``
+(reference: a Go device plugin that exposes vfio-pci NVIDIA GPUs to Kata guests via
+CDI), redesigned for Google Cloud TPUs:
+
+- discovery of ``/dev/accel*`` char devices and vendor-``0x1ae0`` PCIe endpoints
+  (alongside a generalized vfio-pci passthrough path),
+- an ICI slice-topology model as the co-allocation unit (the TPU analogue of the
+  reference's IOMMU group; ref ``pkg/device_plugin/device_plugin.go:31``),
+- the kubelet device-plugin v1beta1 gRPC API advertising ``google.com/tpu``,
+- CDI spec emission that injects device nodes, the ``libtpu.so`` mount, and TPU
+  topology environment into Kata guest VMs (ref ``cdi/spec.go``),
+- a JAX guest harness (``guest/``, ``models/``, ``ops/``, ``parallel/``) implementing
+  the BASELINE validation ladder up to Gemma-2B inference and sharded training.
+
+Subpackage map (host side, no JAX imports):
+  cdi/        CDI data model + atomic spec writer        (ref L1: cdi/)
+  discovery/  sysfs/devfs scanners + pci.ids naming      (ref L3: device_plugin.go)
+  topology/   ICI slice model + preferred allocation     (new; ref stub :378)
+  plugin/     kubelet gRPC server + health + manager     (ref L2: generic_device_plugin.go)
+  multihost/  TPU_WORKER_ID/HOSTNAMES coordination       (new)
+  utils/      logging, metrics, inotify, pod-resources   (ref L0: utils/)
+
+Guest side (JAX; imported lazily so the host daemon never loads jax):
+  guest/      device probe + collective smoke ladder
+  models/     flagship Gemma-style + Llama-style decoders
+  ops/        pallas flash-attention and collective helpers
+  parallel/   mesh construction + dp/fsdp/tp/sp sharding rules
+"""
+
+__version__ = "0.1.0"

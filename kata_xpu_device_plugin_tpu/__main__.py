@@ -1,0 +1,25 @@
+"""CLI entry point (counterpart of the reference's ``cmd/main.go:5-7``).
+
+The reference's ``main()`` is a single call with no flags, no signal handling
+(SURVEY L4). This entry point grows into a real CLI (``run`` / ``status`` /
+``version`` subcommands with full flag coverage) as the framework lands; it is
+kept minimal-but-working at every commit.
+"""
+from __future__ import annotations
+
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    from . import __version__
+
+    if argv[:1] in ([], ["version"], ["--version"]):
+        print(f"kata-tpu-device-plugin {__version__}")
+        return 0
+    print(f"unknown command: {argv[0]!r} (available: version)", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""CDI constants for the TPU device plugin.
+
+Counterpart of the reference's ``cdi/spec.go:12-14`` and ``cdi/constant.go:8-12``
+(CDI version, kind, annotation prefix, device-list strategy names) — but with the
+kind/vendor flipped to Google TPUs, and everything here overridable through
+:mod:`kata_xpu_device_plugin_tpu.config` rather than hardcoded (the reference
+hardcodes all of these; SURVEY §5 "Config / flag system: none").
+"""
+
+# CDI spec schema version this writer emits. 0.6.0 is what containerd 1.7+/CRI-O
+# 1.28+ accept and what the reference pins (ref cdi/spec.go:12).
+CDI_VERSION = "0.6.0"
+
+# Resource/CDI identity for Cloud TPUs. The reference uses "nvidia.com/gpu"
+# (ref cdi/spec.go:13); GKE's convention for TPUs is "google.com/tpu".
+DEFAULT_VENDOR = "google.com"
+DEFAULT_CLASS = "tpu"
+DEFAULT_KIND = f"{DEFAULT_VENDOR}/{DEFAULT_CLASS}"
+
+# Kind used for the generalized whole-VM PCI passthrough path (VFIO-bound TPUs
+# or any other vendor's accelerator), mirroring the reference's only mode.
+VFIO_CLASS = "vfio"
+
+# Annotation key prefix consumed by container runtimes with CDI support
+# (ref cdi/spec.go:14).
+CDI_K8S_PREFIX = "cdi.k8s.io/"
+
+# Kata-specific CDI device annotations. The reference emits these on every CDI
+# device so the Kata runtime hot-plugs the PCI function into the guest VM
+# (ref pkg/device_plugin/device_plugin.go:62-68).
+ANNOTATION_ATTACH_PCI = "attach-pci"
+ANNOTATION_BDF = "bdf"
+
+# Device-list strategies: how allocated devices are communicated to the runtime
+# (ref cdi/constant.go:8-12 and generic_device_plugin.go:52-71). The reference
+# hardcodes cdi-cri on / cdi-annotations off; here both are real config.
+STRATEGY_CDI_CRI = "cdi-cri"
+STRATEGY_CDI_ANNOTATIONS = "cdi-annotations"
+STRATEGY_ENVVAR = "envvar"
+ALL_STRATEGIES = (STRATEGY_CDI_CRI, STRATEGY_CDI_ANNOTATIONS, STRATEGY_ENVVAR)
+
+# Env var surfaced to the container naming the CDI vendor/class it was granted
+# (ref generic_device_plugin.go:348-350 emits KUBERNETES_CDI_VENDOR_CLASS).
+ENV_CDI_VENDOR_CLASS = "KUBERNETES_CDI_VENDOR_CLASS"
+
+# TPU runtime environment injected into the guest so libtpu/JAX initialize the
+# ICI mesh correctly (the TPU-native analogue of "the device node is enough" on
+# the NVIDIA/VFIO path; SURVEY §2 equivalence table).
+ENV_TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+ENV_TPU_CHIPS_PER_HOST_BOUNDS = "TPU_CHIPS_PER_HOST_BOUNDS"
+ENV_TPU_HOST_BOUNDS = "TPU_HOST_BOUNDS"
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+ENV_TPU_SKIP_MDS_QUERY = "TPU_SKIP_MDS_QUERY"
+
+# Default location where containerd/CRI-O pick up CDI spec files
+# (ref pkg/device_plugin/device_plugin.go:20).
+DEFAULT_CDI_DIR = "/var/run/cdi"
+
+# Canonical in-guest path for the injected libtpu (mounted read-only from the
+# host TPU-VM image so XLA in the Kata guest drives the chips directly).
+LIBTPU_CONTAINER_PATH = "/usr/lib/tpu/libtpu.so"
+LIBTPU_ENV = "TPU_LIBRARY_PATH"

@@ -1,0 +1,17 @@
+"""Test configuration.
+
+Host-side tests (cdi, discovery, plugin, topology) never import JAX. JAX-side
+tests (models, ops, parallel, guest) run on a virtual 8-device CPU mesh so
+multi-chip sharding is exercised without TPU hardware — the strategy SURVEY.md
+§4 prescribes (fake sysfs + fake kubelet for infra; forced host-platform device
+count for SPMD).
+"""
+import os
+
+# Must be set before the first `import jax` anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,128 @@
+"""CDI model/writer tests (SURVEY §4: golden-file tests of the spec shapes)."""
+import os
+
+import pytest
+import yaml
+
+from kata_xpu_device_plugin_tpu import cdi
+from kata_xpu_device_plugin_tpu.cdi import constants as C
+
+
+def _tpu_spec() -> cdi.Spec:
+    spec = cdi.Spec(kind="google.com/tpu", cdi_version=C.CDI_VERSION)
+    spec.container_edits.add_env(f"{C.ENV_TPU_SKIP_MDS_QUERY}", "true")
+    spec.container_edits.mounts.append(
+        cdi.Mount(host_path="/usr/lib/tpu/libtpu.so", container_path=C.LIBTPU_CONTAINER_PATH)
+    )
+    for i in range(2):
+        dev = cdi.Device(
+            name=str(i),
+            annotations={C.ANNOTATION_BDF: f"0000:0{i}:00.0"},
+            container_edits=cdi.ContainerEdits(
+                device_nodes=[cdi.DeviceNode(path=f"/dev/accel{i}", type="c", permissions="rw")],
+                env=[f"{C.ENV_TPU_VISIBLE_CHIPS}={i}"],
+            ),
+        )
+        spec.add_device(dev)
+    return spec
+
+
+GOLDEN_YAML = """\
+cdiVersion: 0.6.0
+kind: google.com/tpu
+devices:
+- name: '0'
+  annotations:
+    bdf: '0000:00:00.0'
+  containerEdits:
+    env:
+    - TPU_VISIBLE_CHIPS=0
+    deviceNodes:
+    - path: /dev/accel0
+      type: c
+      permissions: rw
+- name: '1'
+  annotations:
+    bdf: '0000:01:00.0'
+  containerEdits:
+    env:
+    - TPU_VISIBLE_CHIPS=1
+    deviceNodes:
+    - path: /dev/accel1
+      type: c
+      permissions: rw
+containerEdits:
+  env:
+  - TPU_SKIP_MDS_QUERY=true
+  mounts:
+  - hostPath: /usr/lib/tpu/libtpu.so
+    containerPath: /usr/lib/tpu/libtpu.so
+    options:
+    - ro
+    - nosuid
+    - nodev
+    - bind
+    type: bind
+"""
+
+
+def test_golden_yaml_shape():
+    assert cdi.render(_tpu_spec(), cdi.FORMAT_YAML) == GOLDEN_YAML
+
+
+def test_yaml_and_json_roundtrip(tmp_path):
+    spec = _tpu_spec()
+    for fmt in (cdi.FORMAT_YAML, cdi.FORMAT_JSON):
+        path = cdi.save(spec, str(tmp_path), fmt)
+        assert os.path.basename(path) == f"google.com-tpu.{'json' if fmt == 'json' else 'yaml'}"
+        loaded = cdi.load(path)
+        assert loaded.to_dict() == spec.to_dict()
+
+
+def test_atomic_write_leaves_no_tmp(tmp_path):
+    cdi.save(_tpu_spec(), str(tmp_path))
+    leftovers = [f for f in os.listdir(tmp_path) if f.startswith(".cdi-")]
+    assert leftovers == []
+
+
+def test_per_kind_filenames_do_not_collide(tmp_path):
+    # Fixes reference quirk 7 (hardcoded single filename, device_plugin.go:79).
+    cdi.save(cdi.Spec(kind="google.com/tpu"), str(tmp_path))
+    cdi.save(cdi.Spec(kind="google.com/vfio"), str(tmp_path))
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["google.com-tpu.yaml", "google.com-vfio.yaml"]
+
+
+def test_qualified_names():
+    qn = cdi.qualified_name("google.com", "tpu", "3")
+    assert qn == "google.com/tpu=3"
+    assert cdi.parse_qualified_name(qn) == ("google.com", "tpu", "3")
+    assert cdi.is_qualified_name("google.com/tpu=0")
+    assert not cdi.is_qualified_name("google.com/tpu")
+    assert not cdi.is_qualified_name("no-slash=0")
+    with pytest.raises(ValueError):
+        cdi.qualified_name("google.com", "tpu", "bad name")
+
+
+def test_invalid_kind_and_duplicate_device():
+    with pytest.raises(ValueError):
+        cdi.Spec(kind="noslash")
+    spec = cdi.Spec(kind="google.com/tpu")
+    spec.add_device(cdi.Device(name="0"))
+    with pytest.raises(ValueError):
+        spec.add_device(cdi.Device(name="0"))
+
+
+def test_empty_fields_pruned():
+    spec = cdi.Spec(kind="google.com/tpu")
+    d = spec.to_dict()
+    assert "devices" not in d and "annotations" not in d and "containerEdits" not in d
+    doc = yaml.safe_load(cdi.render(spec))
+    assert doc == {"cdiVersion": "0.6.0", "kind": "google.com/tpu"}
+
+
+def test_remove(tmp_path):
+    spec = _tpu_spec()
+    cdi.save(spec, str(tmp_path))
+    cdi.remove(str(tmp_path), spec.kind)
+    assert os.listdir(tmp_path) == []

@@ -1,0 +1,26 @@
+"""Device discovery: TPU-native (/dev/accel* + vendor 1ae0) and generalized
+VFIO paths, plus pci.ids naming (counterpart of the reference's
+``pkg/device_plugin/device_plugin.go`` discovery layer)."""
+from .pciids import GOOGLE_VENDOR, NVIDIA_VENDOR, PciIds, resource_suffix, sanitize_name
+from .sysfs import CharDevice, FakeSysfsBuilder, PciFunction, scan_char_devices, scan_pci
+from .tpu import TpuChip, TpuInventory, scan_tpus
+from .vfio import VfioDevice, VfioInventory, scan_vfio
+
+__all__ = [
+    "GOOGLE_VENDOR",
+    "NVIDIA_VENDOR",
+    "PciIds",
+    "resource_suffix",
+    "sanitize_name",
+    "CharDevice",
+    "FakeSysfsBuilder",
+    "PciFunction",
+    "scan_char_devices",
+    "scan_pci",
+    "TpuChip",
+    "TpuInventory",
+    "scan_tpus",
+    "VfioDevice",
+    "VfioInventory",
+    "scan_vfio",
+]

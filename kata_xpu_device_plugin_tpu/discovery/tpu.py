@@ -1,0 +1,129 @@
+"""TPU discovery: the native path.
+
+Replaces the reference's single hardcoded path — "vendor == 10de && driver ==
+vfio-pci" over /sys/bus/pci/devices (``device_plugin.go:142-160``) — with the
+TPU-first scan (SURVEY §7 stage 2a): enumerate ``/dev/accel*`` char devices
+(the Cloud TPU kernel driver's nodes), correlate them with vendor-``1ae0``
+PCIe endpoints for BDF/NUMA/IOMMU metadata, and derive the host's slice
+topology. The VFIO walk lives in :mod:`.vfio` as the generalized path.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from ..topology.slice import HostTopology, detect_accelerator_type
+from . import sysfs
+from .pciids import GOOGLE_VENDOR, PciIds, resource_suffix
+
+
+@dataclass(frozen=True)
+class TpuChip:
+    """One TPU chip on the host: a /dev/accel node plus optional PCI identity.
+
+    ``index`` (the accelN suffix) is the stable host-local chip id — it is the
+    CDI device name and the device-plugin device id, replacing the reference's
+    fragile global bus-walk counter (SURVEY §Quirks 5).
+    """
+
+    index: int
+    dev_path: str  # /dev/accel<N>
+    major: Optional[int] = None
+    minor: Optional[int] = None
+    pci_address: Optional[str] = None
+    pci_device: Optional[str] = None
+    numa_node: Optional[int] = None
+    vfio_group: Optional[str] = None  # set when the function is vfio-bound
+
+
+@dataclass(frozen=True)
+class TpuInventory:
+    """Everything discovery learned about this host's TPUs."""
+
+    chips: tuple[TpuChip, ...]
+    topology: HostTopology
+    model_suffix: str  # resource-name suffix, e.g. "TPU_V5E"
+
+    @property
+    def count(self) -> int:
+        return len(self.chips)
+
+    def chip(self, index: int) -> TpuChip:
+        for c in self.chips:
+            if c.index == index:
+                return c
+        raise KeyError(index)
+
+
+def scan_tpus(
+    sysfs_root: str = sysfs.DEFAULT_SYSFS_ROOT,
+    dev_root: str = sysfs.DEFAULT_DEV_ROOT,
+    env: Optional[dict[str, str]] = None,
+    pci_ids: Optional[PciIds] = None,
+    accelerator_type: Optional[str] = None,
+) -> TpuInventory:
+    """One-shot scan (re-run periodically by the manager; the reference never
+    rescans — SURVEY §Quirks 9).
+
+    Chip identity comes from /dev/accel*; PCI metadata is correlated by sorted
+    BDF order (the Cloud TPU driver enumerates accel nodes in BDF order). When
+    counts disagree, PCI metadata is attached only pairwise-in-order and the
+    mismatch is left to the caller's logging.
+    """
+    environ: dict[str, str] = os.environ if env is None else env  # type: ignore[assignment]
+    nodes = [
+        n
+        for n in sysfs.scan_char_devices(dev_root, "accel")
+        if n.name[len("accel"):].isdigit()  # accel<N> only; ignore strays
+    ]
+    pci_funcs = [
+        f
+        for f in sysfs.scan_pci(sysfs_root)
+        if f.vendor == GOOGLE_VENDOR and _is_accel_function(f)
+    ]
+
+    chips = []
+    for i, node in enumerate(nodes):
+        suffix = node.name[len("accel"):]
+        index = int(suffix) if suffix.isdigit() else i
+        pci = pci_funcs[i] if i < len(pci_funcs) else None
+        chips.append(
+            TpuChip(
+                index=index,
+                dev_path=node.path,
+                major=node.major,
+                minor=node.minor,
+                pci_address=pci.address if pci else None,
+                pci_device=pci.device if pci else None,
+                numa_node=pci.numa_node if pci else None,
+                vfio_group=pci.iommu_group if pci and pci.driver == "vfio-pci" else None,
+            )
+        )
+
+    accel_type = accelerator_type or detect_accelerator_type(environ, chip_count=len(chips))
+    topo = HostTopology.from_accelerator_type(
+        accel_type,
+        worker_id=int(environ.get("TPU_WORKER_ID", "0") or "0"),
+        worker_hostnames=_split_hostnames(environ.get("TPU_WORKER_HOSTNAMES")),
+    )
+    device_id = next((c.pci_device for c in chips if c.pci_device), None)
+    suffix = resource_suffix(GOOGLE_VENDOR, device_id, pci_ids) if device_id else "TPU"
+    return TpuInventory(chips=tuple(chips), topology=topo, model_suffix=suffix)
+
+
+def _is_accel_function(f: sysfs.PciFunction) -> bool:
+    """Google endpoints that are accelerators (filters out e.g. gVNIC which
+    shares the vendor id): accept known-TPU device ids and anything not bound
+    to a networking driver."""
+    from .pciids import BUILTIN_GOOGLE_DEVICES
+
+    if f.device in BUILTIN_GOOGLE_DEVICES:
+        return True
+    return f.driver not in ("gve", "virtio-pci")
+
+
+def _split_hostnames(raw: Optional[str]) -> tuple[str, ...]:
+    if not raw:
+        return ()
+    return tuple(h for h in raw.split(",") if h)

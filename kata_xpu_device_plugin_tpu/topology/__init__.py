@@ -1,0 +1,29 @@
+"""ICI slice topology model + topology-aware preferred allocation (the TPU
+analogue of the reference's IOMMU-group co-allocation unit; implements what
+``GetPreferredAllocation`` stubs out at generic_device_plugin.go:378-386)."""
+from .preferred import Placement, alignment_score, chip_ids_to_indexes, choose_chips
+from .slice import (
+    FAMILIES,
+    HostTopology,
+    TpuFamily,
+    chip_coord,
+    coord_chip,
+    detect_accelerator_type,
+    parse_accelerator_type,
+    runtime_env,
+)
+
+__all__ = [
+    "Placement",
+    "alignment_score",
+    "chip_ids_to_indexes",
+    "choose_chips",
+    "FAMILIES",
+    "HostTopology",
+    "TpuFamily",
+    "chip_coord",
+    "coord_chip",
+    "detect_accelerator_type",
+    "parse_accelerator_type",
+    "runtime_env",
+]

@@ -1,0 +1,113 @@
+"""Topology-aware preferred allocation.
+
+The reference stubs ``GetPreferredAllocation`` (``generic_device_plugin.go:
+378-386`` returns ``nil, nil``) — for interchangeable VFIO groups that is
+merely lazy; for TPUs it is wrong (SURVEY §Quirks 8). A 4-chip request on a
+v5e-8 host must get an ICI-contiguous 2x2 sub-grid, or the guest's mesh cannot
+use ICI between its chips. This module picks such sub-grids.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Optional, Sequence
+
+from .slice import Coord, HostTopology, chip_coord, coord_chip
+
+
+@dataclass(frozen=True)
+class Placement:
+    """A chosen chip set, with whether it is ICI-contiguous."""
+
+    chips: tuple[int, ...]
+    contiguous: bool
+    shape: Optional[Coord] = None
+
+
+def _placements(grid: Coord, shape: Coord) -> Iterable[tuple[Coord, Coord]]:
+    """All axis-aligned origins (and orientations) where ``shape`` fits in
+    ``grid``. Both xy orientations of the sub-grid are considered (a 1x2 slice
+    can lie along x or y — ICI links exist both ways)."""
+    seen = set()
+    sx, sy, sz = shape
+    for oriented in {(sx, sy, sz), (sy, sx, sz)}:
+        ox_max = grid[0] - oriented[0]
+        oy_max = grid[1] - oriented[1]
+        oz_max = grid[2] - oriented[2]
+        if min(ox_max, oy_max, oz_max) < 0:
+            continue
+        for oz in range(oz_max + 1):
+            for oy in range(oy_max + 1):
+                for ox in range(ox_max + 1):
+                    key = ((ox, oy, oz), oriented)
+                    if key not in seen:
+                        seen.add(key)
+                        yield (ox, oy, oz), oriented
+
+
+def _chips_in_box(topo: HostTopology, origin: Coord, shape: Coord) -> list[int]:
+    fam = topo.family
+    chips = []
+    for dz in range(shape[2]):
+        for dy in range(shape[1]):
+            for dx in range(shape[0]):
+                chips.append(
+                    coord_chip(fam, (origin[0] + dx, origin[1] + dy, origin[2] + dz))
+                )
+    return sorted(chips)
+
+
+def choose_chips(
+    topo: HostTopology,
+    available: Sequence[int],
+    count: int,
+    must_include: Sequence[int] = (),
+) -> Placement:
+    """Pick ``count`` chips from ``available``, preferring an ICI-contiguous
+    axis-aligned sub-grid that covers ``must_include``.
+
+    Falls back to the lowest-indexed available chips (non-contiguous) when no
+    valid box fits — the kubelet treats preferred allocation as advisory, so
+    returning *something* keeps Allocate functional, and the plugin flags
+    non-contiguity in its metrics/logs.
+    """
+    avail = sorted(set(available))
+    must = sorted(set(must_include))
+    if count > len(avail) or len(must) > count or not set(must) <= set(avail):
+        raise ValueError(
+            f"cannot allocate {count} chips from {len(avail)} available "
+            f"(must_include={must})"
+        )
+    shape = topo.family.subslices.get(count)
+    if shape is not None:
+        grid = topo.local_grid()
+        avail_set = set(avail)
+        best: Optional[tuple[tuple, list[int], Coord]] = None
+        for origin, oriented in _placements(grid, shape):
+            chips = _chips_in_box(topo, origin, oriented)
+            if not set(chips) <= avail_set or not set(must) <= set(chips):
+                continue
+            # Deterministic preference: lowest chip ids first (stable across
+            # kubelet retries, like the reference's sorted group ids).
+            key = tuple(chips)
+            if best is None or key < best[0]:
+                best = (key, chips, oriented)
+        if best is not None:
+            return Placement(chips=tuple(best[1]), contiguous=True, shape=best[2])
+    # No contiguous box available (fragmented host or odd count).
+    fill = [c for c in avail if c not in must]
+    chosen = sorted(must + fill[: count - len(must)])
+    return Placement(chips=tuple(chosen), contiguous=False)
+
+
+def chip_ids_to_indexes(ids: Iterable[str]) -> list[int]:
+    """Device-plugin device ids are strings; chips are host-local ints."""
+    return [int(i) for i in ids]
+
+
+def alignment_score(topo: HostTopology, chips: Sequence[int]) -> float:
+    """1.0 when the set is exactly a valid sub-grid; used by tests/metrics."""
+    try:
+        placement = choose_chips(topo, chips, len(chips))
+    except ValueError:
+        return 0.0
+    return 1.0 if placement.contiguous and set(placement.chips) == set(chips) else 0.0

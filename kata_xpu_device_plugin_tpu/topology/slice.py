@@ -1,0 +1,209 @@
+"""ICI slice topology model.
+
+The reference's co-allocation unit is the IOMMU group — any N group ids are
+interchangeable (``generic_device_plugin.go:322-341``). TPU chips are NOT
+interchangeable: they sit at fixed coordinates in the host's ICI grid, and only
+axis-aligned contiguous sub-grids form valid slices (SURVEY §7 "Hard parts").
+This module models host grids per TPU family, maps chip indexes to ICI
+coordinates, validates requestable sub-slice shapes, and emits the libtpu
+topology environment (``TPU_ACCELERATOR_TYPE``, ``TPU_CHIPS_PER_HOST_BOUNDS``,
+``TPU_HOST_BOUNDS``, ``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES``,
+``TPU_VISIBLE_CHIPS``) that JAX/XLA in the Kata guest needs to bring up ICI.
+"""
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+Coord = tuple[int, int, int]
+
+
+@dataclass(frozen=True)
+class TpuFamily:
+    """Static per-generation host layout."""
+
+    name: str  # family prefix in TPU_ACCELERATOR_TYPE, e.g. "v5litepod"
+    chips_per_host: int
+    host_grid: Coord  # ICI grid of one host's chips, e.g. (2, 4, 1) for v5e-8
+    # Requestable chip counts within ONE host, mapped to their sub-grid shape.
+    # (Multi-host slices always take whole hosts; partial-host allocation only
+    # exists where the cloud exposes it — v5e/v6e 1/4/8-chip machine shapes.)
+    subslices: dict[int, Coord]
+    # Suffix in the accelerator type counts chips (v5e/v6e) or TensorCores
+    # (v2-v4/v5p, 2 cores per chip).
+    suffix_counts_cores: bool
+
+
+FAMILIES: dict[str, TpuFamily] = {
+    f.name: f
+    for f in (
+        TpuFamily("v2", 4, (2, 2, 1), {4: (2, 2, 1)}, True),
+        TpuFamily("v3", 4, (2, 2, 1), {4: (2, 2, 1)}, True),
+        TpuFamily("v4", 4, (2, 2, 1), {4: (2, 2, 1)}, True),
+        TpuFamily("v5p", 4, (2, 2, 1), {4: (2, 2, 1)}, True),
+        TpuFamily(
+            "v5litepod",
+            8,
+            (2, 4, 1),
+            {1: (1, 1, 1), 2: (1, 2, 1), 4: (2, 2, 1), 8: (2, 4, 1)},
+            False,
+        ),
+        TpuFamily(
+            "v6e",
+            8,
+            (2, 4, 1),
+            {1: (1, 1, 1), 2: (1, 2, 1), 4: (2, 2, 1), 8: (2, 4, 1)},
+            False,
+        ),
+    )
+}
+
+
+def parse_accelerator_type(accel_type: str) -> tuple[TpuFamily, int]:
+    """``"v5litepod-8"`` -> (family, total chips in the slice).
+
+    Raises ValueError for unknown families or malformed strings.
+    """
+    name, sep, suffix = accel_type.partition("-")
+    fam = FAMILIES.get(name)
+    if fam is None or not sep or not suffix.isdigit():
+        raise ValueError(f"unknown accelerator type: {accel_type!r}")
+    n = int(suffix)
+    chips = n // 2 if fam.suffix_counts_cores else n
+    if chips < 1:
+        raise ValueError(f"accelerator type too small: {accel_type!r}")
+    return fam, chips
+
+
+def chip_coord(fam: TpuFamily, index: int) -> Coord:
+    """ICI coordinate of host-local chip ``index`` (row-major over the grid)."""
+    gx, gy, _gz = fam.host_grid
+    if not 0 <= index < fam.chips_per_host:
+        raise ValueError(f"chip index {index} out of range for {fam.name}")
+    return (index % gx, (index // gx) % gy, index // (gx * gy))
+
+
+def coord_chip(fam: TpuFamily, coord: Coord) -> int:
+    gx, gy, _gz = fam.host_grid
+    x, y, z = coord
+    return x + y * gx + z * gx * gy
+
+
+@dataclass(frozen=True)
+class HostTopology:
+    """The slice topology as seen from one host."""
+
+    accelerator_type: str
+    family: TpuFamily
+    total_chips: int  # whole slice
+    local_chips: int  # on this host
+    num_hosts: int
+    worker_id: int = 0
+    worker_hostnames: tuple[str, ...] = ()
+
+    @classmethod
+    def from_accelerator_type(
+        cls,
+        accel_type: str,
+        worker_id: int = 0,
+        worker_hostnames: Sequence[str] = (),
+    ) -> "HostTopology":
+        fam, chips = parse_accelerator_type(accel_type)
+        local = min(chips, fam.chips_per_host)
+        num_hosts = max(1, math.ceil(chips / fam.chips_per_host))
+        return cls(
+            accelerator_type=accel_type,
+            family=fam,
+            total_chips=chips,
+            local_chips=local,
+            num_hosts=num_hosts,
+            worker_id=worker_id,
+            worker_hostnames=tuple(worker_hostnames),
+        )
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    def local_grid(self) -> Coord:
+        """Grid of the chips present on this host (sub-host slices shrink it)."""
+        if self.local_chips == self.family.chips_per_host:
+            return self.family.host_grid
+        shape = self.family.subslices.get(self.local_chips)
+        if shape is None:
+            raise ValueError(
+                f"{self.accelerator_type}: {self.local_chips} chips/host has no valid grid"
+            )
+        return shape
+
+    def host_bounds(self) -> Coord:
+        """How hosts tile the full slice grid (``TPU_HOST_BOUNDS``).
+
+        Hosts stack along y for 2D families and along z for 3D ones — matching
+        how slices grow: v5e pods extend the 2x4 host grid in y; v4/v5p pods
+        stack 2x2x1 host bricks in z.
+        """
+        if self.num_hosts == 1:
+            return (1, 1, 1)
+        gx, gy, gz = self.family.host_grid
+        if gz == 1 and self.family.chips_per_host == 8:
+            return (1, self.num_hosts, 1)
+        return (1, 1, self.num_hosts)
+
+    def valid_request_counts(self) -> list[int]:
+        """Chip counts a pod may request on this host."""
+        if self.is_multi_host:
+            return [self.local_chips]  # whole host only
+        return sorted(c for c in self.family.subslices if c <= self.local_chips)
+
+    def chips_per_host_bounds_str(self) -> str:
+        gx, gy, gz = self.local_grid()
+        return f"{gx},{gy},{gz}"
+
+    def host_bounds_str(self) -> str:
+        hx, hy, hz = self.host_bounds()
+        return f"{hx},{hy},{hz}"
+
+
+def detect_accelerator_type(
+    env: Optional[dict[str, str]] = None, chip_count: Optional[int] = None
+) -> str:
+    """Best-effort accelerator type: env (GKE sets TPU_ACCELERATOR_TYPE on TPU
+    node pools) → chip-count heuristic.
+
+    Without env, the count is rounded UP to the nearest shape that has a valid
+    grid (a host with 3 healthy chips of a 4-chip machine is still a 4-chip
+    machine) so every returned type survives ``HostTopology.local_grid()``.
+    """
+    env = os.environ if env is None else env
+    from_env = env.get("TPU_ACCELERATOR_TYPE")
+    if from_env:
+        return from_env
+    n = max(1, chip_count or 1)
+    fam = FAMILIES["v5litepod"]
+    if n <= fam.chips_per_host:
+        valid = min(c for c in fam.subslices if c >= n)
+        return f"v5litepod-{valid}"
+    hosts = math.ceil(n / fam.chips_per_host)
+    return f"v5litepod-{hosts * fam.chips_per_host}"
+
+
+def runtime_env(
+    topo: HostTopology, visible_chips: Optional[Sequence[int]] = None
+) -> dict[str, str]:
+    """The env block injected into the guest via CDI ``containerEdits`` so
+    libtpu initializes the ICI mesh (SURVEY §2 TPU-equivalents table)."""
+    env = {
+        "TPU_ACCELERATOR_TYPE": topo.accelerator_type,
+        "TPU_CHIPS_PER_HOST_BOUNDS": topo.chips_per_host_bounds_str(),
+        "TPU_HOST_BOUNDS": topo.host_bounds_str(),
+        "TPU_WORKER_ID": str(topo.worker_id),
+        "TPU_SKIP_MDS_QUERY": "true",
+    }
+    if topo.worker_hostnames:
+        env["TPU_WORKER_HOSTNAMES"] = ",".join(topo.worker_hostnames)
+    if visible_chips is not None:
+        env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in visible_chips)
+    return env

@@ -1,0 +1,141 @@
+"""Discovery tests against fake sysfs/dev trees (SURVEY §4 unit strategy)."""
+import pytest
+
+from kata_xpu_device_plugin_tpu import discovery
+from kata_xpu_device_plugin_tpu.discovery import pciids, sysfs
+
+
+@pytest.fixture
+def fake(tmp_path):
+    return sysfs.FakeSysfsBuilder(root=str(tmp_path))
+
+
+def _v5e8_host(fake):
+    """A v5e-8 host: 8 accel chips with Google PCIe endpoints."""
+    for i in range(8):
+        fake.add_accel_chip(i)
+        fake.add_pci_function(
+            f"0000:0{i}:01.0", vendor="1ae0", device="0063", numa_node=i // 4
+        )
+    return fake
+
+
+def test_scan_tpus_v5e8(fake):
+    _v5e8_host(fake)
+    inv = discovery.scan_tpus(fake.sysfs, fake.dev, env={})
+    assert inv.count == 8
+    assert [c.index for c in inv.chips] == list(range(8))
+    assert inv.chips[0].dev_path.endswith("/dev/accel0")
+    assert inv.chips[3].pci_address == "0000:03:01.0"
+    assert inv.chips[5].numa_node == 1
+    assert inv.model_suffix == "TPU_V5E"
+    assert inv.topology.accelerator_type == "v5litepod-8"
+    assert inv.topology.local_chips == 8
+    assert not inv.topology.is_multi_host
+
+
+def test_scan_tpus_accel_without_pci(fake):
+    # GKE guests may hide PCI topology: /dev/accel alone must still work.
+    for i in range(4):
+        fake.add_accel_chip(i)
+    inv = discovery.scan_tpus(fake.sysfs, fake.dev, env={})
+    assert inv.count == 4
+    assert inv.chips[0].pci_address is None
+    assert inv.model_suffix == "TPU"
+    assert inv.topology.accelerator_type == "v5litepod-4"
+
+
+def test_scan_tpus_respects_env_accel_type(fake):
+    for i in range(4):
+        fake.add_accel_chip(i)
+    inv = discovery.scan_tpus(
+        fake.sysfs, fake.dev, env={"TPU_ACCELERATOR_TYPE": "v5p-8", "TPU_WORKER_ID": "1"}
+    )
+    assert inv.topology.accelerator_type == "v5p-8"
+    assert inv.topology.total_chips == 4
+    assert inv.topology.worker_id == 1
+
+
+def test_scan_tpus_filters_gve_nic(fake):
+    fake.add_accel_chip(0)
+    fake.add_pci_function("0000:00:01.0", vendor="1ae0", device="0063")
+    fake.add_pci_function("0000:00:04.0", vendor="1ae0", device="0042", driver="gve")
+    inv = discovery.scan_tpus(fake.sysfs, fake.dev, env={})
+    assert inv.count == 1
+    assert inv.chips[0].pci_device == "0063"
+
+
+def test_scan_tpus_empty_host(fake):
+    # BASELINE configs[0]: 0-chip dry run must not blow up.
+    inv = discovery.scan_tpus(fake.sysfs, fake.dev, env={})
+    assert inv.count == 0
+
+
+def test_scan_vfio_groups_and_models(fake):
+    # Two GPUs of one model in separate groups + a multi-function board
+    # sharing group 3 + one non-vfio device that must be ignored.
+    fake.add_pci_function("0000:01:00.0", "10de", "2203", driver="vfio-pci", iommu_group="1")
+    fake.add_pci_function("0000:02:00.0", "10de", "2203", driver="vfio-pci", iommu_group="2")
+    fake.add_pci_function("0000:03:00.0", "10de", "2204", driver="vfio-pci", iommu_group="3")
+    fake.add_pci_function("0000:03:00.1", "10de", "1aef", driver="vfio-pci", iommu_group="3")
+    fake.add_pci_function("0000:04:00.0", "10de", "2203", driver="nvidia", iommu_group="4")
+    inv = discovery.scan_vfio(fake.sysfs, vendors=("10de",))
+    assert sorted(inv.groups) == ["1", "2", "3"]
+    assert len(inv.groups["3"]) == 2
+    assert inv.models[("10de", "2203")] == ["1", "2"]
+    assert inv.groups["1"][0].vfio_node == "/dev/vfio/1"
+
+
+def test_scan_vfio_vendor_filter_open(fake):
+    # TPU chips bound to vfio-pci for whole-VM passthrough are discoverable
+    # through the generalized path too.
+    fake.add_pci_function("0000:05:00.0", "1ae0", "0063", driver="vfio-pci", iommu_group="7")
+    inv = discovery.scan_vfio(fake.sysfs)
+    assert list(inv.models) == [("1ae0", "0063")]
+    assert inv.model_suffix(("1ae0", "0063")) == "TPU_V5E"
+
+
+def test_pciids_parse_and_fallbacks():
+    db = pciids.PciIds.parse(
+        "# comment\n"
+        "10de  NVIDIA Corporation\n"
+        "\t2203  GA102 [GeForce RTX 3090 Ti]\n"
+        "\t\t10de 1234  Some subsystem\n"
+        "C 03  Display controller\n"
+        "\t00  VGA compatible controller\n"
+    )
+    assert db.vendor_name("10de") == "NVIDIA Corporation"
+    assert db.device_name("10de", "2203") == "GA102 [GeForce RTX 3090 Ti]"
+    # class-section device lines must not leak into vendor tables
+    assert db.device_name("10de", "00") is None
+    assert pciids.resource_suffix("10de", "2203", db) == "GA102_GEFORCE_RTX_3090_TI"
+    assert pciids.resource_suffix("10de", "ffff", db) == "ffff"  # raw-hex fallback
+    assert pciids.resource_suffix("1ae0", "0063") == "TPU_V5E"  # builtin, no db
+    assert pciids.resource_suffix("1ae0", "9999") == "TPU"  # unknown TPU id
+
+
+def test_shipped_data_file_parses():
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), os.pardir, "kata_xpu_device_plugin_tpu", "data", "pci.ids"
+    )
+    with open(path) as f:
+        db = pciids.PciIds.parse(f.read())
+    assert db.vendor_name("1ae0") == "Google, Inc."
+    assert db.device_name("1ae0", "0063") == "Cloud TPU v5e"
+
+
+def test_sanitize_name():
+    assert pciids.sanitize_name("GA102 [GeForce RTX 3090]") == "GA102_GEFORCE_RTX_3090"
+    assert pciids.sanitize_name("  weird--name!! ") == "WEIRD_NAME"
+
+
+def test_scan_tpus_env_isolation(fake, monkeypatch):
+    # An explicit empty env must NOT fall back to os.environ.
+    monkeypatch.setenv("TPU_WORKER_ID", "3")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "a,b")
+    fake.add_accel_chip(0)
+    inv = discovery.scan_tpus(fake.sysfs, fake.dev, env={})
+    assert inv.topology.worker_id == 0
+    assert inv.topology.worker_hostnames == ()

@@ -1,0 +1,127 @@
+"""ICI topology model + preferred-allocation tests."""
+import pytest
+
+from kata_xpu_device_plugin_tpu import topology
+from kata_xpu_device_plugin_tpu.topology import slice as tslice
+
+
+def topo(accel="v5litepod-8", **kw):
+    return topology.HostTopology.from_accelerator_type(accel, **kw)
+
+
+def test_parse_accelerator_types():
+    fam, chips = tslice.parse_accelerator_type("v5litepod-8")
+    assert fam.name == "v5litepod" and chips == 8
+    fam, chips = tslice.parse_accelerator_type("v4-8")  # cores → 4 chips
+    assert fam.name == "v4" and chips == 4
+    fam, chips = tslice.parse_accelerator_type("v5p-32")
+    assert chips == 16
+    for bad in ("v99-8", "v5litepod", "v4-x"):
+        with pytest.raises(ValueError):
+            tslice.parse_accelerator_type(bad)
+
+
+def test_host_topology_single_host():
+    t = topo("v5litepod-8")
+    assert t.local_chips == 8 and t.num_hosts == 1
+    assert t.local_grid() == (2, 4, 1)
+    assert t.chips_per_host_bounds_str() == "2,4,1"
+    assert t.host_bounds_str() == "1,1,1"
+    assert t.valid_request_counts() == [1, 2, 4, 8]
+
+
+def test_host_topology_subhost():
+    t = topo("v5litepod-4")
+    assert t.local_chips == 4
+    assert t.local_grid() == (2, 2, 1)
+
+
+def test_host_topology_multi_host():
+    t = topo("v5p-32", worker_id=1, worker_hostnames=["h0", "h1", "h2", "h3"])
+    assert t.num_hosts == 4 and t.local_chips == 4
+    assert t.is_multi_host
+    assert t.valid_request_counts() == [4]  # whole host only
+    assert t.host_bounds_str() == "1,1,4"
+    env = topology.runtime_env(t, visible_chips=[0, 1, 2, 3])
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_WORKER_HOSTNAMES"] == "h0,h1,h2,h3"
+    assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+
+
+def test_v5e_multihost_bounds():
+    t = topo("v5litepod-16")
+    assert t.num_hosts == 2 and t.local_chips == 8
+    assert t.host_bounds_str() == "1,2,1"
+
+
+def test_chip_coords_roundtrip():
+    fam = tslice.FAMILIES["v5litepod"]
+    coords = [tslice.chip_coord(fam, i) for i in range(8)]
+    assert coords[0] == (0, 0, 0) and coords[1] == (1, 0, 0) and coords[2] == (0, 1, 0)
+    assert len(set(coords)) == 8
+    for i in range(8):
+        assert tslice.coord_chip(fam, coords[i]) == i
+
+
+def test_choose_chips_contiguous_2x2():
+    t = topo("v5litepod-8")
+    p = topology.choose_chips(t, available=list(range(8)), count=4)
+    assert p.contiguous and p.chips == (0, 1, 2, 3)  # the low 2x2 box
+
+
+def test_choose_chips_avoids_fragmented_box():
+    t = topo("v5litepod-8")
+    # chips 1 and 2 taken: low 2x2 (0,1,2,3) unavailable; upper box (4,5,6,7) is.
+    p = topology.choose_chips(t, available=[0, 3, 4, 5, 6, 7], count=4)
+    assert p.contiguous and p.chips == (4, 5, 6, 7)
+
+
+def test_choose_chips_pair_either_axis():
+    t = topo("v5litepod-8")
+    # 2-chip slice along y: chips 0 and 2 are (0,0) and (0,1).
+    p = topology.choose_chips(t, available=[0, 2, 5], count=2)
+    assert p.contiguous and p.chips == (0, 2)
+
+
+def test_choose_chips_must_include():
+    t = topo("v5litepod-8")
+    p = topology.choose_chips(t, available=list(range(8)), count=4, must_include=[6])
+    assert p.contiguous and 6 in p.chips and p.chips == (4, 5, 6, 7)
+
+
+def test_choose_chips_fallback_non_contiguous():
+    t = topo("v5litepod-8")
+    # No 2x2 box fits in {0, 3, 5, 6}: falls back, still returns 4 chips.
+    p = topology.choose_chips(t, available=[0, 3, 5, 6], count=4)
+    assert not p.contiguous and len(p.chips) == 4
+
+
+def test_choose_chips_errors():
+    t = topo("v5litepod-8")
+    with pytest.raises(ValueError):
+        topology.choose_chips(t, available=[0, 1], count=4)
+    with pytest.raises(ValueError):
+        topology.choose_chips(t, available=[0, 1], count=1, must_include=[7])
+
+
+def test_alignment_score():
+    t = topo("v5litepod-8")
+    assert topology.alignment_score(t, [0, 1, 2, 3]) == 1.0
+    assert topology.alignment_score(t, [0, 3, 5, 6]) == 0.0
+
+
+def test_detect_accelerator_type_rounds_up():
+    # 3 healthy chips of a 4-chip host must yield a type with a valid grid.
+    assert tslice.detect_accelerator_type({}, chip_count=3) == "v5litepod-4"
+    assert tslice.detect_accelerator_type({}, chip_count=6) == "v5litepod-8"
+    assert tslice.detect_accelerator_type({}, chip_count=12) == "v5litepod-16"
+    assert tslice.detect_accelerator_type({}, chip_count=0) == "v5litepod-1"
+    t = topo(tslice.detect_accelerator_type({}, chip_count=3))
+    assert t.local_grid() == (2, 2, 1)  # does not raise
+
+
+def test_choose_chips_must_include_exceeding_count():
+    t = topo("v5litepod-8")
+    with pytest.raises(ValueError):
+        topology.choose_chips(t, available=[0, 1, 2, 3], count=1, must_include=[0, 2])

@@ -1,24 +1,148 @@
 """CLI entry point (counterpart of the reference's ``cmd/main.go:5-7``).
 
-The reference's ``main()`` is a single call with no flags, no signal handling
-(SURVEY L4). This entry point grows into a real CLI (``run`` / ``status`` /
-``version`` subcommands with full flag coverage) as the framework lands; it is
-kept minimal-but-working at every commit.
+The reference's ``main()`` is a single bare call — no flags, no signal
+handling, no subcommands (SURVEY L4). Here:
+
+- ``run``     start the device-plugin daemon (every constant is a flag)
+- ``status``  one-shot report: discovery, CDI specs on disk, pod assignments
+- ``version``
 """
 from __future__ import annotations
 
+import argparse
+import json
+import os
+import signal
 import sys
 
 
 def main(argv: list[str] | None = None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
     from . import __version__
 
-    if argv[:1] in ([], ["version"], ["--version"]):
+    parser = argparse.ArgumentParser(
+        prog="kata-tpu-device-plugin",
+        description="TPU-native Kubernetes device plugin for Kata Containers",
+    )
+    parser.add_argument(
+        "--version", action="version", version=f"kata-tpu-device-plugin {__version__}"
+    )
+    sub = parser.add_subparsers(dest="command")
+    from .config import add_flags
+
+    run_p = sub.add_parser("run", help="run the device-plugin daemon")
+    add_flags(run_p)
+    status_p = sub.add_parser("status", help="report discovery + allocation state")
+    add_flags(status_p)
+    status_p.add_argument("--json", action="store_true", dest="as_json")
+    sub.add_parser("version", help="print version")
+
+    args = parser.parse_args(argv)
+    if args.command in (None, "version"):
         print(f"kata-tpu-device-plugin {__version__}")
         return 0
-    print(f"unknown command: {argv[0]!r} (available: version)", file=sys.stderr)
+    if args.command == "run":
+        return _run(args)
+    if args.command == "status":
+        return _status(args)
+    parser.error(f"unknown command {args.command!r}")
     return 2
+
+
+def _run(args: argparse.Namespace) -> int:
+    from .config import from_args
+    from .plugin.manager import PluginManager
+    from .utils import log, metrics
+
+    cfg = from_args(args)
+    logger = log.setup(cfg.log_level, cfg.log_format)
+    metrics.serve(cfg.metrics_port)
+    mgr = PluginManager(cfg)
+
+    def _on_signal(signum, _frame):
+        logger.info("signal received, shutting down", extra=log.kv(signal=signum))
+        mgr.stop()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    mgr.start()
+    mgr.run_forever()  # ref: blocks on <-stop (device_plugin.go:114)
+    return 0
+
+
+def _status(args: argparse.Namespace) -> int:
+    from .config import from_args
+    from .discovery import scan_tpus, scan_vfio
+    from .discovery.pciids import PciIds
+
+    cfg = from_args(args)
+    db = PciIds.load(cfg.pci_ids_path or None)
+    tpu = scan_tpus(cfg.sysfs_root, cfg.dev_root, pci_ids=db,
+                    accelerator_type=cfg.accelerator_type or None)
+    report: dict = {
+        "tpu": {
+            "resource": cfg.tpu_resource_name,
+            "chips": [
+                {
+                    "index": c.index,
+                    "dev_path": c.dev_path,
+                    "pci_address": c.pci_address,
+                    "numa_node": c.numa_node,
+                    "present": os.path.exists(c.dev_path),
+                }
+                for c in tpu.chips
+            ],
+            "accelerator_type": tpu.topology.accelerator_type,
+            "chips_per_host_bounds": tpu.topology.chips_per_host_bounds_str(),
+            "num_hosts": tpu.topology.num_hosts,
+            "worker_id": tpu.topology.worker_id,
+        },
+        "cdi_specs": sorted(
+            os.path.join(cfg.cdi_dir, f)
+            for f in (os.listdir(cfg.cdi_dir) if os.path.isdir(cfg.cdi_dir) else [])
+            if f.endswith((".yaml", ".json"))
+        ),
+    }
+    if cfg.vfio_vendors:
+        vendors = () if cfg.vfio_vendors == ("*",) else cfg.vfio_vendors
+        vfio = scan_vfio(cfg.sysfs_root, vendors)
+        report["vfio"] = {
+            f"{v}:{d}": groups for (v, d), groups in sorted(vfio.models.items())
+        }
+    try:
+        from .utils.podresources import device_assignments, list_pod_resources
+
+        resp = list_pod_resources(cfg.pod_resources_socket, timeout_s=2.0)
+        report["pod_assignments"] = device_assignments(resp, cfg.resource_namespace)
+    except Exception as e:
+        report["pod_assignments_error"] = str(e) or type(e).__name__
+
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        t = report["tpu"]
+        print(f"resource: {t['resource']}")
+        print(f"accelerator_type: {t['accelerator_type']} "
+              f"(bounds {t['chips_per_host_bounds']}, hosts {t['num_hosts']}, "
+              f"worker {t['worker_id']})")
+        print(f"chips: {len(t['chips'])}")
+        for c in t["chips"]:
+            mark = "ok" if c["present"] else "MISSING"
+            print(f"  accel{c['index']}: {c['dev_path']} [{mark}]"
+                  + (f" pci={c['pci_address']}" if c["pci_address"] else "")
+                  + (f" numa={c['numa_node']}" if c["numa_node"] is not None else ""))
+        for path in report["cdi_specs"]:
+            print(f"cdi spec: {path}")
+        if "vfio" in report:
+            for model, groups in report["vfio"].items():
+                print(f"vfio {model}: groups {','.join(groups)}")
+        if "pod_assignments" in report:
+            for a in report["pod_assignments"]:
+                print(f"pod {a['namespace']}/{a['pod']}/{a['container']}: "
+                      f"{a['resource']} = {','.join(a['device_ids'])}")
+        else:
+            print(f"pod-resources: unavailable ({report['pod_assignments_error']})")
+    return 0
 
 
 if __name__ == "__main__":

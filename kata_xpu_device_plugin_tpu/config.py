@@ -1,0 +1,128 @@
+"""Daemon configuration.
+
+The reference has no config system at all — vendor id, CDI dir, sysfs path,
+pci.ids path, resource namespace, socket naming, strategies and the spec
+filename are all hardcoded constants (SURVEY §5 lists each). Every one of
+those is a real flag/env here; tests inject temp roots through the same
+object instead of monkeypatching package globals.
+
+Precedence: CLI flag > environment (``KATA_TPU_*``) > default.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import MISSING, dataclass, fields
+
+from .cdi import constants as C
+
+# Kubelet filesystem contract (also in plugin.api.glue; duplicated here to
+# keep config import-light — glue pulls in grpc).
+_KUBELET_SOCKET_DIR = "/var/lib/kubelet/device-plugins"
+_POD_RESOURCES_SOCKET = "/var/lib/kubelet/pod-resources/kubelet.sock"
+
+
+@dataclass
+class Config:
+    # Host interface roots (ref device_plugin.go:36-37 package vars).
+    sysfs_root: str = "/sys"
+    dev_root: str = "/dev"
+    pci_ids_path: str = ""  # "" = search ladder (system paths, then bundled)
+
+    # CDI (ref device_plugin.go:20, cdi/spec.go:12-14).
+    cdi_dir: str = C.DEFAULT_CDI_DIR
+    cdi_format: str = "yaml"  # yaml | json
+    resource_namespace: str = C.DEFAULT_VENDOR  # CDI vendor + resource prefix
+    tpu_resource_class: str = C.DEFAULT_CLASS
+
+    # Device-list strategies (ref generic_device_plugin.go:58-66 hardcodes
+    # cdi-cri on, cdi-annotations off).
+    strategies: tuple[str, ...] = (C.STRATEGY_CDI_CRI,)
+
+    # Kubelet endpoints (ref generic_device_plugin.go:76, pluginapi constants).
+    kubelet_socket_dir: str = _KUBELET_SOCKET_DIR
+    kubelet_socket: str = ""  # "" = <kubelet_socket_dir>/kubelet.sock
+    pod_resources_socket: str = _POD_RESOURCES_SOCKET
+
+    # TPU specifics.
+    accelerator_type: str = ""  # "" = autodetect (env / chip count)
+    libtpu_host_path: str = "/usr/lib/tpu/libtpu.so"  # "" disables the mount
+    kata_annotations: bool = True  # attach-pci/bdf hints for Kata hot-plug
+
+    # Generalized VFIO path. Empty vendor tuple = VFIO discovery disabled;
+    # ("*",) = all vendors (the reference pins exactly one vendor, 10de).
+    vfio_vendors: tuple[str, ...] = ()
+
+    # Behavior the reference lacks (SURVEY §Quirks 9).
+    rescan_interval_s: float = 30.0
+    health_poll_interval_s: float = 5.0
+
+    # Observability.
+    metrics_port: int = 9400  # 0 disables
+    log_level: str = "info"
+    log_format: str = "text"
+
+    def __post_init__(self) -> None:
+        if not self.kubelet_socket:
+            self.kubelet_socket = os.path.join(self.kubelet_socket_dir, "kubelet.sock")
+        for s in self.strategies:
+            if s not in C.ALL_STRATEGIES:
+                raise ValueError(f"unknown device-list strategy: {s!r}")
+        if self.cdi_format not in ("yaml", "json"):
+            raise ValueError(f"cdi-format must be yaml or json, got {self.cdi_format!r}")
+
+    @property
+    def tpu_resource_name(self) -> str:
+        """The extended resource advertised for TPU chips (GKE convention
+        ``google.com/tpu``; the reference's analogue is ``nvidia.com/<MODEL>``)."""
+        return f"{self.resource_namespace}/{self.tpu_resource_class}"
+
+    @property
+    def tpu_cdi_kind(self) -> str:
+        return f"{self.resource_namespace}/{self.tpu_resource_class}"
+
+    @property
+    def vfio_cdi_kind(self) -> str:
+        return f"{self.resource_namespace}/{C.VFIO_CLASS}"
+
+
+_ENV_PREFIX = "KATA_TPU_"
+
+
+def _flag(name: str) -> str:
+    return "--" + name.replace("_", "-")
+
+
+def add_flags(parser: argparse.ArgumentParser) -> None:
+    for f in fields(Config):
+        env_val = os.environ.get(_ENV_PREFIX + f.name.upper())
+        # Raw field default, NOT a Config() instance: __post_init__ resolves
+        # derived values (kubelet_socket from kubelet_socket_dir), and a
+        # resolved default would pin the flag to the production path even
+        # when the user overrides the directory it derives from.
+        default = f.default if f.default is not MISSING else f.default_factory()  # type: ignore[misc]
+        if f.type in ("tuple[str, ...]",):
+            default = ",".join(default) if env_val is None else env_val
+            parser.add_argument(_flag(f.name), default=default, help=f"csv ({f.name})")
+        elif isinstance(default, bool):
+            val = default if env_val is None else env_val.lower() in ("1", "true", "yes")
+            parser.add_argument(
+                _flag(f.name), default=val, action=argparse.BooleanOptionalAction
+            )
+        elif isinstance(default, (int, float)) and not isinstance(default, bool):
+            typ = type(default)
+            parser.add_argument(
+                _flag(f.name), type=typ, default=typ(env_val) if env_val else default
+            )
+        else:
+            parser.add_argument(_flag(f.name), default=env_val if env_val is not None else default)
+
+
+def from_args(args: argparse.Namespace) -> Config:
+    kwargs = {}
+    for f in fields(Config):
+        val = getattr(args, f.name)
+        if f.type == "tuple[str, ...]":
+            val = tuple(v for v in str(val).split(",") if v) if val else ()
+        kwargs[f.name] = val
+    return Config(**kwargs)

@@ -88,10 +88,14 @@ class PciIds:
 
     @classmethod
     def load(cls, path: Optional[str] = None) -> "PciIds":
-        """Load from ``path`` if given, else the first existing system path,
-        else the authored table shipped with the package; else empty."""
-        candidates = [path] if path else []
-        candidates += list(SYSTEM_PCIIDS_PATHS)
+        """Load from ``path`` if given (errors if it doesn't exist — an
+        explicit path silently falling through to a different database would
+        ignore the operator's curated names), else the first existing system
+        path, else the authored table shipped with the package; else empty."""
+        if path:
+            with open(path, errors="replace") as f:
+                return cls.parse(f.read())
+        candidates = list(SYSTEM_PCIIDS_PATHS)
         candidates.append(
             os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                          "data", "pci.ids")

@@ -84,10 +84,13 @@ def scan_tpus(
     ]
 
     chips = []
-    for i, node in enumerate(nodes):
-        suffix = node.name[len("accel"):]
-        index = int(suffix) if suffix.isdigit() else i
-        pci = pci_funcs[i] if i < len(pci_funcs) else None
+    for node in nodes:
+        index = int(node.name[len("accel"):])
+        # Correlate by the chip's stable index, not enumeration position —
+        # a missing /dev/accel1 must not shift every later chip onto the
+        # wrong PCI function (and hence the wrong BDF/IOMMU group in Kata
+        # attach hints).
+        pci = pci_funcs[index] if index < len(pci_funcs) else None
         chips.append(
             TpuChip(
                 index=index,

@@ -1,0 +1,20 @@
+"""Kubelet device-plugin layer: gRPC server, allocation policies, health
+watching, and the discovery→CDI→serve orchestration (counterpart of the
+reference's ``pkg/device_plugin``)."""
+from .allocators import TpuAllocator, VfioAllocator
+from .health import HealthWatcher
+from .manager import PluginManager, build_tpu_spec, build_vfio_spec
+from .server import AllocationError, DevicePluginServer, DeviceState, WatchedDevice
+
+__all__ = [
+    "TpuAllocator",
+    "VfioAllocator",
+    "HealthWatcher",
+    "PluginManager",
+    "build_tpu_spec",
+    "build_vfio_spec",
+    "AllocationError",
+    "DevicePluginServer",
+    "DeviceState",
+    "WatchedDevice",
+]

@@ -1,0 +1,137 @@
+"""Resource-specific allocation policies.
+
+Split out of the server (the reference fuses policy into the gRPC handlers,
+``generic_device_plugin.go:274-355``): the server validates ids and streams
+health; allocators decide CDI names, env and topology.
+
+Env contract with the guest: *static* slice topology (accelerator type, host
+bounds, worker id/hostnames, libtpu mount) rides the CDI spec's spec-level
+``containerEdits`` — identical for every pod on the host; the *per-allocation*
+``TPU_VISIBLE_CHIPS`` rides the AllocateResponse env, merged (the reference
+overwrites the env map it just built — SURVEY §Quirks 4).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Sequence
+
+from .. import topology as topo_mod
+from ..cdi import constants as C
+from ..cdi import qualified_name
+from ..discovery.tpu import TpuInventory
+from ..discovery.vfio import VfioInventory
+from ..utils import log, metrics
+from .api import deviceplugin_pb2 as pb
+from .server import AllocationError
+
+LOG = log.get("alloc")
+
+
+class TpuAllocator:
+    """Allocation policy for ``google.com/tpu``: chip ids are host-local
+    indexes; preferred picks ICI-contiguous boxes; Allocate re-validates the
+    chip's device node against the live host (ref re-validation at
+    generic_device_plugin.go:329-338, done against /dev/accel instead)."""
+
+    def __init__(
+        self,
+        inventory: Callable[[], TpuInventory],
+        vendor: str,
+        cls: str,
+        strategies: Sequence[str] = (C.STRATEGY_CDI_CRI,),
+    ):
+        self._inventory = inventory
+        self._vendor = vendor
+        self._cls = cls
+        self._strategies = tuple(strategies)
+        self._resource = f"{vendor}/{cls}"
+
+    def allocate(self, device_ids: Sequence[str]) -> pb.ContainerAllocateResponse:
+        inv = self._inventory()
+        chips = []
+        for dev_id in device_ids:
+            if not dev_id.isdigit():
+                raise AllocationError(f"malformed TPU device id {dev_id!r}")
+            try:
+                chip = inv.chip(int(dev_id))
+            except KeyError:
+                raise AllocationError(f"TPU chip {dev_id} not in current inventory")
+            if not os.path.exists(chip.dev_path):
+                raise AllocationError(f"TPU chip {dev_id} device node vanished")
+            chips.append(chip)
+
+        resp = pb.ContainerAllocateResponse()
+        names = [qualified_name(self._vendor, self._cls, str(c.index)) for c in chips]
+        if C.STRATEGY_CDI_CRI in self._strategies:
+            for name in names:
+                resp.cdi_devices.add(name=name)
+        if C.STRATEGY_CDI_ANNOTATIONS in self._strategies:
+            resp.annotations[f"{C.CDI_K8S_PREFIX}{self._vendor}_{self._cls}"] = ",".join(names)
+        if C.STRATEGY_ENVVAR in self._strategies:
+            # Direct injection for runtimes without CDI: device nodes + mounts
+            # mirror what the CDI spec would edit in.
+            for c in chips:
+                resp.devices.add(
+                    container_path=c.dev_path, host_path=c.dev_path, permissions="rw"
+                )
+        resp.envs[C.ENV_CDI_VENDOR_CLASS] = self._resource
+        resp.envs[C.ENV_TPU_VISIBLE_CHIPS] = ",".join(str(c.index) for c in chips)
+        return resp
+
+    def preferred(
+        self, available: Sequence[str], must_include: Sequence[str], size: int
+    ) -> list[str]:
+        inv = self._inventory()
+        placement = topo_mod.choose_chips(
+            inv.topology,
+            topo_mod.chip_ids_to_indexes(available),
+            size,
+            topo_mod.chip_ids_to_indexes(must_include),
+        )
+        if not placement.contiguous:
+            metrics.noncontiguous_allocations_total.labels(resource=self._resource).inc()
+            LOG.warning(
+                "no ICI-contiguous placement possible",
+                extra=log.kv(available=",".join(available), size=size),
+            )
+        return [str(c) for c in placement.chips]
+
+
+class VfioAllocator:
+    """Allocation policy for whole-VM passthrough: device ids are IOMMU group
+    ids (the reference's model, kept for the generalized path)."""
+
+    def __init__(
+        self,
+        inventory: Callable[[], VfioInventory],
+        vendor: str,
+        model_key: tuple[str, str],
+        revalidate: Optional[Callable[[str], bool]] = None,
+    ):
+        self._inventory = inventory
+        self._vendor = vendor
+        self._model_key = model_key
+        self._revalidate = revalidate
+
+    def allocate(self, device_ids: Sequence[str]) -> pb.ContainerAllocateResponse:
+        inv = self._inventory()
+        resp = pb.ContainerAllocateResponse()
+        names = []
+        for group in device_ids:
+            devs = inv.groups.get(group)
+            if not devs:
+                raise AllocationError(f"IOMMU group {group} not in current inventory")
+            if self._revalidate and not self._revalidate(group):
+                raise AllocationError(f"IOMMU group {group} failed sysfs re-validation")
+            names.append(qualified_name(self._vendor, C.VFIO_CLASS, group))
+        for name in names:
+            resp.cdi_devices.add(name=name)
+        resp.envs[C.ENV_CDI_VENDOR_CLASS] = f"{self._vendor}/{C.VFIO_CLASS}"
+        return resp
+
+    def preferred(
+        self, available: Sequence[str], must_include: Sequence[str], size: int
+    ) -> list[str]:
+        # Groups are interchangeable; NUMA-aware scoring could refine this.
+        rest = [a for a in available if a not in must_include]
+        return (list(must_include) + rest)[:size]

@@ -34,29 +34,23 @@ class TpuFamily:
     # Suffix in the accelerator type counts chips (v5e/v6e) or TensorCores
     # (v2-v4/v5p, 2 cores per chip).
     suffix_counts_cores: bool
+    # ICI mesh dimensionality of the *slice*: 2 for the 2D-torus families
+    # (v2/v3/v5e/v6e, hosts extend the grid in y), 3 for the 3D-torus
+    # families (v4/v5p, hosts stack 2x2x1 bricks in z).
+    slice_dims: int = 2
 
+
+_SUBHOST_8 = {1: (1, 1, 1), 2: (1, 2, 1), 4: (2, 2, 1), 8: (2, 4, 1)}
 
 FAMILIES: dict[str, TpuFamily] = {
     f.name: f
     for f in (
-        TpuFamily("v2", 4, (2, 2, 1), {4: (2, 2, 1)}, True),
-        TpuFamily("v3", 4, (2, 2, 1), {4: (2, 2, 1)}, True),
-        TpuFamily("v4", 4, (2, 2, 1), {4: (2, 2, 1)}, True),
-        TpuFamily("v5p", 4, (2, 2, 1), {4: (2, 2, 1)}, True),
-        TpuFamily(
-            "v5litepod",
-            8,
-            (2, 4, 1),
-            {1: (1, 1, 1), 2: (1, 2, 1), 4: (2, 2, 1), 8: (2, 4, 1)},
-            False,
-        ),
-        TpuFamily(
-            "v6e",
-            8,
-            (2, 4, 1),
-            {1: (1, 1, 1), 2: (1, 2, 1), 4: (2, 2, 1), 8: (2, 4, 1)},
-            False,
-        ),
+        TpuFamily("v2", 4, (2, 2, 1), {4: (2, 2, 1)}, True, slice_dims=2),
+        TpuFamily("v3", 4, (2, 2, 1), {4: (2, 2, 1)}, True, slice_dims=2),
+        TpuFamily("v4", 4, (2, 2, 1), {4: (2, 2, 1)}, True, slice_dims=3),
+        TpuFamily("v5p", 4, (2, 2, 1), {4: (2, 2, 1)}, True, slice_dims=3),
+        TpuFamily("v5litepod", 8, (2, 4, 1), dict(_SUBHOST_8), False, slice_dims=2),
+        TpuFamily("v6e", 8, (2, 4, 1), dict(_SUBHOST_8), False, slice_dims=2),
     )
 }
 
@@ -141,14 +135,13 @@ class HostTopology:
     def host_bounds(self) -> Coord:
         """How hosts tile the full slice grid (``TPU_HOST_BOUNDS``).
 
-        Hosts stack along y for 2D families and along z for 3D ones — matching
-        how slices grow: v5e pods extend the 2x4 host grid in y; v4/v5p pods
-        stack 2x2x1 host bricks in z.
+        Hosts stack along y for 2D-torus families and along z for 3D ones —
+        matching how slices grow: v2/v3/v5e/v6e pods extend the host grid in
+        y; v4/v5p pods stack 2x2x1 host bricks in z.
         """
         if self.num_hosts == 1:
             return (1, 1, 1)
-        gx, gy, gz = self.family.host_grid
-        if gz == 1 and self.family.chips_per_host == 8:
+        if self.family.slice_dims == 2:
             return (1, self.num_hosts, 1)
         return (1, 1, self.num_hosts)
 

@@ -1,0 +1,2 @@
+"""Utilities: structured logging, Prometheus metrics, ctypes inotify, and the
+pod-resources client (counterpart of the reference's ``utils/``)."""

@@ -1,0 +1,63 @@
+"""Structured logging setup.
+
+The reference mixes stdlib log, klog and bare Println (SURVEY §5); here one
+configured logger tree with either key=value text or JSON lines.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+
+ROOT = "katatpu"
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": round(time.time(), 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            entry["exc"] = self.formatException(record.exc_info)
+        extra = getattr(record, "kv", None)
+        if extra:
+            entry.update(extra)
+        return json.dumps(entry, sort_keys=False)
+
+
+class _TextFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        base = (
+            f"{self.formatTime(record, '%Y-%m-%dT%H:%M:%S')} "
+            f"{record.levelname[0]} {record.name} {record.getMessage()}"
+        )
+        extra = getattr(record, "kv", None)
+        if extra:
+            base += " " + " ".join(f"{k}={v}" for k, v in extra.items())
+        if record.exc_info:
+            base += "\n" + self.formatException(record.exc_info)
+        return base
+
+
+def setup(level: str = "info", fmt: str = "text") -> logging.Logger:
+    logger = logging.getLogger(ROOT)
+    logger.setLevel(getattr(logging, level.upper(), logging.INFO))
+    logger.propagate = False
+    logger.handlers.clear()
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_JsonFormatter() if fmt == "json" else _TextFormatter())
+    logger.addHandler(handler)
+    return logger
+
+
+def get(name: str) -> logging.Logger:
+    return logging.getLogger(f"{ROOT}.{name}")
+
+
+def kv(**kwargs) -> dict:
+    """Usage: log.info("allocated", extra=kv(chips=4, pod=uid))."""
+    return {"kv": kwargs}

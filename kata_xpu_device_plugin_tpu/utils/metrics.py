@@ -1,0 +1,41 @@
+"""Prometheus metrics.
+
+The reference has Prometheus only as an unused indirect dependency (SURVEY §5
+"no metrics endpoint"); here the daemon exports real counters/gauges on a
+configurable port.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import Counter, Gauge, start_http_server
+
+NS = "kata_tpu_device_plugin"
+
+devices_total = Gauge(f"{NS}_devices", "Devices advertised", ["resource", "health"])
+allocations_total = Counter(
+    f"{NS}_allocations_total", "Allocate calls served", ["resource", "outcome"]
+)
+allocation_chips_total = Counter(
+    f"{NS}_allocation_chips_total", "Chips handed out", ["resource"]
+)
+noncontiguous_allocations_total = Counter(
+    f"{NS}_noncontiguous_preferred_total",
+    "Preferred-allocation answers that could not be made ICI-contiguous",
+    ["resource"],
+)
+registrations_total = Counter(
+    f"{NS}_registrations_total", "Kubelet registrations performed", ["resource"]
+)
+health_transitions_total = Counter(
+    f"{NS}_health_transitions_total", "Device health transitions", ["resource", "to"]
+)
+rescans_total = Counter(f"{NS}_rescans_total", "Discovery rescans", ["changed"])
+
+
+def serve(port: int) -> Optional[int]:
+    """Start the /metrics HTTP endpoint; 0 disables. Returns the bound port."""
+    if not port:
+        return None
+    start_http_server(port)
+    return port

@@ -8,6 +8,12 @@ count for SPMD).
 """
 import os
 
+# Hermetic host-side tests: this machine may itself be a TPU VM exporting
+# TPU_* topology vars (observed: TPU_ACCELERATOR_TYPE), which discovery
+# legitimately reads in production but must not see under test.
+for _k in [k for k in os.environ if k.startswith("TPU_")]:
+    del os.environ[_k]
+
 # Must be set before the first `import jax` anywhere in the test session.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,58 @@
+"""An in-process fake kubelet (SURVEY §4: "a unix-socket gRPC server
+implementing Registration and driving ListAndWatch/Allocate against the real
+plugin server") — the no-cluster integration seam."""
+from __future__ import annotations
+
+import os
+import threading
+from concurrent import futures
+
+import grpc
+
+from kata_xpu_device_plugin_tpu.plugin.api import deviceplugin_pb2 as pb
+from kata_xpu_device_plugin_tpu.plugin.api import glue
+from kata_xpu_device_plugin_tpu.plugin.api import podresources_pb2 as prpb
+
+
+class FakeKubelet(glue.RegistrationServicer, glue.PodResourcesListerServicer):
+    """Serves Registration (and optionally pod-resources) on
+    ``<socket_dir>/kubelet.sock`` and records what plugins register."""
+
+    def __init__(self, socket_dir: str):
+        self.socket_dir = socket_dir
+        self.socket_path = os.path.join(socket_dir, "kubelet.sock")
+        self.registrations: list[pb.RegisterRequest] = []
+        self.registered = threading.Event()
+        self.pod_resources = prpb.ListPodResourcesResponse()
+        self._server: grpc.Server | None = None
+
+    # Registration service
+    def Register(self, request: pb.RegisterRequest, context) -> pb.Empty:
+        self.registrations.append(request)
+        self.registered.set()
+        return pb.Empty()
+
+    # PodResourcesLister service
+    def List(self, request, context) -> prpb.ListPodResourcesResponse:
+        return self.pod_resources
+
+    def start(self) -> "FakeKubelet":
+        os.makedirs(self.socket_dir, exist_ok=True)
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        glue.add_registration_to_server(self, server)
+        glue.add_pod_resources_to_server(self, server)
+        server.add_insecure_port(f"unix://{self.socket_path}")
+        server.start()
+        self._server = server
+        return self
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.stop(grace=0.5).wait()
+            self._server = None
+
+    def plugin_stub(self, endpoint: str) -> tuple[grpc.Channel, glue.DevicePluginStub]:
+        """Dial back into a plugin's socket the way the kubelet does."""
+        channel = grpc.insecure_channel(f"unix://{os.path.join(self.socket_dir, endpoint)}")
+        grpc.channel_ready_future(channel).result(timeout=5.0)
+        return channel, glue.DevicePluginStub(channel)

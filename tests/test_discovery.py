@@ -139,3 +139,19 @@ def test_scan_tpus_env_isolation(fake, monkeypatch):
     inv = discovery.scan_tpus(fake.sysfs, fake.dev, env={})
     assert inv.topology.worker_id == 0
     assert inv.topology.worker_hostnames == ()
+
+
+def test_scan_tpus_pci_correlation_survives_missing_node(fake):
+    # /dev/accel1 gone but all PCI functions present: accel2 must keep ITS
+    # BDF (index-based correlation), not shift onto chip 1's.
+    _v5e8_host(fake)
+    fake.remove_dev_node("accel1")
+    inv = discovery.scan_tpus(fake.sysfs, fake.dev, env={})
+    assert [c.index for c in inv.chips] == [0, 2, 3, 4, 5, 6, 7]
+    assert inv.chip(2).pci_address == "0000:02:01.0"
+    assert inv.chip(7).pci_address == "0000:07:01.0"
+
+
+def test_pciids_explicit_path_must_exist(tmp_path):
+    with pytest.raises(OSError):
+        pciids.PciIds.load(str(tmp_path / "nope.ids"))

@@ -1,0 +1,330 @@
+"""Plugin server + manager integration tests against the fake kubelet and a
+fake sysfs host (SURVEY §4 integration strategy). No Kubernetes needed."""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+
+import grpc
+import pytest
+
+from kata_xpu_device_plugin_tpu import cdi
+from kata_xpu_device_plugin_tpu.cdi import constants as C
+from kata_xpu_device_plugin_tpu.config import Config
+from kata_xpu_device_plugin_tpu.discovery.sysfs import FakeSysfsBuilder
+from kata_xpu_device_plugin_tpu.plugin import (
+    HealthWatcher,
+    PluginManager,
+)
+from kata_xpu_device_plugin_tpu.plugin.api import deviceplugin_pb2 as pb
+from kata_xpu_device_plugin_tpu.plugin.api import glue
+
+from .fake_kubelet import FakeKubelet
+
+
+@pytest.fixture
+def short_dir():
+    # unix socket paths are capped (~108 chars); pytest tmp_path is too deep.
+    d = tempfile.mkdtemp(prefix="kt-", dir="/tmp")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+@pytest.fixture
+def kubelet(short_dir):
+    fk = FakeKubelet(os.path.join(short_dir, "kubelet")).start()
+    yield fk
+    fk.stop()
+
+
+@pytest.fixture
+def v5e8(short_dir):
+    fake = FakeSysfsBuilder(root=os.path.join(short_dir, "host"))
+    for i in range(8):
+        fake.add_accel_chip(i)
+        fake.add_pci_function(f"0000:0{i}:01.0", "1ae0", "0063", numa_node=i // 4)
+    return fake
+
+
+def make_config(fake, kubelet, short_dir, **overrides) -> Config:
+    kw = dict(
+        sysfs_root=fake.sysfs,
+        dev_root=fake.dev,
+        cdi_dir=os.path.join(short_dir, "cdi"),
+        kubelet_socket_dir=kubelet.socket_dir,
+        rescan_interval_s=0,  # tests drive rescans explicitly
+        health_poll_interval_s=3600,  # tests drive evaluate() explicitly
+        metrics_port=0,
+        libtpu_host_path="",
+    )
+    kw.update(overrides)
+    return Config(**kw)
+
+
+@pytest.fixture
+def manager(v5e8, kubelet, short_dir):
+    mgr = PluginManager(make_config(v5e8, kubelet, short_dir))
+    mgr.start()
+    yield mgr
+    mgr.stop()
+
+
+def test_registration_and_options(manager, kubelet):
+    assert kubelet.registered.wait(5)
+    (reg,) = kubelet.registrations
+    assert reg.resource_name == "google.com/tpu"
+    assert reg.version == "v1beta1"
+    assert reg.options.get_preferred_allocation_available
+    ch, stub = kubelet.plugin_stub(reg.endpoint)
+    with ch:
+        opts = stub.GetDevicePluginOptions(pb.Empty())
+        assert opts.get_preferred_allocation_available
+
+
+def test_list_and_watch_initial(manager, kubelet):
+    ch, stub = kubelet.plugin_stub(kubelet.registrations[0].endpoint)
+    with ch:
+        stream = stub.ListAndWatch(pb.Empty())
+        first = next(stream)
+        assert [d.id for d in first.devices] == [str(i) for i in range(8)]
+        assert all(d.health == glue.HEALTHY for d in first.devices)
+        assert first.devices[5].topology.nodes[0].id == 1  # NUMA propagated
+        stream.cancel()
+
+
+def test_health_transition_streams_update(manager, kubelet, v5e8):
+    plugin = manager.plugins()[0]
+    watcher = HealthWatcher([plugin], use_inotify=False)
+    ch, stub = kubelet.plugin_stub(kubelet.registrations[0].endpoint)
+    with ch:
+        stream = stub.ListAndWatch(pb.Empty())
+        next(stream)  # initial
+        v5e8.remove_dev_node("accel3")
+        watcher.evaluate()
+        update = next(stream)
+        sick = {d.id: d.health for d in update.devices}
+        assert sick["3"] == glue.UNHEALTHY
+        assert sick["2"] == glue.HEALTHY
+        v5e8.add_accel_chip(3)
+        watcher.evaluate()
+        update = next(stream)
+        assert {d.id: d.health for d in update.devices}["3"] == glue.HEALTHY
+        stream.cancel()
+
+
+def test_allocate_cdi_cri(manager, kubelet):
+    ch, stub = kubelet.plugin_stub(kubelet.registrations[0].endpoint)
+    with ch:
+        resp = stub.Allocate(
+            pb.AllocateRequest(
+                container_requests=[pb.ContainerAllocateRequest(device_ids=["0", "1", "2", "3"])]
+            )
+        )
+        (cresp,) = resp.container_responses
+        assert [d.name for d in cresp.cdi_devices] == [
+            f"google.com/tpu={i}" for i in range(4)
+        ]
+        assert cresp.envs[C.ENV_CDI_VENDOR_CLASS] == "google.com/tpu"
+        assert cresp.envs[C.ENV_TPU_VISIBLE_CHIPS] == "0,1,2,3"
+
+
+def test_allocate_unknown_and_unhealthy(manager, kubelet, v5e8):
+    ch, stub = kubelet.plugin_stub(kubelet.registrations[0].endpoint)
+    with ch:
+        with pytest.raises(grpc.RpcError) as exc:
+            stub.Allocate(
+                pb.AllocateRequest(
+                    container_requests=[pb.ContainerAllocateRequest(device_ids=["42"])]
+                )
+            )
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+        plugin = manager.plugins()[0]
+        v5e8.remove_dev_node("accel1")
+        HealthWatcher([plugin], use_inotify=False).evaluate()
+        with pytest.raises(grpc.RpcError) as exc:
+            stub.Allocate(
+                pb.AllocateRequest(
+                    container_requests=[pb.ContainerAllocateRequest(device_ids=["1"])]
+                )
+            )
+        assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+
+
+def test_allocate_revalidates_dev_node(kubelet, v5e8, short_dir):
+    # Node vanishes between health pass and Allocate: must fail closed
+    # (the reference's live sysfs re-validation, done against /dev/accel).
+    # A standalone server (no health watcher) isolates the re-validation seam.
+    from kata_xpu_device_plugin_tpu.discovery import scan_tpus
+    from kata_xpu_device_plugin_tpu.plugin import DevicePluginServer, DeviceState, TpuAllocator
+    from kata_xpu_device_plugin_tpu.plugin.manager import tpu_watched_devices
+
+    inv = scan_tpus(v5e8.sysfs, v5e8.dev, env={})
+    server = DevicePluginServer(
+        resource_name="google.com/tpu",
+        state=DeviceState(tpu_watched_devices(inv)),
+        allocator=TpuAllocator(lambda: inv, "google.com", "tpu"),
+        socket_dir=kubelet.socket_dir,
+    )
+    server.start(register=False)
+    try:
+        ch, stub = kubelet.plugin_stub(server.endpoint)
+        with ch:
+            v5e8.remove_dev_node("accel2")  # no watcher ran: health still Healthy
+            with pytest.raises(grpc.RpcError) as exc:
+                stub.Allocate(
+                    pb.AllocateRequest(
+                        container_requests=[pb.ContainerAllocateRequest(device_ids=["2"])]
+                    )
+                )
+            assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        server.stop()
+
+
+def test_preferred_allocation_contiguous(manager, kubelet):
+    ch, stub = kubelet.plugin_stub(kubelet.registrations[0].endpoint)
+    with ch:
+        resp = stub.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(
+                container_requests=[
+                    pb.ContainerPreferredAllocationRequest(
+                        available_device_ids=["0", "3", "4", "5", "6", "7"],
+                        allocation_size=4,
+                    )
+                ]
+            )
+        )
+        (cresp,) = resp.container_responses
+        assert list(cresp.device_ids) == ["4", "5", "6", "7"]  # the free 2x2 box
+
+
+def test_kubelet_restart_reregisters(manager, kubelet):
+    assert kubelet.registered.wait(5)
+    plugin = manager.plugins()[0]
+    watcher = HealthWatcher([plugin], use_inotify=False)
+    os.unlink(plugin.socket_path)  # kubelet wiped its dir
+    watcher.evaluate()
+    # The manager's own inotify watcher may be mid-restart concurrently with
+    # our explicit evaluate(); wait for the re-registration to land.
+    deadline = time.time() + 5
+    while len(kubelet.registrations) < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert len(kubelet.registrations) >= 2
+    assert plugin.serving
+    # and the plugin still answers on the re-created socket
+    ch, stub = kubelet.plugin_stub(kubelet.registrations[-1].endpoint)
+    with ch:
+        assert stub.GetDevicePluginOptions(pb.Empty()).get_preferred_allocation_available
+
+
+def test_cdi_spec_written(manager):
+    path = os.path.join(manager.cfg.cdi_dir, "google.com-tpu.yaml")
+    spec = cdi.load(path)
+    assert spec.device_names() == [str(i) for i in range(8)]
+    env_keys = {e.split("=")[0] for e in spec.container_edits.env}
+    assert "TPU_ACCELERATOR_TYPE" in env_keys
+    assert "TPU_CHIPS_PER_HOST_BOUNDS" in env_keys
+    node = spec.devices[0].container_edits.device_nodes[0]
+    assert node.path == "/dev/accel0"  # in-guest path, not the fake root
+    assert node.host_path.endswith("/dev/accel0")
+
+
+def test_rescan_picks_up_new_chip(kubelet, short_dir):
+    fake = FakeSysfsBuilder(root=os.path.join(short_dir, "host"))
+    fake.add_accel_chip(0)
+    mgr = PluginManager(make_config(fake, kubelet, short_dir))
+    mgr.start()
+    try:
+        assert mgr.plugins()[0].state.ids() == ["0"]
+        fake.add_accel_chip(1)
+        assert mgr.rescan_once() is True
+        assert mgr.plugins()[0].state.ids() == ["0", "1"]
+        assert mgr.rescan_once() is False  # idempotent
+    finally:
+        mgr.stop()
+
+
+def test_zero_chip_dry_run(kubelet, short_dir):
+    # BASELINE configs[0]: node with no TPUs still serves an empty resource.
+    fake = FakeSysfsBuilder(root=os.path.join(short_dir, "host"))
+    mgr = PluginManager(make_config(fake, kubelet, short_dir))
+    mgr.start()
+    try:
+        assert kubelet.registered.wait(5)
+        ch, stub = kubelet.plugin_stub(kubelet.registrations[0].endpoint)
+        with ch:
+            stream = stub.ListAndWatch(pb.Empty())
+            first = next(stream)
+            assert len(first.devices) == 0
+            stream.cancel()
+        assert not os.path.exists(os.path.join(mgr.cfg.cdi_dir, "google.com-tpu.yaml"))
+    finally:
+        mgr.stop()
+
+
+def test_vfio_model_plugin(kubelet, short_dir):
+    fake = FakeSysfsBuilder(root=os.path.join(short_dir, "host"))
+    fake.add_pci_function("0000:01:00.0", "10de", "2203", driver="vfio-pci", iommu_group="11")
+    fake.add_pci_function("0000:02:00.0", "10de", "2203", driver="vfio-pci", iommu_group="12")
+    mgr = PluginManager(
+        make_config(fake, kubelet, short_dir, vfio_vendors=("10de",))
+    )
+    mgr.start()
+    try:
+        names = {r.resource_name for r in kubelet.registrations}
+        assert "google.com/tpu" in names
+        vfio_res = next(n for n in names if n != "google.com/tpu")
+        reg = next(r for r in kubelet.registrations if r.resource_name == vfio_res)
+        ch, stub = kubelet.plugin_stub(reg.endpoint)
+        with ch:
+            stream = stub.ListAndWatch(pb.Empty())
+            first = next(stream)
+            assert sorted(d.id for d in first.devices) == ["11", "12"]
+            stream.cancel()
+            resp = stub.Allocate(
+                pb.AllocateRequest(
+                    container_requests=[pb.ContainerAllocateRequest(device_ids=["11"])]
+                )
+            )
+            (cresp,) = resp.container_responses
+            assert cresp.cdi_devices[0].name == "google.com/vfio=11"
+        # spec on disk covers the groups
+        spec = cdi.load(os.path.join(mgr.cfg.cdi_dir, "google.com-vfio.yaml"))
+        assert spec.device_names() == ["11", "12"]
+    finally:
+        mgr.stop()
+
+
+def test_vfio_allocate_fails_after_unbind(kubelet, short_dir):
+    fake = FakeSysfsBuilder(root=os.path.join(short_dir, "host"))
+    fake.add_pci_function("0000:01:00.0", "10de", "2203", driver="vfio-pci", iommu_group="11")
+    mgr = PluginManager(make_config(fake, kubelet, short_dir, vfio_vendors=("10de",)))
+    mgr.start()
+    try:
+        reg = next(r for r in kubelet.registrations if r.resource_name != "google.com/tpu")
+        # Driver rebound from vfio-pci to nvidia between discovery and Allocate.
+        fake.add_pci_function("0000:01:00.0", "10de", "2203", driver="nvidia", iommu_group="11")
+        ch, stub = kubelet.plugin_stub(reg.endpoint)
+        with ch:
+            with pytest.raises(grpc.RpcError) as exc:
+                stub.Allocate(
+                    pb.AllocateRequest(
+                        container_requests=[pb.ContainerAllocateRequest(device_ids=["11"])]
+                    )
+                )
+            assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        mgr.stop()
+
+
+def test_manager_stop_reaches_restarted_plugin(manager, kubelet):
+    # Quirk 2 regression: restart() must not orphan the plugin from stop().
+    plugin = manager.plugins()[0]
+    plugin.restart()
+    assert len(kubelet.registrations) == 2
+    manager.stop()
+    assert plugin.stopped
+    assert not os.path.exists(plugin.socket_path)

@@ -125,3 +125,9 @@ def test_choose_chips_must_include_exceeding_count():
     t = topo("v5litepod-8")
     with pytest.raises(ValueError):
         topology.choose_chips(t, available=[0, 1, 2, 3], count=1, must_include=[0, 2])
+
+
+def test_host_bounds_2d_vs_3d_families():
+    assert topo("v3-32").host_bounds_str() == "1,4,1"  # 2D torus: stack in y
+    assert topo("v5p-32").host_bounds_str() == "1,1,4"  # 3D torus: stack in z
+    assert topo("v5litepod-32").host_bounds_str() == "1,4,1"

@@ -1,0 +1,16 @@
+# Runtime image for kata-tpu-device-plugin.
+# The reference uses a 2-stage CUDA ubi8 build for a Go binary
+# (Dockerfile:31-70); a Python daemon needs only a slim base. The binary
+# name/image tag mismatches of the reference (SURVEY §Quirks 1) are avoided
+# by installing one console script from one source of truth (pyproject).
+FROM python:3.12-slim
+
+RUN pip install --no-cache-dir grpcio protobuf PyYAML prometheus_client
+
+WORKDIR /opt/kata-tpu-device-plugin
+COPY pyproject.toml ./
+COPY kata_xpu_device_plugin_tpu ./kata_xpu_device_plugin_tpu
+RUN pip install --no-cache-dir .
+
+ENTRYPOINT ["kata-tpu-device-plugin"]
+CMD ["run"]

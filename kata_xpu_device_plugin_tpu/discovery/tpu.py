@@ -77,11 +77,17 @@ def scan_tpus(
         for n in sysfs.scan_char_devices(dev_root, "accel")
         if n.name[len("accel"):].isdigit()  # accel<N> only; ignore strays
     ]
-    pci_funcs = [
-        f
-        for f in sysfs.scan_pci(sysfs_root)
-        if f.vendor == GOOGLE_VENDOR and _is_accel_function(f)
-    ]
+    google_funcs = [f for f in sysfs.scan_pci(sysfs_root) if f.vendor == GOOGLE_VENDOR]
+    # Prefer the strict filter (known TPU device ids): index↔BDF-order
+    # correlation is only sound when the list holds exactly the TPU endpoints.
+    # A momentarily-unbound gVNIC sharing vendor 1ae0 must not shift every
+    # chip onto the wrong BDF. The heuristic is the fallback for new
+    # generations whose ids aren't in the table yet.
+    from .pciids import BUILTIN_GOOGLE_DEVICES
+
+    pci_funcs = [f for f in google_funcs if f.device in BUILTIN_GOOGLE_DEVICES]
+    if not pci_funcs:
+        pci_funcs = [f for f in google_funcs if _is_accel_function(f)]
 
     chips = []
     for node in nodes:
@@ -104,7 +110,11 @@ def scan_tpus(
             )
         )
 
-    accel_type = accelerator_type or detect_accelerator_type(environ, chip_count=len(chips))
+    accel_type = accelerator_type or detect_accelerator_type(
+        environ,
+        chip_count=len(chips),
+        pci_device_id=next((c.pci_device for c in chips if c.pci_device), None),
+    )
     topo = HostTopology.from_accelerator_type(
         accel_type,
         worker_id=int(environ.get("TPU_WORKER_ID", "0") or "0"),

@@ -18,6 +18,7 @@ from typing import Callable, Optional, Sequence
 from .. import topology as topo_mod
 from ..cdi import constants as C
 from ..cdi import qualified_name
+from ..topology import runtime_env
 from ..discovery.tpu import TpuInventory
 from ..discovery.vfio import VfioInventory
 from ..utils import log, metrics
@@ -39,12 +40,14 @@ class TpuAllocator:
         vendor: str,
         cls: str,
         strategies: Sequence[str] = (C.STRATEGY_CDI_CRI,),
+        libtpu_host_path: str = "",
     ):
         self._inventory = inventory
         self._vendor = vendor
         self._cls = cls
         self._strategies = tuple(strategies)
         self._resource = f"{vendor}/{cls}"
+        self._libtpu_host_path = libtpu_host_path
 
     def allocate(self, device_ids: Sequence[str]) -> pb.ContainerAllocateResponse:
         inv = self._inventory()
@@ -68,12 +71,23 @@ class TpuAllocator:
         if C.STRATEGY_CDI_ANNOTATIONS in self._strategies:
             resp.annotations[f"{C.CDI_K8S_PREFIX}{self._vendor}_{self._cls}"] = ",".join(names)
         if C.STRATEGY_ENVVAR in self._strategies:
-            # Direct injection for runtimes without CDI: device nodes + mounts
-            # mirror what the CDI spec would edit in.
+            # Direct injection for runtimes without CDI: everything the CDI
+            # spec's containerEdits would carry — device nodes, the libtpu
+            # mount, and the static slice-topology env — must ride the
+            # AllocateResponse itself, or libtpu in the pod can't bring up ICI.
             for c in chips:
                 resp.devices.add(
                     container_path=c.dev_path, host_path=c.dev_path, permissions="rw"
                 )
+            for key, val in runtime_env(inv.topology).items():
+                resp.envs[key] = val
+            if self._libtpu_host_path and os.path.exists(self._libtpu_host_path):
+                resp.mounts.add(
+                    container_path=C.LIBTPU_CONTAINER_PATH,
+                    host_path=self._libtpu_host_path,
+                    read_only=True,
+                )
+                resp.envs[C.LIBTPU_ENV] = C.LIBTPU_CONTAINER_PATH
         resp.envs[C.ENV_CDI_VENDOR_CLASS] = self._resource
         resp.envs[C.ENV_TPU_VISIBLE_CHIPS] = ",".join(str(c.index) for c in chips)
         return resp

@@ -237,6 +237,7 @@ class PluginManager:
                 cfg.resource_namespace,
                 cfg.tpu_resource_class,
                 cfg.strategies,
+                libtpu_host_path=cfg.libtpu_host_path,
             ),
             socket_dir=cfg.kubelet_socket_dir,
             kubelet_socket=cfg.kubelet_socket,

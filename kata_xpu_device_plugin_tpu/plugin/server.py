@@ -258,8 +258,11 @@ class DevicePluginServer(glue.DevicePluginServicer):
             self._start_locked(register=True)
 
     def stop(self) -> None:
+        # Set the stop flag BEFORE taking the lock: a concurrent restart()
+        # may hold it through register()'s retry/backoff, and the flag is
+        # what makes those waits return immediately.
+        self._stop.set()
         with self._lock:
-            self._stop.set()
             self._serving.clear()
             if self._server is not None:
                 self._server.stop(grace=1.0).wait()

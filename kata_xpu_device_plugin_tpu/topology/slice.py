@@ -160,27 +160,45 @@ class HostTopology:
         return f"{hx},{hy},{hz}"
 
 
+# PCI device id → TPU family (ids also named in discovery.pciids; kept here so
+# topology resolves generation without importing discovery).
+GOOGLE_DEVICE_TO_FAMILY = {
+    "0027": "v2",
+    "0056": "v3",
+    "005e": "v4",
+    "0062": "v5p",
+    "0063": "v5litepod",
+    "006f": "v6e",
+}
+
+
 def detect_accelerator_type(
-    env: Optional[dict[str, str]] = None, chip_count: Optional[int] = None
+    env: Optional[dict[str, str]] = None,
+    chip_count: Optional[int] = None,
+    pci_device_id: Optional[str] = None,
 ) -> str:
     """Best-effort accelerator type: env (GKE sets TPU_ACCELERATOR_TYPE on TPU
-    node pools) → chip-count heuristic.
+    node pools) → PCI-device-id family + chip-count heuristic.
 
-    Without env, the count is rounded UP to the nearest shape that has a valid
-    grid (a host with 3 healthy chips of a 4-chip machine is still a 4-chip
-    machine) so every returned type survives ``HostTopology.local_grid()``.
+    Without env, the generation comes from the chips' PCI device id when
+    known (a v4 host must not be labelled v5litepod — wrong slice_dims) and
+    the count is rounded UP to the nearest shape that has a valid grid (a
+    host with 3 healthy chips of a 4-chip machine is still a 4-chip machine)
+    so every returned type survives ``HostTopology.local_grid()``.
     """
     env = os.environ if env is None else env
     from_env = env.get("TPU_ACCELERATOR_TYPE")
     if from_env:
         return from_env
+    fam_name = GOOGLE_DEVICE_TO_FAMILY.get((pci_device_id or "").lower(), "v5litepod")
+    fam = FAMILIES[fam_name]
     n = max(1, chip_count or 1)
-    fam = FAMILIES["v5litepod"]
     if n <= fam.chips_per_host:
-        valid = min(c for c in fam.subslices if c >= n)
-        return f"v5litepod-{valid}"
-    hosts = math.ceil(n / fam.chips_per_host)
-    return f"v5litepod-{hosts * fam.chips_per_host}"
+        chips = min(c for c in fam.subslices if c >= n)
+    else:
+        chips = math.ceil(n / fam.chips_per_host) * fam.chips_per_host
+    suffix = chips * 2 if fam.suffix_counts_cores else chips
+    return f"{fam_name}-{suffix}"
 
 
 def runtime_env(

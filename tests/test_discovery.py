@@ -155,3 +155,24 @@ def test_scan_tpus_pci_correlation_survives_missing_node(fake):
 def test_pciids_explicit_path_must_exist(tmp_path):
     with pytest.raises(OSError):
         pciids.PciIds.load(str(tmp_path / "nope.ids"))
+
+
+def test_scan_tpus_ignores_unbound_nic_with_unknown_id(fake):
+    # A momentarily-unbound gVNIC (vendor 1ae0, unknown device id) must not
+    # shift chips onto the wrong BDF: strict known-id filter wins.
+    _v5e8_host(fake)
+    fake.add_pci_function("0000:00:00.5", "1ae0", "0042")  # sorts first, no driver
+    inv = discovery.scan_tpus(fake.sysfs, fake.dev, env={})
+    assert inv.count == 8
+    assert inv.chip(0).pci_address == "0000:00:01.0"
+
+
+def test_detect_family_from_pci_id(fake):
+    # v5p host (4 chips, device id 0062) without env: must NOT be labelled
+    # v5litepod — wrong slice dimensionality.
+    for i in range(4):
+        fake.add_accel_chip(i)
+        fake.add_pci_function(f"0000:0{i}:01.0", "1ae0", "0062")
+    inv = discovery.scan_tpus(fake.sysfs, fake.dev, env={})
+    assert inv.topology.accelerator_type == "v5p-8"
+    assert inv.topology.family.name == "v5p"

@@ -328,3 +328,30 @@ def test_manager_stop_reaches_restarted_plugin(manager, kubelet):
     manager.stop()
     assert plugin.stopped
     assert not os.path.exists(plugin.socket_path)
+
+
+def test_envvar_strategy_carries_full_guest_contract(kubelet, v5e8, short_dir):
+    # Without CDI, AllocateResponse itself must carry topology env + libtpu.
+    libtpu = os.path.join(short_dir, "libtpu.so")
+    open(libtpu, "w").close()
+    mgr = PluginManager(
+        make_config(v5e8, kubelet, short_dir,
+                    strategies=("envvar",), libtpu_host_path=libtpu)
+    )
+    mgr.start()
+    try:
+        ch, stub = kubelet.plugin_stub(kubelet.registrations[0].endpoint)
+        with ch:
+            resp = stub.Allocate(
+                pb.AllocateRequest(
+                    container_requests=[pb.ContainerAllocateRequest(device_ids=["0", "1"])]
+                )
+            )
+            (cr,) = resp.container_responses
+            assert len(cr.devices) == 2 and cr.devices[0].permissions == "rw"
+            assert cr.envs["TPU_ACCELERATOR_TYPE"] == "v5litepod-8"
+            assert cr.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,4,1"
+            assert cr.mounts[0].host_path == libtpu and cr.mounts[0].read_only
+            assert not cr.cdi_devices  # cdi-cri not enabled
+    finally:
+        mgr.stop()

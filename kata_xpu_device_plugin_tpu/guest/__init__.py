@@ -1,0 +1,5 @@
+"""In-guest validation: the BASELINE config ladder (device probe, compute
+check, all-reduce smoke) run inside the Kata guest the plugin provisioned."""
+from .probe import probe_all_reduce, probe_compute, probe_devices, run_ladder
+
+__all__ = ["probe_all_reduce", "probe_compute", "probe_devices", "run_ladder"]

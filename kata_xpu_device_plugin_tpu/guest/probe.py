@@ -1,0 +1,76 @@
+"""Guest validation ladder (BASELINE.json configs[0..2]).
+
+Runs INSIDE the Kata guest (or any JAX environment) to verify the devices
+the plugin injected actually work: device visibility, basic compute, and the
+all-reduce smoke test. Prints one JSON object per check so the results are
+machine-comparable against the north star (``jax.device_count() == 8`` on
+v5e-8).
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+
+def probe_devices(expected: Optional[int] = None) -> dict:
+    """configs[1]: the injected chips initialize and enumerate."""
+    import jax
+
+    devices = jax.devices()
+    result = {
+        "check": "devices",
+        "platform": devices[0].platform if devices else "none",
+        "device_count": jax.device_count(),
+        "local_device_count": jax.local_device_count(),
+        "devices": [str(d) for d in devices],
+        "ok": True,
+    }
+    if expected is not None:
+        result["expected"] = expected
+        result["ok"] = jax.device_count() == expected
+    return result
+
+
+def probe_compute() -> dict:
+    """A matmul runs on the accelerator and returns sane numerics."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    y = (x @ x).astype(jnp.float32)
+    ok = bool(jnp.allclose(y, 256.0))
+    return {"check": "compute", "ok": ok, "value": float(y[0, 0])}
+
+
+def probe_all_reduce() -> dict:
+    """configs[2]: pmap psum across every visible chip exercises ICI."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.collectives import pmap_all_reduce
+
+    n = jax.local_device_count()
+    x = jnp.arange(n, dtype=jnp.float32).reshape(n, 1)
+    out = pmap_all_reduce(x)
+    expect = float(n * (n - 1) / 2)
+    ok = bool(jnp.allclose(out, expect))
+    return {"check": "all_reduce", "devices": n, "ok": ok, "value": float(out[0, 0])}
+
+
+def run_ladder(expected_devices: Optional[int] = None) -> int:
+    """Run all probes; exit code 0 iff every check passed."""
+    ok = True
+    for result in (
+        probe_devices(expected_devices),
+        probe_compute(),
+        probe_all_reduce(),
+    ):
+        print(json.dumps(result))
+        ok = ok and result["ok"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    expected = int(sys.argv[1]) if len(sys.argv) > 1 else None
+    sys.exit(run_ladder(expected))

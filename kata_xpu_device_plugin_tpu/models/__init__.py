@@ -1,0 +1,28 @@
+"""Guest-side model families: the TPU-first decoder core plus Gemma (BASELINE
+inference workload) and Llama-3 (BASELINE training workload) configs."""
+from .gemma import gemma_2b, gemma_2b_bench, gemma_7b
+from .llama import llama3_8b, llama3_train_test
+from .transformer import (
+    DecoderConfig,
+    forward,
+    generate,
+    init_kv_caches,
+    init_params,
+    next_token_loss,
+    tiny_test_config,
+)
+
+__all__ = [
+    "DecoderConfig",
+    "forward",
+    "generate",
+    "init_kv_caches",
+    "init_params",
+    "next_token_loss",
+    "tiny_test_config",
+    "gemma_2b",
+    "gemma_2b_bench",
+    "gemma_7b",
+    "llama3_8b",
+    "llama3_train_test",
+]

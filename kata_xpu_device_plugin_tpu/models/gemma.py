@@ -1,0 +1,54 @@
+"""Gemma model family configs (the BASELINE north-star inference workload:
+"Gemma-2B inference (MaxText) inside Kata guest" — BASELINE.json configs[3]).
+
+Architecture facts are from the public Gemma report: MQA (1 KV head) for the
+2B model, GeGLU MLP, RMSNorm with (1+scale), RoPE, embedding scaling by
+sqrt(d_model), tied unembedding, vocab 256128.
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+
+from .transformer import DecoderConfig
+
+
+def gemma_2b(**overrides) -> DecoderConfig:
+    cfg = DecoderConfig(
+        vocab_size=256128,
+        d_model=2048,
+        n_layers=18,
+        n_heads=8,
+        n_kv_heads=1,  # MQA
+        head_dim=256,
+        d_ff=16384,
+        rope_theta=10000.0,
+        activation="geglu",
+        scale_embeddings=True,
+        tie_embeddings=True,
+    )
+    return replace(cfg, **overrides)
+
+
+def gemma_7b(**overrides) -> DecoderConfig:
+    cfg = DecoderConfig(
+        vocab_size=256128,
+        d_model=3072,
+        n_layers=28,
+        n_heads=16,
+        n_kv_heads=16,
+        head_dim=256,
+        d_ff=24576,
+        rope_theta=10000.0,
+        activation="geglu",
+        scale_embeddings=True,
+        tie_embeddings=True,
+    )
+    return replace(cfg, **overrides)
+
+
+def gemma_2b_bench(**overrides) -> DecoderConfig:
+    """The 2B architecture with a trimmed vocabulary for single-chip
+    benchmarking: the 256k embedding dominates memory/compile at no benefit
+    to a throughput benchmark of random weights. Layer compute is identical
+    to gemma_2b."""
+    return gemma_2b(vocab_size=32128, **overrides)

@@ -1,0 +1,50 @@
+"""Llama-3 model family configs (the BASELINE multi-host training workload:
+"v5p-16 multi-host slice: Llama-3-8B training" — BASELINE.json configs[4]).
+
+Architecture facts from the public Llama 3 report: GQA with 8 KV heads,
+SwiGLU MLP, RMSNorm, RoPE theta 500000, vocab 128256, untied unembedding,
+no embedding scaling.
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+
+from .transformer import DecoderConfig
+
+
+def llama3_8b(**overrides) -> DecoderConfig:
+    cfg = DecoderConfig(
+        vocab_size=128256,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=128,
+        d_ff=14336,
+        rope_theta=500000.0,
+        norm_eps=1e-5,
+        activation="swiglu",
+        scale_embeddings=False,
+        tie_embeddings=False,
+    )
+    return replace(cfg, **overrides)
+
+
+def llama3_train_test(**overrides) -> DecoderConfig:
+    """Llama-3 architecture at test scale (same ratios, 8-divisible dims)
+    for the multi-chip training dry run."""
+    cfg = DecoderConfig(
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=8,
+        n_kv_heads=4,
+        head_dim=16,
+        d_ff=256,
+        rope_theta=500000.0,
+        norm_eps=1e-5,
+        activation="swiglu",
+        scale_embeddings=False,
+        tie_embeddings=False,
+    )
+    return replace(cfg, **overrides)

@@ -1,0 +1,300 @@
+"""TPU-first decoder-only transformer core.
+
+This is the guest-side workload of the framework: the BASELINE ladder ends at
+"Gemma-2B inference (MaxText) inside Kata guest" and "Llama-3-8B training"
+(BASELINE.json configs[3-4]); :mod:`.gemma` and :mod:`.llama` instantiate
+those families over this core.
+
+Design choices are TPU/XLA-native, not a port of any CUDA runtime:
+
+- pure-functional params (a pytree of arrays) + jittable apply; no framework
+  Module state, so ``pjit``/``shard_map`` compose directly;
+- layers stacked on a leading axis and iterated with ``lax.scan`` — one
+  compiled layer body regardless of depth (fast compiles, XLA-friendly);
+- bf16 compute / fp32 parameters & normalization accumulators, attention
+  logits in fp32 (MXU-friendly shapes: head_dim and d_ff multiples of 128);
+- attention implementation is injectable: the XLA reference from
+  :mod:`..ops.attention`, the pallas flash kernel on TPU, or the ring
+  variant for sequence parallelism.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = dict[str, Any]
+AttnFn = Callable[..., jax.Array]  # (q, k, v, causal, q_offset) -> out
+
+
+@dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    d_ff: int
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    # "geglu" (Gemma) or "swiglu" (Llama); both are gated MLPs, differing in
+    # the gate nonlinearity.
+    activation: str = "geglu"
+    # Gemma multiplies embeddings by sqrt(d_model) and ties the unembedding.
+    scale_embeddings: bool = True
+    tie_embeddings: bool = True
+    logits_softcap: float = 0.0  # 0 disables (Gemma-2 uses 30.0)
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    def num_params(self) -> int:
+        embed = self.vocab_size * self.d_model
+        attn = self.d_model * (self.q_dim + 2 * self.kv_dim) + self.q_dim * self.d_model
+        mlp = 3 * self.d_model * self.d_ff
+        norms = 2 * self.d_model
+        per_layer = attn + mlp + norms
+        unembed = 0 if self.tie_embeddings else embed
+        return embed + self.n_layers * per_layer + self.d_model + unembed
+
+
+def tiny_test_config(**overrides) -> DecoderConfig:
+    """A shapes-only config for CPU-mesh tests and the graft dry run: every
+    sharded dimension divisible by 8 (mesh axes) and 2 KV heads for GQA."""
+    base = DecoderConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=8,
+        n_kv_heads=2,
+        head_dim=16,
+        d_ff=128,
+    )
+    return replace(base, **overrides)
+
+
+# ----- initialization ------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: DecoderConfig, dtype=jnp.float32) -> Params:
+    """Stacked-layer parameter pytree (leading axis = layer, for lax.scan)."""
+    k_embed, k_layers, k_unembed = jax.random.split(key, 3)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)).astype(dtype)
+
+    L = cfg.n_layers
+    keys = jax.random.split(k_layers, 7)
+    params: Params = {
+        "embed": dense(k_embed, (cfg.vocab_size, cfg.d_model), cfg.d_model),
+        "layers": {
+            "attn_norm": jnp.ones((L, cfg.d_model), dtype),
+            "wq": dense(keys[0], (L, cfg.d_model, cfg.q_dim), cfg.d_model),
+            "wk": dense(keys[1], (L, cfg.d_model, cfg.kv_dim), cfg.d_model),
+            "wv": dense(keys[2], (L, cfg.d_model, cfg.kv_dim), cfg.d_model),
+            "wo": dense(keys[3], (L, cfg.q_dim, cfg.d_model), cfg.q_dim),
+            "mlp_norm": jnp.ones((L, cfg.d_model), dtype),
+            "w_gate": dense(keys[4], (L, cfg.d_model, cfg.d_ff), cfg.d_model),
+            "w_up": dense(keys[5], (L, cfg.d_model, cfg.d_ff), cfg.d_model),
+            "w_down": dense(keys[6], (L, cfg.d_ff, cfg.d_model), cfg.d_ff),
+        },
+        "final_norm": jnp.ones((cfg.d_model,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["unembed"] = dense(k_unembed, (cfg.d_model, cfg.vocab_size), cfg.d_model)
+    return params
+
+
+# ----- building blocks -----------------------------------------------------
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm with fp32 accumulation (Gemma convention: (1 + scale) * x̂)."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * lax.rsqrt(var + eps)
+    return (normed * (1.0 + scale.astype(jnp.float32))).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding. x: [B, S, H, D], positions: [B, S]."""
+    d = x.shape[-1]
+    freq_exponents = jnp.arange(0, d // 2, dtype=jnp.float32) * (2.0 / d)
+    inv_freq = theta ** -freq_exponents  # [D/2]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
+    angles = angles[:, :, None, :]  # [B, S, 1, D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _gate_act(x: jax.Array, kind: str) -> jax.Array:
+    if kind == "geglu":
+        return jax.nn.gelu(x, approximate=True)
+    if kind == "swiglu":
+        return jax.nn.silu(x)
+    raise ValueError(f"unknown activation {kind!r}")
+
+
+# ----- forward pass --------------------------------------------------------
+
+
+def _layer(
+    cfg: DecoderConfig,
+    attn_fn: AttnFn,
+    x: jax.Array,
+    layer: Params,
+    positions: jax.Array,
+    kv_cache: Optional[tuple[jax.Array, jax.Array]] = None,
+    cache_offset: Optional[jax.Array] = None,
+):
+    """One decoder block. x: [B, S, D]. Returns (x, new_kv)."""
+    B, S, _ = x.shape
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(h.dtype)).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = (h @ layer["wk"].astype(h.dtype)).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ layer["wv"].astype(h.dtype)).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+
+    if kv_cache is not None:
+        # Decode/prefill-with-cache: write new k/v at cache_offset, attend to
+        # the whole cache prefix. Static shapes — XLA-friendly.
+        ck, cv = kv_cache
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_offset, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_offset, 0, 0))
+        attn_out = attn_fn(q, ck, cv, causal=True, q_offset=cache_offset)
+        new_cache = (ck, cv)
+    else:
+        attn_out = attn_fn(q, k, v, causal=True, q_offset=None)
+        new_cache = None
+
+    attn_out = attn_out.reshape(B, S, cfg.q_dim)
+    x = x + attn_out @ layer["wo"].astype(x.dtype)
+
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = _gate_act(h @ layer["w_gate"].astype(h.dtype), cfg.activation)
+    up = h @ layer["w_up"].astype(h.dtype)
+    x = x + (gate * up) @ layer["w_down"].astype(x.dtype)
+    return x, new_cache
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: DecoderConfig,
+    attn_fn: Optional[AttnFn] = None,
+    positions: Optional[jax.Array] = None,
+    kv_caches: Optional[tuple[jax.Array, jax.Array]] = None,
+    cache_offset: Optional[jax.Array] = None,
+):
+    """Full forward. tokens: [B, S] int32 → logits [B, S, vocab].
+
+    With ``kv_caches`` (stacked [L, B, max_len, n_kv, D]) also returns the
+    updated caches — one code path serves training, prefill and decode.
+    """
+    if attn_fn is None:
+        from ..ops.attention import reference_attention
+
+        attn_fn = reference_attention
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    if cfg.scale_embeddings:
+        x = x * jnp.asarray(jnp.sqrt(cfg.d_model), cfg.dtype)
+
+    def body(carry, layer_and_cache):
+        x = carry
+        if kv_caches is not None:
+            layer, (ck, cv) = layer_and_cache
+            x, new_cache = _layer(cfg, attn_fn, x, layer, positions, (ck, cv), cache_offset)
+            return x, new_cache
+        layer = layer_and_cache
+        x, _ = _layer(cfg, attn_fn, x, layer, positions)
+        return x, None
+
+    if kv_caches is not None:
+        x, new_caches = lax.scan(body, x, (params["layers"], kv_caches))
+    else:
+        x, _ = lax.scan(body, x, params["layers"])
+        new_caches = None
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    unembed = (
+        params["embed"].T if cfg.tie_embeddings else params["unembed"]
+    ).astype(cfg.dtype)
+    logits = (x @ unembed).astype(jnp.float32)
+    if cfg.logits_softcap:
+        logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
+    if kv_caches is not None:
+        return logits, new_caches
+    return logits
+
+
+# ----- loss / training -----------------------------------------------------
+
+
+def next_token_loss(params: Params, tokens: jax.Array, cfg: DecoderConfig,
+                    attn_fn: Optional[AttnFn] = None) -> jax.Array:
+    """Mean cross-entropy of predicting tokens[:, 1:] from tokens[:, :-1]."""
+    logits = forward(params, tokens[:, :-1], cfg, attn_fn=attn_fn)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+# ----- KV cache / generation ----------------------------------------------
+
+
+def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
+                   dtype=None) -> tuple[jax.Array, jax.Array]:
+    """Stacked caches [L, B, max_len, n_kv_heads, head_dim]."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "max_len"))
+def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
+             steps: int, max_len: int = 0):
+    """Greedy generation: prefill the prompt, then lax.scan the decode loop
+    (everything under one jit — no per-token dispatch overhead)."""
+    B, S = prompt.shape
+    max_len = max_len or S + steps
+    caches = init_kv_caches(cfg, B, max_len)
+    logits, caches = forward(
+        params, prompt, cfg, kv_caches=caches, cache_offset=jnp.int32(0)
+    )
+    last = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+    if steps == 0:
+        return jnp.zeros((B, 0), jnp.int32)
+
+    def step(carry, _):
+        caches, tok, pos = carry
+        positions = pos[:, None] * jnp.ones((B, 1), jnp.int32)
+        logits, caches = forward(
+            params, tok[:, None], cfg, positions=positions,
+            kv_caches=caches, cache_offset=pos[0],
+        )
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        return (caches, nxt, pos + 1), nxt
+
+    pos = jnp.full((B,), S, jnp.int32)
+    (_, _, _), out = lax.scan(step, (caches, last, pos), None, length=steps - 1)
+    return jnp.concatenate([last[:, None], out.T], axis=1)

@@ -1,0 +1,21 @@
+"""TPU compute ops: attention implementations (XLA reference, pallas flash)
+and collective helpers."""
+from .attention import best_attention, flash_attention, reference_attention
+from .collectives import (
+    all_gather,
+    mesh_all_reduce,
+    pmap_all_reduce,
+    reduce_scatter,
+    ring_all_reduce,
+)
+
+__all__ = [
+    "best_attention",
+    "flash_attention",
+    "reference_attention",
+    "all_gather",
+    "mesh_all_reduce",
+    "pmap_all_reduce",
+    "reduce_scatter",
+    "ring_all_reduce",
+]

@@ -1,0 +1,91 @@
+"""Attention implementations.
+
+Three interchangeable implementations behind one signature
+``(q, k, v, causal=True, q_offset=None) -> out``:
+
+- :func:`reference_attention` — plain XLA einsum path (always correct;
+  XLA already fuses mask+softmax into the matmuls well on TPU);
+- :func:`flash_attention` — pallas TPU kernel (:mod:`.flash`), blockwise
+  online-softmax so the [S, S] score matrix never materializes in HBM;
+- :func:`make_ring_attention` (:mod:`..parallel.ring`) — sequence-parallel
+  ring attention over an ICI axis for long-context (SURVEY: long-context is
+  first-class, not an afterthought).
+
+Shapes: q [B, Sq, H, D]; k/v [B, Sk, KV, D] with H a multiple of KV (GQA:
+Gemma-2B uses KV=1, Llama-3-8B KV=8). ``q_offset`` is the absolute position
+of q's first token when attending into a longer KV prefix (decode).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _expand_kv(k: jax.Array, n_heads: int) -> jax.Array:
+    """[B, S, KV, D] → [B, S, H, D] by repeating each KV head H/KV times."""
+    kv = k.shape[2]
+    if kv == n_heads:
+        return k
+    return jnp.repeat(k, n_heads // kv, axis=2)
+
+
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    q_offset: Optional[jax.Array] = None,
+) -> jax.Array:
+    """XLA attention with fp32 logits. Used on CPU, in tests, and as the
+    numerics oracle for the pallas kernel."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    k = _expand_kv(k, H)
+    v = _expand_kv(v, H)
+    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    logits = logits * scale
+    if causal:
+        q_pos = jnp.arange(Sq)
+        if q_offset is not None:
+            q_pos = q_pos + q_offset
+        k_pos = jnp.arange(Sk)
+        mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    q_offset: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Pallas flash attention on TPU; falls back to the reference elsewhere
+    (pallas interpret mode on CPU is far slower than XLA) and for the tiny
+    shapes where a kernel launch can't pay for itself."""
+    from .flash import supports
+
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if (
+        not on_tpu
+        or q_offset is not None  # decode-into-cache: tiny q, XLA path
+        or Sq < 128
+        or not supports(Sq, Sk, D)
+    ):
+        return reference_attention(q, k, v, causal=causal, q_offset=q_offset)
+    from .flash import pallas_flash_attention
+
+    return pallas_flash_attention(q, k, v, causal=causal)
+
+
+def best_attention(*args, **kwargs):
+    """Alias: the framework default (flash on TPU, reference elsewhere)."""
+    return flash_attention(*args, **kwargs)

@@ -1,0 +1,77 @@
+"""Collective helpers over the device mesh.
+
+XLA's collectives (psum/all_gather/reduce_scatter/ppermute) ARE the
+distributed backend on TPU — they compile onto ICI/DCN links (SURVEY §5's
+TPU-native equivalence for the reference's gRPC/NCCL-less world). These
+wrappers exist for the guest smoke ladder (BASELINE configs[2]: "pmap
+all-reduce smoke test") and for tests that assert collective correctness on
+the virtual CPU mesh; model code relies on GSPMD-inserted collectives.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+
+def pmap_all_reduce(x_per_device: jax.Array) -> jax.Array:
+    """BASELINE configs[2] smoke: psum over all local devices via pmap.
+    Input leading axis = device count."""
+    return jax.pmap(lambda x: lax.psum(x, "i"), axis_name="i")(x_per_device)
+
+
+def mesh_all_reduce(mesh: Mesh, x: jax.Array, axis: str) -> jax.Array:
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P()
+    )
+    def _psum(x_shard):
+        return lax.psum(x_shard, axis)
+
+    return _psum(x)
+
+
+def ring_all_reduce(mesh: Mesh, x: jax.Array, axis: str) -> jax.Array:
+    """Explicit ring all-reduce via ppermute — demonstrates (and tests) the
+    neighbor-hop pattern ring attention relies on. XLA's native psum is what
+    production code should use."""
+    n = mesh.shape[axis]
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+    )
+    def _ring(x_shard):
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        def step(_t, carry):
+            acc, blk = carry
+            blk = lax.ppermute(blk, axis, perm)
+            return acc + blk, blk
+
+        total, _ = lax.fori_loop(0, n - 1, step, (x_shard, x_shard))
+        return total
+
+    return _ring(x)
+
+
+def all_gather(mesh: Mesh, x: jax.Array, axis: str) -> jax.Array:
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False
+    )
+    def _ag(x_shard):
+        return lax.all_gather(x_shard, axis, tiled=True)
+
+    return _ag(x)
+
+
+def reduce_scatter(mesh: Mesh, x: jax.Array, axis: str) -> jax.Array:
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(None), out_specs=P(axis), check_vma=False
+    )
+    def _rs(x_full):
+        return lax.psum_scatter(x_full, axis, scatter_dimension=0, tiled=True)
+
+    return _rs(x)

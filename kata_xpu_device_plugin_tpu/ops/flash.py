@@ -1,0 +1,163 @@
+"""Pallas TPU flash attention (forward).
+
+Blockwise online-softmax attention: the [Sq, Sk] score matrix never reaches
+HBM — each (q-block, k-block) tile is computed in VMEM on the MXU, with
+running max/denominator carried in VMEM scratch across the (sequential) last
+grid dimension. Supports GQA/MQA natively by index-mapping each q head onto
+its KV head, so KV heads are never materialized H/KV times.
+
+Used for prefill/inference (the decode hot path is tiny-q and stays on XLA;
+training uses the XLA reference path which autodiffs). Numerics oracle:
+``tests/test_ops.py`` compares against ``reference_attention`` on CPU via
+interpret mode, and the bench compares on the real chip.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def pick_block(seq_len: int, requested: int) -> Optional[int]:
+    """Largest usable block ≤ requested: divides ``seq_len``, multiple of 8,
+    at least 128 (TPU tile constraints). None when no such block exists —
+    callers then take the XLA reference path."""
+    for b in range(min(requested, seq_len), 127, -8):
+        if seq_len % b == 0:
+            return b
+    return None
+
+
+def supports(sq: int, sk: int, d: int) -> bool:
+    """Whether the pallas kernel can run these self-attention shapes."""
+    return (
+        (d % 128 == 0 or d == 64)
+        and pick_block(sq, 512) is not None
+        and pick_block(sk, 512) is not None
+    )
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
+    block_q: int, block_k: int,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)  # [BQ, D]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)  # [BK, D]
+        v = v_ref[0, 0, :, :].astype(jnp.float32)  # [BK, D]
+
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [BQ, BK]
+
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]  # [BQ, 1]
+        l_prev = l_scr[:, 0:1]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)  # [BQ, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(logits - m_new)  # [BQ, BK]
+        correction = jnp.exp(m_prev - m_new)  # [BQ, 1]
+        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+
+        acc = acc_scr[...] * correction  # [BQ, D]
+        acc = acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[...] = acc
+
+    if causal:
+        # Skip k-blocks entirely above the causal frontier — ~half the grid
+        # at long sequence; the MXU never sees fully-masked tiles.
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        denom = l_scr[:, 0:1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[0, 0, :, :] = (acc_scr[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def pallas_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    q_offset: Optional[jax.Array] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """q [B, Sq, H, D]; k/v [B, Sk, KV, D], H % KV == 0. Self-attention only
+    (``q_offset`` unsupported here — callers fall back to the reference)."""
+    if q_offset is not None:
+        raise ValueError("pallas_flash_attention is for self-attention (q_offset=None)")
+    B, Sq, H, D = q.shape
+    _, Sk, KV, _ = k.shape
+    assert H % KV == 0, (H, KV)
+    group = H // KV
+    block_q = pick_block(Sq, block_q)
+    block_k = pick_block(Sk, block_k)
+    if block_q is None or block_k is None:
+        raise ValueError(
+            f"no valid flash block for Sq={Sq}, Sk={Sk} (need a divisor ≥128, "
+            "multiple of 8); use reference_attention"
+        )
+    grid = (B, H, Sq // block_q, Sk // block_k)
+
+    scale = float(1.0 / (D ** 0.5))
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+    )
+    # Pallas TPU tiles the LAST TWO dims: run the kernel in [B, H, S, D]
+    # layout so (S-block, D) are the tiled pair.
+    q_t = q.transpose(0, 2, 1, 3)  # [B, H, Sq, D]
+    k_t = k.transpose(0, 2, 1, 3)  # [B, KV, Sk, D]
+    v_t = v.transpose(0, 2, 1, 3)
+    out_t = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // group, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q_t.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max (col 0 used)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom
+            pltpu.VMEM((block_q, D), jnp.float32),  # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q_t, k_t, v_t)
+    return out_t.transpose(0, 2, 1, 3)

@@ -1,0 +1,41 @@
+"""Parallelism: mesh construction, dp/fsdp/tp sharding rules + train step,
+and sequence-parallel ring attention."""
+from .mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    build_mesh,
+    default_mesh_shape,
+    seq_mesh,
+)
+from .ring import make_ring_attention
+from .sharding import (
+    BATCH_SPEC,
+    PARAM_RULES,
+    init_sharded_params,
+    make_optimizer,
+    make_train_step,
+    param_shardings,
+    shard_batch,
+    shard_params,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_FSDP",
+    "AXIS_MODEL",
+    "AXIS_SEQ",
+    "build_mesh",
+    "default_mesh_shape",
+    "seq_mesh",
+    "make_ring_attention",
+    "BATCH_SPEC",
+    "PARAM_RULES",
+    "init_sharded_params",
+    "make_optimizer",
+    "make_train_step",
+    "param_shardings",
+    "shard_batch",
+    "shard_params",
+]

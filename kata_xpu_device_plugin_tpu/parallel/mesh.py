@@ -1,0 +1,72 @@
+"""Device mesh construction.
+
+The scaling model is jax.sharding over an ICI mesh: pick a mesh, annotate
+shardings, let XLA insert collectives. Axes:
+
+- ``data``  — pure data parallelism (gradient psum over DCN/ICI)
+- ``fsdp``  — data parallelism with parameter/optimizer sharding
+             (all-gather params, reduce-scatter grads; rides ICI)
+- ``model`` — tensor parallelism (heads / mlp-hidden sharding)
+- ``seq``   — sequence/context parallelism (ring attention over ICI)
+
+On hardware the mesh should map so ``model``/``seq`` ride ICI neighbors;
+``jax.experimental.mesh_utils.create_device_mesh`` handles the physical
+assignment on real slices.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_MODEL)
+
+
+def default_mesh_shape(n_devices: int) -> dict[str, int]:
+    """A reasonable dp×fsdp×tp factorization: tensor parallelism over the
+    closest ICI neighbors (≤4 ways), FSDP over the rest, pure DP only when
+    the device count has leftover factors."""
+    model = 1
+    for cand in (4, 2):
+        if n_devices % cand == 0 and n_devices >= cand * 2:
+            model = cand
+            break
+    rest = n_devices // model
+    fsdp = rest
+    data = 1
+    if rest % 2 == 0 and rest >= 4:
+        data = 2
+        fsdp = rest // 2
+    return {AXIS_DATA: data, AXIS_FSDP: fsdp, AXIS_MODEL: model}
+
+
+def build_mesh(
+    shape: Optional[dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Sequence[str] = MESH_AXES,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    shape = shape or default_mesh_shape(len(devices))
+    dims = [shape.get(a, 1) for a in axis_names]
+    if int(np.prod(dims)) != len(devices):
+        raise ValueError(f"mesh shape {shape} does not cover {len(devices)} devices")
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(dims, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(dims)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def seq_mesh(n_seq: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1D mesh for sequence-parallel ring attention tests/benchmarks."""
+    devices = list(devices if devices is not None else jax.devices())[:n_seq]
+    return Mesh(np.asarray(devices), (AXIS_SEQ,))

@@ -1,0 +1,100 @@
+"""Ring attention: sequence/context parallelism over an ICI axis.
+
+Long-context is first-class (SURVEY §5): sequences longer than one chip's
+HBM shard across the ``seq`` mesh axis; each device holds a [B, S/n] slice
+of Q/K/V. K/V blocks rotate around the ring with ``lax.ppermute`` while each
+device accumulates blockwise online-softmax attention of its local Q against
+every block — compute overlaps the neighbor-to-neighbor ICI transfer, and no
+device ever materializes the full sequence.
+
+Causal masking works on *global* positions: the block arriving at step ``t``
+on device ``i`` originated on device ``(i - t) mod n``, so its key offset is
+known statically per step.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import _expand_kv
+from .mesh import AXIS_SEQ
+
+NEG_INF = -1e30
+
+
+def _local_ring_attention(
+    q: jax.Array,  # [B, S_loc, H, D] — this device's query shard
+    k: jax.Array,  # [B, S_loc, KV, D]
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Runs INSIDE shard_map over ``axis_name``."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    k = _expand_kv(k, H)
+    v = _expand_kv(v, H)
+    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    q_pos = idx * S + jnp.arange(S)  # global positions of local queries
+
+    m = jnp.full((B, H, S, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, S, 1), jnp.float32)
+    acc = jnp.zeros((B, S, H, D), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(t, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src = (idx - t) % n  # ring owner of the block now resident here
+        k_pos = src * S + jnp.arange(S)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]  # [S, S] global causal
+            logits = jnp.where(mask[None, None], logits, NEG_INF)
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr.transpose(0, 2, 1, 3) + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+        )
+        # Rotate K/V to the next ring neighbor (ICI hop) for the next step.
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return k_nxt, v_nxt, m_new, l, acc
+
+    k_blk, v_blk, m, l, acc = lax.fori_loop(0, n, step, (k, v, m, l, acc))
+    denom = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1, 3)  # [B, S, H, 1]
+    return (acc / denom).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis: str = AXIS_SEQ):
+    """Returns ``ring_attn(q, k, v)`` operating on GLOBAL [B, S, H, D] arrays
+    sharded over ``axis`` in S. Drop-in for the attention seam when the model
+    runs sequence-parallel."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, axis, None, None),) * 3,
+        out_specs=P(None, axis, None, None),
+        check_vma=False,  # online-softmax carries start axis-invariant
+    )
+    def ring(q, k, v):
+        return _local_ring_attention(q, k, v, axis_name=axis, causal=True)
+
+    def ring_attn(q, k, v, causal: bool = True, q_offset: Optional[jax.Array] = None):
+        if not causal or q_offset is not None:
+            raise ValueError("ring attention supports causal self-attention only")
+        return ring(q, k, v)
+
+    return ring_attn

@@ -1,0 +1,145 @@
+"""Sharding rules and the sharded training/inference steps.
+
+GSPMD style: parameters and batches get NamedShardings from the rules below;
+XLA inserts the collectives (all-gather for fsdp params, reduce-scatter for
+grads, all-to-all/psum for tensor-parallel matmuls). No hand-written
+collective calls in the train step — that is the TPU-native shape of the
+reference's "distributed backend" capability (SURVEY §5: collectives ride
+ICI via XLA, not an NCCL port).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..models import transformer as tfm
+from .mesh import AXIS_DATA, AXIS_FSDP, AXIS_MODEL
+
+# Parameter PartitionSpecs by param-tree path suffix. Layer-stacked arrays
+# carry a leading (layer) axis that is never sharded. Rationale:
+# - attention/MLP "wide" matrices shard their wide dim over model (tp) and
+#   their d_model dim over fsdp;
+# - embed shards vocab over model, d_model over fsdp (logits psum over model);
+# - norms are tiny → replicated.
+PARAM_RULES: dict[str, P] = {
+    "embed": P(AXIS_MODEL, AXIS_FSDP),
+    "unembed": P(AXIS_FSDP, AXIS_MODEL),
+    "layers.attn_norm": P(None, None),
+    "layers.mlp_norm": P(None, None),
+    "layers.wq": P(None, AXIS_FSDP, AXIS_MODEL),
+    "layers.wk": P(None, AXIS_FSDP, AXIS_MODEL),
+    "layers.wv": P(None, AXIS_FSDP, AXIS_MODEL),
+    "layers.wo": P(None, AXIS_MODEL, AXIS_FSDP),
+    "layers.w_gate": P(None, AXIS_FSDP, AXIS_MODEL),
+    "layers.w_up": P(None, AXIS_FSDP, AXIS_MODEL),
+    "layers.w_down": P(None, AXIS_MODEL, AXIS_FSDP),
+    "final_norm": P(None),
+}
+
+BATCH_SPEC = P((AXIS_DATA, AXIS_FSDP), None)  # [batch, seq]
+
+
+def param_spec(path: str) -> P:
+    if path in PARAM_RULES:
+        return PARAM_RULES[path]
+    raise KeyError(f"no sharding rule for param {path!r}")
+
+
+def _tree_paths(params: Any, prefix: str = "") -> Any:
+    if isinstance(params, dict):
+        return {k: _tree_paths(v, f"{prefix}.{k}" if prefix else k) for k, v in params.items()}
+    return prefix
+
+
+def param_shardings(params: Any, mesh: Mesh) -> Any:
+    paths = _tree_paths(params)
+    return jax.tree.map(lambda p: NamedSharding(mesh, param_spec(p)), paths)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place a (host or single-device) param tree onto the mesh."""
+    return jax.device_put(params, param_shardings(params, mesh))
+
+
+def init_sharded_params(
+    key: jax.Array, cfg: tfm.DecoderConfig, mesh: Mesh, dtype=jnp.float32
+) -> Any:
+    """Initialize directly into the sharded layout (never materializes the
+    full model on one device — required at Llama-3-8B scale)."""
+    shardings = param_shardings(
+        jax.eval_shape(lambda: tfm.init_params(key, cfg, dtype)), mesh
+    )
+    init = jax.jit(
+        lambda k: tfm.init_params(k, cfg, dtype), out_shardings=shardings
+    )
+    return init(key)
+
+
+# ----- training ------------------------------------------------------------
+
+
+def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01) -> optax.GradientTransformation:
+    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
+
+
+def make_train_step(
+    cfg: tfm.DecoderConfig,
+    mesh: Mesh,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    attn_fn: Optional[Callable] = None,
+):
+    """Returns (init_state, step). ``step(state, tokens) -> (state, loss)``,
+    jitted over the mesh with donated state."""
+    optimizer = optimizer or make_optimizer()
+
+    def init_state(key: jax.Array):
+        params = init_sharded_params(key, cfg, mesh)
+        opt_state = jax.jit(
+            optimizer.init, out_shardings=_opt_shardings(optimizer, params, mesh)
+        )(params)
+        return {"params": params, "opt": opt_state, "step": jnp.zeros((), jnp.int32)}
+
+    def loss_fn(params, tokens):
+        return tfm.next_token_loss(params, tokens, cfg, attn_fn=attn_fn)
+
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens)
+        updates, new_opt = optimizer.update(grads, state["opt"], state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
+
+    return init_state, step
+
+
+def _opt_shardings(optimizer, params, mesh):
+    """Optimizer-state shardings mirror the params they track (fsdp shards
+    the Adam moments too); non-param leaves (step counters) replicate.
+
+    Adam's mu/nu trees repeat the param tree structure, so a leaf's param
+    identity is the longest path suffix that matches a PARAM_RULES entry.
+    """
+    replicated = NamedSharding(mesh, P())
+
+    def leaf_sharding(path, _leaf):
+        names = [p.key for p in path if hasattr(p, "key")]
+        for n in range(len(names), 0, -1):
+            cand = ".".join(names[-n:])
+            if cand in PARAM_RULES:
+                return NamedSharding(mesh, PARAM_RULES[cand])
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(
+        leaf_sharding, jax.eval_shape(optimizer.init, params)
+    )
+
+
+def shard_batch(tokens: jax.Array, mesh: Mesh) -> jax.Array:
+    return jax.device_put(tokens, NamedSharding(mesh, BATCH_SPEC))

@@ -14,10 +14,15 @@ import os
 for _k in [k for k in os.environ if k.startswith("TPU_")]:
     del os.environ[_k]
 
-# Must be set before the first `import jax` anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must run before any test imports jax. This host's axon TPU plugin ignores
+# the JAX_PLATFORMS env var, so force the platform through jax.config (works
+# as long as no backend has initialized yet).
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,83 @@
+"""Op tests: pallas flash attention numerics (interpret mode on CPU) and the
+guest probe ladder."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu.ops.attention import reference_attention
+from kata_xpu_device_plugin_tpu.ops.flash import pallas_flash_attention
+
+
+@pytest.mark.parametrize("kv_heads", [1, 2])  # MQA (Gemma) and GQA
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_reference(kv_heads, causal):
+    B, S, H, D = 1, 256, 2, 64
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(keys[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(keys[1], (B, S, kv_heads, D), jnp.float32)
+    v = jax.random.normal(keys[2], (B, S, kv_heads, D), jnp.float32)
+    out = pallas_flash_attention(
+        q, k, v, causal=causal, block_q=128, block_k=128, interpret=True
+    )
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_rejects_offset():
+    q = jnp.zeros((1, 128, 2, 64))
+    with pytest.raises(ValueError):
+        pallas_flash_attention(q, q, q, q_offset=jnp.int32(4))
+
+
+def test_reference_attention_decode_offset():
+    # Decode: 1 query at absolute position 5 attending into an 8-long cache
+    # where only the first 6 slots are real. Must equal full-sequence attention.
+    B, S, H, D = 1, 6, 2, 16
+    keys = jax.random.split(jax.random.PRNGKey(1), 3)
+    q_full = jax.random.normal(keys[0], (B, S, H, D))
+    k_full = jax.random.normal(keys[1], (B, S, H, D))
+    v_full = jax.random.normal(keys[2], (B, S, H, D))
+    full = reference_attention(q_full, k_full, v_full, causal=True)
+
+    cache_k = jnp.concatenate([k_full, jnp.zeros((B, 2, H, D))], axis=1)
+    cache_v = jnp.concatenate([v_full, jnp.zeros((B, 2, H, D))], axis=1)
+    out = reference_attention(
+        q_full[:, 5:6], cache_k, cache_v, causal=True, q_offset=jnp.int32(5)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[:, 0]), np.asarray(full[:, 5]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_guest_probe_ladder():
+    from kata_xpu_device_plugin_tpu.guest import probe_all_reduce, probe_compute, probe_devices
+
+    d = probe_devices(expected=8)
+    assert d["ok"] and d["platform"] == "cpu"
+    assert probe_compute()["ok"]
+    ar = probe_all_reduce()
+    assert ar["ok"] and ar["devices"] == 8
+
+
+def test_flash_block_picking():
+    from kata_xpu_device_plugin_tpu.ops.flash import pick_block, supports
+
+    assert pick_block(2048, 512) == 512
+    assert pick_block(768, 512) == 384  # not 512: must divide
+    assert pick_block(640, 512) == 320
+    assert pick_block(127, 512) is None
+    assert supports(768, 768, 256)
+    assert not supports(100, 100, 256)
+
+
+def test_flash_non_divisible_seq_interpret():
+    # 384-length sequence: block shrinks to a divisor instead of asserting.
+    B, S, H, D = 1, 384, 2, 64
+    keys = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(keys[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(keys[1], (B, S, 1, D), jnp.float32)
+    v = jax.random.normal(keys[2], (B, S, 1, D), jnp.float32)
+    out = pallas_flash_attention(q, k, v, causal=True, interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)

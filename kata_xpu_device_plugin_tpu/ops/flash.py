@@ -29,7 +29,9 @@ def pick_block(seq_len: int, requested: int) -> Optional[int]:
     """Largest usable block ≤ requested: divides ``seq_len``, multiple of 8,
     at least 128 (TPU tile constraints). None when no such block exists —
     callers then take the XLA reference path."""
-    for b in range(min(requested, seq_len), 127, -8):
+    start = min(requested, seq_len)
+    start -= start % 8  # descend over 8-aligned candidates only
+    for b in range(start, 127, -8):
         if seq_len % b == 0:
             return b
     return None

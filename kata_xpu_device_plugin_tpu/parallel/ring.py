@@ -51,8 +51,7 @@ def _local_ring_attention(
     acc = jnp.zeros((B, S, H, D), jnp.float32)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def step(t, carry):
-        k_blk, v_blk, m, l, acc = carry
+    def accumulate(t, k_blk, v_blk, m, l, acc):
         src = (idx - t) % n  # ring owner of the block now resident here
         k_pos = src * S + jnp.arange(S)
         logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
@@ -67,12 +66,22 @@ def _local_ring_attention(
         acc = acc * corr.transpose(0, 2, 1, 3) + jnp.einsum(
             "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
         )
+        return m_new, l, acc
+
+    def step(t, carry):
+        k_blk, v_blk, m, l, acc = carry
+        m, l, acc = accumulate(t, k_blk, v_blk, m, l, acc)
         # Rotate K/V to the next ring neighbor (ICI hop) for the next step.
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
-        return k_nxt, v_nxt, m_new, l, acc
+        return k_nxt, v_nxt, m, l, acc
 
-    k_blk, v_blk, m, l, acc = lax.fori_loop(0, n, step, (k, v, m, l, acc))
+    # The block arriving for step n-1 is consumed OUTSIDE the loop so the
+    # final (dead) ppermute rotation is never emitted — fori_loop bodies are
+    # traced once, so a trailing in-loop rotate would cost a full K+V ICI hop
+    # every call.
+    k_blk, v_blk, m, l, acc = lax.fori_loop(0, n - 1, step, (k, v, m, l, acc))
+    m, l, acc = accumulate(n - 1, k_blk, v_blk, m, l, acc)
     denom = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1, 3)  # [B, S, H, 1]
     return (acc / denom).astype(q.dtype)
 

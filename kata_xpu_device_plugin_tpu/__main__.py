@@ -58,27 +58,51 @@ def _run(args: argparse.Namespace) -> int:
     metrics.serve(cfg.metrics_port)
     mgr = PluginManager(cfg)
 
-    def _on_signal(signum, _frame):
-        logger.info("signal received, shutting down", extra=log.kv(signal=signum))
-        mgr.stop()
+    # Self-pipe shutdown: the handler runs ON the main thread, which may be
+    # mid-start() holding a plugin-server lock, or mid-Event.wait() holding
+    # that event's internal lock — so the handler must not touch locks or
+    # Events at all (manager.request_stop docs). It only writes a byte
+    # (async-signal-safe); a watcher thread does the actual stop request
+    # from a different thread, where Event.set cannot self-deadlock.
+    sig_r, sig_w = os.pipe()
 
+    def _on_signal(signum, _frame):
+        try:
+            os.write(sig_w, bytes([signum & 0x7F]))
+        except OSError:
+            pass
+
+    def _signal_watcher():
+        data = os.read(sig_r, 1)
+        logger.info(
+            "signal received, shutting down",
+            extra=log.kv(signal=data[0] if data else "?"),
+        )
+        mgr.request_stop()
+
+    import threading
+
+    threading.Thread(target=_signal_watcher, name="signal-watcher", daemon=True).start()
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
 
-    mgr.start()
-    mgr.run_forever()  # ref: blocks on <-stop (device_plugin.go:114)
+    try:
+        mgr.start()
+        mgr.run_forever()  # ref: blocks on <-stop (device_plugin.go:114)
+    finally:
+        mgr.stop()
     return 0
 
 
 def _status(args: argparse.Namespace) -> int:
     from .config import from_args
-    from .discovery import scan_tpus, scan_vfio
-    from .discovery.pciids import PciIds
+    from .plugin.manager import PluginManager
 
     cfg = from_args(args)
-    db = PciIds.load(cfg.pci_ids_path or None)
-    tpu = scan_tpus(cfg.sysfs_root, cfg.dev_root, pci_ids=db,
-                    accelerator_type=cfg.accelerator_type or None)
+    # The manager's scan, not a raw scan_tpus: status must report the same
+    # multihost-overlaid identity the daemon writes into CDI specs — but a
+    # read-only command must not touch the daemon's persisted state.
+    tpu, vfio = PluginManager(cfg, state_readonly=True).scan()
     report: dict = {
         "tpu": {
             "resource": cfg.tpu_resource_name,
@@ -96,6 +120,7 @@ def _status(args: argparse.Namespace) -> int:
             "chips_per_host_bounds": tpu.topology.chips_per_host_bounds_str(),
             "num_hosts": tpu.topology.num_hosts,
             "worker_id": tpu.topology.worker_id,
+            "worker_hostnames": list(tpu.topology.worker_hostnames),
         },
         "cdi_specs": sorted(
             os.path.join(cfg.cdi_dir, f)
@@ -104,8 +129,6 @@ def _status(args: argparse.Namespace) -> int:
         ),
     }
     if cfg.vfio_vendors:
-        vendors = () if cfg.vfio_vendors == ("*",) else cfg.vfio_vendors
-        vfio = scan_vfio(cfg.sysfs_root, vendors)
         report["vfio"] = {
             f"{v}:{d}": groups for (v, d), groups in sorted(vfio.models.items())
         }

@@ -49,6 +49,23 @@ class Config:
     libtpu_host_path: str = "/usr/lib/tpu/libtpu.so"  # "" disables the mount
     kata_annotations: bool = True  # attach-pci/bdf hints for Kata hot-plug
 
+    # Multi-host slice identity (SURVEY §7 stage 7). Defaults resolve through
+    # the multihost ladder (flags → TPU_WORKER_* env → metadata dir → derived
+    # from hostname ordering); a standalone host needs none of them.
+    worker_id: int = -1  # -1 = auto
+    worker_hostnames: tuple[str, ...] = ()
+    # Name to match against worker lists. In a non-hostNetwork DaemonSet the
+    # pod's own hostname is the pod name, never a node name — project
+    # spec.nodeName via the downward API into KATA_TPU_NODE_NAME.
+    node_name: str = ""
+    metadata_dir: str = ""  # dir of GCE-TPU-VM-style metadata attribute files
+    state_dir: str = "/var/run/kata-tpu"  # persisted worker identity ("" off)
+
+    # Multislice: several ICI slices cooperating over DCN (MEGASCALE env).
+    num_slices: int = 1
+    slice_id: int = 0
+    megascale_coordinator: str = ""
+
     # Generalized VFIO path. Empty vendor tuple = VFIO discovery disabled;
     # ("*",) = all vendors (the reference pins exactly one vendor, 10de).
     vfio_vendors: tuple[str, ...] = ()
@@ -70,6 +87,21 @@ class Config:
                 raise ValueError(f"unknown device-list strategy: {s!r}")
         if self.cdi_format not in ("yaml", "json"):
             raise ValueError(f"cdi-format must be yaml or json, got {self.cdi_format!r}")
+        if self.num_slices < 1:
+            raise ValueError(f"num-slices must be >= 1, got {self.num_slices}")
+        if self.num_slices > 1 and not 0 <= self.slice_id < self.num_slices:
+            raise ValueError(
+                f"slice-id {self.slice_id} out of range for {self.num_slices} slices"
+            )
+        if len(set(self.worker_hostnames)) != len(self.worker_hostnames):
+            raise ValueError("worker-hostnames contains duplicates")
+        if self.worker_id >= 0 and self.worker_hostnames and (
+            self.worker_id >= len(self.worker_hostnames)
+        ):
+            raise ValueError(
+                f"worker-id {self.worker_id} out of range for "
+                f"{len(self.worker_hostnames)} worker-hostnames"
+            )
 
     @property
     def tpu_resource_name(self) -> str:

@@ -62,6 +62,7 @@ def scan_tpus(
     env: Optional[dict[str, str]] = None,
     pci_ids: Optional[PciIds] = None,
     accelerator_type: Optional[str] = None,
+    resolve_env_identity: bool = True,
 ) -> TpuInventory:
     """One-shot scan (re-run periodically by the manager; the reference never
     rescans — SURVEY §Quirks 9).
@@ -115,10 +116,30 @@ def scan_tpus(
         chip_count=len(chips),
         pci_device_id=next((c.pci_device for c in chips if c.pci_device), None),
     )
+    # Worker identity from env is parsed by the multihost resolver — the one
+    # parser of TPU_WORKER_ID/TPU_WORKER_HOSTNAMES. The manager disables
+    # this (resolve_env_identity=False) because its membership overlay
+    # re-resolves env with the proper --node-name; resolving here too would
+    # duplicate the work and warn with the wrong (pod) hostname.
+    from ..multihost.resolver import env_hostnames, from_env
+
+    mem = (
+        from_env(environ, hostname=environ.get("HOSTNAME", ""))
+        if resolve_env_identity
+        else None
+    )
+    # When no id is derivable the peer list still passes through (worker 0):
+    # dropping TPU_WORKER_HOSTNAMES from the topology would hide the slice's
+    # membership from direct scan_tpus callers.
+    hostnames = (
+        mem.hostnames
+        if mem
+        else (env_hostnames(environ) if resolve_env_identity else ())
+    )
     topo = HostTopology.from_accelerator_type(
         accel_type,
-        worker_id=int(environ.get("TPU_WORKER_ID", "0") or "0"),
-        worker_hostnames=_split_hostnames(environ.get("TPU_WORKER_HOSTNAMES")),
+        worker_id=mem.worker_id if mem else 0,
+        worker_hostnames=hostnames,
     )
     device_id = next((c.pci_device for c in chips if c.pci_device), None)
     suffix = resource_suffix(GOOGLE_VENDOR, device_id, pci_ids) if device_id else "TPU"
@@ -134,9 +155,3 @@ def _is_accel_function(f: sysfs.PciFunction) -> bool:
     if f.device in BUILTIN_GOOGLE_DEVICES:
         return True
     return f.driver not in ("gve", "virtio-pci")
-
-
-def _split_hostnames(raw: Optional[str]) -> tuple[str, ...]:
-    if not raw:
-        return ()
-    return tuple(h for h in raw.split(",") if h)

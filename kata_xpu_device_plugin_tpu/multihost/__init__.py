@@ -1,0 +1,31 @@
+"""Multi-host slice coordination (SURVEY §7 stage 7).
+
+The reference has zero cross-node logic — its co-allocation unit (the IOMMU
+group, ``device_plugin.go:31``) never spans hosts. A TPU v5p-16 slice does:
+four hosts, each running its own plugin in its own DaemonSet pod, must hand
+their Kata guests a *consistent* view of the slice — the same ordered
+``TPU_WORKER_HOSTNAMES`` everywhere and a unique ``TPU_WORKER_ID`` per host —
+or libtpu/XLA inside the guests cannot bring up ICI/DCN.
+
+Design constraints (SURVEY §7 "Hard parts"): no central coordinator, and the
+assignment must survive pod restarts. Both fall out of making worker-id a
+*pure function of stable inputs*: the slice's hostname list, identical on
+every host because each source (flags, env, metadata) is slice-wide. Every
+host reads the same list independently, finds itself in it, and persists the
+result so a restarted pod keeps its identity even if a metadata source flaps.
+"""
+from .resolver import (
+    SliceMembership,
+    canonical_order,
+    multislice_env,
+    parse_worker_network_endpoints,
+    resolve_membership,
+)
+
+__all__ = [
+    "SliceMembership",
+    "canonical_order",
+    "multislice_env",
+    "parse_worker_network_endpoints",
+    "resolve_membership",
+]

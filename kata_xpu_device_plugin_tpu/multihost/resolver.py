@@ -1,0 +1,423 @@
+"""Worker-id / hostname resolution for multi-host slices.
+
+Sources, in precedence order (first complete answer wins):
+
+1. **Explicit config** — ``--worker-id`` + ``--worker-hostnames`` flags (or
+   their ``KATA_TPU_*`` env forms). The operator's word is final.
+2. **libtpu env** — ``TPU_WORKER_ID`` + ``TPU_WORKER_HOSTNAMES`` already set
+   on the node (GKE TPU node pools set these on TPU-VM node pools).
+3. **Metadata directory** — files named after the GCE TPU-VM metadata
+   attributes, mounted or written by a metadata agent:
+   ``agent-worker-number`` (this host's id) and ``worker-network-endpoints``
+   (the slice's ordered endpoint list). This is how bare TPU VMs learn their
+   identity; the DaemonSet can project the same attributes as files.
+4. **Derived** — given only a peer hostname list (flag/env/metadata) *without*
+   an id, every host takes its own index in that list. Each source's order is
+   authoritative and identical on every host (a DaemonSet hands all pods the
+   same flag/env; the metadata attribute is slice-wide), so the assignment is
+   a pure function of stable inputs → no coordinator, consistent everywhere,
+   stable across restarts. :func:`canonical_order` is exported for genuinely
+   unordered host lists (e.g. DNS-discovered peers).
+
+Whatever resolves is persisted to a state file; on later failures (metadata
+server down after a pod restart) the persisted identity is reused, and on
+*disagreement* the live answer wins but the drift is logged — a resized slice
+is a new slice.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+from dataclasses import dataclass
+from typing import Mapping, Optional, Sequence
+
+from ..utils import log
+
+LOG = log.get("multihost")
+
+# GCE TPU-VM metadata attribute names (surfaced to the plugin as files in a
+# metadata directory; names match the real attributes so an agent can dump
+# them 1:1).
+ATTR_WORKER_NUMBER = "agent-worker-number"
+ATTR_WORKER_ENDPOINTS = "worker-network-endpoints"
+ATTR_ACCEL_TYPE = "accelerator-type"
+
+STATE_FILE = "worker-identity.json"
+
+
+@dataclass(frozen=True)
+class SliceMembership:
+    """This host's resolved identity within its slice."""
+
+    worker_id: int
+    hostnames: tuple[str, ...]  # canonical order; index == worker id
+    source: str  # "config" | "env" | "metadata" | "derived" | "state"
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hostnames) or 1
+
+
+_ORDINAL_RE = re.compile(r"^(.*?)(\d+)$")
+
+
+def _sort_key(hostname: str) -> tuple[str, int]:
+    """Numeric-suffix-aware ordering: ``…-w-10`` sorts after ``…-w-9``.
+
+    GKE multi-host TPU pods/nodes end in an ordinal (``-w-<N>`` on TPU VMs,
+    ``-<N>`` for StatefulSet-style pods); plain lexicographic order would
+    scramble ids past 9 hosts, breaking the id↔coordinate correspondence
+    libtpu expects.
+    """
+    m = _ORDINAL_RE.match(hostname)
+    if m:
+        return (m.group(1), int(m.group(2)))
+    return (hostname, -1)
+
+
+def canonical_order(hostnames: Sequence[str]) -> tuple[str, ...]:
+    """The slice-wide canonical hostname ordering (dedup + ordinal sort)."""
+    return tuple(sorted(dict.fromkeys(hostnames), key=_sort_key))
+
+
+def parse_worker_network_endpoints(raw: str) -> tuple[str, ...]:
+    """Parse the ``worker-network-endpoints`` metadata attribute.
+
+    Real-world shapes: comma-separated workers, each worker either a bare
+    hostname/IP or colon-joined fields (``<id>:<ip>:<port>`` on TPU VMs).
+    The *order* of the attribute is the worker order — preserved, not
+    re-sorted: the metadata service is authoritative about ids.
+    """
+    out = []
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        out.append(_pick_host(entry.split(":")))
+    return tuple(out)
+
+
+_IPV4 = re.compile(r"\d+\.\d+\.\d+\.\d+")
+
+
+def _pick_host(fields: Sequence[str]) -> str:
+    """Best addressable field of one endpoint: hostname > IPv4 > first."""
+    for f in fields:
+        if f and not f.isdigit() and not _IPV4.fullmatch(f):
+            return f
+    for f in fields:
+        if _IPV4.fullmatch(f):
+            return f
+    return fields[0]
+
+
+def _match_self(hostnames: Sequence[str], hostname: str) -> Optional[int]:
+    """Index of this host in the list; exact match first, then short-name
+    match (metadata lists FQDNs while the pod sees the short hostname).
+    The short-name fallback never applies to IPs — '10.0.0.9' must not
+    "match" '10.0.0.1' via their shared first octet."""
+    for i, h in enumerate(hostnames):
+        if h == hostname:
+            return i
+    if _IPV4.fullmatch(hostname):
+        return None
+    short = hostname.split(".")[0]
+    for i, h in enumerate(hostnames):
+        if not _IPV4.fullmatch(h) and h.split(".")[0] == short:
+            return i
+    return None
+
+
+def _read_attr(metadata_dir: str, name: str) -> Optional[str]:
+    try:
+        with open(os.path.join(metadata_dir, name)) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+# ----- state persistence ---------------------------------------------------
+
+
+def _state_path(state_dir: str) -> str:
+    return os.path.join(state_dir, STATE_FILE)
+
+
+def load_state(state_dir: str) -> Optional[SliceMembership]:
+    try:
+        with open(_state_path(state_dir)) as f:
+            raw = json.load(f)
+        return SliceMembership(
+            worker_id=int(raw["worker_id"]),
+            hostnames=tuple(raw["hostnames"]),
+            source="state",
+        )
+    except (OSError, KeyError, ValueError, TypeError):
+        return None
+
+
+def clear_state(state_dir: str) -> None:
+    try:
+        os.remove(_state_path(state_dir))
+    except OSError:
+        pass
+
+
+def save_state(state_dir: str, mem: SliceMembership) -> None:
+    try:
+        os.makedirs(state_dir, exist_ok=True)
+        tmp = _state_path(state_dir) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"worker_id": mem.worker_id, "hostnames": list(mem.hostnames)}, f)
+        os.replace(tmp, _state_path(state_dir))
+    except OSError:
+        LOG.warning("could not persist worker identity to %s", state_dir)
+
+
+# ----- resolution ----------------------------------------------------------
+
+
+def resolve_membership(
+    env: Optional[Mapping[str, str]] = None,
+    *,
+    hostname: Optional[str] = None,
+    explicit_worker_id: int = -1,
+    explicit_hostnames: Sequence[str] = (),
+    metadata_dir: str = "",
+    state_dir: str = "",
+    num_hosts_hint: int = 0,
+    state_readonly: bool = False,
+    defer_save: bool = False,
+) -> Optional[SliceMembership]:
+    """Resolve this host's slice membership, or None for a single-host node.
+
+    Returns None only when no source mentions peers at all — the node is a
+    standalone host and the default ``worker_id=0`` topology stands.
+    ``num_hosts_hint`` (from the accelerator type) guards the persisted-state
+    fallback: a persisted multi-host identity on a node whose hardware now
+    says "standalone" is a leftover from a deleted slice, not an outage.
+    """
+    env = os.environ if env is None else env
+    hostname = hostname or env.get("HOSTNAME") or socket.gethostname()
+
+    mem = (
+        _from_config(explicit_worker_id, explicit_hostnames, hostname)
+        or _from_env(env, hostname)
+        or _from_metadata(metadata_dir, hostname)
+    )
+    if mem is None and explicit_worker_id >= 0:
+        # Id pinned but no source resolved a membership (nothing lists peers,
+        # or the metadata entries don't self-match); peers may merge in below.
+        mem = SliceMembership(explicit_worker_id, (), "config")
+    if mem is not None and explicit_worker_id >= 0 and mem.source != "config":
+        # --worker-id without --worker-hostnames: the operator pins the id,
+        # the hostname list still comes from whichever source has it.
+        if mem.hostnames and explicit_worker_id >= len(mem.hostnames):
+            LOG.warning(
+                "--worker-id %d exceeds the %d-host list from %s; honoring it anyway",
+                explicit_worker_id,
+                len(mem.hostnames),
+                mem.source,
+            )
+        mem = SliceMembership(explicit_worker_id, mem.hostnames, "config")
+    if mem is not None and not mem.hostnames:
+        # A bare id (GKE sets TPU_WORKER_ID alone on some pools, or a pinned
+        # --worker-id) answers "who am I" but not "who else is there" — a
+        # later source (or the persisted state during an outage) may still
+        # know the peer list; the resolved id stays authoritative.
+        peers = _metadata_hostnames(metadata_dir)
+        if not peers and state_dir and (st := load_state(state_dir)) is not None:
+            # Persisted peers are only trusted when they corroborate the
+            # live id and don't contradict an authoritative topology hint —
+            # a node reused in a different pool must not resurrect a deleted
+            # slice's peer list just because GKE still sets a bare id.
+            if num_hosts_hint and st.num_hosts != num_hosts_hint:
+                LOG.warning(
+                    "discarding persisted peer list (%d hosts): this node's "
+                    "topology implies %d host(s) — slice was deleted",
+                    st.num_hosts,
+                    num_hosts_hint,
+                )
+                if not state_readonly:
+                    clear_state(state_dir)
+            elif st.worker_id == mem.worker_id:
+                peers = st.hostnames
+        if peers and mem.worker_id >= len(peers):
+            LOG.warning(
+                "worker id %d is not addressable in the %d-host peer list %s; "
+                "ignoring the peers",
+                mem.worker_id,
+                len(peers),
+                peers,
+            )
+            peers = ()
+        if peers:
+            mem = SliceMembership(mem.worker_id, peers, mem.source)
+
+    if mem is None:
+        if state_dir and (persisted := load_state(state_dir)) is not None:
+            if num_hosts_hint and persisted.num_hosts != num_hosts_hint:
+                LOG.warning(
+                    "discarding persisted identity (id=%d, %d hosts): this "
+                    "node's topology implies %d host(s) — slice was deleted",
+                    persisted.worker_id,
+                    persisted.num_hosts,
+                    num_hosts_hint,
+                )
+                if not state_readonly:
+                    clear_state(state_dir)
+                return None
+            LOG.info(
+                "no live identity source; reusing persisted worker id %d",
+                persisted.worker_id,
+            )
+            return persisted
+        return None
+
+    if not defer_save and not state_readonly:
+        persist_membership(state_dir, mem)
+    return mem
+
+
+def persist_membership(state_dir: str, mem: SliceMembership) -> None:
+    """Commit an ACCEPTED membership to the state file (drift-aware,
+    no-op when unchanged). Callers that validate further — the manager
+    checks the membership against the hardware topology — resolve with
+    ``defer_save=True`` and call this only on acceptance, so a refused
+    identity never haunts later rescans/restarts from disk."""
+    if not state_dir or not mem.hostnames:
+        # Hostname-less memberships are never persisted: they carry nothing a
+        # restart couldn't re-derive, and must not clobber a complete
+        # identity saved while the metadata source was up.
+        return
+    prior = load_state(state_dir)
+    if prior is not None and (
+        prior.worker_id != mem.worker_id or prior.hostnames != mem.hostnames
+    ):
+        LOG.warning(
+            "worker identity drifted (was id=%d/%d hosts, now id=%d/%d hosts) "
+            "— slice was likely recreated",
+            prior.worker_id,
+            prior.num_hosts,
+            mem.worker_id,
+            mem.num_hosts,
+        )
+    if prior is None or (prior.worker_id, prior.hostnames) != (
+        mem.worker_id,
+        mem.hostnames,
+    ):
+        save_state(state_dir, mem)
+
+
+def _from_config(
+    worker_id: int, hostnames: Sequence[str], hostname: str
+) -> Optional[SliceMembership]:
+    """Operator-supplied flags. Order is preserved, not canonicalized — a
+    DaemonSet hands every pod the identical flag value, and with an explicit
+    ``--worker-id`` the position of each host in the list IS the operator's
+    id assignment (re-sorting would scramble it)."""
+    if not hostnames:
+        return None
+    hosts = tuple(dict.fromkeys(hostnames))
+    if worker_id >= 0:
+        if worker_id >= len(hosts):
+            LOG.error(
+                "--worker-id %d out of range for %d worker-hostnames; ignoring flags",
+                worker_id,
+                len(hosts),
+            )
+            return None
+        return SliceMembership(worker_id, hosts, "config")
+    idx = _match_self(hosts, hostname)
+    if idx is None:
+        LOG.warning("this host %r is not in --worker-hostnames %s", hostname, hosts)
+        return None
+    return SliceMembership(idx, hosts, "derived")
+
+
+def env_hostnames(env: Mapping[str, str]) -> tuple[str, ...]:
+    """The ``TPU_WORKER_HOSTNAMES`` peer list, order preserved (env order is
+    authoritative — GKE sets it slice-wide)."""
+    raw = env.get("TPU_WORKER_HOSTNAMES", "")
+    return tuple(h.strip() for h in raw.split(",") if h.strip())
+
+
+def from_env(env: Mapping[str, str], hostname: str = "") -> Optional[SliceMembership]:
+    """Membership from the libtpu env vars. The ONLY parser of
+    ``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES`` in the framework — discovery
+    delegates here so the contract cannot diverge between layers."""
+    hosts = env_hostnames(env)
+    raw_id = env.get("TPU_WORKER_ID", "").strip()
+    has_id = raw_id.lstrip("-").isdigit() and int(raw_id) >= 0
+    if not hosts:
+        # GKE sets TPU_WORKER_ID even on single-host pools; a bare id is
+        # meaningful (and harmless) without a peer list.
+        return SliceMembership(int(raw_id), (), "env") if has_id else None
+    if has_id:
+        return SliceMembership(int(raw_id), hosts, "env")
+    idx = _match_self(hosts, hostname)
+    if idx is None:
+        LOG.warning(
+            "TPU_WORKER_HOSTNAMES is set but %r is not in it and TPU_WORKER_ID "
+            "is absent — cannot derive a worker id (set --node-name?)",
+            hostname,
+        )
+        return None
+    return SliceMembership(idx, hosts, "derived")
+
+
+_from_env = from_env
+
+
+def _metadata_hostnames(metadata_dir: str) -> tuple[str, ...]:
+    """Just the peer list from metadata — usable even when this host's id
+    comes from elsewhere (bare TPU_WORKER_ID) and self-matching would fail."""
+    if not metadata_dir:
+        return ()
+    raw = _read_attr(metadata_dir, ATTR_WORKER_ENDPOINTS)
+    return parse_worker_network_endpoints(raw) if raw else ()
+
+
+def _from_metadata(metadata_dir: str, hostname: str) -> Optional[SliceMembership]:
+    hosts = _metadata_hostnames(metadata_dir)
+    if not hosts:
+        return None
+    raw_id = _read_attr(metadata_dir, ATTR_WORKER_NUMBER)
+    if raw_id is not None and raw_id.isdigit():
+        return SliceMembership(int(raw_id), hosts, "metadata")
+    idx = _match_self(hosts, hostname)
+    if idx is None:
+        LOG.warning(
+            "metadata lists workers %s but %r is not among them and no "
+            "%s attribute exists — cannot derive a worker id",
+            hosts,
+            hostname,
+            ATTR_WORKER_NUMBER,
+        )
+        return None
+    return SliceMembership(idx, hosts, "derived")
+
+
+# ----- multislice (DCN) ----------------------------------------------------
+
+
+def multislice_env(
+    num_slices: int, slice_id: int, coordinator_address: str
+) -> dict[str, str]:
+    """MEGASCALE env for multislice jobs: several ICI slices cooperating over
+    DCN. Injected alongside the per-slice topology env when the operator
+    configures multislice; libtpu's DCN transport reads these directly.
+    """
+    if num_slices <= 1:
+        return {}
+    if not 0 <= slice_id < num_slices:
+        raise ValueError(f"slice_id {slice_id} out of range for {num_slices} slices")
+    env = {
+        "MEGASCALE_NUM_SLICES": str(num_slices),
+        "MEGASCALE_SLICE_ID": str(slice_id),
+    }
+    if coordinator_address:
+        env["MEGASCALE_COORDINATOR_ADDRESS"] = coordinator_address
+    return env

@@ -8,6 +8,7 @@ shutdown path, per-kind CDI spec files, and the TPU-native spec content
 """
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 from typing import Optional
@@ -19,7 +20,10 @@ from ..discovery import pciids
 from ..discovery.sysfs import read_id_file, read_link_base
 from ..discovery.tpu import TpuInventory, scan_tpus
 from ..discovery.vfio import VfioInventory, scan_vfio
+from ..multihost import multislice_env, resolve_membership
+from ..multihost.resolver import clear_state, persist_membership
 from ..topology import runtime_env
+from ..topology.slice import HostTopology
 from ..utils import log, metrics
 from .allocators import TpuAllocator, VfioAllocator
 from .health import HealthWatcher
@@ -37,6 +41,7 @@ def build_tpu_spec(inv: TpuInventory, cfg: Config) -> cdi.Spec:
     libtpu mount + static slice-topology env shared by every allocation."""
     spec = cdi.Spec(kind=cfg.tpu_cdi_kind, cdi_version=C.CDI_VERSION)
     env = runtime_env(inv.topology)  # static: type, bounds, worker id/hosts
+    env.update(multislice_env(cfg.num_slices, cfg.slice_id, cfg.megascale_coordinator))
     for key, val in sorted(env.items()):
         spec.container_edits.add_env(key, val)
     if cfg.libtpu_host_path and os.path.exists(cfg.libtpu_host_path):
@@ -149,8 +154,11 @@ def vfio_watched_devices(
 class PluginManager:
     """Owns discovery state and the fleet of per-resource plugin servers."""
 
-    def __init__(self, cfg: Config):
+    def __init__(self, cfg: Config, state_readonly: bool = False):
         self.cfg = cfg
+        # True for one-shot introspection (the `status` subcommand): resolve
+        # identity without writing/clearing the daemon's persisted state.
+        self.state_readonly = state_readonly
         self._db = pciids.PciIds.load(cfg.pci_ids_path or None)
         self._lock = threading.Lock()
         self._tpu_inv: Optional[TpuInventory] = None
@@ -182,7 +190,9 @@ class PluginManager:
             cfg.dev_root,
             pci_ids=self._db,
             accelerator_type=cfg.accelerator_type or None,
+            resolve_env_identity=False,  # _apply_membership owns identity
         )
+        tpu_inv = self._apply_membership(tpu_inv)
         if cfg.vfio_vendors:
             vendors = () if cfg.vfio_vendors == ("*",) else cfg.vfio_vendors
             vfio_inv = scan_vfio(cfg.sysfs_root, vendors)
@@ -203,6 +213,96 @@ class PluginManager:
             self._tpu_inv = tpu_inv
             self._vfio_inv = vfio_inv
         return tpu_inv, vfio_inv
+
+    def _apply_membership(self, tpu_inv: TpuInventory) -> TpuInventory:
+        """Overlay the multihost-resolved worker identity onto the scanned
+        topology (SURVEY §7 stage 7). ``scan_tpus`` already honors the libtpu
+        env; this adds the flag/metadata/derived sources and persistence."""
+        cfg = self.cfg
+        topo = tpu_inv.topology
+        # The accelerator type is authoritative when pinned by flag or node
+        # env. Autodetection only counts LOCAL chips — it cannot see the rest
+        # of the slice, so its num_hosts=1 must neither veto a multi-host
+        # membership nor invalidate persisted identity during an outage.
+        authoritative = bool(cfg.accelerator_type) or bool(
+            os.environ.get("TPU_ACCELERATOR_TYPE")
+        )
+        mem = resolve_membership(
+            hostname=cfg.node_name or None,
+            explicit_worker_id=cfg.worker_id,
+            explicit_hostnames=cfg.worker_hostnames,
+            metadata_dir=cfg.metadata_dir,
+            state_dir=cfg.state_dir,
+            num_hosts_hint=topo.num_hosts if authoritative else 0,
+            state_readonly=self.state_readonly,
+            defer_save=True,  # persist only what we ACCEPT below
+        )
+        if mem is None:
+            return tpu_inv
+        accepted = True
+        if mem.num_hosts > 1 and mem.num_hosts != topo.num_hosts:
+            scaled = None if authoritative else self._scale_topology(topo, mem)
+            if scaled is None:
+                # Writing N hostnames against mismatched host bounds would
+                # hand guests a self-contradictory env; fail closed to a
+                # clean SINGLE-host identity covering only the local chips
+                # (a multi-host type with worker 0 everywhere and no peer
+                # list would be just as contradictory).
+                LOG.error(
+                    "refusing %d-host membership: %s implies %d host(s) — fix "
+                    "--accelerator-type or the worker hostname list",
+                    mem.num_hosts,
+                    topo.accelerator_type,
+                    topo.num_hosts,
+                )
+                topo = self._standalone_topology(topo)
+                accepted = False
+            else:
+                topo = scaled
+        else:
+            topo = dataclasses.replace(
+                topo, worker_id=mem.worker_id, worker_hostnames=mem.hostnames
+            )
+        if not self.state_readonly and cfg.state_dir:
+            if accepted:
+                persist_membership(cfg.state_dir, mem)
+            else:
+                # A refused identity must not haunt later rescans/restarts.
+                clear_state(cfg.state_dir)
+        return dataclasses.replace(tpu_inv, topology=topo)
+
+    @staticmethod
+    def _standalone_topology(topo: HostTopology) -> HostTopology:
+        """This host's local chips as a self-consistent single-host slice."""
+        fam = topo.family
+        suffix = (
+            topo.local_chips * 2 if fam.suffix_counts_cores else topo.local_chips
+        )
+        return HostTopology.from_accelerator_type(f"{fam.name}-{suffix}")
+
+    @staticmethod
+    def _scale_topology(topo, mem) -> Optional[HostTopology]:
+        """Rebuild an autodetected single-host topology at the membership's
+        host count (local chips × N hosts), keeping bounds and type
+        consistent with the hostnames the guests will see. Returns None when
+        no valid topology exists at that host count — a partial host (e.g. 4
+        chips of an 8-chip v5e machine) cannot be part of a multi-host slice."""
+        fam = topo.family
+        chips = topo.local_chips * mem.num_hosts
+        suffix = chips * 2 if fam.suffix_counts_cores else chips
+        scaled = HostTopology.from_accelerator_type(
+            f"{fam.name}-{suffix}",
+            worker_id=mem.worker_id,
+            worker_hostnames=mem.hostnames,
+        )
+        if scaled.num_hosts != mem.num_hosts or scaled.local_chips != topo.local_chips:
+            return None
+        LOG.info(
+            "scaled autodetected topology to %s for %d-host membership",
+            scaled.accelerator_type,
+            mem.num_hosts,
+        )
+        return scaled
 
     def write_specs(self) -> list[str]:
         cfg = self.cfg
@@ -227,6 +327,8 @@ class PluginManager:
         )
         self.write_specs()
 
+        if self._stop.is_set():
+            return
         # The TPU plugin always runs — a 0-chip node advertises an empty list
         # (BASELINE config[0] dry run) and picks devices up on rescan.
         self._tpu_plugin = DevicePluginServer(
@@ -242,9 +344,16 @@ class PluginManager:
             socket_dir=cfg.kubelet_socket_dir,
             kubelet_socket=cfg.kubelet_socket,
         )
+        # The plugin must be visible to request_stop() BEFORE start() blocks
+        # in registration backoff, or a signal landing in between would miss
+        # its stop event and wait out the full backoff.
+        if self._stop.is_set():
+            return
         self._tpu_plugin.start(register=register)
 
         for key, groups in vfio_inv.models.items():
+            if self._stop.is_set():
+                return
             self._spawn_vfio_plugin(key, groups, register)
 
         self._watcher = HealthWatcher(
@@ -277,8 +386,13 @@ class PluginManager:
             socket_dir=cfg.kubelet_socket_dir,
             kubelet_socket=cfg.kubelet_socket,
         )
+        # Visible to request_stop() before start() can block (see start()).
+        # Locked: the signal-watcher thread iterates plugins() concurrently.
+        with self._lock:
+            self._vfio_plugins[key] = plugin
+        if self._stop.is_set():
+            return
         plugin.start(register=register)
-        self._vfio_plugins[key] = plugin
         if self._watcher:
             self._watcher.add_plugin(plugin)
 
@@ -303,7 +417,8 @@ class PluginManager:
         out = []
         if self._tpu_plugin:
             out.append(self._tpu_plugin)
-        out.extend(self._vfio_plugins.values())
+        with self._lock:  # rescan thread may be inserting concurrently
+            out.extend(self._vfio_plugins.values())
         return out
 
     def rescan_once(self) -> bool:
@@ -317,6 +432,10 @@ class PluginManager:
         ):
             changed = True
             self._tpu_plugin.state.replace(tpu_watched_devices(tpu_inv))
+        if tpu_inv.topology != old_tpu.topology:
+            # Worker identity can resolve after startup (metadata agent racing
+            # the DaemonSet) — the spec on disk must follow it.
+            changed = True
         if vfio_inv.models != old_vfio.models:
             changed = True
             for key, groups in vfio_inv.models.items():
@@ -342,8 +461,24 @@ class PluginManager:
                 LOG.exception("rescan failed")
 
     def run_forever(self) -> None:
-        """Block until stop() (ref ``<-stop`` at device_plugin.go:114)."""
+        """Block until stop()/request_stop() (ref ``<-stop``,
+        device_plugin.go:114)."""
         self._stop.wait()
+
+    def request_stop(self) -> None:
+        """Shutdown request that takes no plugin-server locks.
+
+        The main thread may be inside ``DevicePluginServer.start()`` holding
+        the server lock (kubelet registration backoff); calling ``stop()``
+        there would deadlock. This only sets events — which is also what
+        wakes register's backoff waits, bounding shutdown latency — and the
+        main loop falls out of :meth:`run_forever` into the real
+        :meth:`stop`. Call from a normal thread (the daemon routes signals
+        through a watcher thread), never directly from a signal handler.
+        """
+        self._stop.set()
+        for plugin in self.plugins():
+            plugin.request_stop()
 
     def stop(self) -> None:
         self._stop.set()

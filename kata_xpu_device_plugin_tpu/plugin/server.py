@@ -257,6 +257,14 @@ class DevicePluginServer(glue.DevicePluginServicer):
                 self._server = None
             self._start_locked(register=True)
 
+    def request_stop(self) -> None:
+        """Server-lock-free stop request, usable while another thread is
+        inside start()/register() holding the server lock: just flips the
+        event that register's dial/backoff waits on. The real teardown must
+        still follow via stop(). (Not async-signal-safe — call from a normal
+        thread, e.g. the daemon's signal-watcher, never a signal handler.)"""
+        self._stop.set()
+
     def stop(self) -> None:
         # Set the stop flag BEFORE taking the lock: a concurrent restart()
         # may hold it through register()'s retry/backoff, and the flag is

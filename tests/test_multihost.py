@@ -1,0 +1,619 @@
+"""Multi-host coordination tests (SURVEY §4 "multi-node without a real
+cluster"): several simulated hosts in one process, each with its own metadata
+/ fake sysfs, must independently agree on the slice's worker ordering and
+emit consistent topology env — the invariant libtpu needs across the Kata
+pods of one v5p-16 slice (SURVEY §7 stage 7, hard part #3)."""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from kata_xpu_device_plugin_tpu.config import Config
+from kata_xpu_device_plugin_tpu.discovery.sysfs import FakeSysfsBuilder
+from kata_xpu_device_plugin_tpu.multihost import (
+    SliceMembership,
+    canonical_order,
+    multislice_env,
+    parse_worker_network_endpoints,
+    resolve_membership,
+)
+from kata_xpu_device_plugin_tpu.multihost.resolver import load_state
+from kata_xpu_device_plugin_tpu.plugin.manager import PluginManager, build_tpu_spec
+
+HOSTS4 = ("t1v-n-abc-w-0", "t1v-n-abc-w-1", "t1v-n-abc-w-2", "t1v-n-abc-w-3")
+
+
+# ----- pure helpers --------------------------------------------------------
+
+
+def test_canonical_order_numeric_suffix():
+    # Lexicographic order would put w-10 before w-2; ordinal order must not.
+    hosts = [f"slice-w-{i}" for i in (10, 2, 0, 11, 1)]
+    assert canonical_order(hosts) == tuple(f"slice-w-{i}" for i in (0, 1, 2, 10, 11))
+
+
+def test_canonical_order_dedup_and_plain_names():
+    assert canonical_order(["b", "a", "b"]) == ("a", "b")
+
+
+def test_parse_worker_network_endpoints_tpu_vm_shape():
+    raw = "t1v-w-0:10.130.0.9:8476, t1v-w-1:10.130.0.10:8476"
+    assert parse_worker_network_endpoints(raw) == ("t1v-w-0", "t1v-w-1")
+
+
+def test_parse_worker_network_endpoints_bare_ips_and_hosts():
+    assert parse_worker_network_endpoints("10.0.0.1:8476,10.0.0.2") == (
+        "10.0.0.1",
+        "10.0.0.2",
+    )
+    assert parse_worker_network_endpoints("a.internal,b.internal") == (
+        "a.internal",
+        "b.internal",
+    )
+
+
+def test_multislice_env():
+    assert multislice_env(1, 0, "") == {}
+    env = multislice_env(4, 2, "coord:8080")
+    assert env["MEGASCALE_NUM_SLICES"] == "4"
+    assert env["MEGASCALE_SLICE_ID"] == "2"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "coord:8080"
+    with pytest.raises(ValueError):
+        multislice_env(4, 4, "")
+
+
+# ----- resolution ladder ---------------------------------------------------
+
+
+def test_resolve_standalone_host_is_none(tmp_path):
+    assert (
+        resolve_membership({}, hostname="solo", state_dir=str(tmp_path)) is None
+    )
+
+
+def test_resolve_explicit_config_wins_over_env():
+    mem = resolve_membership(
+        {"TPU_WORKER_ID": "3", "TPU_WORKER_HOSTNAMES": "x,y,z,w"},
+        hostname="h-w-1",
+        explicit_worker_id=1,
+        explicit_hostnames=HOSTS4,
+    )
+    assert mem == SliceMembership(1, HOSTS4, "config")
+
+
+def test_resolve_env_is_authoritative_and_unsorted():
+    # GKE sets both vars together; env order must be preserved as-is.
+    mem = resolve_membership(
+        {"TPU_WORKER_ID": "2", "TPU_WORKER_HOSTNAMES": "c,a,b"}, hostname="zz"
+    )
+    assert mem == SliceMembership(2, ("c", "a", "b"), "env")
+
+
+def test_resolve_env_hostnames_without_id_derives_own_index():
+    mem = resolve_membership(
+        {"TPU_WORKER_HOSTNAMES": "a,b,c"}, hostname="b.cluster.local"
+    )
+    assert mem is not None and (mem.worker_id, mem.source) == (1, "derived")
+
+
+def _write_metadata(d, endpoints, worker_number=None):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "worker-network-endpoints"), "w") as f:
+        f.write(endpoints)
+    if worker_number is not None:
+        with open(os.path.join(d, "agent-worker-number"), "w") as f:
+            f.write(str(worker_number))
+
+
+def test_resolve_metadata_directory(tmp_path):
+    md = tmp_path / "md"
+    _write_metadata(md, ",".join(f"{h}:10.0.0.{i}:8476" for i, h in enumerate(HOSTS4)), 2)
+    mem = resolve_membership({}, hostname="unrelated", metadata_dir=str(md))
+    assert mem == SliceMembership(2, HOSTS4, "metadata")
+
+
+def test_each_simulated_host_agrees_without_coordinator(tmp_path):
+    """16 hosts, ordinals crossing 9, no worker-number attribute anywhere:
+    every host derives its id purely from the shared hostname list."""
+    hosts = tuple(f"pod-w-{i}" for i in range(16))
+    seen = {}
+    for h in sorted(hosts, reverse=True):  # resolution order must not matter
+        mem = resolve_membership({}, hostname=h, explicit_hostnames=list(hosts))
+        assert mem is not None and mem.hostnames == canonical_order(hosts)
+        seen[h] = mem.worker_id
+    assert sorted(seen.values()) == list(range(16))
+    assert seen["pod-w-10"] == 10  # ordinal, not lexicographic
+
+
+def test_resolve_persists_and_survives_source_loss(tmp_path):
+    md, state = str(tmp_path / "md"), str(tmp_path / "state")
+    _write_metadata(md, ",".join(HOSTS4), 3)
+    first = resolve_membership({}, hostname="x", metadata_dir=md, state_dir=state)
+    assert first is not None and first.worker_id == 3
+    assert load_state(state) is not None
+    # Pod restart with the metadata agent down: identity must not change.
+    again = resolve_membership({}, hostname="x", metadata_dir="", state_dir=state)
+    assert again is not None
+    assert (again.worker_id, again.hostnames, again.source) == (3, HOSTS4, "state")
+
+
+def test_resolve_live_source_wins_over_stale_state(tmp_path):
+    md, state = str(tmp_path / "md"), str(tmp_path / "state")
+    _write_metadata(md, ",".join(HOSTS4), 1)
+    resolve_membership({}, hostname="x", metadata_dir=md, state_dir=state)
+    _write_metadata(md, ",".join(HOSTS4[:2]), 0)  # slice recreated smaller
+    mem = resolve_membership({}, hostname="x", metadata_dir=md, state_dir=state)
+    assert mem is not None and (mem.worker_id, mem.num_hosts) == (0, 2)
+    persisted = load_state(state)
+    assert persisted is not None and persisted.worker_id == 0
+
+
+def test_host_not_in_list_resolves_none():
+    assert resolve_membership({}, hostname="stranger", explicit_hostnames=HOSTS4) is None
+
+
+def test_explicit_id_preserves_operator_hostname_order():
+    # Position in the operator's list IS the id assignment; never re-sort it.
+    mem = resolve_membership(
+        {}, hostname="x", explicit_worker_id=0, explicit_hostnames=("c", "a", "b")
+    )
+    assert mem == SliceMembership(0, ("c", "a", "b"), "config")
+
+
+def test_explicit_id_out_of_range_is_rejected():
+    mem = resolve_membership(
+        {"TPU_WORKER_HOSTNAMES": "a,b"},
+        hostname="b",
+        explicit_worker_id=7,
+        explicit_hostnames=("a", "b"),
+    )
+    # The flag *pair* is invalid and dropped, but the pinned id still
+    # overrides the env-derived answer (operator's word is final; warned).
+    assert mem is not None and mem.worker_id == 7 and mem.source == "config"
+
+
+def test_explicit_id_without_hostnames_is_honored():
+    mem = resolve_membership({}, hostname="x", explicit_worker_id=2)
+    assert mem == SliceMembership(2, (), "config")
+
+
+def test_explicit_id_overrides_env_derived_id():
+    mem = resolve_membership(
+        {"TPU_WORKER_HOSTNAMES": "a,b,c", "TPU_WORKER_ID": "1"},
+        hostname="c",
+        explicit_worker_id=2,
+    )
+    assert mem == SliceMembership(2, ("a", "b", "c"), "config")
+
+
+def test_stale_state_discarded_when_node_repurposed(tmp_path):
+    """A node pulled out of a deleted v5p-32 slice and redeployed standalone
+    must not keep emitting its dead multi-host identity."""
+    md, state = str(tmp_path / "md"), str(tmp_path / "state")
+    _write_metadata(md, ",".join(HOSTS4), 3)
+    resolve_membership({}, hostname="x", metadata_dir=md, state_dir=state)
+    assert load_state(state) is not None
+    # Metadata gone AND the hardware now says single-host:
+    mem = resolve_membership({}, hostname="x", state_dir=state, num_hosts_hint=1)
+    assert mem is None
+    assert load_state(state) is None  # cleared, not just ignored
+
+
+def test_state_not_rewritten_when_unchanged(tmp_path):
+    md, state = str(tmp_path / "md"), str(tmp_path / "state")
+    _write_metadata(md, ",".join(HOSTS4), 1)
+    resolve_membership({}, hostname="x", metadata_dir=md, state_dir=state)
+    path = os.path.join(state, "worker-identity.json")
+    ino = os.stat(path).st_ino
+    resolve_membership({}, hostname="x", metadata_dir=md, state_dir=state)
+    assert os.stat(path).st_ino == ino  # os.replace would have changed it
+
+
+def test_config_validates_multislice_and_worker_id(tmp_path):
+    with pytest.raises(ValueError):
+        Config(num_slices=4, slice_id=4)
+    with pytest.raises(ValueError):
+        Config(num_slices=0)
+    with pytest.raises(ValueError):
+        Config(worker_id=2, worker_hostnames=("a", "b"))
+
+
+# ----- manager integration: a v5p-16 slice as two simulated hosts ----------
+
+
+def _v5p_host(root: str) -> FakeSysfsBuilder:
+    fake = FakeSysfsBuilder(root=root)
+    for i in range(4):
+        fake.add_accel_chip(i)
+        fake.add_pci_function(f"0000:0{i}:05.0", "1ae0", "0062", numa_node=i // 2)
+    return fake
+
+
+def _env_dict(spec) -> dict[str, str]:
+    return dict(e.split("=", 1) for e in spec.container_edits.env)
+
+
+def test_v5p16_two_hosts_emit_consistent_cdi_env(tmp_path):
+    """SURVEY §4's multi-node simulation: one manager per fake host, shared
+    metadata content, distinct worker numbers → CDI specs whose guests can
+    form one slice (same hostnames/bounds, unique ids)."""
+    hostnames = ("vp-w-0", "vp-w-1")
+    envs = []
+    for worker in range(2):
+        root = str(tmp_path / f"host{worker}")
+        fake = _v5p_host(root)
+        md = str(tmp_path / f"md{worker}")
+        _write_metadata(md, ",".join(hostnames), worker)
+        cfg = Config(
+            sysfs_root=fake.sysfs,
+            dev_root=fake.dev,
+            cdi_dir=str(tmp_path / f"cdi{worker}"),
+            accelerator_type="v5p-16",
+            metadata_dir=md,
+            state_dir=str(tmp_path / f"state{worker}"),
+            metrics_port=0,
+            libtpu_host_path="",
+        )
+        mgr = PluginManager(cfg)
+        tpu_inv, _ = mgr.scan()
+        assert tpu_inv.topology.num_hosts == 2
+        envs.append(_env_dict(build_tpu_spec(tpu_inv, cfg)))
+
+    assert envs[0]["TPU_WORKER_ID"] == "0" and envs[1]["TPU_WORKER_ID"] == "1"
+    for key in ("TPU_WORKER_HOSTNAMES", "TPU_HOST_BOUNDS", "TPU_CHIPS_PER_HOST_BOUNDS",
+                "TPU_ACCELERATOR_TYPE"):
+        assert envs[0][key] == envs[1][key], key
+    assert envs[0]["TPU_WORKER_HOSTNAMES"] == "vp-w-0,vp-w-1"
+    assert envs[0]["TPU_HOST_BOUNDS"] == "1,1,2"  # v5p stacks host bricks in z
+
+
+def test_autodetected_topology_scales_to_membership(tmp_path):
+    """No --accelerator-type and no TPU_* env: discovery only sees 4 local
+    chips (v5p device id → 'v5p-8', 1 host). A 2-host membership must scale
+    the topology, not ship 2 hostnames against 1-host bounds."""
+    fake = _v5p_host(str(tmp_path / "host"))
+    md = str(tmp_path / "md")
+    _write_metadata(md, "vp-w-0,vp-w-1", 1)
+    cfg = Config(
+        sysfs_root=fake.sysfs,
+        dev_root=fake.dev,
+        cdi_dir=str(tmp_path / "cdi"),
+        metadata_dir=md,
+        state_dir=str(tmp_path / "state"),
+        metrics_port=0,
+        libtpu_host_path="",
+    )
+    tpu_inv, _ = PluginManager(cfg).scan()
+    topo = tpu_inv.topology
+    assert topo.accelerator_type == "v5p-16"
+    assert (topo.num_hosts, topo.worker_id) == (2, 1)
+    assert topo.host_bounds_str() == "1,1,2"
+
+
+def test_authoritative_type_mismatch_fails_closed(tmp_path):
+    """An explicit single-host accelerator type contradicting a 2-host
+    membership must not produce a self-contradictory guest env."""
+    fake = _v5p_host(str(tmp_path / "host"))
+    md = str(tmp_path / "md")
+    _write_metadata(md, "vp-w-0,vp-w-1", 1)
+    cfg = Config(
+        sysfs_root=fake.sysfs,
+        dev_root=fake.dev,
+        cdi_dir=str(tmp_path / "cdi"),
+        accelerator_type="v5p-8",  # pinned: 1 host
+        metadata_dir=md,
+        state_dir="",
+        metrics_port=0,
+        libtpu_host_path="",
+    )
+    tpu_inv, _ = PluginManager(cfg).scan()
+    topo = tpu_inv.topology
+    assert (topo.num_hosts, topo.worker_id, topo.worker_hostnames) == (1, 0, ())
+
+
+def test_autodetect_outage_keeps_persisted_identity(tmp_path):
+    """Metadata agent down on restart + autodetected type: num_hosts=1 from
+    local chips must NOT clear the persisted 2-host identity."""
+    fake = _v5p_host(str(tmp_path / "host"))
+    md, state = str(tmp_path / "md"), str(tmp_path / "state")
+    _write_metadata(md, "vp-w-0,vp-w-1", 1)
+    base = dict(
+        sysfs_root=fake.sysfs,
+        dev_root=fake.dev,
+        cdi_dir=str(tmp_path / "cdi"),
+        state_dir=state,
+        metrics_port=0,
+        libtpu_host_path="",
+    )
+    PluginManager(Config(metadata_dir=md, **base)).scan()
+    assert load_state(state) is not None
+    import shutil
+
+    shutil.rmtree(md)
+    tpu_inv, _ = PluginManager(Config(metadata_dir=md, **base)).scan()
+    topo = tpu_inv.topology
+    assert (topo.worker_id, topo.worker_hostnames) == (1, ("vp-w-0", "vp-w-1"))
+    assert topo.num_hosts == 2  # scaled from persisted membership
+
+
+def test_from_env_bare_worker_id():
+    from kata_xpu_device_plugin_tpu.multihost.resolver import from_env
+
+    assert from_env({"TPU_WORKER_ID": "0"}) == SliceMembership(0, (), "env")
+    assert from_env({}) is None
+
+
+def test_bare_env_id_merges_metadata_hostnames(tmp_path):
+    """GKE sets TPU_WORKER_ID alone on some pools; the peer list from
+    metadata must still reach the guests (id stays authoritative)."""
+    md = str(tmp_path / "md")
+    _write_metadata(md, ",".join(HOSTS4))  # endpoints only, no worker-number
+    mem = resolve_membership(
+        {"TPU_WORKER_ID": "2"}, hostname="unmatched", metadata_dir=md
+    )
+    assert mem is not None
+    assert (mem.worker_id, mem.hostnames, mem.source) == (2, HOSTS4, "env")
+
+
+def test_bare_env_id_merges_persisted_hostnames_and_does_not_clobber(tmp_path):
+    md, state = str(tmp_path / "md"), str(tmp_path / "state")
+    _write_metadata(md, ",".join(HOSTS4), 2)
+    resolve_membership({}, hostname="x", metadata_dir=md, state_dir=state)
+    import shutil
+
+    shutil.rmtree(md)  # metadata outage; only the bare env id remains
+    mem = resolve_membership(
+        {"TPU_WORKER_ID": "2"}, hostname="x", metadata_dir=md, state_dir=state
+    )
+    assert mem is not None and mem.hostnames == HOSTS4
+    persisted = load_state(state)  # complete identity must survive untouched
+    assert persisted is not None and persisted.hostnames == HOSTS4
+
+
+def test_authoritative_mismatch_strips_env_baked_identity(tmp_path, monkeypatch):
+    """Env carries a 4-host identity that scan_tpus bakes into the topology;
+    a pinned 1-host accelerator type must strip it, not half-refuse it."""
+    fake = _v5p_host(str(tmp_path / "host"))
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "a,b,c,d")
+    cfg = Config(
+        sysfs_root=fake.sysfs,
+        dev_root=fake.dev,
+        cdi_dir=str(tmp_path / "cdi"),
+        accelerator_type="v5p-8",  # authoritative: 1 host
+        state_dir="",
+        metrics_port=0,
+        libtpu_host_path="",
+    )
+    tpu_inv, _ = PluginManager(cfg).scan()
+    topo = tpu_inv.topology
+    assert (topo.num_hosts, topo.worker_id, topo.worker_hostnames) == (1, 0, ())
+    env = _env_dict(build_tpu_spec(tpu_inv, cfg))
+    assert "TPU_WORKER_HOSTNAMES" not in env and env["TPU_WORKER_ID"] == "0"
+
+
+def test_partial_host_cannot_scale_to_multihost(tmp_path):
+    """4 chips of an 8-chip v5e machine + a claimed 2-host membership: no
+    valid topology exists — fail closed instead of inventing 'v5litepod-8'
+    (which would be ONE 8-chip host, not two 4-chip ones)."""
+    fake = FakeSysfsBuilder(root=str(tmp_path / "host"))
+    for i in range(4):
+        fake.add_accel_chip(i)
+        fake.add_pci_function(f"0000:0{i}:04.0", "1ae0", "0063", numa_node=0)
+    md = str(tmp_path / "md")
+    _write_metadata(md, "e-w-0,e-w-1", 1)
+    cfg = Config(
+        sysfs_root=fake.sysfs,
+        dev_root=fake.dev,
+        cdi_dir=str(tmp_path / "cdi"),
+        metadata_dir=md,
+        state_dir="",
+        metrics_port=0,
+        libtpu_host_path="",
+    )
+    tpu_inv, _ = PluginManager(cfg).scan()
+    topo = tpu_inv.topology
+    assert (topo.num_hosts, topo.worker_id, topo.worker_hostnames) == (1, 0, ())
+
+
+def test_ip_hostname_never_short_name_matches():
+    # '10.0.0.9' must not claim worker 0 of a slice listed as bare IPs.
+    mem = resolve_membership(
+        {}, hostname="10.0.0.9", explicit_hostnames=("10.0.0.1", "10.0.0.2")
+    )
+    assert mem is None
+    mem = resolve_membership(
+        {}, hostname="10.0.0.2", explicit_hostnames=("10.0.0.1", "10.0.0.2")
+    )
+    assert mem is not None and mem.worker_id == 1  # exact IP match still works
+
+
+def test_explicit_flag_id_merges_metadata_peers(tmp_path):
+    """--worker-id must get the same peer merge a bare env id gets."""
+    md = str(tmp_path / "md")
+    _write_metadata(md, ",".join(HOSTS4))  # no agent-worker-number
+    mem = resolve_membership(
+        {}, hostname="unmatched", explicit_worker_id=2, metadata_dir=md
+    )
+    assert mem is not None
+    assert (mem.worker_id, mem.hostnames, mem.source) == (2, HOSTS4, "config")
+
+
+def test_authoritative_refusal_rebuilds_standalone_topology(tmp_path):
+    """Fail-closed must not keep multi-host bounds with worker 0 / no peers —
+    the emitted env has to be self-consistent for the LOCAL chips."""
+    fake = _v5p_host(str(tmp_path / "host"))
+    md = str(tmp_path / "md")
+    _write_metadata(md, "a,b,c,d", 1)  # 4 hosts, contradicting v5p-16 (2)
+    cfg = Config(
+        sysfs_root=fake.sysfs,
+        dev_root=fake.dev,
+        cdi_dir=str(tmp_path / "cdi"),
+        accelerator_type="v5p-16",
+        metadata_dir=md,
+        state_dir="",
+        metrics_port=0,
+        libtpu_host_path="",
+    )
+    tpu_inv, _ = PluginManager(cfg).scan()
+    topo = tpu_inv.topology
+    assert (topo.num_hosts, topo.worker_id, topo.worker_hostnames) == (1, 0, ())
+    assert topo.accelerator_type == "v5p-8"  # local 4 chips, not the pinned 16
+    assert topo.host_bounds_str() == "1,1,1"
+
+
+def test_config_rejects_duplicate_worker_hostnames():
+    with pytest.raises(ValueError):
+        Config(worker_hostnames=("a", "a", "b"))
+
+
+def test_status_reports_overlaid_identity(tmp_path, capsys):
+    """`status` must show the identity the daemon actually emits."""
+    import json as jsonlib
+
+    from kata_xpu_device_plugin_tpu.__main__ import main
+
+    fake = _v5p_host(str(tmp_path / "host"))
+    md = str(tmp_path / "md")
+    _write_metadata(md, "vp-w-0,vp-w-1", 1)
+    rc = main([
+        "status", "--json",
+        "--sysfs-root", fake.sysfs, "--dev-root", fake.dev,
+        "--cdi-dir", str(tmp_path / "cdi"), "--metadata-dir", md,
+        "--state-dir", "", "--metrics-port", "0", "--libtpu-host-path", "",
+    ])
+    assert rc == 0
+    report = jsonlib.loads(capsys.readouterr().out)
+    assert report["tpu"]["worker_id"] == 1
+    assert report["tpu"]["worker_hostnames"] == ["vp-w-0", "vp-w-1"]
+    assert report["tpu"]["num_hosts"] == 2
+
+
+def test_persisted_peers_require_id_corroboration(tmp_path):
+    """A reused node where GKE still sets a bare TPU_WORKER_ID must not
+    resurrect a deleted slice's peer list unless the ids agree."""
+    md, state = str(tmp_path / "md"), str(tmp_path / "state")
+    _write_metadata(md, ",".join(HOSTS4), 1)
+    resolve_membership({}, hostname="x", metadata_dir=md, state_dir=state)
+    import shutil
+
+    shutil.rmtree(md)
+    # Different id -> no merge, hostname-less membership stands.
+    mem = resolve_membership({"TPU_WORKER_ID": "0"}, hostname="x", state_dir=state)
+    assert mem is not None and (mem.worker_id, mem.hostnames) == (0, ())
+    # Matching id -> persisted peers corroborate and merge.
+    mem = resolve_membership({"TPU_WORKER_ID": "1"}, hostname="x", state_dir=state)
+    assert mem is not None and (mem.worker_id, mem.hostnames) == (1, HOSTS4)
+
+
+def test_persisted_peers_respect_num_hosts_hint(tmp_path):
+    md, state = str(tmp_path / "md"), str(tmp_path / "state")
+    _write_metadata(md, ",".join(HOSTS4), 1)
+    resolve_membership({}, hostname="x", metadata_dir=md, state_dir=state)
+    import shutil
+
+    shutil.rmtree(md)
+    mem = resolve_membership(
+        {"TPU_WORKER_ID": "1"}, hostname="x", state_dir=state, num_hosts_hint=1
+    )
+    assert mem is not None and mem.hostnames == ()
+    assert load_state(state) is None  # stale state cleared
+
+
+def test_merge_rejects_unaddressable_worker_id(tmp_path):
+    md = str(tmp_path / "md")
+    _write_metadata(md, "a,b")  # 2 peers, no worker-number
+    mem = resolve_membership(
+        {}, hostname="zz", explicit_worker_id=5, metadata_dir=md
+    )
+    assert mem is not None and (mem.worker_id, mem.hostnames) == (5, ())
+
+
+def test_status_never_writes_state(tmp_path, capsys):
+    from kata_xpu_device_plugin_tpu.__main__ import main
+
+    fake = _v5p_host(str(tmp_path / "host"))
+    md, state = str(tmp_path / "md"), str(tmp_path / "state")
+    _write_metadata(md, "vp-w-0,vp-w-1", 1)
+    rc = main([
+        "status", "--json",
+        "--sysfs-root", fake.sysfs, "--dev-root", fake.dev,
+        "--cdi-dir", str(tmp_path / "cdi"), "--metadata-dir", md,
+        "--state-dir", state, "--metrics-port", "0", "--libtpu-host-path", "",
+    ])
+    assert rc == 0
+    capsys.readouterr()
+    assert load_state(state) is None  # read-only: nothing persisted
+
+
+def test_refused_membership_is_never_persisted(tmp_path):
+    """An identity the manager refuses (partial host × claimed multi-host)
+    must not be written to — and must be purged from — the state file."""
+    fake = FakeSysfsBuilder(root=str(tmp_path / "host"))
+    for i in range(4):  # half of an 8-chip v5e machine
+        fake.add_accel_chip(i)
+        fake.add_pci_function(f"0000:0{i}:04.0", "1ae0", "0063", numa_node=0)
+    md, state = str(tmp_path / "md"), str(tmp_path / "state")
+    _write_metadata(md, "e-w-0,e-w-1", 1)
+    cfg = Config(
+        sysfs_root=fake.sysfs,
+        dev_root=fake.dev,
+        cdi_dir=str(tmp_path / "cdi"),
+        metadata_dir=md,
+        state_dir=state,
+        metrics_port=0,
+        libtpu_host_path="",
+    )
+    mgr = PluginManager(cfg)
+    tpu_inv, _ = mgr.scan()
+    assert tpu_inv.topology.num_hosts == 1  # refused, failed closed
+    assert load_state(state) is None  # nothing persisted, nothing to haunt
+
+
+def test_scan_tpus_preserves_env_hostnames_without_id(tmp_path):
+    """Direct scan_tpus callers still see the peer list even when no worker
+    id is derivable from env (pod hostname not in the list)."""
+    from kata_xpu_device_plugin_tpu.discovery import scan_tpus
+
+    fake = _v5p_host(str(tmp_path / "host"))
+    inv = scan_tpus(
+        fake.sysfs, fake.dev, env={"TPU_WORKER_HOSTNAMES": "a,b,c,d"}
+    )
+    assert inv.topology.worker_id == 0
+    assert inv.topology.worker_hostnames == ("a", "b", "c", "d")
+
+
+def test_daemonset_mounts_state_dir():
+    import yaml
+
+    with open(os.path.join(os.path.dirname(__file__), "..", "deploy",
+                           "kata-tpu-device-plugin.yaml")) as f:
+        ds = next(d for d in yaml.safe_load_all(f) if d.get("kind") == "DaemonSet")
+    spec = ds["spec"]["template"]["spec"]
+    vols = {v["name"]: v for v in spec["volumes"]}
+    mounts = {m["name"]: m for m in spec["containers"][0]["volumeMounts"]}
+    assert vols["state"]["hostPath"]["path"] == "/var/run/kata-tpu"
+    assert mounts["state"]["mountPath"] == "/var/run/kata-tpu"
+
+
+def test_multislice_flags_emit_megascale_env(tmp_path):
+    fake = _v5p_host(str(tmp_path / "host"))
+    cfg = Config(
+        sysfs_root=fake.sysfs,
+        dev_root=fake.dev,
+        cdi_dir=str(tmp_path / "cdi"),
+        accelerator_type="v5p-8",
+        num_slices=2,
+        slice_id=1,
+        megascale_coordinator="coord.svc:8080",
+        state_dir="",
+        metrics_port=0,
+        libtpu_host_path="",
+    )
+    mgr = PluginManager(cfg)
+    tpu_inv, _ = mgr.scan()
+    env = _env_dict(build_tpu_spec(tpu_inv, cfg))
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "coord.svc:8080"

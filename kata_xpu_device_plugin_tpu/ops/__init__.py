@@ -1,5 +1,5 @@
-"""TPU compute ops: attention implementations (XLA reference, pallas flash)
-and collective helpers."""
+"""TPU compute ops: attention implementations (XLA reference, pallas flash),
+collective helpers, and the expert-parallel MoE FFN."""
 from .attention import best_attention, flash_attention, reference_attention
 from .collectives import (
     all_gather,
@@ -7,6 +7,15 @@ from .collectives import (
     pmap_all_reduce,
     reduce_scatter,
     ring_all_reduce,
+)
+from .moe import (
+    AXIS_EXPERT,
+    MoEConfig,
+    expert_mesh,
+    init_moe_params,
+    moe_ffn,
+    moe_param_specs,
+    reference_moe,
 )
 
 __all__ = [
@@ -18,4 +27,11 @@ __all__ = [
     "pmap_all_reduce",
     "reduce_scatter",
     "ring_all_reduce",
+    "AXIS_EXPERT",
+    "MoEConfig",
+    "expert_mesh",
+    "init_moe_params",
+    "moe_ffn",
+    "moe_param_specs",
+    "reference_moe",
 ]

@@ -1,0 +1,149 @@
+"""Mixture-of-experts FFN with expert parallelism (Switch-style top-1
+routing, dense dispatch/combine einsums).
+
+The reference runs no model code (SURVEY §2 "parallelism strategies —
+ABSENT"); this completes the guest-side parallelism stack (dp/fsdp/tp/sp +
+pp + ep). TPU-first design: routing is expressed as dense one-hot
+dispatch/combine tensors feeding batched einsums — static shapes, no
+gather/scatter, everything tiles onto the MXU — and expert parallelism is
+pure GSPMD: expert-major tensors carry a sharding constraint on the
+``expert`` mesh axis, and XLA inserts the all-to-all that moves tokens to
+their experts' devices over ICI. No hand-written collectives.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+AXIS_EXPERT = "expert"
+
+Params = dict
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    num_experts: int
+    # Per-expert buffer = ceil(tokens/experts * factor); tokens routed past
+    # it are dropped (their residual stream passes through unchanged).
+    capacity_factor: float = 2.0
+
+
+def expert_mesh(n_devices: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh sharding experts across devices."""
+    from ..parallel.mesh import mesh_1d
+
+    return mesh_1d(n_devices, AXIS_EXPERT, devices)
+
+
+def init_moe_params(key: jax.Array, cfg: MoEConfig, dtype=jnp.float32) -> Params:
+    kr, kg, ki, ko = jax.random.split(key, 4)
+    d, f, e = cfg.d_model, cfg.d_ff, cfg.num_experts
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape) / math.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "router": dense(kr, (d, e), d),
+        "w_gate": dense(kg, (e, d, f), d),  # expert-major: shard dim 0 over ep
+        "w_in": dense(ki, (e, d, f), d),
+        "w_out": dense(ko, (e, f, d), f),
+    }
+
+
+def moe_param_specs() -> Params:
+    """PartitionSpecs for the params: experts sharded, router replicated."""
+    return {
+        "router": P(),
+        "w_gate": P(AXIS_EXPERT),
+        "w_in": P(AXIS_EXPERT),
+        "w_out": P(AXIS_EXPERT),
+    }
+
+
+def _constrain(x: jax.Array, mesh: Optional[Mesh], spec: P) -> jax.Array:
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def moe_ffn(
+    params: Params,
+    x: jax.Array,
+    cfg: MoEConfig,
+    mesh: Optional[Mesh] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Apply the MoE FFN to ``x`` of shape (..., d_model).
+
+    Returns ``(y, aux_loss)`` where ``aux_loss`` is the Switch load-balancing
+    term (num_experts * sum over experts of fraction-routed x mean-prob),
+    minimized at uniform routing.
+    """
+    orig_shape = x.shape
+    tokens = x.reshape(-1, cfg.d_model)
+    n_tok, e = tokens.shape[0], cfg.num_experts
+    capacity = max(1, math.ceil(n_tok / e * cfg.capacity_factor))
+
+    logits = tokens @ params["router"].astype(tokens.dtype)  # (T, E)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # (T,) top-1
+    gate = jnp.max(probs, axis=-1)  # (T,)
+
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T, E)
+    # Position of each token within its expert's buffer (0-based), computed
+    # with a cumsum — static shapes, no sort/scatter.
+    pos = jnp.einsum("te,te->t", jnp.cumsum(onehot, axis=0) - 1.0, onehot)
+    kept = pos < capacity
+    dispatch = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)[:, None, :]
+        * kept[:, None, None]
+    )  # (T, E, C) 0/1
+    combine = dispatch * gate[:, None, None]  # (T, E, C)
+
+    # Token -> expert buffers. Sharding the E axis makes XLA all-to-all the
+    # tokens onto the expert-parallel devices.
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(tokens.dtype), tokens)
+    expert_in = _constrain(expert_in, mesh, P(AXIS_EXPERT, None, None))
+
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"])) * (
+        jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"])
+    )
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+    expert_out = _constrain(expert_out, mesh, P(AXIS_EXPERT, None, None))
+
+    y = jnp.einsum("tec,ecd->td", combine.astype(tokens.dtype), expert_out)
+    # Dropped tokens (over capacity) contribute zero — the caller's residual
+    # connection carries them through, as in Switch Transformer.
+
+    # Switch f_i is the PRE-drop routed fraction: clamping by `kept` would
+    # cap an over-capacity expert's penalty at capacity/T — under-penalizing
+    # exactly the collapsed-router state the loss exists to prevent.
+    frac_routed = jnp.mean(onehot, axis=0)  # (E,)
+    mean_prob = jnp.mean(probs, axis=0)  # (E,)
+    aux_loss = e * jnp.sum(frac_routed * mean_prob)
+    return y.reshape(orig_shape), aux_loss
+
+
+def reference_moe(params: Params, x: jax.Array, cfg: MoEConfig) -> jax.Array:
+    """Per-token direct computation (no capacity, no dispatch tensors): what
+    ``moe_ffn`` must match when capacity is ample."""
+    tokens = x.reshape(-1, cfg.d_model)
+    logits = tokens @ params["router"].astype(tokens.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1).astype(tokens.dtype)
+
+    def per_token(tok, i, g):
+        h = jax.nn.silu(tok @ params["w_gate"][i]) * (tok @ params["w_in"][i])
+        return g * (h @ params["w_out"][i])
+
+    out = jax.vmap(per_token)(tokens, idx, gate)
+    return out.reshape(x.shape)

@@ -1,5 +1,6 @@
 """Parallelism: mesh construction, dp/fsdp/tp sharding rules + train step,
-and sequence-parallel ring attention."""
+sequence-parallel ring attention, GPipe pipeline parallelism, and (via
+ops.moe) expert parallelism."""
 from .mesh import (
     AXIS_DATA,
     AXIS_FSDP,
@@ -8,6 +9,13 @@ from .mesh import (
     build_mesh,
     default_mesh_shape,
     seq_mesh,
+)
+from .pipeline import (
+    AXIS_PIPE,
+    make_pipeline,
+    pipe_mesh,
+    sequential_reference,
+    stack_stage_params,
 )
 from .ring import make_ring_attention
 from .sharding import (
@@ -29,6 +37,11 @@ __all__ = [
     "build_mesh",
     "default_mesh_shape",
     "seq_mesh",
+    "AXIS_PIPE",
+    "make_pipeline",
+    "pipe_mesh",
+    "sequential_reference",
+    "stack_stage_params",
     "make_ring_attention",
     "BATCH_SPEC",
     "PARAM_RULES",

@@ -66,7 +66,17 @@ def build_mesh(
     return Mesh(dev_array, tuple(axis_names))
 
 
+def mesh_1d(
+    n: int, axis_name: str, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """1-D mesh over the first ``n`` devices — shared constructor for the
+    sequence-, pipeline- and expert-parallel axes."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices for axis {axis_name!r}, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n]), (axis_name,))
+
+
 def seq_mesh(n_seq: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """1D mesh for sequence-parallel ring attention tests/benchmarks."""
-    devices = list(devices if devices is not None else jax.devices())[:n_seq]
-    return Mesh(np.asarray(devices), (AXIS_SEQ,))
+    return mesh_1d(n_seq, AXIS_SEQ, devices)

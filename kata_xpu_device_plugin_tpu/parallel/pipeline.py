@@ -1,0 +1,127 @@
+"""Pipeline parallelism: a GPipe microbatch schedule over a 1-D ``pipe``
+mesh axis.
+
+The reference runs no model code (SURVEY §2 "parallelism strategies —
+ABSENT"); this is part of the guest-side capability stack that validates what
+the plugin injects. TPU-first design: the schedule is a single
+``lax.fori_loop`` of compute + ``lax.ppermute`` neighbor exchanges — the
+collective-permute rides ICI between adjacent chips, there is no
+data-dependent Python control flow, and every shape is static so XLA can
+overlap the permute with the next tick's compute.
+
+Layout: stage ``s`` holds slice ``s`` of the stacked stage parameters
+(leading axis sharded over ``pipe``). Microbatches enter at stage 0, flow
+through the ring one hop per tick, and exit at stage ``P-1``; a run of ``M``
+microbatches takes ``M + P - 1`` ticks (the classic GPipe bubble).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+try:  # jax.shard_map is the stable home (v0.8+); experimental before that
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+AXIS_PIPE = "pipe"
+
+
+def _pvary(x: jax.Array, axis: str) -> jax.Array:
+    """Mark ``x`` as device-varying over ``axis`` (no-op on JAX versions
+    whose shard_map has no varying-axis type system)."""
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, (axis,), to="varying")
+    pvary = getattr(lax, "pvary", None)
+    return pvary(x, (axis,)) if pvary is not None else x
+
+
+def pipe_mesh(n_stages: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh for pipeline stages (one stage per device)."""
+    from .mesh import mesh_1d
+
+    return mesh_1d(n_stages, AXIS_PIPE, devices)
+
+
+def stack_stage_params(stage_params: Sequence[Any]) -> Any:
+    """Stack per-stage parameter pytrees along a new leading axis — the axis
+    the pipeline shards over ``pipe``."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params)
+
+
+def make_pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    num_stages: int,
+    mesh: Mesh,
+    axis: str = AXIS_PIPE,
+):
+    """Build ``pipelined(stacked_params, microbatches) -> outputs``.
+
+    ``stage_fn(params, x) -> y`` must preserve ``x``'s shape/dtype (a
+    transformer block does); ``microbatches`` is ``(M, mb, ...)`` and comes
+    back transformed by all ``num_stages`` stages in order, replicated on
+    every device.
+    """
+    if mesh.shape[axis] != num_stages:
+        raise ValueError(
+            f"mesh axis {axis!r} has {mesh.shape[axis]} devices, want {num_stages}"
+        )
+    shifts = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def per_stage(params_blk: Any, mbs: jax.Array) -> jax.Array:
+        stage_idx = lax.axis_index(axis)
+        own_params = jax.tree.map(lambda p: p[0], params_blk)
+        num_mb = mbs.shape[0]
+
+        def tick(t, carry):
+            state, outputs = carry
+            # Stage 0 ingests microbatch t (clamped: past the end it feeds
+            # don't-care values that never reach a valid output slot).
+            inject = lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, num_mb - 1), 0, keepdims=False
+            )
+            x = jnp.where(stage_idx == 0, inject, state)
+            y = stage_fn(own_params, x)
+            # Stage P-1 has just finished microbatch t-(P-1).
+            out_t = t - (num_stages - 1)
+            safe_t = jnp.clip(out_t, 0, num_mb - 1)
+            write = jnp.logical_and(stage_idx == num_stages - 1, out_t >= 0)
+            prev = lax.dynamic_index_in_dim(outputs, safe_t, 0, keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(write, y, prev), safe_t, 0
+            )
+            # One ICI hop: every stage hands its activation to the next.
+            state = lax.ppermute(y, axis, shifts)
+            return state, outputs
+
+        # The loop carry is device-varying (each stage holds different
+        # activations); the zero init must be marked varying over the pipe
+        # axis or the carry types disagree under shard_map's type system.
+        init = jax.tree.map(
+            lambda z: _pvary(z, axis), (jnp.zeros_like(mbs[0]), jnp.zeros_like(mbs))
+        )
+        _, outputs = lax.fori_loop(0, num_mb + num_stages - 1, tick, init)
+        # Only the last stage holds real outputs; psum broadcasts them (all
+        # other stages contribute zeros) so the result is replicated.
+        outputs = jnp.where(stage_idx == num_stages - 1, outputs, jnp.zeros_like(outputs))
+        return lax.psum(outputs, axis)
+
+    return shard_map(per_stage, mesh=mesh, in_specs=(P(axis), P()), out_specs=P())
+
+
+def sequential_reference(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Sequence[Any],
+    mbs: jax.Array,
+) -> jax.Array:
+    """What the pipeline must equal: every microbatch through every stage."""
+    out = mbs
+    for params in stage_params:
+        out = jax.vmap(lambda x, p=params: stage_fn(p, x))(out)
+    return out

@@ -41,6 +41,7 @@ class TpuAllocator:
         cls: str,
         strategies: Sequence[str] = (C.STRATEGY_CDI_CRI,),
         libtpu_host_path: str = "",
+        revalidate: Optional[Callable[[object], bool]] = None,
     ):
         self._inventory = inventory
         self._vendor = vendor
@@ -48,6 +49,11 @@ class TpuAllocator:
         self._strategies = tuple(strategies)
         self._resource = f"{vendor}/{cls}"
         self._libtpu_host_path = libtpu_host_path
+        # Driver-level liveness check supplied by the manager (dev node AND
+        # sysfs class entry / vfio group node — the same pair health
+        # watches); bare existence would hand a pod the orphaned node a
+        # driver unbind leaves behind.
+        self._revalidate = revalidate or (lambda chip: os.path.exists(chip.dev_path))
 
     def allocate(self, device_ids: Sequence[str]) -> pb.ContainerAllocateResponse:
         inv = self._inventory()
@@ -59,8 +65,8 @@ class TpuAllocator:
                 chip = inv.chip(int(dev_id))
             except KeyError:
                 raise AllocationError(f"TPU chip {dev_id} not in current inventory")
-            if not os.path.exists(chip.dev_path):
-                raise AllocationError(f"TPU chip {dev_id} device node vanished")
+            if not self._revalidate(chip):
+                raise AllocationError(f"TPU chip {dev_id} failed liveness re-validation")
             chips.append(chip)
 
         resp = pb.ContainerAllocateResponse()

@@ -9,6 +9,14 @@ detect a kubelet restart and re-register. Differences:
   poll rather than replacing it — char devices like ``/dev/accel*`` don't
   reliably emit create/remove the way ``/dev/vfio/<group>`` does (SURVEY §7
   "Hard parts"), and a poll converges even when events are lost;
+- health is driver-level, not just dev-node existence (SURVEY §7 hard part
+  #4), WITHOUT ever open()ing the nodes — probing an exclusive-open device
+  (vfio groups, accel chips) would race the guest/VMM's own open and make
+  VM startup fail transiently. Instead each chip additionally watches the
+  kernel's driver-state paths: its ``/sys/class/accel`` entry (removed on
+  driver unbind while the stale ``/dev`` node can linger) or, for
+  vfio-bound chips, the ``/dev/vfio/<group>`` node the kernel removes on
+  unbind (``tpu_watched_devices`` pairs them up);
 - one watcher serves all plugins (the reference spawns one per plugin and
   leaks the old one on restart).
 """

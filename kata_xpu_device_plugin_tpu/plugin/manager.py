@@ -17,7 +17,7 @@ from .. import cdi
 from ..cdi import constants as C
 from ..config import Config
 from ..discovery import pciids
-from ..discovery.sysfs import read_id_file, read_link_base
+from ..discovery.sysfs import ACCEL_CLASS_SUBDIR, read_id_file, read_link_base
 from ..discovery.tpu import TpuInventory, scan_tpus
 from ..discovery.vfio import VfioInventory, scan_vfio
 from ..multihost import multislice_env, resolve_membership
@@ -124,15 +124,31 @@ def _container_dev_path(host_path: str, dev_root: str) -> str:
     return host_path
 
 
-def tpu_watched_devices(inv: TpuInventory) -> list[WatchedDevice]:
-    return [
-        WatchedDevice(
-            id=str(chip.index),
-            numa_node=chip.numa_node,
-            watch_paths=(chip.dev_path,),
+def tpu_watched_devices(
+    inv: TpuInventory, sysfs_root: str = "/sys", dev_root: str = "/dev"
+) -> list[WatchedDevice]:
+    """Each chip watches its /dev node AND a driver-state path (SURVEY §7
+    hard part #4): the /sys/class/accel entry for natively-driven chips (a
+    driver unbind removes it while the stale char device can linger), or the
+    /dev/vfio/<group> node for vfio-bound chips (the accel class entry does
+    not exist under vfio-pci; the kernel removes the group node on unbind).
+    Never open()s a node — that would race the guest's exclusive open."""
+    out = []
+    for chip in inv.chips:
+        if chip.vfio_group:
+            driver_path = os.path.join(dev_root, "vfio", chip.vfio_group)
+        else:
+            driver_path = os.path.join(
+                sysfs_root, ACCEL_CLASS_SUBDIR, os.path.basename(chip.dev_path)
+            )
+        out.append(
+            WatchedDevice(
+                id=str(chip.index),
+                numa_node=chip.numa_node,
+                watch_paths=(chip.dev_path, driver_path),
+            )
         )
-        for chip in inv.chips
-    ]
+    return out
 
 
 def vfio_watched_devices(
@@ -333,7 +349,7 @@ class PluginManager:
         # (BASELINE config[0] dry run) and picks devices up on rescan.
         self._tpu_plugin = DevicePluginServer(
             resource_name=cfg.tpu_resource_name,
-            state=DeviceState(tpu_watched_devices(tpu_inv)),
+            state=DeviceState(tpu_watched_devices(tpu_inv, cfg.sysfs_root, cfg.dev_root)),
             allocator=TpuAllocator(
                 self.tpu_inventory,
                 cfg.resource_namespace,
@@ -431,7 +447,9 @@ class PluginManager:
             [c.index for c in tpu_inv.chips] != [c.index for c in old_tpu.chips]
         ):
             changed = True
-            self._tpu_plugin.state.replace(tpu_watched_devices(tpu_inv))
+            self._tpu_plugin.state.replace(
+                tpu_watched_devices(tpu_inv, self.cfg.sysfs_root, self.cfg.dev_root)
+            )
         if tpu_inv.topology != old_tpu.topology:
             # Worker identity can resolve after startup (metadata agent racing
             # the DaemonSet) — the spec on disk must follow it.

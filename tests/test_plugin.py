@@ -164,7 +164,7 @@ def test_allocate_revalidates_dev_node(kubelet, v5e8, short_dir):
     inv = scan_tpus(v5e8.sysfs, v5e8.dev, env={})
     server = DevicePluginServer(
         resource_name="google.com/tpu",
-        state=DeviceState(tpu_watched_devices(inv)),
+        state=DeviceState(tpu_watched_devices(inv, v5e8.sysfs)),
         allocator=TpuAllocator(lambda: inv, "google.com", "tpu"),
         socket_dir=kubelet.socket_dir,
     )
@@ -355,3 +355,53 @@ def test_envvar_strategy_carries_full_guest_contract(kubelet, v5e8, short_dir):
             assert not cr.cdi_devices  # cdi-cri not enabled
     finally:
         mgr.stop()
+
+
+def test_driver_unbind_flips_unhealthy(manager, kubelet, v5e8):
+    """SURVEY §7 hard part #4: a vanished /sys/class/accel entry means the
+    driver is gone — Unhealthy even though the stale /dev node lingers."""
+    import shutil
+
+    plugin = manager.plugins()[0]
+    watcher = HealthWatcher([plugin], use_inotify=False)
+    watcher.evaluate()
+    assert all(d.health == glue.HEALTHY for d in plugin.state.snapshot())
+    shutil.rmtree(os.path.join(v5e8.sysfs, "class/accel/accel5"))
+    watcher.evaluate()
+    health = {d.id: d.health for d in plugin.state.snapshot()}
+    assert health["5"] == glue.UNHEALTHY
+    assert health["0"] == glue.HEALTHY
+    # dev node is still there — existence alone would have said Healthy
+    assert os.path.exists(os.path.join(v5e8.dev, "accel5"))
+
+
+def test_node_alive_errno_classification(monkeypatch, tmp_path):
+    import errno
+    import stat as stat_mod
+
+    from kata_xpu_device_plugin_tpu.plugin import health as H
+
+    # Regular file: existence is the signal.
+    f = tmp_path / "plain"
+    f.write_text("")
+    assert H.node_alive(str(f))
+    assert not H.node_alive(str(tmp_path / "missing"))
+
+    # Char devices: openability decides.
+    class FakeStat:
+        st_mode = stat_mod.S_IFCHR | 0o600
+
+    monkeypatch.setattr(H.os, "stat", lambda p: FakeStat())
+
+    def open_raising(err):
+        def _open(path, flags):
+            raise OSError(err, os.strerror(err), path)
+
+        return _open
+
+    monkeypatch.setattr(H.os, "open", open_raising(errno.EBUSY))
+    assert H.node_alive("/dev/accel0")  # held by a guest: alive
+    monkeypatch.setattr(H.os, "open", open_raising(errno.ENXIO))
+    assert not H.node_alive("/dev/accel0")  # orphaned inode: dead
+    monkeypatch.setattr(H.os, "open", open_raising(errno.ENODEV))
+    assert not H.node_alive("/dev/accel0")

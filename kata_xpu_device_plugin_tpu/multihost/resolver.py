@@ -356,7 +356,20 @@ def from_env(env: Mapping[str, str], hostname: str = "") -> Optional[SliceMember
         # meaningful (and harmless) without a peer list.
         return SliceMembership(int(raw_id), (), "env") if has_id else None
     if has_id:
-        return SliceMembership(int(raw_id), hosts, "env")
+        wid = int(raw_id)
+        if wid >= len(hosts):
+            # Mirror the merge-path guard at resolve_membership: a malformed
+            # node env must not propagate an unaddressable id+peer pair into
+            # the CDI spec env.
+            LOG.warning(
+                "TPU_WORKER_ID %d is not an index into the %d-host "
+                "TPU_WORKER_HOSTNAMES %s; ignoring the peer list",
+                wid,
+                len(hosts),
+                hosts,
+            )
+            return SliceMembership(wid, (), "env")
+        return SliceMembership(wid, hosts, "env")
     idx = _match_self(hosts, hostname)
     if idx is None:
         LOG.warning(

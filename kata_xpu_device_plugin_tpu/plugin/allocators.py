@@ -49,10 +49,12 @@ class TpuAllocator:
         self._strategies = tuple(strategies)
         self._resource = f"{vendor}/{cls}"
         self._libtpu_host_path = libtpu_host_path
-        # Driver-level liveness check supplied by the manager (dev node AND
-        # sysfs class entry / vfio group node — the same pair health
-        # watches); bare existence would hand a pod the orphaned node a
-        # driver unbind leaves behind.
+        # Driver-level liveness check supplied by the manager
+        # (``manager.tpu_chip_alive``: node_alive over the same
+        # dev+driver-state pair health watches); bare existence would hand a
+        # pod the orphaned node a driver unbind leaves behind. The
+        # existence-only fallback applies only to direct construction in
+        # tests.
         self._revalidate = revalidate or (lambda chip: os.path.exists(chip.dev_path))
 
     def allocate(self, device_ids: Sequence[str]) -> pb.ContainerAllocateResponse:

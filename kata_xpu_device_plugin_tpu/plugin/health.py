@@ -10,19 +10,26 @@ detect a kubelet restart and re-register. Differences:
   reliably emit create/remove the way ``/dev/vfio/<group>`` does (SURVEY §7
   "Hard parts"), and a poll converges even when events are lost;
 - health is driver-level, not just dev-node existence (SURVEY §7 hard part
-  #4), WITHOUT ever open()ing the nodes — probing an exclusive-open device
-  (vfio groups, accel chips) would race the guest/VMM's own open and make
-  VM startup fail transiently. Instead each chip additionally watches the
-  kernel's driver-state paths: its ``/sys/class/accel`` entry (removed on
-  driver unbind while the stale ``/dev`` node can linger) or, for
-  vfio-bound chips, the ``/dev/vfio/<group>`` node the kernel removes on
-  unbind (``tpu_watched_devices`` pairs them up);
+  #4), via two complementary signals. Each chip watches the kernel's
+  driver-state paths alongside its dev node: its ``/sys/class/accel`` entry
+  (removed on driver unbind while the stale ``/dev`` node can linger) or,
+  for vfio-bound chips, the ``/dev/vfio/<group>`` node the kernel removes
+  on unbind (``tpu_watched_devices`` pairs them up). On top of existence,
+  :func:`node_alive` classifies char devices by probing with a
+  non-blocking ``open()``: an orphaned inode whose driver is gone answers
+  ``ENXIO``/``ENODEV`` (dead) even though the path exists, while a node
+  held exclusively by a guest answers ``EBUSY`` (alive). The probe is
+  never aimed at a device that currently looks healthy — that would race
+  the VMM's exclusive open every poll — only at confirming recovery of an
+  Unhealthy one, and at allocate time (before any guest holds the node);
 - one watcher serves all plugins (the reference spawns one per plugin and
   leaks the old one on restart).
 """
 from __future__ import annotations
 
+import errno
 import os
+import stat
 import threading
 from typing import Sequence
 
@@ -31,6 +38,37 @@ from .api import glue
 from .server import DevicePluginServer
 
 LOG = log.get("health")
+
+#: errnos from open(2) on a char device that mean "the driver behind this
+#: inode is gone" — the node is a leftover the unbind didn't clean up.
+_ORPHANED_ERRNOS = frozenset({errno.ENXIO, errno.ENODEV})
+
+
+def node_alive(path: str) -> bool:
+    """Driver-level liveness of a device path (ref re-validates sysfs at
+    allocate time, ``generic_device_plugin.go:329-338``; for ``/dev/accel*``
+    the equivalent signal lives behind the inode, not in the path).
+
+    - missing path → dead;
+    - regular files / directories / sysfs entries → existence is the signal;
+    - char devices → a non-blocking ``open()`` probe, classified by errno:
+      ``ENXIO``/``ENODEV`` mean the driver no longer backs the inode (dead);
+      anything else — notably ``EBUSY``/``EACCES`` from a guest's exclusive
+      open — means a live driver answered (alive). A successful open is
+      closed immediately.
+    """
+    try:
+        st = os.stat(path)
+    except OSError:
+        return False
+    if not stat.S_ISCHR(st.st_mode):
+        return True
+    try:
+        fd = os.open(path, os.O_RDONLY | os.O_NONBLOCK | os.O_CLOEXEC)
+    except OSError as e:
+        return e.errno not in _ORPHANED_ERRNOS
+    os.close(fd)
+    return True
 
 
 class HealthWatcher(threading.Thread):
@@ -112,7 +150,19 @@ class HealthWatcher(threading.Thread):
             for dev in plugin.state.snapshot():
                 if not dev.watch_paths:
                     continue
+                # Existence of the dev+driver-state pair decides steady-state
+                # health WITHOUT open()ing anything: probing a healthy,
+                # possibly guest-held node every poll would race the VMM's
+                # exclusive open (the watcher winning the race fails VM
+                # startup). The open-probe classifier runs only to confirm
+                # RECOVERY of an already-Unhealthy device — a lingering node
+                # must answer open(2) (or be guest-held, EBUSY) before it
+                # flips back to Healthy — and at allocate time
+                # (``manager.tpu_chip_alive``), which runs before any guest
+                # can hold the node.
                 alive = all(os.path.exists(p) for p in dev.watch_paths)
+                if alive and dev.health == glue.UNHEALTHY:
+                    alive = all(node_alive(p) for p in dev.watch_paths)
                 health = glue.HEALTHY if alive else glue.UNHEALTHY
                 if plugin.state.set_health(dev.id, health):
                     metrics.health_transitions_total.labels(

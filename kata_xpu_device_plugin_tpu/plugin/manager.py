@@ -25,6 +25,7 @@ from ..multihost.resolver import clear_state, persist_membership
 from ..topology import runtime_env
 from ..topology.slice import HostTopology
 from ..utils import log, metrics
+from . import health
 from .allocators import TpuAllocator, VfioAllocator
 from .health import HealthWatcher
 from .server import DevicePluginServer, DeviceState, WatchedDevice
@@ -132,23 +133,41 @@ def tpu_watched_devices(
     driver unbind removes it while the stale char device can linger), or the
     /dev/vfio/<group> node for vfio-bound chips (the accel class entry does
     not exist under vfio-pci; the kernel removes the group node on unbind).
-    Never open()s a node — that would race the guest's exclusive open."""
-    out = []
-    for chip in inv.chips:
-        if chip.vfio_group:
-            driver_path = os.path.join(dev_root, "vfio", chip.vfio_group)
-        else:
-            driver_path = os.path.join(
-                sysfs_root, ACCEL_CLASS_SUBDIR, os.path.basename(chip.dev_path)
-            )
-        out.append(
-            WatchedDevice(
-                id=str(chip.index),
-                numa_node=chip.numa_node,
-                watch_paths=(chip.dev_path, driver_path),
-            )
+    The same pair also backs allocate-time re-validation via
+    :func:`tpu_chip_alive`."""
+    return [
+        WatchedDevice(
+            id=str(chip.index),
+            numa_node=chip.numa_node,
+            watch_paths=tpu_chip_watch_paths(chip, sysfs_root, dev_root),
         )
-    return out
+        for chip in inv.chips
+    ]
+
+
+def tpu_chip_watch_paths(
+    chip, sysfs_root: str = "/sys", dev_root: str = "/dev"
+) -> tuple[str, str]:
+    """(dev node, driver-state path) — the liveness pair for one chip."""
+    if chip.vfio_group:
+        driver_path = os.path.join(dev_root, "vfio", chip.vfio_group)
+    else:
+        driver_path = os.path.join(
+            sysfs_root, ACCEL_CLASS_SUBDIR, os.path.basename(chip.dev_path)
+        )
+    return (chip.dev_path, driver_path)
+
+
+def tpu_chip_alive(chip, sysfs_root: str = "/sys", dev_root: str = "/dev") -> bool:
+    """Allocate-time liveness: the chip's dev node answers a non-blocking
+    open probe (or is guest-held) AND its driver-state path still exists —
+    the ref's sysfs re-validation (``generic_device_plugin.go:329-338``)
+    done against the same pair the health watcher tracks, so a chip the
+    watcher would flag Unhealthy can never be handed to a pod in the window
+    before the next health pass."""
+    return all(
+        health.node_alive(p) for p in tpu_chip_watch_paths(chip, sysfs_root, dev_root)
+    )
 
 
 def vfio_watched_devices(
@@ -275,6 +294,25 @@ class PluginManager:
                 accepted = False
             else:
                 topo = scaled
+        elif topo.num_hosts > 1 and len(mem.hostnames) != topo.num_hosts:
+            # A bare worker id (pinned --worker-id, or GKE's lone
+            # TPU_WORKER_ID) — or a too-short peer list — on a multi-host
+            # type would hand guests TPU_HOST_BOUNDS implying N hosts with
+            # a missing/short peer list: the same self-contradictory env
+            # the refusal branch above exists to prevent. Fail closed the
+            # same way. (A LONGER list is unreachable here: it makes
+            # mem.num_hosts > 1 != topo.num_hosts, caught above.)
+            LOG.error(
+                "refusing membership with %d hostname(s) (worker id %d) for "
+                "multi-host %s (%d hosts): supply a full --worker-hostnames /"
+                " TPU_WORKER_HOSTNAMES list or a metadata dir",
+                len(mem.hostnames),
+                mem.worker_id,
+                topo.accelerator_type,
+                topo.num_hosts,
+            )
+            topo = self._standalone_topology(topo)
+            accepted = False
         else:
             topo = dataclasses.replace(
                 topo, worker_id=mem.worker_id, worker_hostnames=mem.hostnames
@@ -356,6 +394,9 @@ class PluginManager:
                 cfg.tpu_resource_class,
                 cfg.strategies,
                 libtpu_host_path=cfg.libtpu_host_path,
+                revalidate=lambda chip: tpu_chip_alive(
+                    chip, cfg.sysfs_root, cfg.dev_root
+                ),
             ),
             socket_dir=cfg.kubelet_socket_dir,
             kubelet_socket=cfg.kubelet_socket,

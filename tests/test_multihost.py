@@ -344,6 +344,58 @@ def test_from_env_bare_worker_id():
     assert from_env({}) is None
 
 
+def test_from_env_unaddressable_id_drops_peer_list():
+    """ADVICE r1: TPU_WORKER_ID >= len(TPU_WORKER_HOSTNAMES) is a malformed
+    node env — the id stays (it answers "who am I"), the peers are dropped
+    rather than propagated into the CDI spec env."""
+    from kata_xpu_device_plugin_tpu.multihost.resolver import from_env
+
+    mem = from_env({"TPU_WORKER_ID": "5", "TPU_WORKER_HOSTNAMES": "a,b"})
+    assert mem == SliceMembership(5, (), "env")
+    # in-range id keeps the list
+    mem = from_env({"TPU_WORKER_ID": "1", "TPU_WORKER_HOSTNAMES": "a,b"})
+    assert mem == SliceMembership(1, ("a", "b"), "env")
+
+
+def test_hostnameless_membership_on_multihost_type_fails_closed(tmp_path):
+    """ADVICE r1: a bare worker id overlaid on a multi-host accelerator type
+    would give guests N-host bounds with an empty TPU_WORKER_HOSTNAMES —
+    fail closed to the standalone topology instead."""
+    fake = _v5p_host(str(tmp_path / "host"))
+    cfg = Config(
+        sysfs_root=fake.sysfs,
+        dev_root=fake.dev,
+        cdi_dir=str(tmp_path / "cdi"),
+        accelerator_type="v5p-16",  # authoritative: 2 hosts
+        worker_id=1,  # pinned, but no peer list anywhere
+        metrics_port=0,
+        libtpu_host_path="",
+    )
+    tpu_inv, _ = PluginManager(cfg).scan()
+    topo = tpu_inv.topology
+    assert (topo.num_hosts, topo.worker_id, topo.worker_hostnames) == (1, 0, ())
+
+
+def test_short_peer_list_on_multihost_type_fails_closed(tmp_path):
+    """A 1-entry peer list against a 2-host type is the same contradiction
+    as an empty one (its mem.num_hosts==1 slips past the count-mismatch
+    guard) — must also fail closed to the standalone topology."""
+    fake = _v5p_host(str(tmp_path / "host"))
+    cfg = Config(
+        sysfs_root=fake.sysfs,
+        dev_root=fake.dev,
+        cdi_dir=str(tmp_path / "cdi"),
+        accelerator_type="v5p-16",  # authoritative: 2 hosts
+        worker_id=0,
+        worker_hostnames=("hosta",),  # too short for 2 hosts
+        metrics_port=0,
+        libtpu_host_path="",
+    )
+    tpu_inv, _ = PluginManager(cfg).scan()
+    topo = tpu_inv.topology
+    assert (topo.num_hosts, topo.worker_id, topo.worker_hostnames) == (1, 0, ())
+
+
 def test_bare_env_id_merges_metadata_hostnames(tmp_path):
     """GKE sets TPU_WORKER_ID alone on some pools; the peer list from
     metadata must still reach the guests (id stays authoritative)."""

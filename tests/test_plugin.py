@@ -375,6 +375,96 @@ def test_driver_unbind_flips_unhealthy(manager, kubelet, v5e8):
     assert os.path.exists(os.path.join(v5e8.dev, "accel5"))
 
 
+def test_recovery_requires_live_driver(manager, kubelet, v5e8, monkeypatch):
+    """Flipping back to Healthy is gated on the open-probe: a path that
+    reappears but whose driver answers ENXIO stays Unhealthy; a guest-held
+    node (EBUSY) recovers. Steady-state Healthy never probes (no VMM race)."""
+    import errno
+    import stat as stat_mod
+
+    from kata_xpu_device_plugin_tpu.plugin import health as H
+
+    plugin = manager.plugins()[0]
+    watcher = HealthWatcher([plugin], use_inotify=False)
+    sys_entry = os.path.join(v5e8.sysfs, "class/accel/accel0")
+    shutil.rmtree(sys_entry)
+    watcher.evaluate()
+    assert {d.id: d.health for d in plugin.state.snapshot()}["0"] == glue.UNHEALTHY
+
+    os.makedirs(sys_entry)  # path is back — recovery now hinges on the probe
+    dev0 = os.path.join(v5e8.dev, "accel0")
+    real_stat, real_open = os.stat, os.open
+
+    class CharStat:
+        st_mode = stat_mod.S_IFCHR | 0o600
+
+    monkeypatch.setattr(
+        H.os,
+        "stat",
+        lambda p, *a, **kw: CharStat() if p == dev0 else real_stat(p, *a, **kw),
+    )
+
+    def open_with(err):
+        def _open(path, flags, *a):
+            if path == dev0:
+                raise OSError(err, os.strerror(err), path)
+            return real_open(path, flags, *a)
+
+        return _open
+
+    monkeypatch.setattr(H.os, "open", open_with(errno.ENXIO))
+    watcher.evaluate()
+    assert {d.id: d.health for d in plugin.state.snapshot()}["0"] == glue.UNHEALTHY
+
+    monkeypatch.setattr(H.os, "open", open_with(errno.EBUSY))
+    watcher.evaluate()
+    assert {d.id: d.health for d in plugin.state.snapshot()}["0"] == glue.HEALTHY
+
+
+def test_allocate_revalidates_driver_liveness(manager, kubelet, monkeypatch, v5e8):
+    """VERDICT r1 #2 acceptance: an Allocate against an orphaned char device
+    (open → ENXIO) fails closed, while a guest-held one (EBUSY) allocates."""
+    import errno
+    import stat as stat_mod
+
+    from kata_xpu_device_plugin_tpu.plugin import health as H
+
+    dev0 = os.path.join(v5e8.dev, "accel0")
+    real_stat, real_open = os.stat, os.open
+
+    class CharStat:
+        st_mode = stat_mod.S_IFCHR | 0o600
+
+    def fake_stat(path, *a, **kw):
+        if path == dev0:
+            return CharStat()
+        return real_stat(path, *a, **kw)
+
+    def open_with(err):
+        def _open(path, flags, *a):
+            if path == dev0:
+                raise OSError(err, os.strerror(err), path)
+            return real_open(path, flags, *a)
+
+        return _open
+
+    monkeypatch.setattr(H.os, "stat", fake_stat)
+    req = pb.AllocateRequest(
+        container_requests=[pb.ContainerAllocateRequest(device_ids=["0"])]
+    )
+    ch, stub = kubelet.plugin_stub(kubelet.registrations[0].endpoint)
+    with ch:
+        monkeypatch.setattr(H.os, "open", open_with(errno.EBUSY))
+        resp = stub.Allocate(req)
+        assert resp.container_responses[0].cdi_devices
+
+        monkeypatch.setattr(H.os, "open", open_with(errno.ENXIO))
+        with pytest.raises(grpc.RpcError) as exc:
+            stub.Allocate(req)
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "liveness" in exc.value.details()
+
+
 def test_node_alive_errno_classification(monkeypatch, tmp_path):
     import errno
     import stat as stat_mod

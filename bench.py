@@ -2,7 +2,7 @@
 """Headline benchmark: Gemma-2B-architecture greedy decode throughput on the
 attached TPU (BASELINE.json metric: "tokens/sec/chip").
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 ``vs_baseline`` is the fraction of the chip's memory-bandwidth roofline
 achieved: greedy decode is HBM-bound — every generated token must stream all
@@ -14,17 +14,32 @@ The reference publishes no numbers (SURVEY §6: "published": {}), so the
 roofline is the honest fixed yardstick: 1.0 is perfect, and improvements
 across rounds move the ratio up. Runs single-chip (the only hardware here);
 multi-chip scaling is validated by __graft_entry__.dryrun_multichip.
+
+Hardening (round-1 lesson: one transient backend failure must not cost the
+round's perf evidence). A hung remote-TPU tunnel blocks *inside a native
+call*, where no in-process watchdog (SIGALRM included) can fire — so the
+measurement runs in a KILLABLE WORKER SUBPROCESS under a supervisor:
+
+- the supervisor enforces a hard wall-clock budget per attempt and SIGKILLs
+  a hung worker;
+- failures retry with backoff in a fresh interpreter (a failed PJRT init is
+  sticky in-process);
+- the final attempt pins ``JAX_PLATFORMS=cpu`` with smoke shapes so the
+  round records *something*, clearly labeled with platform + config;
+- after all retries the supervisor still prints a machine-readable
+  diagnostic JSON line and exits nonzero — never a bare stack trace.
+
+Flags: --profile-dir DIR dumps a jax.profiler (xplane) trace of the measured
+decode runs. --smoke runs tiny shapes (harness validation, not the metric).
 """
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-
-from kata_xpu_device_plugin_tpu.models import gemma_2b_bench
-from kata_xpu_device_plugin_tpu.models.transformer import generate, init_params
 
 # Per-chip HBM bandwidth (GB/s) by TPU generation — public spec-sheet numbers.
 HBM_GBPS = {"v5e": 819.0, "v5p": 2765.0, "v4": 1228.0, "v6e": 1640.0, "cpu": 50.0}
@@ -32,45 +47,217 @@ HBM_GBPS = {"v5e": 819.0, "v5p": 2765.0, "v4": 1228.0, "v6e": 1640.0, "cpu": 50.
 BATCH = 8
 PROMPT_LEN = 128
 DECODE_STEPS = 128
+PREFILL_LEN = 2048  # separate prefill metric: long enough for flash to matter
+
+METRIC = "gemma2b_decode_tok_per_s_per_chip"
+
+MAX_ATTEMPTS = int(os.environ.get("KATA_TPU_BENCH_ATTEMPTS", "3"))
+ATTEMPT_TIMEOUT_S = int(os.environ.get("KATA_TPU_BENCH_ATTEMPT_TIMEOUT_S", "1500"))
+SMOKE_TIMEOUT_S = int(os.environ.get("KATA_TPU_BENCH_SMOKE_TIMEOUT_S", "600"))
 
 
-def detect_hbm_gbps() -> float:
-    dev = jax.devices()[0]
-    kind = getattr(dev, "device_kind", "").lower()
+# --------------------------------------------------------------------------
+# Supervisor: retries a killable worker; the ONLY stdout it emits is the one
+# JSON result line (worker stdout is captured, stderr passes through).
+# --------------------------------------------------------------------------
+
+
+def supervise(args: argparse.Namespace) -> int:
+    worker_cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
+    if args.profile_dir:
+        worker_cmd += ["--profile-dir", args.profile_dir]
+    if args.smoke:
+        worker_cmd += ["--smoke"]
+
+    errors: list[str] = []
+    for attempt in range(MAX_ATTEMPTS):
+        env = dict(os.environ)
+        cmd = list(worker_cmd)
+        timeout = SMOKE_TIMEOUT_S if args.smoke else ATTEMPT_TIMEOUT_S
+        if attempt == MAX_ATTEMPTS - 1 and attempt > 0 and not args.smoke:
+            # Last resort: a labeled CPU smoke figure beats an empty round.
+            env["JAX_PLATFORMS"] = "cpu"
+            cmd += ["--smoke", "--fallback"]
+            timeout = SMOKE_TIMEOUT_S
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=sys.stderr, text=True
+        )
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+            errors.append(f"attempt {attempt + 1}: killed after {timeout}s (hung)")
+            out = out or ""
+        line = _last_json_line(out)
+        if proc.returncode == 0 and line is not None:
+            line["attempts"] = attempt + 1
+            print(json.dumps(line), flush=True)
+            return 0
+        if not errors or not errors[-1].startswith(f"attempt {attempt + 1}"):
+            errors.append(
+                f"attempt {attempt + 1}: rc={proc.returncode}, "
+                f"tail={out.strip().splitlines()[-1][:200] if out.strip() else ''}"
+            )
+        if attempt + 1 < MAX_ATTEMPTS:
+            delay = 5.0 * (2**attempt)
+            print(
+                f"bench: {errors[-1]}; retrying in {delay:.0f}s "
+                f"({attempt + 2}/{MAX_ATTEMPTS})",
+                file=sys.stderr,
+                flush=True,
+            )
+            time.sleep(delay)
+
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": None,
+                "unit": "tok/s",
+                "vs_baseline": None,
+                "error": "; ".join(errors)[-1000:],
+                "attempts": MAX_ATTEMPTS,
+                "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+            }
+        ),
+        flush=True,
+    )
+    return 1
+
+
+def _last_json_line(out: str):
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if obj.get("metric") == METRIC:
+                return obj
+    return None
+
+
+# --------------------------------------------------------------------------
+# Worker: one measurement attempt. Raises/exits nonzero on failure; the
+# supervisor owns retries and the kill switch.
+# --------------------------------------------------------------------------
+
+
+def detect_hbm_gbps(dev) -> float:
+    kind = str(getattr(dev, "device_kind", "")).lower()
     for key, bw in HBM_GBPS.items():
         if key in kind:
             return bw
-    return HBM_GBPS["v5e" if dev.platform == "tpu" else "cpu"]
+    from kata_xpu_device_plugin_tpu.ops.attention import on_tpu
+
+    return HBM_GBPS["v5e" if on_tpu() else "cpu"]
 
 
-def main() -> None:
-    cfg = gemma_2b_bench()
+def worker(args: argparse.Namespace) -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # Some platform plugins ignore the env var; pin through jax.config
+        # too (must happen before any backend initializes).
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    devs = jax.devices()
+    if not devs:
+        raise RuntimeError("no devices visible")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kata_xpu_device_plugin_tpu.models import gemma_2b_bench, tiny_test_config
+    from kata_xpu_device_plugin_tpu.models.transformer import (
+        forward,
+        generate,
+        init_params,
+    )
+    from kata_xpu_device_plugin_tpu.ops.attention import (
+        flash_attention,
+        flash_eligible,
+        reference_attention,
+    )
+
+    # A real tiny dispatch: devices() can succeed while the transport is
+    # dead; one add must round-trip before we trust the backend.
+    np.asarray(jnp.ones((8,)) + 1)
+
+    global BATCH, PROMPT_LEN, DECODE_STEPS, PREFILL_LEN
+    if args.smoke:
+        cfg = tiny_test_config()
+        BATCH, PROMPT_LEN, DECODE_STEPS, PREFILL_LEN = 2, 16, 8, 64
+    else:
+        cfg = gemma_2b_bench()
+    max_len = PROMPT_LEN + DECODE_STEPS
+
     key = jax.random.PRNGKey(0)
     params = jax.jit(lambda k: init_params(k, cfg, dtype=jnp.bfloat16))(key)
     jax.block_until_ready(params)
 
-    import numpy as np
-
-    max_len = PROMPT_LEN + DECODE_STEPS
-
     def run(seed: int):
-        # Fresh prompt every iteration and a full device→host transfer of the
-        # result: the remote-device (axon) path can serve repeated identical
+        # Fresh prompt every iteration and a full device→host transfer of
+        # the result: the remote-device tunnel can serve repeated identical
         # executions from cache and does not reliably block on
         # block_until_ready, so only transferred, input-varying runs measure
         # real decode time.
         prompt = jax.random.randint(
-            jax.random.PRNGKey(seed), (BATCH, PROMPT_LEN), 0, cfg.vocab_size,
-            dtype=jnp.int32,
+            jax.random.PRNGKey(seed), (BATCH, PROMPT_LEN), 0,
+            cfg.vocab_size, dtype=jnp.int32,
         )
         np.asarray(prompt)
         t0 = time.perf_counter()
-        out = np.asarray(generate(params, prompt, cfg, steps=DECODE_STEPS, max_len=max_len))
+        out = np.asarray(
+            generate(params, prompt, cfg, steps=DECODE_STEPS, max_len=max_len)
+        )
         return time.perf_counter() - t0, out
 
     run(0)  # warm-up: compiles prefill + decode scan
+
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
     times = [run(seed)[0] for seed in range(1, 4)]
+    if args.profile_dir:
+        jax.profiler.stop_trace()
     dt = min(times)
+
+    # ----- separate prefill metric: pallas flash vs XLA reference ----------
+    prefill_flash = flash_eligible(PREFILL_LEN, PREFILL_LEN, cfg.head_dim)
+
+    def time_prefill(fn) -> float:
+        best = float("inf")
+        for seed in range(4):
+            toks = jax.random.randint(
+                jax.random.PRNGKey(100 + seed), (1, PREFILL_LEN), 0,
+                cfg.vocab_size, dtype=jnp.int32,
+            )
+            np.asarray(toks)
+            t0 = time.perf_counter()
+            np.asarray(fn(params, toks))
+            elapsed = time.perf_counter() - t0
+            if seed > 0:  # first run includes compile
+                best = min(best, elapsed)
+        return best
+
+    # The jitted fns return only the LAST-TOKEN logits: that still forces the
+    # full forward on varying inputs, but the host transfer is ~1 MB instead
+    # of the [S, vocab] fp32 tensor — which at tunnel bandwidth would swamp
+    # the flash-vs-reference delta being measured.
+    prefill_s = {
+        "reference": time_prefill(
+            jax.jit(lambda p, t: forward(p, t, cfg, attn_fn=reference_attention)[:, -1])
+        )
+    }
+    if prefill_flash:
+        prefill_s["flash"] = time_prefill(
+            jax.jit(lambda p, t: forward(p, t, cfg, attn_fn=flash_attention)[:, -1])
+        )
 
     total_tokens = BATCH * DECODE_STEPS  # decode tokens (prefill amortized in)
     tok_per_s = total_tokens / dt
@@ -79,23 +266,49 @@ def main() -> None:
     # mean KV prefix for the whole batch.
     param_bytes = cfg.num_params() * 2
     mean_prefix = PROMPT_LEN + DECODE_STEPS / 2
-    kv_bytes_per_step = (
-        2 * cfg.n_layers * BATCH * mean_prefix * cfg.kv_dim * 2
-    )
-    roofline_steps = detect_hbm_gbps() * 1e9 / (param_bytes + kv_bytes_per_step)
+    kv_bytes_per_step = 2 * cfg.n_layers * BATCH * mean_prefix * cfg.kv_dim * 2
+    roofline_steps = detect_hbm_gbps(devs[0]) * 1e9 / (param_bytes + kv_bytes_per_step)
     roofline_tok_s = roofline_steps * BATCH
 
-    print(
-        json.dumps(
-            {
-                "metric": "gemma2b_decode_tok_per_s_per_chip",
-                "value": round(tok_per_s, 1),
-                "unit": "tok/s",
-                "vs_baseline": round(tok_per_s / roofline_tok_s, 4),
-            }
+    out = {
+        "metric": METRIC,
+        "value": round(tok_per_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_per_s / roofline_tok_s, 4),
+        "platform": devs[0].platform,
+        "device_kind": str(getattr(devs[0], "device_kind", "")),
+        "config": "smoke-tiny" if args.smoke else "gemma2b",
+        "prefill_attn": "pallas_flash" if prefill_flash else "xla_reference",
+        "prefill_tok_per_s": round(PREFILL_LEN / min(prefill_s.values()), 1),
+    }
+    if args.fallback:
+        out["note"] = "cpu fallback after TPU attempts failed; not a TPU number"
+    if prefill_flash:
+        out["prefill_flash_s"] = round(prefill_s["flash"], 4)
+        out["prefill_reference_s"] = round(prefill_s["reference"], 4)
+        out["prefill_flash_speedup"] = round(
+            prefill_s["reference"] / prefill_s["flash"], 3
         )
+    print(json.dumps(out), flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile-dir", default="", help="dump a jax.profiler trace here")
+    ap.add_argument(
+        "--smoke",
+        action="store_true",
+        help="tiny config/shapes: validates the harness end-to-end in seconds "
+        "(the number it prints is NOT the headline metric)",
     )
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--fallback", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.worker:
+        worker(args)
+        return 0
+    return supervise(args)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -148,6 +148,28 @@ def _gate_act(x: jax.Array, kind: str) -> jax.Array:
     raise ValueError(f"unknown activation {kind!r}")
 
 
+def embed(params: Params, tokens: jax.Array, cfg: DecoderConfig) -> jax.Array:
+    """Token embedding (+ Gemma's sqrt(d_model) scaling). Shared by the
+    unpipelined forward and the pipeline-parallel path so the two cannot
+    drift."""
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    if cfg.scale_embeddings:
+        x = x * jnp.asarray(jnp.sqrt(cfg.d_model), cfg.dtype)
+    return x
+
+
+def unembed(params: Params, x: jax.Array, cfg: DecoderConfig) -> jax.Array:
+    """Final norm → (tied) unembedding → fp32 logits (+ optional softcap)."""
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    proj = (params["embed"].T if cfg.tie_embeddings else params["unembed"]).astype(
+        cfg.dtype
+    )
+    logits = (x @ proj).astype(jnp.float32)
+    if cfg.logits_softcap:
+        logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
+    return logits
+
+
 # ----- forward pass --------------------------------------------------------
 
 
@@ -159,6 +181,7 @@ def _layer(
     positions: jax.Array,
     kv_cache: Optional[tuple[jax.Array, jax.Array]] = None,
     cache_offset: Optional[jax.Array] = None,
+    prefill: bool = False,
 ):
     """One decoder block. x: [B, S, D]. Returns (x, new_kv)."""
     B, S, _ = x.shape
@@ -169,9 +192,20 @@ def _layer(
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
 
-    if kv_cache is not None:
-        # Decode/prefill-with-cache: write new k/v at cache_offset, attend to
-        # the whole cache prefix. Static shapes — XLA-friendly.
+    if kv_cache is not None and prefill:
+        # Prefill: the cache is empty, so attention over the FRESH k/v is
+        # exact self-attention — no q_offset, no reading back the max_len
+        # cache. This both skips the dead [S, max_len-S] score region and
+        # makes the shapes eligible for the pallas flash kernel (which is
+        # self-attention only).
+        ck, cv = kv_cache
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        attn_out = attn_fn(q, k, v, causal=True, q_offset=None)
+        new_cache = (ck, cv)
+    elif kv_cache is not None:
+        # Decode: write new k/v at cache_offset, attend to the whole cache
+        # prefix. Static shapes — XLA-friendly.
         ck, cv = kv_cache
         ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_offset, 0, 0))
         cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_offset, 0, 0))
@@ -199,11 +233,15 @@ def forward(
     positions: Optional[jax.Array] = None,
     kv_caches: Optional[tuple[jax.Array, jax.Array]] = None,
     cache_offset: Optional[jax.Array] = None,
+    prefill: bool = False,
 ):
     """Full forward. tokens: [B, S] int32 → logits [B, S, vocab].
 
     With ``kv_caches`` (stacked [L, B, max_len, n_kv, D]) also returns the
     updated caches — one code path serves training, prefill and decode.
+    ``prefill=True`` (static) means the caches are empty: k/v are written at
+    offset 0 and attention runs over the fresh k/v only (self-attention —
+    flash-kernel eligible) instead of reading back the padded cache.
     """
     if attn_fn is None:
         from ..ops.attention import reference_attention
@@ -213,15 +251,16 @@ def forward(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    if cfg.scale_embeddings:
-        x = x * jnp.asarray(jnp.sqrt(cfg.d_model), cfg.dtype)
+    x = embed(params, tokens, cfg)
 
     def body(carry, layer_and_cache):
         x = carry
         if kv_caches is not None:
             layer, (ck, cv) = layer_and_cache
-            x, new_cache = _layer(cfg, attn_fn, x, layer, positions, (ck, cv), cache_offset)
+            x, new_cache = _layer(
+                cfg, attn_fn, x, layer, positions, (ck, cv), cache_offset,
+                prefill=prefill,
+            )
             return x, new_cache
         layer = layer_and_cache
         x, _ = _layer(cfg, attn_fn, x, layer, positions)
@@ -233,13 +272,7 @@ def forward(
         x, _ = lax.scan(body, x, params["layers"])
         new_caches = None
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    unembed = (
-        params["embed"].T if cfg.tie_embeddings else params["unembed"]
-    ).astype(cfg.dtype)
-    logits = (x @ unembed).astype(jnp.float32)
-    if cfg.logits_softcap:
-        logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
+    logits = unembed(params, x, cfg)
     if kv_caches is not None:
         return logits, new_caches
     return logits
@@ -269,16 +302,26 @@ def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "max_len"))
+@partial(jax.jit, static_argnames=("cfg", "steps", "max_len", "attn_fn"))
 def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
-             steps: int, max_len: int = 0):
+             steps: int, max_len: int = 0, attn_fn: Optional[AttnFn] = None):
     """Greedy generation: prefill the prompt, then lax.scan the decode loop
-    (everything under one jit — no per-token dispatch overhead)."""
+    (everything under one jit — no per-token dispatch overhead).
+
+    ``attn_fn`` defaults to :func:`..ops.attention.flash_attention`, whose
+    trace-time dispatch runs the pallas flash kernel for the prefill
+    (self-attention, flash-eligible shapes on TPU) and the XLA reference for
+    the tiny-q decode steps."""
+    if attn_fn is None:
+        from ..ops.attention import flash_attention
+
+        attn_fn = flash_attention
     B, S = prompt.shape
     max_len = max_len or S + steps
     caches = init_kv_caches(cfg, B, max_len)
     logits, caches = forward(
-        params, prompt, cfg, kv_caches=caches, cache_offset=jnp.int32(0)
+        params, prompt, cfg, attn_fn=attn_fn, kv_caches=caches,
+        cache_offset=jnp.int32(0), prefill=True,
     )
     last = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
 
@@ -289,7 +332,7 @@ def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
         caches, tok, pos = carry
         positions = pos[:, None] * jnp.ones((B, 1), jnp.int32)
         logits, caches = forward(
-            params, tok[:, None], cfg, positions=positions,
+            params, tok[:, None], cfg, attn_fn=attn_fn, positions=positions,
             kv_caches=caches, cache_offset=pos[0],
         )
         nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)

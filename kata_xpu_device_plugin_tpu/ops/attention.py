@@ -59,6 +59,34 @@ def reference_attention(
     return out.astype(q.dtype)
 
 
+def on_tpu() -> bool:
+    """True when the default backend executes on TPU hardware — directly
+    (platform ``tpu``) or through a remote-TPU relay plugin whose platform
+    name differs but whose device kind names a TPU generation."""
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    if dev.platform == "tpu":
+        return True
+    kind = str(getattr(dev, "device_kind", "")).lower()
+    return "tpu" in kind or any(g in kind for g in ("v4", "v5e", "v5p", "v6e"))
+
+
+def flash_eligible(sq: int, sk: int, d: int, q_offset=None) -> bool:
+    """Trace-time dispatch decision shared by :func:`flash_attention` and the
+    bench's path reporting: pallas flash runs for self-attention shapes on
+    TPU where a kernel launch pays for itself."""
+    from .flash import supports
+
+    return (
+        on_tpu()
+        and q_offset is None  # decode-into-cache: tiny q, XLA path
+        and sq >= 128
+        and supports(sq, sk, d)
+    )
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -69,17 +97,9 @@ def flash_attention(
     """Pallas flash attention on TPU; falls back to the reference elsewhere
     (pallas interpret mode on CPU is far slower than XLA) and for the tiny
     shapes where a kernel launch can't pay for itself."""
-    from .flash import supports
-
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if (
-        not on_tpu
-        or q_offset is not None  # decode-into-cache: tiny q, XLA path
-        or Sq < 128
-        or not supports(Sq, Sk, D)
-    ):
+    if not flash_eligible(Sq, Sk, D, q_offset):
         return reference_attention(q, k, v, causal=causal, q_offset=q_offset)
     from .flash import pallas_flash_attention
 

@@ -1,6 +1,7 @@
 """Parallelism: mesh construction, dp/fsdp/tp sharding rules + train step,
-sequence-parallel ring attention, GPipe pipeline parallelism, and (via
-ops.moe) expert parallelism."""
+sequence-parallel ring attention, GPipe pipeline parallelism, (via ops.moe)
+expert parallelism, and sharding-aware checkpoint/resume."""
+from .checkpoint import TrainCheckpointer
 from .mesh import (
     AXIS_DATA,
     AXIS_FSDP,
@@ -13,6 +14,7 @@ from .mesh import (
 from .pipeline import (
     AXIS_PIPE,
     make_pipeline,
+    make_transformer_pipeline,
     pipe_mesh,
     sequential_reference,
     stack_stage_params,
@@ -39,6 +41,7 @@ __all__ = [
     "seq_mesh",
     "AXIS_PIPE",
     "make_pipeline",
+    "make_transformer_pipeline",
     "pipe_mesh",
     "sequential_reference",
     "stack_stage_params",
@@ -51,4 +54,5 @@ __all__ = [
     "param_shardings",
     "shard_batch",
     "shard_params",
+    "TrainCheckpointer",
 ]

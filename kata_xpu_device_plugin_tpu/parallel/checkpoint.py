@@ -1,0 +1,111 @@
+"""Sharding-aware train-state checkpoint/resume.
+
+The reference has no checkpointing at all (SURVEY §5 "Checkpoint / resume:
+none") — this is a beyond-parity component required for the BASELINE
+Llama-3-8B training config: a multi-hour run must survive pod preemption
+(the Kata guest can be killed at any step) and resume bit-identically.
+
+TPU-native shape: orbax (the JAX checkpointing library) with OCDBT +
+zarr3 under the hood — each host writes only the shards it owns, and
+restore places shards directly into the target ``NamedSharding``s without
+ever materializing a full array on one device. The wrapper pins the small
+API surface the framework needs (save/restore/latest) so call sites do not
+track orbax API churn.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from ..utils import log
+
+LOG = log.get("checkpoint")
+
+
+def _abstract_like(state: Any) -> Any:
+    """ShapeDtypeStruct tree carrying each leaf's sharding — the restore
+    target spec (restored arrays land already sharded, no host round-trip)."""
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
+
+    return jax.tree.map(leaf, state)
+
+
+class TrainCheckpointer:
+    """Step-indexed train-state checkpoints in one directory.
+
+    ``state`` is any pytree of jax.Arrays — the framework convention is
+    ``{"params": ..., "opt": ..., "step": ...}`` from
+    :func:`.sharding.make_train_step`. Writes are atomic (orbax finalizes a
+    step directory only after all shards land), so a kill mid-save leaves
+    the previous step as ``latest``.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+    ):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True,
+            ),
+        )
+
+    # ----- write -----------------------------------------------------------
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Save ``state`` at ``step``. Returns False when the manager's
+        save-interval policy skips this step (force=True overrides)."""
+        saved = self._mngr.save(
+            int(step), args=self._ocp.args.StandardSave(state), force=force
+        )
+        if saved:
+            LOG.info("checkpoint saved", extra=log.kv(step=int(step), dir=self._dir))
+        return bool(saved)
+
+    def wait(self) -> None:
+        """Block until async writes are durable (call before process exit)."""
+        self._mngr.wait_until_finished()
+
+    # ----- read ------------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        """Restore into the same shapes/dtypes/shardings as ``state_like``
+        (a live or abstract state tree). ``step=None`` means latest."""
+        step = self._mngr.latest_step() if step is None else int(step)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self._dir}")
+        restored = self._mngr.restore(
+            step, args=self._ocp.args.StandardRestore(_abstract_like(state_like))
+        )
+        LOG.info("checkpoint restored", extra=log.kv(step=step, dir=self._dir))
+        return restored
+
+    def close(self) -> None:
+        self._mngr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
+        self.close()
+        return False

@@ -115,6 +115,64 @@ def make_pipeline(
     return shard_map(per_stage, mesh=mesh, in_specs=(P(axis), P()), out_specs=P())
 
 
+def make_transformer_pipeline(
+    cfg,
+    n_stages: int,
+    mesh: Mesh,
+    axis: str = AXIS_PIPE,
+    attn_fn: Optional[Callable] = None,
+):
+    """Pipeline-parallel decoder forward: the ``cfg.n_layers`` transformer
+    blocks are split into ``n_stages`` contiguous chunks, each chunk living
+    on one device of the ``pipe`` axis; microbatches of activations flow
+    stage-to-stage over ``ppermute`` (ICI neighbor hops). Embedding,
+    final norm and unembedding are replicated outside the pipeline (they are
+    tiny next to the layer stack).
+
+    Returns ``pipelined_forward(params, tokens_mb) -> logits`` with
+    ``tokens_mb`` shaped ``[M, mb, S]`` (M microbatches) and logits
+    ``[M, mb, S, vocab]``, equal to the unpipelined
+    :func:`..models.transformer.forward` per microbatch.
+    """
+    from ..models import transformer as tfm
+
+    if attn_fn is None:
+        from ..ops.attention import reference_attention
+
+        attn_fn = reference_attention
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by {n_stages} stages"
+        )
+    layers_per_stage = cfg.n_layers // n_stages
+
+    def stage_fn(stage_layers: Any, x: jax.Array) -> jax.Array:
+        B, S, _ = x.shape
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+        def body(h, layer):
+            h, _ = tfm._layer(cfg, attn_fn, h, layer, positions)
+            return h, None
+
+        x, _ = lax.scan(body, x, stage_layers)
+        return x
+
+    pipe = make_pipeline(stage_fn, n_stages, mesh, axis)
+
+    def pipelined_forward(params: Any, tokens_mb: jax.Array) -> jax.Array:
+        x = tfm.embed(params, tokens_mb, cfg)  # [M, mb, S, D]
+        # Stacked layers [L, ...] → [n_stages, L/n_stages, ...]: leading axis
+        # shards over ``pipe``, the second is each stage's local scan.
+        stage_layers = jax.tree.map(
+            lambda a: a.reshape((n_stages, layers_per_stage) + a.shape[1:]),
+            params["layers"],
+        )
+        y = pipe(stage_layers, x)
+        return tfm.unembed(params, y, cfg)
+
+    return pipelined_forward
+
+
 def sequential_reference(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     stage_params: Sequence[Any],

@@ -102,7 +102,14 @@ def make_train_step(
         opt_state = jax.jit(
             optimizer.init, out_shardings=_opt_shardings(optimizer, params, mesh)
         )(params)
-        return {"params": params, "opt": opt_state, "step": jnp.zeros((), jnp.int32)}
+        # The step counter is mesh-replicated like the scalar opt leaves so
+        # the WHOLE state tree has committed mesh shardings — a restored
+        # checkpoint then re-places every leaf identically instead of mixing
+        # single-device and mesh-committed arrays (which jit rejects).
+        step_counter = jax.device_put(
+            jnp.zeros((), jnp.int32), NamedSharding(mesh, P())
+        )
+        return {"params": params, "opt": opt_state, "step": step_counter}
 
     def loss_fn(params, tokens):
         return tfm.next_token_loss(params, tokens, cfg, attn_fn=attn_fn)

@@ -1,0 +1,86 @@
+"""Checkpoint/resume on the virtual CPU mesh: a train loop killed mid-run
+must resume from disk to bit-identical losses (VERDICT r1 item 7)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu import parallel
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+
+
+def _batch(cfg, mesh, step: int):
+    """Deterministic per-step batch so two runs see identical data."""
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1000 + step), (8, 16), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    return parallel.shard_batch(toks, mesh)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return parallel.build_mesh(parallel.default_mesh_shape(8))
+
+
+def test_kill_and_resume_bit_identical(tmp_path, mesh):
+    cfg = tiny_test_config()
+    init_state, step_fn = parallel.make_train_step(cfg, mesh)
+
+    # Uninterrupted run: 6 steps, record losses.
+    state = init_state(jax.random.PRNGKey(0))
+    full_losses = []
+    for i in range(6):
+        state, loss = step_fn(state, _batch(cfg, mesh, i))
+        full_losses.append(np.asarray(loss))
+
+    # Interrupted run: same init, checkpoint each step, "die" after step 3.
+    ckpt_dir = str(tmp_path / "ckpt")
+    state = init_state(jax.random.PRNGKey(0))
+    with parallel.TrainCheckpointer(ckpt_dir, max_to_keep=2) as ck:
+        for i in range(3):
+            state, loss = step_fn(state, _batch(cfg, mesh, i))
+            assert ck.save(int(state["step"]), state)
+            np.testing.assert_array_equal(np.asarray(loss), full_losses[i])
+
+    # "Restart": a fresh checkpointer + a fresh abstract state restores the
+    # latest step into the same shardings, and the remaining steps reproduce
+    # the uninterrupted losses bit-for-bit.
+    with parallel.TrainCheckpointer(ckpt_dir) as ck:
+        assert ck.latest_step() == 3
+        template = init_state(jax.random.PRNGKey(7))  # different key: values must come from disk
+        restored = ck.restore(template)
+    assert int(restored["step"]) == 3
+    for leaf, ref_leaf in zip(
+        jax.tree.leaves(restored), jax.tree.leaves(template)
+    ):
+        assert leaf.sharding == ref_leaf.sharding
+    state = restored
+    for i in range(3, 6):
+        state, loss = step_fn(state, _batch(cfg, mesh, i))
+        np.testing.assert_array_equal(np.asarray(loss), full_losses[i])
+
+
+def test_max_to_keep_prunes_old_steps(tmp_path, mesh):
+    cfg = tiny_test_config()
+    init_state, step_fn = parallel.make_train_step(cfg, mesh)
+    state = init_state(jax.random.PRNGKey(0))
+    with parallel.TrainCheckpointer(str(tmp_path), max_to_keep=2) as ck:
+        for i in range(4):
+            state, _ = step_fn(state, _batch(cfg, mesh, i))
+            ck.save(int(state["step"]), state)
+        ck.wait()
+        assert ck.latest_step() == 4
+        assert sorted(ck._mngr.all_steps()) == [3, 4]  # 1 and 2 pruned
+
+    with parallel.TrainCheckpointer(str(tmp_path)) as ck:
+        state2 = ck.restore(state)  # live state as template
+        assert int(state2["step"]) == 4
+
+
+def test_restore_empty_dir_raises(tmp_path, mesh):
+    cfg = tiny_test_config()
+    init_state, _ = parallel.make_train_step(cfg, mesh)
+    state = init_state(jax.random.PRNGKey(0))
+    with parallel.TrainCheckpointer(str(tmp_path)) as ck:
+        with pytest.raises(FileNotFoundError):
+            ck.restore(state)

@@ -384,6 +384,10 @@ def test_recovery_requires_live_driver(manager, kubelet, v5e8, monkeypatch):
 
     from kata_xpu_device_plugin_tpu.plugin import health as H
 
+    # The manager's own inotify watcher wakes on the fs events below and
+    # would race the monkeypatched os functions; this test drives health
+    # deterministically through its own watcher.
+    manager._watcher.stop()
     plugin = manager.plugins()[0]
     watcher = HealthWatcher([plugin], use_inotify=False)
     sys_entry = os.path.join(v5e8.sysfs, "class/accel/accel0")

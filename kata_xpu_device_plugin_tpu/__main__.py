@@ -73,18 +73,38 @@ def _run(args: argparse.Namespace) -> int:
             pass
 
     def _signal_watcher():
-        data = os.read(sig_r, 1)
-        logger.info(
-            "signal received, shutting down",
-            extra=log.kv(signal=data[0] if data else "?"),
-        )
-        mgr.request_stop()
+        while True:
+            data = os.read(sig_r, 1)
+            if data and data[0] == (signal.SIGUSR1 & 0x7F):
+                # Observability hook: dump live manager state as one
+                # structured log line, keep running. On a SEPARATE short
+                # thread — debug_report/logging take manager+handler locks,
+                # and a dump wedged on one of them must not stop this
+                # watcher from reading the next (shutdown) signal byte.
+                def _dump():
+                    try:
+                        logger.info(
+                            "debug state dump (SIGUSR1)",
+                            extra=log.kv(state=json.dumps(mgr.debug_report())),
+                        )
+                    except Exception as e:
+                        logger.error("debug dump failed", extra=log.kv(err=str(e)))
+
+                threading.Thread(target=_dump, name="debug-dump", daemon=True).start()
+                continue
+            logger.info(
+                "signal received, shutting down",
+                extra=log.kv(signal=data[0] if data else "?"),
+            )
+            mgr.request_stop()
+            return
 
     import threading
 
     threading.Thread(target=_signal_watcher, name="signal-watcher", daemon=True).start()
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGUSR1, _on_signal)
 
     try:
         mgr.start()

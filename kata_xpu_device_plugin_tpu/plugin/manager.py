@@ -478,6 +478,46 @@ class PluginManager:
             out.extend(self._vfio_plugins.values())
         return out
 
+    def debug_report(self) -> dict:
+        """Snapshot of live manager state for observability (dumped on
+        SIGUSR1 by the daemon — the pprof-handler equivalent the reference
+        never registers, SURVEY §5 tracing row)."""
+        tpu_inv = self._tpu_inv
+        report: dict = {
+            "plugins": [
+                {
+                    "resource": p.resource_name,
+                    "serving": p.serving,
+                    "stopped": p.stopped,
+                    "socket": p.socket_path,
+                    "devices": [
+                        {"id": d.id, "health": d.health}
+                        for d in p.state.snapshot()
+                    ],
+                }
+                for p in self.plugins()
+            ],
+            "watcher_alive": bool(self._watcher and self._watcher.is_alive()),
+            "rescan_alive": bool(
+                self._rescan_thread and self._rescan_thread.is_alive()
+            ),
+        }
+        if tpu_inv is not None:
+            topo = tpu_inv.topology
+            report["tpu"] = {
+                "chips": tpu_inv.count,
+                "accelerator_type": topo.accelerator_type,
+                "num_hosts": topo.num_hosts,
+                "worker_id": topo.worker_id,
+                "worker_hostnames": list(topo.worker_hostnames),
+            }
+        if self._vfio_inv is not None:
+            report["vfio_models"] = {
+                f"{v}:{d}": groups
+                for (v, d), groups in sorted(self._vfio_inv.models.items())
+            }
+        return report
+
     def rescan_once(self) -> bool:
         """One re-discovery pass; returns True when anything changed."""
         old_tpu = self.tpu_inventory()

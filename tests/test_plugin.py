@@ -375,6 +375,18 @@ def test_driver_unbind_flips_unhealthy(manager, kubelet, v5e8):
     assert os.path.exists(os.path.join(v5e8.dev, "accel5"))
 
 
+def test_debug_report_snapshots_live_state(manager):
+    rep = manager.debug_report()
+    (tpu_plugin,) = [p for p in rep["plugins"] if p["resource"] == "google.com/tpu"]
+    assert tpu_plugin["serving"] and not tpu_plugin["stopped"]
+    assert {d["id"] for d in tpu_plugin["devices"]} == {str(i) for i in range(8)}
+    assert rep["tpu"]["chips"] == 8
+    assert rep["watcher_alive"]
+    import json
+
+    json.dumps(rep)  # must be directly serializable for the SIGUSR1 dump
+
+
 def test_recovery_requires_live_driver(manager, kubelet, v5e8, monkeypatch):
     """Flipping back to Healthy is gated on the open-probe: a path that
     reappears but whose driver answers ENXIO stays Unhealthy; a guest-held

@@ -164,7 +164,9 @@ def unembed(params: Params, x: jax.Array, cfg: DecoderConfig) -> jax.Array:
     proj = (params["embed"].T if cfg.tie_embeddings else params["unembed"]).astype(
         cfg.dtype
     )
-    logits = (x @ proj).astype(jnp.float32)
+    # bf16×bf16 on the MXU with fp32 accumulation — not a bf16 matmul whose
+    # low bits are discarded before a separate fp32 cast.
+    logits = jnp.matmul(x, proj, preferred_element_type=jnp.float32)
     if cfg.logits_softcap:
         logits = jnp.tanh(logits / cfg.logits_softcap) * cfg.logits_softcap
     return logits
@@ -281,14 +283,21 @@ def forward(
 # ----- loss / training -----------------------------------------------------
 
 
+def token_nll_sum(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Summed negative log-likelihood of ``targets`` under ``logits`` — the
+    one cross-entropy body shared by the unpipelined loss and the composed
+    pipeline loss (so the two cannot drift)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.sum(ll)
+
+
 def next_token_loss(params: Params, tokens: jax.Array, cfg: DecoderConfig,
                     attn_fn: Optional[AttnFn] = None) -> jax.Array:
     """Mean cross-entropy of predicting tokens[:, 1:] from tokens[:, :-1]."""
     logits = forward(params, tokens[:, :-1], cfg, attn_fn=attn_fn)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return token_nll_sum(logits, targets) / targets.size
 
 
 # ----- KV cache / generation ----------------------------------------------
@@ -302,42 +311,96 @@ def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "max_len", "attn_fn"))
-def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
-             steps: int, max_len: int = 0, attn_fn: Optional[AttnFn] = None):
-    """Greedy generation: prefill the prompt, then lax.scan the decode loop
-    (everything under one jit — no per-token dispatch overhead).
-
-    ``attn_fn`` defaults to :func:`..ops.attention.flash_attention`, whose
-    trace-time dispatch runs the pallas flash kernel for the prefill
-    (self-attention, flash-eligible shapes on TPU) and the XLA reference for
-    the tiny-q decode steps."""
+@partial(jax.jit, static_argnames=("cfg", "max_len", "attn_fn"))
+def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
+            max_len: int, attn_fn: Optional[AttnFn] = None):
+    """Prefill the prompt into fresh KV caches. Returns
+    ``(caches, next_token, pos)`` — the greedy next token and the scalar
+    position where decode continues. Separately jitted from
+    :func:`decode` so the bench can time the bandwidth-bound decode loop on
+    its own (prefill is compute-bound; folding it into the decode timing
+    understates decode tok/s)."""
     if attn_fn is None:
         from ..ops.attention import flash_attention
 
         attn_fn = flash_attention
     B, S = prompt.shape
-    max_len = max_len or S + steps
     caches = init_kv_caches(cfg, B, max_len)
     logits, caches = forward(
         params, prompt, cfg, attn_fn=attn_fn, kv_caches=caches,
         cache_offset=jnp.int32(0), prefill=True,
     )
     last = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+    return caches, last, jnp.int32(S)
 
-    if steps == 0:
-        return jnp.zeros((B, 0), jnp.int32)
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn"))
+def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
+                 cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn] = None):
+    if attn_fn is None:
+        from ..ops.attention import flash_attention
+
+        attn_fn = flash_attention
+    B = tok.shape[0]
 
     def step(carry, _):
         caches, tok, pos = carry
-        positions = pos[:, None] * jnp.ones((B, 1), jnp.int32)
+        positions = jnp.full((B, 1), pos, jnp.int32)
         logits, caches = forward(
             params, tok[:, None], cfg, attn_fn=attn_fn, positions=positions,
-            kv_caches=caches, cache_offset=pos[0],
+            kv_caches=caches, cache_offset=pos,
         )
         nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
         return (caches, nxt, pos + 1), nxt
 
-    pos = jnp.full((B,), S, jnp.int32)
-    (_, _, _), out = lax.scan(step, (caches, last, pos), None, length=steps - 1)
-    return jnp.concatenate([last[:, None], out.T], axis=1)
+    init = (caches, tok, jnp.asarray(pos, jnp.int32))
+    (_, _, _), out = lax.scan(step, init, None, length=steps)
+    return out.T
+
+
+def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
+           cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn] = None):
+    """Greedy-decode ``steps`` tokens after ``tok`` as one lax.scan — no
+    per-token dispatch overhead. Returns [B, steps]. ``pos`` is a SCALAR:
+    the whole batch decodes in lockstep at one shared position (the cache
+    write index and causal mask are batch-wide; ragged prompts need
+    left-padding upstream)."""
+    cache_len = caches[0].shape[2]
+    if steps > cache_len:
+        raise ValueError(f"steps={steps} exceeds cache max_len={cache_len}")
+    try:
+        pos_concrete = int(pos)
+    except Exception:  # traced under an outer jit: that caller owns the bound
+        pos_concrete = None
+    if pos_concrete is not None and pos_concrete + steps > cache_len:
+        # dynamic_update_slice silently CLAMPS out-of-range writes — an
+        # overrun would corrupt the last cache slot, not raise.
+        raise ValueError(
+            f"pos={pos_concrete} + steps={steps} overruns cache max_len={cache_len}"
+        )
+    return _decode_scan(params, caches, tok, pos, cfg, steps, attn_fn)
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "max_len", "attn_fn"))
+def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
+             steps: int, max_len: int = 0, attn_fn: Optional[AttnFn] = None):
+    """Greedy generation: :func:`prefill` then :func:`decode`, composed under
+    one jit.
+
+    ``attn_fn`` defaults to :func:`..ops.attention.flash_attention`, whose
+    trace-time dispatch runs the pallas flash kernel for the prefill
+    (self-attention, flash-eligible shapes on TPU) and the XLA reference for
+    the tiny-q decode steps."""
+    B, S = prompt.shape
+    max_len = max_len or S + steps
+    if S + steps > max_len:
+        raise ValueError(
+            f"prompt_len={S} + steps={steps} overruns max_len={max_len}"
+        )
+    caches, last, pos = prefill(params, prompt, cfg, max_len, attn_fn=attn_fn)
+    if steps == 0:
+        return jnp.zeros((B, 0), jnp.int32)
+    if steps == 1:
+        return last[:, None]
+    out = decode(params, caches, last, pos, cfg, steps - 1, attn_fn=attn_fn)
+    return jnp.concatenate([last[:, None], out], axis=1)

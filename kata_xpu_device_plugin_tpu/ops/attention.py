@@ -24,7 +24,12 @@ import jax.numpy as jnp
 
 
 def _expand_kv(k: jax.Array, n_heads: int) -> jax.Array:
-    """[B, S, KV, D] → [B, S, H, D] by repeating each KV head H/KV times."""
+    """[B, S, KV, D] → [B, S, H, D] by repeating each KV head H/KV times.
+
+    Only for code paths that genuinely need materialized heads; the attention
+    implementations below are GQA-grouped and never call it — repeating KV
+    multiplies HBM cache traffic by H/KV on the bandwidth-bound decode path.
+    """
     kv = k.shape[2]
     if kv == n_heads:
         return k
@@ -38,25 +43,37 @@ def reference_attention(
     causal: bool = True,
     q_offset: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """XLA attention with fp32 logits. Used on CPU, in tests, and as the
-    numerics oracle for the pallas kernel."""
+    """XLA attention, GQA-grouped: q's H heads fold into [KV, H/KV] groups so
+    K/V are read once per KV head — no ``jnp.repeat`` of the KV cache (on MQA
+    decode that repeat would multiply cache traffic up to H×). Dots run in
+    the inputs' native dtype (bf16 on TPU: the MXU does bf16×bf16→fp32 at 2×
+    fp32 throughput) with fp32 accumulation via ``preferred_element_type``;
+    softmax math stays fp32. Used on CPU, in tests, and as the numerics
+    oracle for the pallas kernel."""
     B, Sq, H, D = q.shape
-    Sk = k.shape[1]
-    k = _expand_kv(k, H)
-    v = _expand_kv(v, H)
-    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
-    logits = logits * scale
+    Sk, KV = k.shape[1], k.shape[2]
+    assert H % KV == 0, (H, KV)
+    G = H // KV
+    qg = q.reshape(B, Sq, KV, G, D)
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * (1.0 / float(D) ** 0.5)
     if causal:
         q_pos = jnp.arange(Sq)
         if q_offset is not None:
             q_pos = q_pos + q_offset
         k_pos = jnp.arange(Sk)
         mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
-        logits = jnp.where(mask[None, None], logits, -1e30)
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
-    return out.astype(q.dtype)
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, Sq, H, D).astype(q.dtype)
 
 
 def on_tpu() -> bool:
@@ -94,11 +111,18 @@ def flash_attention(
     causal: bool = True,
     q_offset: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Pallas flash attention on TPU; falls back to the reference elsewhere
-    (pallas interpret mode on CPU is far slower than XLA) and for the tiny
-    shapes where a kernel launch can't pay for itself."""
+    """Trace-time dispatch over the pallas kernels on TPU: the blockwise
+    flash kernel for self-attention (prefill/training) and the fused
+    single-token kernel for decode-into-cache; the XLA reference elsewhere
+    (pallas interpret mode on CPU is far slower than XLA) and for shapes
+    where a kernel launch can't pay for itself."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
+    if causal and q_offset is not None and Sq == 1 and on_tpu():
+        from .decode_attn import pallas_decode_attention, supports_decode
+
+        if supports_decode(Sq, Sk, D):
+            return pallas_decode_attention(q, k, v, q_offset)
     if not flash_eligible(Sq, Sk, D, q_offset):
         return reference_attention(q, k, v, causal=causal, q_offset=q_offset)
     from .flash import pallas_flash_attention

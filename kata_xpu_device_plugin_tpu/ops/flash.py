@@ -61,13 +61,17 @@ def _flash_kernel(
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     def compute():
-        q = q_ref[0, 0, :, :].astype(jnp.float32)  # [BQ, D]
-        k = k_ref[0, 0, :, :].astype(jnp.float32)  # [BK, D]
-        v = v_ref[0, 0, :, :].astype(jnp.float32)  # [BK, D]
+        # Dots run in the inputs' native dtype: on TPU the MXU does
+        # bf16×bf16→fp32 at ~2× fp32 throughput, so casting inputs up before
+        # the dot would halve kernel FLOPs. Softmax math and both
+        # accumulators stay fp32 (preferred_element_type below).
+        q = q_ref[0, 0, :, :]  # [BQ, D]
+        k = k_ref[0, 0, :, :]  # [BK, D]
+        v = v_ref[0, 0, :, :]  # [BK, D]
 
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [BQ, BK]
+        ) * scale  # [BQ, BK] fp32
 
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -84,7 +88,8 @@ def _flash_kernel(
 
         acc = acc_scr[...] * correction  # [BQ, D]
         acc = acc + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)

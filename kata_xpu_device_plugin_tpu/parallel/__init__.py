@@ -2,6 +2,14 @@
 sequence-parallel ring attention, GPipe pipeline parallelism, (via ops.moe)
 expert parallelism, and sharding-aware checkpoint/resume."""
 from .checkpoint import TrainCheckpointer
+from .composed import (
+    composed_mesh,
+    init_pp_params,
+    make_pp_loss,
+    make_pp_train_step,
+    shard_microbatches,
+    to_pp_params,
+)
 from .mesh import (
     AXIS_DATA,
     AXIS_FSDP,
@@ -32,6 +40,12 @@ from .sharding import (
 )
 
 __all__ = [
+    "composed_mesh",
+    "init_pp_params",
+    "make_pp_loss",
+    "make_pp_train_step",
+    "shard_microbatches",
+    "to_pp_params",
     "AXIS_DATA",
     "AXIS_FSDP",
     "AXIS_MODEL",

@@ -115,6 +115,31 @@ def make_pipeline(
     return shard_map(per_stage, mesh=mesh, in_specs=(P(axis), P()), out_specs=P())
 
 
+def transformer_stage_fn(cfg, attn_fn: Optional[Callable] = None):
+    """One pipeline stage of a decoder: scan a [L_stage, ...]-stacked layer
+    chunk over [B, S, D] activations. Shared by the 1-D pipeline wrapper and
+    the composed pp×fsdp×tp step so the stage body cannot drift."""
+    from ..models import transformer as tfm
+
+    if attn_fn is None:
+        from ..ops.attention import reference_attention
+
+        attn_fn = reference_attention
+
+    def stage_fn(stage_layers: Any, x: jax.Array) -> jax.Array:
+        B, S, _ = x.shape
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+        def body(h, layer):
+            h, _ = tfm._layer(cfg, attn_fn, h, layer, positions)
+            return h, None
+
+        x, _ = lax.scan(body, x, stage_layers)
+        return x
+
+    return stage_fn
+
+
 def make_transformer_pipeline(
     cfg,
     n_stages: int,
@@ -136,28 +161,13 @@ def make_transformer_pipeline(
     """
     from ..models import transformer as tfm
 
-    if attn_fn is None:
-        from ..ops.attention import reference_attention
-
-        attn_fn = reference_attention
     if cfg.n_layers % n_stages:
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by {n_stages} stages"
         )
     layers_per_stage = cfg.n_layers // n_stages
 
-    def stage_fn(stage_layers: Any, x: jax.Array) -> jax.Array:
-        B, S, _ = x.shape
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-
-        def body(h, layer):
-            h, _ = tfm._layer(cfg, attn_fn, h, layer, positions)
-            return h, None
-
-        x, _ = lax.scan(body, x, stage_layers)
-        return x
-
-    pipe = make_pipeline(stage_fn, n_stages, mesh, axis)
+    pipe = make_pipeline(transformer_stage_fn(cfg, attn_fn), n_stages, mesh, axis)
 
     def pipelined_forward(params: Any, tokens_mb: jax.Array) -> jax.Array:
         x = tfm.embed(params, tokens_mb, cfg)  # [M, mb, S, D]

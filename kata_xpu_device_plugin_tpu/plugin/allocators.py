@@ -154,6 +154,30 @@ class VfioAllocator:
     def preferred(
         self, available: Sequence[str], must_include: Sequence[str], size: int
     ) -> list[str]:
-        # Groups are interchangeable; NUMA-aware scoring could refine this.
+        """NUMA-aware pick (generalizes the ref's nil stub at
+        generic_device_plugin.go:378-386): groups are functionally
+        interchangeable, but cross-socket DMA costs — so fill from the NUMA
+        node that (a) already hosts the must-include groups and (b) can
+        satisfy the most of the request, before spilling to other nodes."""
+        inv = self._inventory()
+
+        def node_of(group: str):
+            devs = inv.groups.get(group) or []
+            nodes = {d.numa_node for d in devs if d.numa_node is not None}
+            return nodes.pop() if len(nodes) == 1 else None
+
+        picked = list(must_include)
         rest = [a for a in available if a not in must_include]
-        return (list(must_include) + rest)[:size]
+        by_node: dict[object, list[str]] = {}
+        for g in rest:
+            by_node.setdefault(node_of(g), []).append(g)
+        pinned = {node_of(g) for g in must_include} - {None}
+        # Nodes the request is already on first, then by how much of the
+        # remainder they can satisfy; unknown-NUMA groups last.
+        order = sorted(
+            by_node,
+            key=lambda n: (n not in pinned, n is None, -len(by_node[n])),
+        )
+        for node in order:
+            picked.extend(by_node[node])
+        return picked[:size]

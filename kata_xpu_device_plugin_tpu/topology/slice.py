@@ -17,6 +17,10 @@ import os
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
+from ..utils import log
+
+LOG = log.get("topology")
+
 Coord = tuple[int, int, int]
 
 
@@ -190,7 +194,17 @@ def detect_accelerator_type(
     from_env = env.get("TPU_ACCELERATOR_TYPE")
     if from_env:
         return from_env
-    fam_name = GOOGLE_DEVICE_TO_FAMILY.get((pci_device_id or "").lower(), "v5litepod")
+    fam_name = GOOGLE_DEVICE_TO_FAMILY.get((pci_device_id or "").lower())
+    if fam_name is None:
+        # A wrong family means wrong slice_dims/host bounds and a guest whose
+        # ICI mesh won't come up — the operator must hear about the guess.
+        fam_name = "v5litepod"
+        LOG.warning(
+            "TPU family not identifiable: assuming %s; set TPU_ACCELERATOR_TYPE "
+            "on the node if this is wrong",
+            fam_name,
+            extra=log.kv(pci_device_id=pci_device_id or "<none>"),
+        )
     fam = FAMILIES[fam_name]
     n = max(1, chip_count or 1)
     if n <= fam.chips_per_host:

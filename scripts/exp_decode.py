@@ -1,0 +1,232 @@
+#!/usr/bin/env python3
+"""Decode-step microbenchmark: where does the non-roofline 19% go?
+
+Times structural variants of the Gemma-2B decode step on the attached chip:
+  v0  current forward (layer lax.scan, separate wq/wk/wv and gate/up matmuls)
+  v1  fused wqkv [d, q+2kv] and w_gateup [d, 2f] matmuls
+  v2  v1 + layer-scan unroll
+Prints ms/step and implied roofline fraction for each.
+"""
+from __future__ import annotations
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, "/root/repo")
+
+from kata_xpu_device_plugin_tpu.models import gemma_2b_bench
+from kata_xpu_device_plugin_tpu.models.transformer import (
+    forward,
+    init_kv_caches,
+    init_params,
+    rms_norm,
+    rope,
+)
+
+cfg = gemma_2b_bench()
+B, PROMPT, STEPS = 8, 128, 128
+MAX_LEN = PROMPT + STEPS
+
+key = jax.random.PRNGKey(0)
+params = jax.jit(lambda k: init_params(k, cfg, dtype=jnp.bfloat16))(key)
+jax.block_until_ready(params)
+
+param_bytes = cfg.num_params() * 2
+HBM = 819e9
+ideal_ms = param_bytes / HBM * 1e3
+print(f"params {cfg.num_params()/1e9:.3f}G -> ideal {ideal_ms:.3f} ms/step")
+
+
+def fuse(params):
+    l = params["layers"]
+    return {
+        "embed": params["embed"],
+        "final_norm": params["final_norm"],
+        "layers": {
+            "attn_norm": l["attn_norm"],
+            "wqkv": jnp.concatenate([l["wq"], l["wk"], l["wv"]], axis=2),
+            "wo": l["wo"],
+            "mlp_norm": l["mlp_norm"],
+            "w_gateup": jnp.concatenate([l["w_gate"], l["w_up"]], axis=2),
+            "w_down": l["w_down"],
+        },
+    }
+
+
+fparams = jax.jit(fuse)(params)
+jax.block_until_ready(fparams)
+
+
+def fused_layer(x, layer, positions, kv_cache, cache_offset):
+    Bq, S, _ = x.shape
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    qkv = h @ layer["wqkv"].astype(h.dtype)
+    q = qkv[..., : cfg.q_dim].reshape(Bq, S, cfg.n_heads, cfg.head_dim)
+    k = qkv[..., cfg.q_dim : cfg.q_dim + cfg.kv_dim].reshape(
+        Bq, S, cfg.n_kv_heads, cfg.head_dim
+    )
+    v = qkv[..., cfg.q_dim + cfg.kv_dim :].reshape(Bq, S, cfg.n_kv_heads, cfg.head_dim)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    ck, cv = kv_cache
+    ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_offset, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_offset, 0, 0))
+    from kata_xpu_device_plugin_tpu.ops.attention import reference_attention
+
+    attn = reference_attention(q, ck, cv, causal=True, q_offset=cache_offset)
+    x = x + attn.reshape(Bq, S, cfg.q_dim) @ layer["wo"].astype(x.dtype)
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gu = h @ layer["w_gateup"].astype(h.dtype)
+    gate = jax.nn.gelu(gu[..., : cfg.d_ff], approximate=True)
+    x = x + (gate * gu[..., cfg.d_ff :]) @ layer["w_down"].astype(x.dtype)
+    return x, (ck, cv)
+
+
+def fused_forward(fp, tokens, positions, caches, cache_offset, unroll=1):
+    x = fp["embed"].astype(cfg.dtype)[tokens] * jnp.asarray(
+        jnp.sqrt(cfg.d_model), cfg.dtype
+    )
+
+    def body(x, layer_and_cache):
+        layer, (ck, cv) = layer_and_cache
+        x, new_cache = fused_layer(x, layer, positions, (ck, cv), cache_offset)
+        return x, new_cache
+
+    x, new_caches = lax.scan(body, x, (fp["layers"], caches), unroll=unroll)
+    x = rms_norm(x, fp["final_norm"], cfg.norm_eps)
+    logits = jnp.matmul(
+        x, fp["embed"].T.astype(cfg.dtype), preferred_element_type=jnp.float32
+    )
+    return logits, new_caches
+
+
+def make_decode_v0():
+    @jax.jit
+    def dec(params, caches, tok, pos):
+        def step(carry, _):
+            caches, tok, pos = carry
+            positions = pos[:, None] * jnp.ones((B, 1), jnp.int32)
+            logits, caches = forward(
+                params, tok[:, None], cfg, positions=positions,
+                kv_caches=caches, cache_offset=pos[0],
+            )
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            return (caches, nxt, pos + 1), nxt
+
+        (_, _, _), out = lax.scan(step, (caches, tok, pos), None, length=STEPS)
+        return out.T
+
+    return dec
+
+
+def make_decode_fused(unroll):
+    @jax.jit
+    def dec(fp, caches, tok, pos):
+        def step(carry, _):
+            caches, tok, pos = carry
+            positions = pos[:, None] * jnp.ones((B, 1), jnp.int32)
+            logits, caches = fused_forward(
+                fp, tok[:, None], positions, caches, pos[0], unroll=unroll
+            )
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            return (caches, nxt, pos + 1), nxt
+
+        (_, _, _), out = lax.scan(step, (caches, tok, pos), None, length=STEPS)
+        return out.T
+
+    return dec
+
+
+def timeit(name, fn, p):
+    caches = init_kv_caches(cfg, B, MAX_LEN)
+    tok = jnp.zeros((B,), jnp.int32)
+    pos = jnp.full((B,), PROMPT, jnp.int32)
+    np.asarray(fn(p, caches, tok, pos))  # compile
+    best = float("inf")
+    for s in range(3):
+        tok2 = jax.random.randint(jax.random.PRNGKey(s), (B,), 0, cfg.vocab_size)
+        np.asarray(tok2)
+        t0 = time.perf_counter()
+        np.asarray(fn(p, caches, tok2, pos))
+        best = min(best, time.perf_counter() - t0)
+    ms = best / STEPS * 1e3
+    print(f"{name:24s} {ms:7.3f} ms/step  roofline_frac={ideal_ms/ms:.3f}")
+    return ms
+
+
+timeit("v0 current", make_decode_v0(), params)
+timeit("v1 fused", make_decode_fused(1), fparams)
+timeit("v2 fused+unroll3", make_decode_fused(3), fparams)
+timeit("v3 fused+unroll6", make_decode_fused(6), fparams)
+
+
+def make_decode_ablate(skip_attn=False, skip_mlp=False, skip_unembed=False):
+    def layer_fn(x, layer, positions, kv_cache, cache_offset):
+        Bq, S, _ = x.shape
+        ck, cv = kv_cache
+        if not skip_attn:
+            h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+            qkv = h @ layer["wqkv"].astype(h.dtype)
+            q = qkv[..., : cfg.q_dim].reshape(Bq, S, cfg.n_heads, cfg.head_dim)
+            k = qkv[..., cfg.q_dim : cfg.q_dim + cfg.kv_dim].reshape(
+                Bq, S, cfg.n_kv_heads, cfg.head_dim
+            )
+            v = qkv[..., cfg.q_dim + cfg.kv_dim :].reshape(
+                Bq, S, cfg.n_kv_heads, cfg.head_dim
+            )
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+            ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_offset, 0, 0))
+            cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_offset, 0, 0))
+            from kata_xpu_device_plugin_tpu.ops.attention import reference_attention
+
+            attn = reference_attention(q, ck, cv, causal=True, q_offset=cache_offset)
+            x = x + attn.reshape(Bq, S, cfg.q_dim) @ layer["wo"].astype(x.dtype)
+        if not skip_mlp:
+            h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+            gu = h @ layer["w_gateup"].astype(h.dtype)
+            gate = jax.nn.gelu(gu[..., : cfg.d_ff], approximate=True)
+            x = x + (gate * gu[..., cfg.d_ff :]) @ layer["w_down"].astype(x.dtype)
+        return x, (ck, cv)
+
+    @jax.jit
+    def dec(fp, caches, tok, pos):
+        def step(carry, _):
+            caches, tok, pos = carry
+            positions = pos[:, None] * jnp.ones((B, 1), jnp.int32)
+            x = fp["embed"].astype(cfg.dtype)[tok[:, None]] * jnp.asarray(
+                jnp.sqrt(cfg.d_model), cfg.dtype
+            )
+
+            def body(x, layer_and_cache):
+                layer, cc = layer_and_cache
+                return layer_fn(x, layer, positions, cc, pos[0])
+
+            x, caches = lax.scan(body, x, (fp["layers"], caches))
+            x = rms_norm(x, fp["final_norm"], cfg.norm_eps)
+            if skip_unembed:
+                nxt = x[:, -1, 0].astype(jnp.int32) % cfg.vocab_size
+            else:
+                logits = jnp.matmul(
+                    x, fp["embed"].T.astype(cfg.dtype),
+                    preferred_element_type=jnp.float32,
+                )
+                nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            return (caches, nxt, pos + 1), nxt
+
+        (_, _, _), out = lax.scan(step, (caches, tok, pos), None, length=STEPS)
+        return out.T
+
+    return dec
+
+
+timeit("ab full", make_decode_ablate(), fparams)
+timeit("ab no-attn", make_decode_ablate(skip_attn=True), fparams)
+timeit("ab no-mlp", make_decode_ablate(skip_mlp=True), fparams)
+timeit("ab no-unembed", make_decode_ablate(skip_unembed=True), fparams)

@@ -1,0 +1,83 @@
+"""Composed pp×fsdp×tp on one mesh (VERDICT r2 item 2): the pipelined loss
+must equal the unpipelined loss on the flattened batch, and the composed
+train step must track the unpipelined sharded train step."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import init_params, next_token_loss
+from kata_xpu_device_plugin_tpu.parallel import composed
+
+M, MB, S = 4, 2, 16
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_test_config(n_layers=4, dtype=jnp.float32)
+
+
+def _tokens(cfg):
+    return jax.random.randint(
+        jax.random.PRNGKey(1), (M, MB, S), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+
+
+@pytest.mark.parametrize("shape", [(2, 2, 2), (4, 2, 1), (2, 1, 4)])
+def test_pp_loss_matches_unpipelined(cfg, shape):
+    pipe, fsdp, model = shape
+    mesh = composed.composed_mesh(pipe, fsdp, model)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = _tokens(cfg)
+
+    pp_tree = composed.to_pp_params(params, pipe)
+    pp_params = jax.device_put(
+        pp_tree, composed.pp_param_shardings(pp_tree, mesh)
+    )
+    loss_fn = composed.make_pp_loss(cfg, mesh, n_stages=pipe, num_microbatches=M)
+    pp_loss = jax.jit(loss_fn)(pp_params, composed.shard_microbatches(tokens, mesh))
+    ref = next_token_loss(params, tokens.reshape(M * MB, S), cfg)
+    np.testing.assert_allclose(float(pp_loss), float(ref), rtol=1e-5)
+
+
+def test_pp_train_step_matches_unpipelined_sharded(cfg):
+    """Same init key, same batch: the composed pp×fsdp×tp step and the
+    unpipelined dp×fsdp×tp step must produce the same loss trajectory."""
+    from kata_xpu_device_plugin_tpu import parallel
+
+    mesh = composed.composed_mesh(2, 2, 2)
+    tokens = _tokens(cfg)
+    init_state, step = composed.make_pp_train_step(cfg, mesh, 2, M)
+    state = init_state(jax.random.PRNGKey(0))
+    toks_sh = composed.shard_microbatches(tokens, mesh)
+
+    flat_mesh = parallel.build_mesh(
+        {"data": 1, "fsdp": 4, "model": 2}, devices=jax.devices()
+    )
+    ref_init, ref_step = parallel.make_train_step(cfg, flat_mesh)
+    ref_state = ref_init(jax.random.PRNGKey(0))
+    flat = parallel.shard_batch(tokens.reshape(M * MB, S), flat_mesh)
+
+    for _ in range(2):
+        state, pp_loss = step(state, toks_sh)
+        ref_state, ref_loss = ref_step(ref_state, flat)
+        np.testing.assert_allclose(float(pp_loss), float(ref_loss), rtol=2e-4)
+    assert int(state["step"]) == 2
+
+
+def test_pp_requires_divisible_shapes(cfg):
+    mesh = composed.composed_mesh(2, 2, 2)
+    with pytest.raises(ValueError, match="not divisible"):
+        composed.make_pp_loss(cfg, mesh, n_stages=3, num_microbatches=M)
+    with pytest.raises(ValueError, match="not divisible"):
+        composed.make_pp_loss(cfg, mesh, n_stages=2, num_microbatches=3)
+
+
+def test_microbatch_block_ownership(cfg):
+    """Memory honesty: the [M, mb, S] token array is sharded over pipe — each
+    stage device holds M/P microbatches, not all of them."""
+    mesh = composed.composed_mesh(4, 2, 1)
+    toks = composed.shard_microbatches(_tokens(cfg), mesh)
+    for shard in toks.addressable_shards:
+        assert shard.data.shape[0] == M // 4

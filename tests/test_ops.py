@@ -81,3 +81,40 @@ def test_flash_non_divisible_seq_interpret():
     out = pallas_flash_attention(q, k, v, causal=True, interpret=True)
     ref = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("kv_heads,n_heads", [(1, 8), (2, 8), (4, 4)])
+def test_decode_kernel_matches_reference(kv_heads, n_heads):
+    from kata_xpu_device_plugin_tpu.ops.decode_attn import (
+        pallas_decode_attention,
+        supports_decode,
+    )
+
+    B, S, D = 3, 256, 64
+    assert supports_decode(1, S, D)
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(keys[0], (B, 1, n_heads, D), jnp.float32)
+    k = jax.random.normal(keys[1], (B, S, kv_heads, D), jnp.float32)
+    v = jax.random.normal(keys[2], (B, S, kv_heads, D), jnp.float32)
+    for pos in [0, 5, 130, 255]:
+        # Zero the unwritten tail like a real cache (the kernel must not
+        # read it anyway: blocks past pos are skipped entirely).
+        mask = (jnp.arange(S) <= pos)[None, :, None, None]
+        out = pallas_decode_attention(
+            q, k * mask, v * mask, jnp.int32(pos), interpret=True
+        )
+        ref = reference_attention(
+            q, k * mask, v * mask, causal=True, q_offset=jnp.int32(pos)
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+        )
+
+
+def test_decode_kernel_support_gate():
+    from kata_xpu_device_plugin_tpu.ops.decode_attn import supports_decode
+
+    assert supports_decode(1, 256, 128)
+    assert not supports_decode(2, 256, 128)  # multi-token q is flash's job
+    assert not supports_decode(1, 100, 128)  # cache not block-aligned
+    assert not supports_decode(1, 256, 96)  # head_dim not lane-aligned

@@ -511,3 +511,30 @@ def test_node_alive_errno_classification(monkeypatch, tmp_path):
     assert not H.node_alive("/dev/accel0")  # orphaned inode: dead
     monkeypatch.setattr(H.os, "open", open_raising(errno.ENODEV))
     assert not H.node_alive("/dev/accel0")
+
+
+def test_vfio_preferred_numa_affinity():
+    """preferred() fills from one NUMA node before spilling (the policy the
+    ref's stub at generic_device_plugin.go:378-386 never grew)."""
+    from kata_xpu_device_plugin_tpu.discovery.vfio import VfioDevice, VfioInventory
+    from kata_xpu_device_plugin_tpu.plugin.allocators import VfioAllocator
+
+    inv = VfioInventory()
+    for group, node in [("1", 0), ("2", 1), ("3", 0), ("4", 1), ("5", 1)]:
+        inv.groups[group] = [
+            VfioDevice(
+                address=f"0000:0{group}:00.0", vendor="10de", device="2330",
+                iommu_group=group, numa_node=node,
+            )
+        ]
+    alloc = VfioAllocator(lambda: inv, "nvidia.com", ("10de", "2330"))
+
+    # Node 1 can satisfy the whole request; node 0 cannot.
+    picked = alloc.preferred(["1", "2", "3", "4", "5"], [], 3)
+    assert sorted(picked) == ["2", "4", "5"]
+    # must_include pins the node: same-node groups fill the remainder.
+    picked = alloc.preferred(["1", "2", "3", "4", "5"], ["1"], 2)
+    assert picked == ["1", "3"]
+    # Larger than any one node: same-node prefix first, then spill.
+    picked = alloc.preferred(["1", "2", "3", "4", "5"], [], 4)
+    assert len(picked) == 4

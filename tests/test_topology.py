@@ -131,3 +131,22 @@ def test_host_bounds_2d_vs_3d_families():
     assert topo("v3-32").host_bounds_str() == "1,4,1"  # 2D torus: stack in y
     assert topo("v5p-32").host_bounds_str() == "1,1,4"  # 3D torus: stack in z
     assert topo("v5litepod-32").host_bounds_str() == "1,4,1"
+
+
+def test_detect_accelerator_type_unknown_id_warns(caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="katatpu.topology"):
+        t = tslice.detect_accelerator_type({}, chip_count=4, pci_device_id="beef")
+    assert t == "v5litepod-4"
+    assert any("assuming v5litepod" in r.getMessage() for r in caplog.records)
+
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="katatpu.topology"):
+        assert tslice.detect_accelerator_type(
+            {}, chip_count=4, pci_device_id="0062"
+        ).startswith("v5p")
+        assert (
+            tslice.detect_accelerator_type({"TPU_ACCELERATOR_TYPE": "v4-8"}) == "v4-8"
+        )
+    assert not caplog.records  # known id / env: no warning

@@ -1,0 +1,48 @@
+"""Mixtral model family configs — the MoE workload of the guest compute
+stack (SURVEY §2 lists expert parallelism as a first-class component to
+build; this makes it reachable from the model stack, not just a leaf op).
+
+Architecture facts from the public Mixtral report: 8 experts, top-2 routing
+with renormalized gates, otherwise the Llama architecture (GQA 8 KV heads,
+SwiGLU experts, RoPE theta 1e6, vocab 32000, untied unembedding).
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+
+from .transformer import DecoderConfig
+
+
+def mixtral_8x7b(**overrides) -> DecoderConfig:
+    cfg = DecoderConfig(
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=128,
+        d_ff=14336,
+        rope_theta=1e6,
+        norm_eps=1e-5,
+        activation="swiglu",
+        scale_embeddings=False,
+        tie_embeddings=False,
+        moe_num_experts=8,
+        moe_top_k=2,
+    )
+    return replace(cfg, **overrides)
+
+
+def mixtral_test_config(**overrides) -> DecoderConfig:
+    """Shapes-only Mixtral-style config for CPU-mesh tests and the dryrun:
+    4 experts (divisible by the test meshes' expert axis), ample capacity so
+    nothing drops and outputs are comparable to the per-token reference."""
+    from .transformer import tiny_test_config
+
+    base = tiny_test_config(
+        activation="swiglu",
+        moe_num_experts=4,
+        moe_top_k=2,
+        moe_capacity_factor=4.0,
+    )
+    return replace(base, **overrides)

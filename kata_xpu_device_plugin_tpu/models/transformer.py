@@ -49,6 +49,13 @@ class DecoderConfig:
     scale_embeddings: bool = True
     tie_embeddings: bool = True
     logits_softcap: float = 0.0  # 0 disables (Gemma-2 uses 30.0)
+    # MoE: num_experts > 0 replaces the dense FFN with a top-k MoE FFN in
+    # EVERY layer (Mixtral layout; uniform layers keep the lax.scan single
+    # compiled body). The silu-gated expert MLP comes from ops.moe.
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01  # load-balancing loss weight
     dtype: Any = jnp.bfloat16
 
     @property
@@ -59,10 +66,29 @@ class DecoderConfig:
     def kv_dim(self) -> int:
         return self.n_kv_heads * self.head_dim
 
+    @property
+    def moe(self) -> bool:
+        return self.moe_num_experts > 0
+
+    def moe_cfg(self):
+        from ..ops.moe import MoEConfig
+
+        return MoEConfig(
+            d_model=self.d_model,
+            d_ff=self.d_ff,
+            num_experts=self.moe_num_experts,
+            capacity_factor=self.moe_capacity_factor,
+            top_k=self.moe_top_k,
+        )
+
     def num_params(self) -> int:
         embed = self.vocab_size * self.d_model
         attn = self.d_model * (self.q_dim + 2 * self.kv_dim) + self.q_dim * self.d_model
-        mlp = 3 * self.d_model * self.d_ff
+        if self.moe:
+            mlp = self.d_model * self.moe_num_experts  # router
+            mlp += self.moe_num_experts * 3 * self.d_model * self.d_ff
+        else:
+            mlp = 3 * self.d_model * self.d_ff
         norms = 2 * self.d_model
         per_layer = attn + mlp + norms
         unembed = 0 if self.tie_embeddings else embed
@@ -95,20 +121,32 @@ def init_params(key: jax.Array, cfg: DecoderConfig, dtype=jnp.float32) -> Params
         return (jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)).astype(dtype)
 
     L = cfg.n_layers
-    keys = jax.random.split(k_layers, 7)
-    params: Params = {
-        "embed": dense(k_embed, (cfg.vocab_size, cfg.d_model), cfg.d_model),
-        "layers": {
-            "attn_norm": jnp.ones((L, cfg.d_model), dtype),
-            "wq": dense(keys[0], (L, cfg.d_model, cfg.q_dim), cfg.d_model),
-            "wk": dense(keys[1], (L, cfg.d_model, cfg.kv_dim), cfg.d_model),
-            "wv": dense(keys[2], (L, cfg.d_model, cfg.kv_dim), cfg.d_model),
-            "wo": dense(keys[3], (L, cfg.q_dim, cfg.d_model), cfg.q_dim),
-            "mlp_norm": jnp.ones((L, cfg.d_model), dtype),
+    keys = jax.random.split(k_layers, 8)
+    layers: Params = {
+        "attn_norm": jnp.ones((L, cfg.d_model), dtype),
+        "wq": dense(keys[0], (L, cfg.d_model, cfg.q_dim), cfg.d_model),
+        "wk": dense(keys[1], (L, cfg.d_model, cfg.kv_dim), cfg.d_model),
+        "wv": dense(keys[2], (L, cfg.d_model, cfg.kv_dim), cfg.d_model),
+        "wo": dense(keys[3], (L, cfg.q_dim, cfg.d_model), cfg.q_dim),
+        "mlp_norm": jnp.ones((L, cfg.d_model), dtype),
+    }
+    if cfg.moe:
+        E, F = cfg.moe_num_experts, cfg.d_ff
+        layers.update({
+            "router": dense(keys[7], (L, cfg.d_model, E), cfg.d_model),
+            "moe_w_gate": dense(keys[4], (L, E, cfg.d_model, F), cfg.d_model),
+            "moe_w_in": dense(keys[5], (L, E, cfg.d_model, F), cfg.d_model),
+            "moe_w_out": dense(keys[6], (L, E, F, cfg.d_model), F),
+        })
+    else:
+        layers.update({
             "w_gate": dense(keys[4], (L, cfg.d_model, cfg.d_ff), cfg.d_model),
             "w_up": dense(keys[5], (L, cfg.d_model, cfg.d_ff), cfg.d_model),
             "w_down": dense(keys[6], (L, cfg.d_ff, cfg.d_model), cfg.d_ff),
-        },
+        })
+    params: Params = {
+        "embed": dense(k_embed, (cfg.vocab_size, cfg.d_model), cfg.d_model),
+        "layers": layers,
         "final_norm": jnp.ones((cfg.d_model,), dtype),
     }
     if not cfg.tie_embeddings:
@@ -184,8 +222,10 @@ def _layer(
     kv_cache: Optional[tuple[jax.Array, jax.Array]] = None,
     cache_offset: Optional[jax.Array] = None,
     prefill: bool = False,
+    moe_mesh=None,
 ):
-    """One decoder block. x: [B, S, D]. Returns (x, new_kv)."""
+    """One decoder block. x: [B, S, D]. Returns (x, new_kv, aux) where aux
+    is the layer's MoE load-balancing loss (0.0 for dense layers)."""
     B, S, _ = x.shape
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     q = (h @ layer["wq"].astype(h.dtype)).reshape(B, S, cfg.n_heads, cfg.head_dim)
@@ -221,10 +261,29 @@ def _layer(
     x = x + attn_out @ layer["wo"].astype(x.dtype)
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = _gate_act(h @ layer["w_gate"].astype(h.dtype), cfg.activation)
-    up = h @ layer["w_up"].astype(h.dtype)
-    x = x + (gate * up) @ layer["w_down"].astype(x.dtype)
-    return x, new_cache
+    if cfg.moe:
+        from ..ops import moe as moe_mod
+
+        moe_params = {"router": layer["router"], "w_gate": layer["moe_w_gate"],
+                      "w_in": layer["moe_w_in"], "w_out": layer["moe_w_out"]}
+        n_tokens = h.shape[0] * h.shape[1]
+        if moe_mesh is not None and moe_mod.dispatch_shardable(
+            n_tokens, cfg.moe_num_experts, moe_mesh
+        ):
+            # Data-sharded dispatch: sort/scatter run per token shard and
+            # the all-to-all carries only capacity buffers over ICI.
+            y, aux = moe_mod.moe_ffn_sharded(moe_params, h, cfg.moe_cfg(), moe_mesh)
+        else:
+            # Indivisible token count (or no mesh): GSPMD global dispatch —
+            # correct on any batch, just not dispatch-sharded.
+            y, aux = moe_mod.moe_ffn(moe_params, h, cfg.moe_cfg(), mesh=moe_mesh)
+        x = x + y.astype(x.dtype)
+    else:
+        gate = _gate_act(h @ layer["w_gate"].astype(h.dtype), cfg.activation)
+        up = h @ layer["w_up"].astype(h.dtype)
+        x = x + (gate * up) @ layer["w_down"].astype(x.dtype)
+        aux = jnp.float32(0.0)
+    return x, new_cache, aux
 
 
 def forward(
@@ -236,6 +295,8 @@ def forward(
     kv_caches: Optional[tuple[jax.Array, jax.Array]] = None,
     cache_offset: Optional[jax.Array] = None,
     prefill: bool = False,
+    moe_mesh=None,
+    return_aux: bool = False,
 ):
     """Full forward. tokens: [B, S] int32 → logits [B, S, vocab].
 
@@ -244,6 +305,10 @@ def forward(
     ``prefill=True`` (static) means the caches are empty: k/v are written at
     offset 0 and attention runs over the fresh k/v only (self-attention —
     flash-kernel eligible) instead of reading back the padded cache.
+
+    ``return_aux=True`` (static) appends the per-layer-mean MoE
+    load-balancing loss to the return value (0.0 for dense configs);
+    ``moe_mesh`` is the mesh whose expert axis shards the MoE buffers.
     """
     if attn_fn is None:
         from ..ops.attention import reference_attention
@@ -259,25 +324,27 @@ def forward(
         x = carry
         if kv_caches is not None:
             layer, (ck, cv) = layer_and_cache
-            x, new_cache = _layer(
+            x, new_cache, aux = _layer(
                 cfg, attn_fn, x, layer, positions, (ck, cv), cache_offset,
-                prefill=prefill,
+                prefill=prefill, moe_mesh=moe_mesh,
             )
-            return x, new_cache
+            return x, (new_cache, aux)
         layer = layer_and_cache
-        x, _ = _layer(cfg, attn_fn, x, layer, positions)
-        return x, None
+        x, _, aux = _layer(cfg, attn_fn, x, layer, positions, moe_mesh=moe_mesh)
+        return x, aux
 
     if kv_caches is not None:
-        x, new_caches = lax.scan(body, x, (params["layers"], kv_caches))
+        x, (new_caches, auxes) = lax.scan(body, x, (params["layers"], kv_caches))
     else:
-        x, _ = lax.scan(body, x, params["layers"])
+        x, auxes = lax.scan(body, x, params["layers"])
         new_caches = None
+    aux = jnp.mean(auxes)  # [L] per-layer load-balance losses
 
     logits = unembed(params, x, cfg)
-    if kv_caches is not None:
-        return logits, new_caches
-    return logits
+    out = (logits, new_caches) if kv_caches is not None else (logits,)
+    if return_aux:
+        out = out + (aux,)
+    return out[0] if len(out) == 1 else out
 
 
 # ----- loss / training -----------------------------------------------------
@@ -293,11 +360,19 @@ def token_nll_sum(logits: jax.Array, targets: jax.Array) -> jax.Array:
 
 
 def next_token_loss(params: Params, tokens: jax.Array, cfg: DecoderConfig,
-                    attn_fn: Optional[AttnFn] = None) -> jax.Array:
-    """Mean cross-entropy of predicting tokens[:, 1:] from tokens[:, :-1]."""
-    logits = forward(params, tokens[:, :-1], cfg, attn_fn=attn_fn)
+                    attn_fn: Optional[AttnFn] = None, moe_mesh=None) -> jax.Array:
+    """Mean cross-entropy of predicting tokens[:, 1:] from tokens[:, :-1],
+    plus ``cfg.moe_aux_weight`` × the MoE load-balancing loss when the
+    config is MoE (the aux term is what keeps the router from collapsing)."""
+    logits, aux = forward(
+        params, tokens[:, :-1], cfg, attn_fn=attn_fn, moe_mesh=moe_mesh,
+        return_aux=True,
+    )
     targets = tokens[:, 1:]
-    return token_nll_sum(logits, targets) / targets.size
+    loss = token_nll_sum(logits, targets) / targets.size
+    if cfg.moe:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
 
 
 # ----- KV cache / generation ----------------------------------------------

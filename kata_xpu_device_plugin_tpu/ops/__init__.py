@@ -11,9 +11,11 @@ from .collectives import (
 from .moe import (
     AXIS_EXPERT,
     MoEConfig,
+    dispatch_shardable,
     expert_mesh,
     init_moe_params,
     moe_ffn,
+    moe_ffn_sharded,
     moe_param_specs,
     reference_moe,
 )
@@ -29,9 +31,11 @@ __all__ = [
     "ring_all_reduce",
     "AXIS_EXPERT",
     "MoEConfig",
+    "dispatch_shardable",
     "expert_mesh",
     "init_moe_params",
     "moe_ffn",
+    "moe_ffn_sharded",
     "moe_param_specs",
     "reference_moe",
 ]

@@ -121,6 +121,13 @@ def transformer_stage_fn(cfg, attn_fn: Optional[Callable] = None):
     the composed pp×fsdp×tp step so the stage body cannot drift."""
     from ..models import transformer as tfm
 
+    if getattr(cfg, "moe", False):
+        # The stage body discards each layer's aux loss; training an MoE
+        # config here would silently drop the router-balancing term.
+        raise ValueError(
+            "pipeline stages do not thread the MoE aux loss yet; "
+            "use the unpipelined make_train_step for MoE configs"
+        )
     if attn_fn is None:
         from ..ops.attention import reference_attention
 
@@ -131,7 +138,7 @@ def transformer_stage_fn(cfg, attn_fn: Optional[Callable] = None):
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
         def body(h, layer):
-            h, _ = tfm._layer(cfg, attn_fn, h, layer, positions)
+            h, _, _aux = tfm._layer(cfg, attn_fn, h, layer, positions)
             return h, None
 
         x, _ = lax.scan(body, x, stage_layers)

@@ -38,6 +38,13 @@ PARAM_RULES: dict[str, P] = {
     "layers.w_gate": P(None, AXIS_FSDP, AXIS_MODEL),
     "layers.w_up": P(None, AXIS_FSDP, AXIS_MODEL),
     "layers.w_down": P(None, AXIS_MODEL, AXIS_FSDP),
+    # MoE layers: experts shard over the model axis (ep replaces tp in the
+    # FFN — ops.moe.expert_axis_for), d_model over fsdp; the tiny router is
+    # replicated on the expert dim.
+    "layers.router": P(None, AXIS_FSDP, None),
+    "layers.moe_w_gate": P(None, AXIS_MODEL, AXIS_FSDP, None),
+    "layers.moe_w_in": P(None, AXIS_MODEL, AXIS_FSDP, None),
+    "layers.moe_w_out": P(None, AXIS_MODEL, None, AXIS_FSDP),
     "final_norm": P(None),
 }
 
@@ -112,7 +119,9 @@ def make_train_step(
         return {"params": params, "opt": opt_state, "step": step_counter}
 
     def loss_fn(params, tokens):
-        return tfm.next_token_loss(params, tokens, cfg, attn_fn=attn_fn)
+        return tfm.next_token_loss(
+            params, tokens, cfg, attn_fn=attn_fn, moe_mesh=mesh if cfg.moe else None
+        )
 
     from functools import partial
 

@@ -1,0 +1,163 @@
+"""MoE wired into the model stack (VERDICT r2 item 3): a Mixtral-style
+config must flow through forward / next_token_loss / make_train_step with
+experts sharded over the mesh, and match the per-token reference expert
+computation when capacity is ample."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu import parallel
+from kata_xpu_device_plugin_tpu.models import mixtral_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import (
+    forward,
+    generate,
+    init_params,
+    next_token_loss,
+)
+from kata_xpu_device_plugin_tpu.ops import moe as moe_mod
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return mixtral_test_config(dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(jax.random.PRNGKey(0), cfg)
+
+
+def _tokens(cfg, shape=(2, 16)):
+    return jax.random.randint(
+        jax.random.PRNGKey(1), shape, 0, cfg.vocab_size, dtype=jnp.int32
+    )
+
+
+def test_moe_forward_matches_per_token_reference(cfg, params, monkeypatch):
+    """At ample capacity the dispatch machinery must equal computing each
+    token's top-k experts directly (reference_moe)."""
+    tokens = _tokens(cfg)
+    out = forward(params, tokens, cfg)
+
+    real_moe_ffn = moe_mod.moe_ffn
+
+    def via_reference(p, x, mcfg, mesh=None, axis=None):
+        del mesh, axis
+        return moe_mod.reference_moe(p, x, mcfg), jnp.float32(0.0)
+
+    monkeypatch.setattr(moe_mod, "moe_ffn", via_reference)
+    ref = forward(params, tokens, cfg)
+    monkeypatch.setattr(moe_mod, "moe_ffn", real_moe_ffn)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_moe_aux_loss_plumbed(cfg, params):
+    """The load-balancing aux term must reach the training loss."""
+    from dataclasses import replace
+
+    tokens = _tokens(cfg)
+    with_aux = next_token_loss(params, tokens, cfg)
+    without = next_token_loss(params, tokens, replace(cfg, moe_aux_weight=0.0))
+    # aux_loss >= 1.0 by construction (E * sum f_i p_i minimized at uniform),
+    # so the weighted difference must be positive and roughly aux_weight-sized.
+    diff = float(with_aux - without)
+    assert diff > 0.5 * cfg.moe_aux_weight, diff
+
+
+def test_moe_train_step_ep_fsdp(cfg):
+    """An ep×fsdp train step: experts shard over the model axis, tokens over
+    data/fsdp; loss is finite and decreases."""
+    mesh = parallel.build_mesh(
+        {"data": 1, "fsdp": 2, "model": 4}, devices=jax.devices()
+    )
+    init_state, step = parallel.make_train_step(cfg, mesh)
+    state = init_state(jax.random.PRNGKey(0))
+    # Expert-major tensors really are sharded over the model axis.
+    w = state["params"]["layers"]["moe_w_gate"]
+    assert w.sharding.spec[1] == "model"
+    tokens = parallel.shard_batch(_tokens(cfg, (8, 16)), mesh)
+    losses = []
+    for _ in range(3):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_generate_runs(cfg, params):
+    out = generate(params, _tokens(cfg, (2, 8)), cfg, steps=4, max_len=16)
+    assert out.shape == (2, 4)
+    assert bool(jnp.all((out >= 0) & (out < cfg.vocab_size)))
+
+
+def test_moe_param_count_formula(cfg):
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == cfg.num_params()
+
+
+def test_moe_sharded_dispatch_matches_reference():
+    """VERDICT r2 item 4: token-sharded dispatch on a 2-D (data × expert)
+    mesh — per-shard sort/scatter, all_to_all capacity buffers — must equal
+    the per-token reference at ample capacity, and each device must hold
+    only its T/n token shard of the dispatch work."""
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    mcfg = moe_mod.MoEConfig(
+        d_model=16, d_ff=32, num_experts=4, capacity_factor=8.0, top_k=2
+    )
+    mesh = Mesh(mesh_utils.create_device_mesh((2, 4)), ("data", "expert"))
+    mparams = moe_mod.init_moe_params(jax.random.PRNGKey(0), mcfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, mcfg.d_model))
+
+    ref = moe_mod.reference_moe(mparams, x, mcfg)
+    y, aux = jax.jit(lambda p, t: moe_mod.moe_ffn_sharded(p, t, mcfg, mesh))(
+        mparams, x
+    )
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-4, atol=1e-5)
+    assert float(aux) > 0
+
+    # Global-formula aux and sharded-global aux agree (same routing).
+    _, aux_global = moe_mod.moe_ffn(mparams, x, mcfg)
+    np.testing.assert_allclose(float(aux), float(aux_global), rtol=1e-5)
+
+
+def test_moe_sharded_rejects_indivisible():
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    mcfg = moe_mod.MoEConfig(d_model=16, d_ff=32, num_experts=3, top_k=1)
+    mesh = Mesh(mesh_utils.create_device_mesh((2, 4)), ("data", "expert"))
+    mparams = moe_mod.init_moe_params(jax.random.PRNGKey(0), mcfg)
+    x = jnp.zeros((2, 16, 16))
+    with pytest.raises(ValueError, match="not divisible"):
+        moe_mod.moe_ffn_sharded(mparams, x, mcfg, mesh)  # E=3, ep=4
+    mcfg4 = moe_mod.MoEConfig(d_model=16, d_ff=32, num_experts=4, top_k=1)
+    mparams4 = moe_mod.init_moe_params(jax.random.PRNGKey(0), mcfg4)
+    with pytest.raises(ValueError, match="not divisible"):
+        moe_mod.moe_ffn_sharded(mparams4, jnp.zeros((3, 3, 16)), mcfg4, mesh)
+
+
+def test_moe_indivisible_batch_falls_back_to_global_dispatch():
+    """A batch that is valid for the dense model must train for MoE too:
+    when T doesn't divide the mesh, the layer falls back to the GSPMD global
+    dispatch instead of raising."""
+    cfg = mixtral_test_config(dtype=jnp.float32)
+    mesh = parallel.build_mesh(
+        {"data": 1, "fsdp": 2, "model": 4}, devices=jax.devices()
+    )
+    init_state, step = parallel.make_train_step(cfg, mesh)
+    state = init_state(jax.random.PRNGKey(0))
+    # B=4, S=16 → T = 4*15 = 60, not divisible by 8.
+    tokens = parallel.shard_batch(_tokens(cfg, (4, 16)), mesh)
+    state, loss = step(state, tokens)
+    assert np.isfinite(float(loss))
+
+
+def test_moe_rejected_by_pipeline():
+    cfg = mixtral_test_config(dtype=jnp.float32)
+    mesh = parallel.composed_mesh(2, 2, 2)
+    with pytest.raises(ValueError, match="aux loss"):
+        parallel.make_pp_loss(cfg, mesh, n_stages=2, num_microbatches=4)

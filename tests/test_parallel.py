@@ -157,7 +157,7 @@ def test_pipeline_transformer_block_stages():
     positions = jnp.arange(seq)[None, :]
 
     def stage(layer_params, x):
-        y, _cache = _layer(
+        y, _cache, _aux = _layer(
             cfg, reference_attention, x, layer_params, positions
         )
         return y

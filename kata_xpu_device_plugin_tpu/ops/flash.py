@@ -1,4 +1,4 @@
-"""Pallas TPU flash attention (forward).
+"""Pallas TPU flash attention (forward + custom_vjp backward).
 
 Blockwise online-softmax attention: the [Sq, Sk] score matrix never reaches
 HBM — each (q-block, k-block) tile is computed in VMEM on the MXU, with
@@ -6,10 +6,14 @@ running max/denominator carried in VMEM scratch across the (sequential) last
 grid dimension. Supports GQA/MQA natively by index-mapping each q head onto
 its KV head, so KV heads are never materialized H/KV times.
 
-Used for prefill/inference (the decode hot path is tiny-q and stays on XLA;
-training uses the XLA reference path which autodiffs). Numerics oracle:
-``tests/test_ops.py`` compares against ``reference_attention`` on CPU via
-interpret mode, and the bench compares on the real chip.
+Training-ready: the forward also emits the per-row logsumexp, and a
+``jax.custom_vjp`` backward recomputes each tile's probabilities from it
+(FlashAttention-2 style — dq gridded over q-blocks, dk/dv over k-blocks), so
+the Llama-scale training path never materializes [S, S] either. The decode
+hot path is tiny-q and lives in :mod:`.decode_attn`. Numerics oracle:
+``tests/test_ops.py`` compares forward AND gradients against
+``reference_attention`` on CPU via interpret mode; the bench compares on the
+real chip.
 """
 from __future__ import annotations
 
@@ -47,12 +51,20 @@ def supports(sq: int, sk: int, d: int) -> bool:
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
-    block_q: int, block_k: int,
+    off_ref, q_ref, k_ref, v_ref, o_ref, *rest,
+    scale: float, causal: bool, block_q: int, block_k: int, emit_lse: bool,
 ):
+    if emit_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     num_k = pl.num_programs(3)
+    # Global-position offsets (scalar-prefetched): zero for plain
+    # self-attention; ring attention passes each device's sequence offsets
+    # so the causal frontier is judged on GLOBAL positions.
+    q_off, k_off = off_ref[0], off_ref[1]
 
     @pl.when(ki == 0)
     def _init():
@@ -74,8 +86,12 @@ def _flash_kernel(
         ) * scale  # [BQ, BK] fp32
 
         if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            q_pos = q_off + qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_off + ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
             logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
 
         m_prev = m_scr[:, 0:1]  # [BQ, 1]
@@ -97,9 +113,9 @@ def _flash_kernel(
         acc_scr[...] = acc
 
     if causal:
-        # Skip k-blocks entirely above the causal frontier — ~half the grid
-        # at long sequence; the MXU never sees fully-masked tiles.
-        pl.when(ki * block_k <= qi * block_q + block_q - 1)(compute)
+        # Skip k-blocks entirely above the (global) causal frontier — ~half
+        # the grid at long sequence; the MXU never sees fully-masked tiles.
+        pl.when(k_off + ki * block_k <= q_off + qi * block_q + block_q - 1)(compute)
     else:
         compute()
 
@@ -108,6 +124,381 @@ def _flash_kernel(
         denom = l_scr[:, 0:1]
         denom = jnp.where(denom == 0.0, 1.0, denom)
         o_ref[0, 0, :, :] = (acc_scr[...] / denom).astype(o_ref.dtype)
+        if emit_lse:
+            # Logsumexp per query row, saved for the backward recompute
+            # (stored 128-wide: lane-aligned, read back as column 0).
+            lse = m_scr[:, 0:1] + jnp.log(denom)
+            lse_ref[0, 0, :, :] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group, interpret, scale,
+              offsets=(0, 0), need_lse=True):
+    """[B, H, S, D]-layout forward returning (out, logsumexp[B, H, Sq, 128]
+    or None). ``offsets = (q_off, k_off)`` are global sequence offsets (may
+    be traced scalars — ring attention passes per-device offsets).
+    ``need_lse=False`` (inference: no backward, no ring merge) skips the
+    logsumexp write entirely — it is pure extra HBM traffic there."""
+    B, H, Sq, D = q_t.shape
+    Sk = k_t.shape[2]
+    grid = (B, H, Sq // block_q, Sk // block_k)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, emit_lse=need_lse,
+    )
+    offs = jnp.asarray(offsets, jnp.int32)  # (q_off, k_off) tuple or [2] array
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki, off: (b, h, qi, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, qi, ki, off: (b, h // group, ki, 0)
+    )
+    row_spec = pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki, off: (b, h, qi, 0))
+    out_specs = [q_spec] + ([row_spec] if need_lse else [])
+    out_shape = [jax.ShapeDtypeStruct(q_t.shape, q_t.dtype)] + (
+        [jax.ShapeDtypeStruct((B, H, Sq, 128), jnp.float32)] if need_lse else []
+    )
+    res = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=out_specs,
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 128), jnp.float32),  # running max (col 0)
+                pltpu.VMEM((block_q, 128), jnp.float32),  # running denom
+                pltpu.VMEM((block_q, D), jnp.float32),  # output accumulator
+            ],
+        ),
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(offs, q_t, k_t, v_t)
+    return (res[0], res[1]) if need_lse else (res[0], None)
+
+
+# ----- backward (FlashAttention-2 style: recompute p from q/k + logsumexp,
+# dq gridded over q-blocks, dk/dv gridded over k-blocks) --------------------
+
+
+def _bwd_dq_kernel(
+    off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    num_k = pl.num_programs(3)
+    q_off, k_off = off_ref[0], off_ref[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, 0:1]  # [BQ, 1]
+        delta = delta_ref[0, 0][:, 0:1]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        p = jnp.exp(s - lse)  # [BQ, BK]
+        if causal:
+            q_pos = q_off + qi * block_q + lax.broadcasted_iota(jnp.int32, p.shape, 0)
+            k_pos = k_off + ki * block_k + lax.broadcasted_iota(jnp.int32, p.shape, 1)
+            p = jnp.where(k_pos <= q_pos, p, 0.0)
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        ds = p * (dp - delta) * scale
+        dq_scr[...] += lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(
+            k_off + ki * block_k <= q_off + qi * block_q + block_q - 1
+        )(compute)
+    else:
+        compute()
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+    num_q = pl.num_programs(3)
+    q_off, k_off = off_ref[0], off_ref[1]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, 0:1]
+        delta = delta_ref[0, 0][:, 0:1]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        p = jnp.exp(s - lse)  # [BQ, BK]
+        if causal:
+            q_pos = q_off + qi * block_q + lax.broadcasted_iota(jnp.int32, p.shape, 0)
+            k_pos = k_off + ki * block_k + lax.broadcasted_iota(jnp.int32, p.shape, 1)
+            p = jnp.where(k_pos <= q_pos, p, 0.0)
+        pv = p.astype(do.dtype)
+        dv_scr[...] += lax.dot_general(
+            pv, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BK, D]
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_scr[...] += lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BK, D]
+
+    if causal:
+        # This k-block only sees q-blocks at or below the frontier.
+        pl.when(
+            k_off + ki * block_k <= q_off + qi * block_q + block_q - 1
+        )(compute)
+    else:
+        compute()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_call(q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k,
+              group, interpret, scale, offsets=(0, 0), dlse=None):
+    """Gradients in the [B, H, S, D] layout. dk/dv are per Q-HEAD here; the
+    caller sums head groups down to the KV heads.
+
+    ``dlse`` is the cotangent of the logsumexp output (ring attention's
+    merge differentiates through it): d lse_i/d s_ij = p_ij, so it simply
+    joins the ds bracket — ds = p·(dp − (Δ − dlse))·scale."""
+    B, H, Sq, D = q_t.shape
+    Sk = k_t.shape[2]
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, XLA-side, stored
+    # 128-wide like the logsumexp.
+    delta = jnp.sum(do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse
+    delta = jnp.broadcast_to(delta[..., None], (B, H, Sq, 128))
+    offs = jnp.asarray(offsets, jnp.int32)
+
+    q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki, off: (b, h, qi, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, qi, ki, off: (b, h // group, ki, 0)
+    )
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, 128), lambda b, h, qi, ki, off: (b, h, qi, 0)
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, Sq // block_q, Sk // block_k),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            out_specs=q_spec,
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q_t.shape, q_t.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(offs, q_t, k_t, v_t, do_t, lse, delta)
+
+    # dk/dv: grid sequential over q-blocks; indices (b, h, ki, qi).
+    q_spec2 = pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi, off: (b, h, qi, 0))
+    kv_spec2 = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, ki, qi, off: (b, h // group, ki, 0)
+    )
+    kv_out_spec = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, ki, qi, off: (b, h, ki, 0)
+    )
+    row_spec2 = pl.BlockSpec(
+        (1, 1, block_q, 128), lambda b, h, ki, qi, off: (b, h, qi, 0)
+    )
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, Sk // block_k, Sq // block_q),
+            in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+            out_specs=[kv_out_spec, kv_out_spec],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((block_k, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            # fp32 partials: each is a per-q-head contribution that the
+            # caller sums across the GQA group — rounding to bf16 BEFORE
+            # that sum would grow gradient error with the group size.
+            jax.ShapeDtypeStruct((B, H, Sk, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Sk, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(offs, q_t, k_t, v_t, do_t, lse, delta)
+    return dq, dk_h, dv_h
+
+
+def _group_kv_grads(dk_h, dv_h, KV, group):
+    """Per-q-head dk/dv → per-KV-head (sum each group of G q-heads)."""
+    B, H, Sk, D = dk_h.shape
+    dk = dk_h.reshape(B, KV, group, Sk, D).sum(axis=2)
+    dv = dv_h.reshape(B, KV, group, Sk, D).sum(axis=2)
+    return dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    group = q.shape[2] // k.shape[2]
+    scale = float(1.0 / (q.shape[3] ** 0.5))
+    # Pallas TPU tiles the LAST TWO dims: run kernels in [B, H, S, D] layout
+    # so (S-block, D) are the tiled pair. No lse output on the primal path —
+    # inference would pay its HBM write for nothing.
+    out_t, _ = _fwd_call(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+        causal, block_q, block_k, group, interpret, scale, need_lse=False,
+    )
+    return out_t.transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    group = q.shape[2] // k.shape[2]
+    scale = float(1.0 / (q.shape[3] ** 0.5))
+    q_t = q.transpose(0, 2, 1, 3)
+    k_t = k.transpose(0, 2, 1, 3)
+    v_t = v.transpose(0, 2, 1, 3)
+    out_t, lse = _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group,
+                           interpret, scale)
+    return out_t.transpose(0, 2, 1, 3), (q_t, k_t, v_t, out_t, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
+    q_t, k_t, v_t, out_t, lse = res
+    B, H, Sq, D = q_t.shape
+    KV = k_t.shape[1]
+    group = H // KV
+    scale = float(1.0 / (D**0.5))
+    do_t = dout.transpose(0, 2, 1, 3)
+    dq, dk_h, dv_h = _bwd_call(
+        q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k, group,
+        interpret, scale,
+    )
+    dk, dv = _group_kv_grads(dk_h, dv_h, KV, group)
+    return (
+        dq.transpose(0, 2, 1, 3),
+        dk.transpose(0, 2, 1, 3).astype(k_t.dtype),
+        dv.transpose(0, 2, 1, 3).astype(v_t.dtype),
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ----- ring-attention block API (differentiable) ---------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_block(q, k, v, offs, causal, block_q, block_k, interpret):
+    out, _ = _flash_block_fwd(q, k, v, offs, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_block_fwd(q, k, v, offs, causal, block_q, block_k, interpret):
+    group = q.shape[2] // k.shape[2]
+    scale = float(1.0 / (q.shape[3] ** 0.5))
+    q_t = q.transpose(0, 2, 1, 3)
+    k_t = k.transpose(0, 2, 1, 3)
+    v_t = v.transpose(0, 2, 1, 3)
+    out_t, lse = _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group,
+                           interpret, scale, offsets=offs)
+    out = (out_t.transpose(0, 2, 1, 3), lse[..., 0].transpose(0, 2, 1))
+    return out, (q_t, k_t, v_t, out_t, lse, offs)
+
+
+def _flash_block_bwd(causal, block_q, block_k, interpret, res, cts):
+    import numpy as _np
+
+    q_t, k_t, v_t, out_t, lse, offs = res
+    dout, dlse_bsh = cts
+    B, H, Sq, D = q_t.shape
+    KV = k_t.shape[1]
+    group = H // KV
+    scale = float(1.0 / (D**0.5))
+    do_t = dout.transpose(0, 2, 1, 3)
+    # defvjp without symbolic_zeros: the lse cotangent is always a dense
+    # array (zeros when lse is unused downstream).
+    dlse = dlse_bsh.transpose(0, 2, 1).astype(jnp.float32)  # [B, H, Sq]
+    dq, dk_h, dv_h = _bwd_call(
+        q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k, group,
+        interpret, scale, offsets=offs, dlse=dlse,
+    )
+    dk, dv = _group_kv_grads(dk_h, dv_h, KV, group)
+    return (
+        dq.transpose(0, 2, 1, 3),
+        dk.transpose(0, 2, 1, 3).astype(k_t.dtype),
+        dv.transpose(0, 2, 1, 3).astype(v_t.dtype),
+        _np.zeros(offs.shape, jax.dtypes.float0),  # int offsets: no gradient
+    )
+
+
+_flash_block.defvjp(_flash_block_fwd, _flash_block_bwd)
+
+
+def flash_block_attention(
+    q: jax.Array,  # [B, S_q, H, D]
+    k: jax.Array,  # [B, S_k, KV, D]
+    v: jax.Array,
+    q_offset,  # global position of q[0] (scalar, may be traced)
+    k_offset,  # global position of k[0]
+    causal: bool = True,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """One block-pair's partial attention for ring attention: returns
+    ``(out, lse)`` where ``out`` is softmax-normalized WITHIN the block and
+    ``lse [B, S_q, H]`` is its log-sum-exp — exactly what the ring's running
+    (m, l, acc) merge needs to combine blocks across ``ppermute`` steps.
+    Differentiable (custom_vjp recomputes blockwise; the lse cotangent joins
+    the ds bracket), so the fused sp path trains."""
+    assert q.shape[3] == k.shape[3] and q.shape[2] % k.shape[2] == 0, (
+        q.shape, k.shape)
+    bq = pick_block(q.shape[1], block_q)
+    bk = pick_block(k.shape[1], block_k)
+    if bq is None or bk is None:
+        raise ValueError(f"no valid flash block for Sq={q.shape[1]}, Sk={k.shape[1]}")
+    offs = jnp.stack([jnp.int32(q_offset), jnp.int32(k_offset)])
+    return _flash_block(q, k, v, offs, causal, bq, bk, interpret)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
@@ -122,13 +513,14 @@ def pallas_flash_attention(
     interpret: bool = False,
 ) -> jax.Array:
     """q [B, Sq, H, D]; k/v [B, Sk, KV, D], H % KV == 0. Self-attention only
-    (``q_offset`` unsupported here — callers fall back to the reference)."""
+    (``q_offset`` unsupported here — callers fall back to the reference).
+    Differentiable: a custom_vjp recomputes attention blockwise from the
+    saved logsumexp, so training never materializes [Sq, Sk]."""
     if q_offset is not None:
         raise ValueError("pallas_flash_attention is for self-attention (q_offset=None)")
     B, Sq, H, D = q.shape
     _, Sk, KV, _ = k.shape
     assert H % KV == 0, (H, KV)
-    group = H // KV
     block_q = pick_block(Sq, block_q)
     block_k = pick_block(Sk, block_k)
     if block_q is None or block_k is None:
@@ -136,35 +528,4 @@ def pallas_flash_attention(
             f"no valid flash block for Sq={Sq}, Sk={Sk} (need a divisor ≥128, "
             "multiple of 8); use reference_attention"
         )
-    grid = (B, H, Sq // block_q, Sk // block_k)
-
-    scale = float(1.0 / (D ** 0.5))
-    kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
-    )
-    # Pallas TPU tiles the LAST TWO dims: run the kernel in [B, H, S, D]
-    # layout so (S-block, D) are the tiled pair.
-    q_t = q.transpose(0, 2, 1, 3)  # [B, H, Sq, D]
-    k_t = k.transpose(0, 2, 1, 3)  # [B, KV, Sk, D]
-    v_t = v.transpose(0, 2, 1, 3)
-    out_t = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // group, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // group, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(q_t.shape, q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running max (col 0 used)
-            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom
-            pltpu.VMEM((block_q, D), jnp.float32),  # output accumulator
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(q_t, k_t, v_t)
-    return out_t.transpose(0, 2, 1, 3)
+    return _flash(q, k, v, causal, block_q, block_k, interpret)

@@ -101,7 +101,14 @@ def make_train_step(
     attn_fn: Optional[Callable] = None,
 ):
     """Returns (init_state, step). ``step(state, tokens) -> (state, loss)``,
-    jitted over the mesh with donated state."""
+    jitted over the mesh with donated state.
+
+    ``attn_fn`` defaults to the XLA reference. The differentiable pallas
+    flash kernel (``ops.attention.flash_attention``) can be passed instead,
+    but note the step is plain-jit GSPMD: a pallas custom call has no SPMD
+    partitioning rule, so on a sharded mesh XLA may replicate its operands —
+    wrap it in shard_map over the batch axes before making it the default
+    (single-device training benefits today)."""
     optimizer = optimizer or make_optimizer()
 
     def init_state(key: jax.Array):

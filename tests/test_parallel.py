@@ -305,3 +305,50 @@ def test_moe_expert_parallel_matches_unsharded():
     np.testing.assert_allclose(
         np.asarray(y_local), np.asarray(jax.device_get(y_ep)), rtol=1e-4, atol=1e-5
     )
+
+
+def test_ring_attention_flash_fused():
+    """Ring attention with the pallas block kernel per ring step (VERDICT r2
+    item 6): global-causal numerics must still match reference_attention."""
+    from kata_xpu_device_plugin_tpu.ops.attention import reference_attention
+    from kata_xpu_device_plugin_tpu.parallel import seq_mesh
+    from kata_xpu_device_plugin_tpu.parallel.ring import make_ring_attention
+
+    B, S, H, KV, D = 1, 4 * 128, 2, 1, 64  # S_loc=128: block-kernel eligible
+    keys = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(keys[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(keys[1], (B, S, KV, D), jnp.float32)
+    v = jax.random.normal(keys[2], (B, S, KV, D), jnp.float32)
+    mesh = seq_mesh(4)
+    ref = reference_attention(q, k, v, causal=True)
+    ring = make_ring_attention(mesh, use_flash=True, flash_interpret=True)
+    out = jax.jit(ring)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-4)
+
+
+def test_ring_attention_flash_fused_gradients():
+    """The fused sp path must TRAIN: gradients through the per-block pallas
+    kernel (lse cotangent folded into the recompute) match the reference."""
+    from kata_xpu_device_plugin_tpu.ops.attention import reference_attention
+    from kata_xpu_device_plugin_tpu.parallel import seq_mesh
+    from kata_xpu_device_plugin_tpu.parallel.ring import make_ring_attention
+
+    B, S, H, KV, D = 1, 4 * 128, 2, 1, 64
+    keys = jax.random.split(jax.random.PRNGKey(8), 4)
+    q = jax.random.normal(keys[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(keys[1], (B, S, KV, D), jnp.float32)
+    v = jax.random.normal(keys[2], (B, S, KV, D), jnp.float32)
+    dout = jax.random.normal(keys[3], q.shape, jnp.float32)
+    ring = make_ring_attention(seq_mesh(4), use_flash=True, flash_interpret=True)
+
+    gf = jax.grad(
+        lambda q, k, v: jnp.sum(ring(q, k, v) * dout), argnums=(0, 1, 2)
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: jnp.sum(reference_attention(q, k, v, causal=True) * dout),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, nm in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-4, err_msg=f"d{nm}"
+        )

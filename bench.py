@@ -181,6 +181,7 @@ def worker(args: argparse.Namespace) -> None:
         prefill,
     )
     from kata_xpu_device_plugin_tpu.ops.attention import (
+        decode_eligible,
         flash_attention,
         flash_eligible,
         reference_attention,
@@ -288,6 +289,11 @@ def worker(args: argparse.Namespace) -> None:
         "platform": devs[0].platform,
         "device_kind": str(getattr(devs[0], "device_kind", "")),
         "config": "smoke-tiny" if args.smoke else "gemma2b",
+        "decode_attn": (
+            "pallas_fused"
+            if decode_eligible(1, max_len, cfg.head_dim, True, 0)
+            else "xla_reference"
+        ),
         "decode_s": round(dt, 4),
         "prompt_prefill_s": round(prompt_prefill_s, 4),
         "e2e_tok_per_s": round(total_tokens / best_e2e_s, 1),

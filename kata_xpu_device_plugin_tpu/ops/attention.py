@@ -104,6 +104,17 @@ def flash_eligible(sq: int, sk: int, d: int, q_offset=None) -> bool:
     )
 
 
+def decode_eligible(sq: int, sk: int, d: int, causal: bool, q_offset) -> bool:
+    """Trace-time gate for the fused decode kernel — the ONE place the
+    dispatch condition lives (the bench's path label uses it too, so label
+    and dispatch cannot drift)."""
+    from .decode_attn import supports_decode
+
+    return (
+        causal and q_offset is not None and on_tpu() and supports_decode(sq, sk, d)
+    )
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -118,11 +129,10 @@ def flash_attention(
     where a kernel launch can't pay for itself."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
-    if causal and q_offset is not None and Sq == 1 and on_tpu():
-        from .decode_attn import pallas_decode_attention, supports_decode
+    if decode_eligible(Sq, Sk, D, causal, q_offset):
+        from .decode_attn import pallas_decode_attention
 
-        if supports_decode(Sq, Sk, D):
-            return pallas_decode_attention(q, k, v, q_offset)
+        return pallas_decode_attention(q, k, v, q_offset)
     if not flash_eligible(Sq, Sk, D, q_offset):
         return reference_attention(q, k, v, causal=causal, q_offset=q_offset)
     from .flash import pallas_flash_attention

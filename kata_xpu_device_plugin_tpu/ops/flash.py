@@ -27,6 +27,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# Lane width for per-row side outputs (logsumexp, delta): only column 0 is
+# read back, so keep the HBM footprint at 8 lanes (sublane-aligned) rather
+# than a full 128-lane tile.
+ROW_W = 8
 
 
 def pick_block(seq_len: int, requested: int) -> Optional[int]:
@@ -126,14 +130,14 @@ def _flash_kernel(
         o_ref[0, 0, :, :] = (acc_scr[...] / denom).astype(o_ref.dtype)
         if emit_lse:
             # Logsumexp per query row, saved for the backward recompute
-            # (stored 128-wide: lane-aligned, read back as column 0).
+            # (stored ROW_W-wide; read back as column 0).
             lse = m_scr[:, 0:1] + jnp.log(denom)
             lse_ref[0, 0, :, :] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
 def _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group, interpret, scale,
               offsets=(0, 0), need_lse=True):
-    """[B, H, S, D]-layout forward returning (out, logsumexp[B, H, Sq, 128]
+    """[B, H, S, D]-layout forward returning (out, logsumexp[B, H, Sq, ROW_W]
     or None). ``offsets = (q_off, k_off)`` are global sequence offsets (may
     be traced scalars — ring attention passes per-device offsets).
     ``need_lse=False`` (inference: no backward, no ring merge) skips the
@@ -150,10 +154,12 @@ def _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group, interpret, scale,
     kv_spec = pl.BlockSpec(
         (1, 1, block_k, D), lambda b, h, qi, ki, off: (b, h // group, ki, 0)
     )
-    row_spec = pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki, off: (b, h, qi, 0))
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, ROW_W), lambda b, h, qi, ki, off: (b, h, qi, 0)
+    )
     out_specs = [q_spec] + ([row_spec] if need_lse else [])
     out_shape = [jax.ShapeDtypeStruct(q_t.shape, q_t.dtype)] + (
-        [jax.ShapeDtypeStruct((B, H, Sq, 128), jnp.float32)] if need_lse else []
+        [jax.ShapeDtypeStruct((B, H, Sq, ROW_W), jnp.float32)] if need_lse else []
     )
     res = pl.pallas_call(
         kernel,
@@ -294,11 +300,11 @@ def _bwd_call(q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k,
     B, H, Sq, D = q_t.shape
     Sk = k_t.shape[2]
     # delta_i = rowsum(dO_i * O_i) — cheap elementwise, XLA-side, stored
-    # 128-wide like the logsumexp.
+    # ROW_W-wide like the logsumexp.
     delta = jnp.sum(do_t.astype(jnp.float32) * out_t.astype(jnp.float32), axis=-1)
     if dlse is not None:
         delta = delta - dlse
-    delta = jnp.broadcast_to(delta[..., None], (B, H, Sq, 128))
+    delta = jnp.broadcast_to(delta[..., None], (B, H, Sq, ROW_W))
     offs = jnp.asarray(offsets, jnp.int32)
 
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki, off: (b, h, qi, 0))
@@ -306,7 +312,7 @@ def _bwd_call(q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k,
         (1, 1, block_k, D), lambda b, h, qi, ki, off: (b, h // group, ki, 0)
     )
     row_spec = pl.BlockSpec(
-        (1, 1, block_q, 128), lambda b, h, qi, ki, off: (b, h, qi, 0)
+        (1, 1, block_q, ROW_W), lambda b, h, qi, ki, off: (b, h, qi, 0)
     )
 
     dq = pl.pallas_call(
@@ -337,7 +343,7 @@ def _bwd_call(q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k,
         (1, 1, block_k, D), lambda b, h, ki, qi, off: (b, h, ki, 0)
     )
     row_spec2 = pl.BlockSpec(
-        (1, 1, block_q, 128), lambda b, h, ki, qi, off: (b, h, qi, 0)
+        (1, 1, block_q, ROW_W), lambda b, h, ki, qi, off: (b, h, qi, 0)
     )
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
@@ -392,33 +398,22 @@ def _flash(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    group = q.shape[2] // k.shape[2]
-    scale = float(1.0 / (q.shape[3] ** 0.5))
-    q_t = q.transpose(0, 2, 1, 3)
-    k_t = k.transpose(0, 2, 1, 3)
-    v_t = v.transpose(0, 2, 1, 3)
-    out_t, lse = _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group,
-                           interpret, scale)
-    return out_t.transpose(0, 2, 1, 3), (q_t, k_t, v_t, out_t, lse)
+    """VJP forward rule: the zero-offset case of the block rules — one
+    numerical implementation for both the self-attention and ring paths."""
+    (out, _lse), res = _flash_block_fwd(
+        q, k, v, jnp.zeros((2,), jnp.int32), causal, block_q, block_k, interpret
+    )
+    return out, res
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
-    q_t, k_t, v_t, out_t, lse = res
-    B, H, Sq, D = q_t.shape
-    KV = k_t.shape[1]
-    group = H // KV
-    scale = float(1.0 / (D**0.5))
-    do_t = dout.transpose(0, 2, 1, 3)
-    dq, dk_h, dv_h = _bwd_call(
-        q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k, group,
-        interpret, scale,
+    lse = res[4]
+    B, H, Sq = lse.shape[:3]
+    dlse_zero = jnp.zeros((B, Sq, H), jnp.float32)
+    dq, dk, dv, _doffs = _flash_block_bwd(
+        causal, block_q, block_k, interpret, res, (dout, dlse_zero)
     )
-    dk, dv = _group_kv_grads(dk_h, dv_h, KV, group)
-    return (
-        dq.transpose(0, 2, 1, 3),
-        dk.transpose(0, 2, 1, 3).astype(k_t.dtype),
-        dv.transpose(0, 2, 1, 3).astype(v_t.dtype),
-    )
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -177,6 +177,7 @@ def worker(args: argparse.Namespace) -> None:
     from kata_xpu_device_plugin_tpu.models.transformer import (
         decode,
         forward,
+        fuse_decoder_params,
         init_params,
         prefill,
     )
@@ -200,7 +201,11 @@ def worker(args: argparse.Namespace) -> None:
     max_len = PROMPT_LEN + DECODE_STEPS
 
     key = jax.random.PRNGKey(0)
-    params = jax.jit(lambda k: init_params(k, cfg, dtype=jnp.bfloat16))(key)
+    # Fused inference layout: wqkv / w_gateup stream each weight group in one
+    # matmul on the bandwidth-bound decode step.
+    params = jax.jit(
+        lambda k: fuse_decoder_params(init_params(k, cfg, dtype=jnp.bfloat16))
+    )(key)
     jax.block_until_ready(params)
 
     def run(seed: int):

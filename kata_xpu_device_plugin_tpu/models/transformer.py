@@ -154,6 +154,31 @@ def init_params(key: jax.Array, cfg: DecoderConfig, dtype=jnp.float32) -> Params
     return params
 
 
+def fuse_decoder_params(params: Params) -> Params:
+    """Inference-layout transform: concatenate wq/wk/wv into one
+    ``wqkv [L, d, q+2kv]`` and w_gate/w_up into ``w_gateup [L, d, 2f]``.
+
+    The bandwidth-bound decode step then streams each weight group in one
+    matmul instead of three/two — measured ~1% faster end-to-end decode on
+    v5e (scripts/exp_decode.py). :func:`_layer` understands both layouts, so
+    the same forward/generate code runs either; training keeps the separate
+    layout (its sharding rules and checkpoints are keyed to it)."""
+    layers = params["layers"]
+    if "wqkv" in layers or "router" in layers:
+        return params  # already fused, or MoE (no dense ffn to fuse)
+    fused = {
+        k: v for k, v in layers.items()
+        if k not in ("wq", "wk", "wv", "w_gate", "w_up")
+    }
+    fused["wqkv"] = jnp.concatenate(
+        [layers["wq"], layers["wk"], layers["wv"]], axis=2
+    )
+    fused["w_gateup"] = jnp.concatenate([layers["w_gate"], layers["w_up"]], axis=2)
+    out = dict(params)
+    out["layers"] = fused
+    return out
+
+
 # ----- building blocks -----------------------------------------------------
 
 
@@ -228,9 +253,21 @@ def _layer(
     is the layer's MoE load-balancing loss (0.0 for dense layers)."""
     B, S, _ = x.shape
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"].astype(h.dtype)).reshape(B, S, cfg.n_heads, cfg.head_dim)
-    k = (h @ layer["wk"].astype(h.dtype)).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ layer["wv"].astype(h.dtype)).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    if "wqkv" in layer:
+        # Fused projection (see fuse_decoder_params): one matmul streams the
+        # q/k/v weights in a single pass — fewer kernels on the
+        # bandwidth-bound decode step.
+        qkv = h @ layer["wqkv"].astype(h.dtype)
+        q = qkv[..., : cfg.q_dim]
+        k = qkv[..., cfg.q_dim : cfg.q_dim + cfg.kv_dim]
+        v = qkv[..., cfg.q_dim + cfg.kv_dim :]
+    else:
+        q = h @ layer["wq"].astype(h.dtype)
+        k = h @ layer["wk"].astype(h.dtype)
+        v = h @ layer["wv"].astype(h.dtype)
+    q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
 
@@ -278,6 +315,11 @@ def _layer(
             # correct on any batch, just not dispatch-sharded.
             y, aux = moe_mod.moe_ffn(moe_params, h, cfg.moe_cfg(), mesh=moe_mesh)
         x = x + y.astype(x.dtype)
+    elif "w_gateup" in layer:
+        gu = h @ layer["w_gateup"].astype(h.dtype)
+        gate = _gate_act(gu[..., : cfg.d_ff], cfg.activation)
+        x = x + (gate * gu[..., cfg.d_ff :]) @ layer["w_down"].astype(x.dtype)
+        aux = jnp.float32(0.0)
     else:
         gate = _gate_act(h @ layer["w_gate"].astype(h.dtype), cfg.activation)
         up = h @ layer["w_up"].astype(h.dtype)

@@ -74,6 +74,11 @@ def supervise(args: argparse.Namespace) -> int:
         env = dict(os.environ)
         cmd = list(worker_cmd)
         timeout = SMOKE_TIMEOUT_S if args.smoke else ATTEMPT_TIMEOUT_S
+        if attempt >= 1:
+            # The pallas decode kernel is the newest code on the measured
+            # path; if attempt 1 hung or crashed, retry WITHOUT it so a
+            # kernel/runtime incompatibility still yields a real TPU number.
+            env["KATA_TPU_DISABLE_DECODE_KERNEL"] = "1"
         if attempt == MAX_ATTEMPTS - 1 and attempt > 0 and not args.smoke:
             # Last resort: a labeled CPU smoke figure beats an empty round.
             env["JAX_PLATFORMS"] = "cpu"

@@ -107,9 +107,15 @@ def flash_eligible(sq: int, sk: int, d: int, q_offset=None) -> bool:
 def decode_eligible(sq: int, sk: int, d: int, causal: bool, q_offset) -> bool:
     """Trace-time gate for the fused decode kernel — the ONE place the
     dispatch condition lives (the bench's path label uses it too, so label
-    and dispatch cannot drift)."""
+    and dispatch cannot drift). ``KATA_TPU_DISABLE_DECODE_KERNEL=1`` forces
+    the XLA path — the bench supervisor sets it on retry so a kernel that
+    misbehaves on some TPU runtime can't cost the whole measurement."""
+    import os
+
     from .decode_attn import supports_decode
 
+    if os.environ.get("KATA_TPU_DISABLE_DECODE_KERNEL", "") == "1":
+        return False
     return (
         causal and q_offset is not None and on_tpu() and supports_decode(sq, sk, d)
     )

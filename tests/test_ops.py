@@ -179,3 +179,14 @@ def test_training_through_flash_attention():
         ),
         gf, gr,
     )
+
+
+def test_decode_kernel_kill_switch(monkeypatch):
+    from kata_xpu_device_plugin_tpu.ops.attention import decode_eligible, on_tpu
+
+    # Eligibility on this host may be False anyway (CPU); the switch must
+    # force False even where every other condition holds.
+    monkeypatch.setenv("KATA_TPU_DISABLE_DECODE_KERNEL", "1")
+    assert decode_eligible(1, 256, 128, True, 0) is False
+    monkeypatch.delenv("KATA_TPU_DISABLE_DECODE_KERNEL")
+    assert decode_eligible(1, 256, 128, True, 0) == (on_tpu() and True)

@@ -67,6 +67,13 @@ def make_pipeline(
     transformer block does); ``microbatches`` is ``(M, mb, ...)`` and comes
     back transformed by all ``num_stages`` stages in order, replicated on
     every device.
+
+    Memory tradeoff (deliberate): every stage holds all M microbatches and
+    the psum broadcasts full outputs — activation footprint does NOT scale
+    with 1/P here. This wrapper is the simple, self-contained unit-semantics
+    pipeline; the production path is :mod:`.composed`, whose schedule shards
+    microbatch ingestion/egress per stage (1/P activations) and composes
+    with fsdp/tp on one mesh.
     """
     if mesh.shape[axis] != num_stages:
         raise ValueError(

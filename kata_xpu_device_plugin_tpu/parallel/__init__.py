@@ -28,6 +28,7 @@ from .pipeline import (
     stack_stage_params,
 )
 from .ring import make_ring_attention
+from .ulysses import make_ulysses_attention
 from .sharding import (
     BATCH_SPEC,
     PARAM_RULES,
@@ -60,6 +61,7 @@ __all__ = [
     "sequential_reference",
     "stack_stage_params",
     "make_ring_attention",
+    "make_ulysses_attention",
     "BATCH_SPEC",
     "PARAM_RULES",
     "init_sharded_params",

@@ -1,0 +1,113 @@
+"""Ulysses sequence parallelism: all-to-all head-parallel attention.
+
+The second long-context strategy next to :mod:`.ring` (SURVEY §5 names both:
+"no ring attention, context parallel, blockwise, or Ulysses anywhere" — the
+reference has none). Where ring attention keeps queries resident and rotates
+K/V around the ICI ring (n-1 neighbor hops, compute overlapped), Ulysses
+re-shards ONCE: an all-to-all turns the sequence-sharded [B, S/n, H, D]
+q/k/v into head-sharded [B, S, H/n, D], each device runs FULL-sequence
+attention for its head group (the pallas flash kernel applies directly —
+it is plain self-attention), and a reverse all-to-all restores sequence
+sharding. Two collectives total, O(S·H·D/n) bytes each.
+
+Tradeoffs (why both exist):
+- Ulysses needs ``H % n == 0`` (and ``KV % n == 0`` unless KV heads are
+  replicated); ring has no head-count constraint — MQA models (Gemma: KV=1)
+  at high sp degree want ring.
+- Ulysses does one big reshard; ring pays n-1 smaller hops but overlaps them
+  with compute. On ICI both are bandwidth-fine; Ulysses wins when local
+  full-sequence attention can use the flash kernel at its best block sizes.
+
+GQA handling: when ``KV < n`` the KV heads are replicated across the group
+after the all-to-all (each device needs its head group's KV anyway — the
+cache is small relative to activations at that point); when ``KV % n == 0``
+K/V all-to-all exactly like q.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+
+try:  # jax.shard_map is the stable home (v0.8+)
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from .mesh import AXIS_SEQ
+
+
+def _seq_to_heads(x: jax.Array, axis: str) -> jax.Array:
+    """[B, S_loc, H, D] (seq-sharded view) → [B, S, H_loc, D]: all-to-all
+    splitting the head axis across the group and concatenating sequence."""
+    return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _heads_to_seq(x: jax.Array, axis: str) -> jax.Array:
+    return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    axis: str = AXIS_SEQ,
+    attn_fn: Optional[Callable] = None,
+):
+    """Returns ``ulysses_attn(q, k, v)`` on GLOBAL [B, S, H, D] arrays
+    sharded over ``axis`` in S (drop-in for the attention seam, like
+    :func:`.ring.make_ring_attention`). ``attn_fn`` runs the full-sequence
+    attention per head group and defaults to the flash dispatcher (pallas on
+    TPU, XLA reference elsewhere)."""
+    if attn_fn is None:
+        from ..ops.attention import flash_attention
+
+        attn_fn = flash_attention
+    n = mesh.shape[axis]
+
+    def local(q, k, v):
+        # q [B, S_loc, H, D]; k/v [B, S_loc, KV, D]
+        B, S_loc, H, D = q.shape
+        KV = k.shape[2]
+        if H % n:
+            raise ValueError(f"Ulysses needs n_heads % sp == 0, got H={H}, sp={n}")
+        qh = _seq_to_heads(q, axis)  # [B, S, H/n, D]
+        if KV % n == 0:
+            kh = _seq_to_heads(k, axis)
+            vh = _seq_to_heads(v, axis)
+        elif n % KV == 0:
+            # Few KV heads (GQA/MQA), several devices per kv head: gather
+            # the full sequence of all KV heads and slice the ONE kv head
+            # this device's q-head group maps to (h_loc divides group here,
+            # so the group never straddles a kv boundary; the slice count is
+            # static). KV cache is small next to q at this point.
+            k_full = lax.all_gather(k, axis, axis=1, tiled=True)  # [B, S, KV, D]
+            v_full = lax.all_gather(v, axis, axis=1, tiled=True)
+            group = H // KV  # q heads per kv head (global)
+            h_loc = H // n
+            kv_start = (lax.axis_index(axis) * h_loc) // group
+            kh = lax.dynamic_slice_in_dim(k_full, kv_start, 1, axis=2)
+            vh = lax.dynamic_slice_in_dim(v_full, kv_start, 1, axis=2)
+        else:
+            raise ValueError(
+                f"Ulysses sp degree {n} must divide n_kv_heads={KV} or be a "
+                f"multiple of it (ring attention has no such constraint)"
+            )
+        out = attn_fn(qh, kh, vh, causal=True, q_offset=None)
+        return _heads_to_seq(out, axis)
+
+    mapped = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, axis, None, None),) * 3,
+        out_specs=P(None, axis, None, None),
+        check_vma=False,
+    )
+
+    def ulysses_attn(q, k, v, causal: bool = True, q_offset=None):
+        if not causal or q_offset is not None:
+            raise ValueError("ulysses attention supports causal self-attention only")
+        return mapped(q, k, v)
+
+    return ulysses_attn

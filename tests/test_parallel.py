@@ -352,3 +352,34 @@ def test_ring_attention_flash_fused_gradients():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-4, err_msg=f"d{nm}"
         )
+
+
+@pytest.mark.parametrize("n,kv", [(4, 8), (4, 2), (4, 1), (8, 2), (2, 4)])
+def test_ulysses_attention_matches_reference(n, kv):
+    """Ulysses sp (all-to-all head-parallel attention): numerics must match
+    full attention for KV%n==0 (all-to-all KV) and n%KV==0 (gather+slice)."""
+    from kata_xpu_device_plugin_tpu.ops.attention import reference_attention
+    from kata_xpu_device_plugin_tpu.parallel import make_ulysses_attention, seq_mesh
+
+    B, S, H, D = 2, n * 16, 8, 16
+    keys = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(keys[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(keys[1], (B, S, kv, D), jnp.float32)
+    v = jax.random.normal(keys[2], (B, S, kv, D), jnp.float32)
+    ua = make_ulysses_attention(seq_mesh(n), attn_fn=reference_attention)
+    out = jax.jit(ua)(q, k, v)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_rejects_bad_degrees():
+    from kata_xpu_device_plugin_tpu.parallel import make_ulysses_attention, seq_mesh
+
+    mesh = seq_mesh(8)
+    q = jnp.zeros((1, 64, 4, 16))  # H=4 not divisible by sp=8
+    with pytest.raises(ValueError, match="n_heads"):
+        jax.jit(make_ulysses_attention(mesh))(q, q, q)
+    q = jnp.zeros((1, 64, 8, 16))
+    k = jnp.zeros((1, 64, 3, 16))  # KV=3: neither divides nor is divided by 8
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        jax.jit(make_ulysses_attention(mesh))(q, k, k)

@@ -339,8 +339,15 @@ def forward(
     prefill: bool = False,
     moe_mesh=None,
     return_aux: bool = False,
+    remat: bool = False,
 ):
     """Full forward. tokens: [B, S] int32 → logits [B, S, vocab].
+
+    ``remat=True`` wraps each layer in ``jax.checkpoint``: the backward pass
+    recomputes layer activations instead of storing all L of them — memory
+    scales O(1) in depth instead of O(L), the standard TPU HBM-for-FLOPs
+    trade at Llama scale (the flash kernel's custom_vjp already recomputes
+    attention internally; this extends the policy to the whole block).
 
     With ``kv_caches`` (stacked [L, B, max_len, n_kv, D]) also returns the
     updated caches — one code path serves training, prefill and decode.
@@ -375,6 +382,9 @@ def forward(
         x, _, aux = _layer(cfg, attn_fn, x, layer, positions, moe_mesh=moe_mesh)
         return x, aux
 
+    if remat and kv_caches is None:
+        body = jax.checkpoint(body)
+
     if kv_caches is not None:
         x, (new_caches, auxes) = lax.scan(body, x, (params["layers"], kv_caches))
     else:
@@ -402,13 +412,14 @@ def token_nll_sum(logits: jax.Array, targets: jax.Array) -> jax.Array:
 
 
 def next_token_loss(params: Params, tokens: jax.Array, cfg: DecoderConfig,
-                    attn_fn: Optional[AttnFn] = None, moe_mesh=None) -> jax.Array:
+                    attn_fn: Optional[AttnFn] = None, moe_mesh=None,
+                    remat: bool = False) -> jax.Array:
     """Mean cross-entropy of predicting tokens[:, 1:] from tokens[:, :-1],
     plus ``cfg.moe_aux_weight`` × the MoE load-balancing loss when the
     config is MoE (the aux term is what keeps the router from collapsing)."""
     logits, aux = forward(
         params, tokens[:, :-1], cfg, attn_fn=attn_fn, moe_mesh=moe_mesh,
-        return_aux=True,
+        return_aux=True, remat=remat,
     )
     targets = tokens[:, 1:]
     loss = token_nll_sum(logits, targets) / targets.size
@@ -420,6 +431,35 @@ def next_token_loss(params: Params, tokens: jax.Array, cfg: DecoderConfig,
 # ----- KV cache / generation ----------------------------------------------
 
 
+def greedy_token(logits: jax.Array) -> jax.Array:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def sample_token(logits: jax.Array, key: jax.Array, temperature: jax.Array,
+                 top_k: int) -> jax.Array:
+    """Temperature sampling from [B, vocab] fp32 logits, optionally
+    truncated to the ``top_k`` most likely tokens. ``temperature`` is a
+    TRACED scalar — changing it between calls does not recompile (only the
+    static ``top_k`` does)."""
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def _sampling_args(temperature, top_k, key):
+    """Resolve the STATIC sample-vs-greedy decision at the python wrapper
+    level (so temperature itself can stay traced) and validate the key."""
+    do_sample = not (isinstance(temperature, (int, float)) and temperature == 0.0)
+    if do_sample and key is None:
+        raise ValueError(
+            "temperature > 0 requires an explicit PRNG key — a silent "
+            "default would return the identical 'sample' on every call"
+        )
+    return do_sample, key if key is not None else jax.random.PRNGKey(0)
+
+
 def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
                    dtype=None) -> tuple[jax.Array, jax.Array]:
     """Stacked caches [L, B, max_len, n_kv_heads, head_dim]."""
@@ -428,15 +468,17 @@ def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_len", "attn_fn"))
+@partial(jax.jit, static_argnames=("cfg", "max_len", "attn_fn", "return_logits"))
 def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
-            max_len: int, attn_fn: Optional[AttnFn] = None):
+            max_len: int, attn_fn: Optional[AttnFn] = None,
+            return_logits: bool = False):
     """Prefill the prompt into fresh KV caches. Returns
     ``(caches, next_token, pos)`` — the greedy next token and the scalar
-    position where decode continues. Separately jitted from
-    :func:`decode` so the bench can time the bandwidth-bound decode loop on
-    its own (prefill is compute-bound; folding it into the decode timing
-    understates decode tok/s)."""
+    position where decode continues (``return_logits=True`` yields the
+    last-position logits instead of the argmax token, for samplers).
+    Separately jitted from :func:`decode` so the bench can time the
+    bandwidth-bound decode loop on its own (prefill is compute-bound;
+    folding it into the decode timing understates decode tok/s)."""
     if attn_fn is None:
         from ..ops.attention import flash_attention
 
@@ -447,41 +489,49 @@ def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
         params, prompt, cfg, attn_fn=attn_fn, kv_caches=caches,
         cache_offset=jnp.int32(0), prefill=True,
     )
-    last = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+    last = logits[:, -1, :]
+    if not return_logits:
+        last = jnp.argmax(last, axis=-1).astype(jnp.int32)
     return caches, last, jnp.int32(S)
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn"))
+@partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn", "do_sample", "top_k"))
 def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
-                 cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn] = None):
+                 cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn],
+                 do_sample: bool, top_k: int, temperature, key: jax.Array):
     if attn_fn is None:
         from ..ops.attention import flash_attention
 
         attn_fn = flash_attention
     B = tok.shape[0]
 
-    def step(carry, _):
+    def step(carry, step_key):
         caches, tok, pos = carry
         positions = jnp.full((B, 1), pos, jnp.int32)
         logits, caches = forward(
             params, tok[:, None], cfg, attn_fn=attn_fn, positions=positions,
             kv_caches=caches, cache_offset=pos,
         )
-        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        last = logits[:, -1, :]
+        nxt = (sample_token(last, step_key, temperature, top_k) if do_sample
+               else greedy_token(last))
         return (caches, nxt, pos + 1), nxt
 
     init = (caches, tok, jnp.asarray(pos, jnp.int32))
-    (_, _, _), out = lax.scan(step, init, None, length=steps)
+    (_, _, _), out = lax.scan(step, init, jax.random.split(key, steps))
     return out.T
 
 
 def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
-           cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn] = None):
-    """Greedy-decode ``steps`` tokens after ``tok`` as one lax.scan — no
-    per-token dispatch overhead. Returns [B, steps]. ``pos`` is a SCALAR:
-    the whole batch decodes in lockstep at one shared position (the cache
-    write index and causal mask are batch-wide; ragged prompts need
-    left-padding upstream)."""
+           cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn] = None,
+           temperature: float = 0.0, top_k: int = 0,
+           key: Optional[jax.Array] = None):
+    """Decode ``steps`` tokens after ``tok`` as one lax.scan — no per-token
+    dispatch overhead. Returns [B, steps]. ``pos`` is a SCALAR: the whole
+    batch decodes in lockstep at one shared position (the cache write index
+    and causal mask are batch-wide; ragged prompts need left-padding
+    upstream). Greedy by default; ``temperature``/``top_k``/``key`` switch
+    to sampling (:func:`select_token`)."""
     cache_len = caches[0].shape[2]
     if steps > cache_len:
         raise ValueError(f"steps={steps} exceeds cache max_len={cache_len}")
@@ -495,29 +545,49 @@ def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
         raise ValueError(
             f"pos={pos_concrete} + steps={steps} overruns cache max_len={cache_len}"
         )
-    return _decode_scan(params, caches, tok, pos, cfg, steps, attn_fn)
+    do_sample, key = _sampling_args(temperature, top_k, key)
+    return _decode_scan(params, caches, tok, pos, cfg, steps, attn_fn,
+                        do_sample, top_k, jnp.float32(temperature), key)
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "max_len", "attn_fn"))
+@partial(jax.jit, static_argnames=("cfg", "steps", "max_len", "attn_fn",
+                                   "do_sample", "top_k"))
+def _generate_impl(params, prompt, cfg, steps, max_len, attn_fn,
+                   do_sample: bool, top_k: int, temperature, key):
+    B, S = prompt.shape
+    k_first, k_rest = jax.random.split(key)
+    caches, last_logits, pos = prefill(
+        params, prompt, cfg, max_len, attn_fn=attn_fn, return_logits=True
+    )
+    last = (sample_token(last_logits, k_first, temperature, top_k) if do_sample
+            else greedy_token(last_logits))
+    if steps == 0:
+        return jnp.zeros((B, 0), jnp.int32)
+    if steps == 1:
+        return last[:, None]
+    out = _decode_scan(params, caches, last, pos, cfg, steps - 1, attn_fn,
+                       do_sample, top_k, temperature, k_rest)
+    return jnp.concatenate([last[:, None], out], axis=1)
+
+
 def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
-             steps: int, max_len: int = 0, attn_fn: Optional[AttnFn] = None):
-    """Greedy generation: :func:`prefill` then :func:`decode`, composed under
-    one jit.
+             steps: int, max_len: int = 0, attn_fn: Optional[AttnFn] = None,
+             temperature: float = 0.0, top_k: int = 0,
+             key: Optional[jax.Array] = None):
+    """Generation: :func:`prefill` then :func:`decode`, composed under one
+    jit. Greedy by default; ``temperature``/``top_k``/``key`` sample instead
+    (``temperature`` is traced — varying it does not recompile).
 
     ``attn_fn`` defaults to :func:`..ops.attention.flash_attention`, whose
     trace-time dispatch runs the pallas flash kernel for the prefill
-    (self-attention, flash-eligible shapes on TPU) and the XLA reference for
-    the tiny-q decode steps."""
+    (self-attention, flash-eligible shapes on TPU) and the fused decode
+    kernel for the tiny-q decode steps."""
     B, S = prompt.shape
     max_len = max_len or S + steps
     if S + steps > max_len:
         raise ValueError(
             f"prompt_len={S} + steps={steps} overruns max_len={max_len}"
         )
-    caches, last, pos = prefill(params, prompt, cfg, max_len, attn_fn=attn_fn)
-    if steps == 0:
-        return jnp.zeros((B, 0), jnp.int32)
-    if steps == 1:
-        return last[:, None]
-    out = decode(params, caches, last, pos, cfg, steps - 1, attn_fn=attn_fn)
-    return jnp.concatenate([last[:, None], out], axis=1)
+    do_sample, key = _sampling_args(temperature, top_k, key)
+    return _generate_impl(params, prompt, cfg, steps, max_len, attn_fn,
+                          do_sample, top_k, jnp.float32(temperature), key)

@@ -99,6 +99,7 @@ def make_train_step(
     mesh: Mesh,
     optimizer: Optional[optax.GradientTransformation] = None,
     attn_fn: Optional[Callable] = None,
+    remat: bool = False,
 ):
     """Returns (init_state, step). ``step(state, tokens) -> (state, loss)``,
     jitted over the mesh with donated state.
@@ -127,7 +128,8 @@ def make_train_step(
 
     def loss_fn(params, tokens):
         return tfm.next_token_loss(
-            params, tokens, cfg, attn_fn=attn_fn, moe_mesh=mesh if cfg.moe else None
+            params, tokens, cfg, attn_fn=attn_fn,
+            moe_mesh=mesh if cfg.moe else None, remat=remat,
         )
 
     from functools import partial

@@ -158,3 +158,65 @@ def test_fused_param_layout_matches_unfused():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
     out = generate(fused, tokens, cfg, steps=4, max_len=16)
     assert out.shape == (2, 4)
+
+
+def test_remat_matches_no_remat():
+    """jax.checkpoint over the layer scan must not change loss or grads."""
+    from kata_xpu_device_plugin_tpu.models import tiny_test_config
+    from kata_xpu_device_plugin_tpu.models.transformer import (
+        init_params,
+        next_token_loss,
+    )
+
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    l0, g0 = jax.value_and_grad(lambda p: next_token_loss(p, tokens, cfg))(params)
+    l1, g1 = jax.value_and_grad(
+        lambda p: next_token_loss(p, tokens, cfg, remat=True)
+    )(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        g0, g1,
+    )
+
+
+def test_sampled_generation():
+    """Temperature/top-k sampling: valid tokens, deterministic per key,
+    different keys explore, temperature=0 reduces to greedy."""
+    from kata_xpu_device_plugin_tpu.models import tiny_test_config
+    from kata_xpu_device_plugin_tpu.models.transformer import generate, init_params
+
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+
+    greedy = generate(params, prompt, cfg, steps=6, max_len=16)
+    greedy_keyed = generate(
+        params, prompt, cfg, steps=6, max_len=16, key=jax.random.PRNGKey(5)
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(greedy_keyed))
+
+    s1 = generate(params, prompt, cfg, steps=6, max_len=16,
+                  temperature=1.0, top_k=16, key=jax.random.PRNGKey(2))
+    s1b = generate(params, prompt, cfg, steps=6, max_len=16,
+                   temperature=1.0, top_k=16, key=jax.random.PRNGKey(2))
+    s2 = generate(params, prompt, cfg, steps=6, max_len=16,
+                  temperature=1.0, top_k=16, key=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s1b))
+    assert not np.array_equal(np.asarray(s1), np.asarray(s2))
+    assert bool(jnp.all((s1 >= 0) & (s1 < cfg.vocab_size)))
+
+
+def test_sampling_requires_key():
+    from kata_xpu_device_plugin_tpu.models import tiny_test_config
+    from kata_xpu_device_plugin_tpu.models.transformer import generate, init_params
+
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="PRNG key"):
+        generate(params, prompt, cfg, steps=2, temperature=0.8)

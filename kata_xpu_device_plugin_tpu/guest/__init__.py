@@ -1,5 +1,13 @@
 """In-guest validation: the BASELINE config ladder (device probe, compute
 check, all-reduce smoke) run inside the Kata guest the plugin provisioned."""
+from .distributed import initialize_from_env, resolve
 from .probe import probe_all_reduce, probe_compute, probe_devices, run_ladder
 
-__all__ = ["probe_all_reduce", "probe_compute", "probe_devices", "run_ladder"]
+__all__ = [
+    "initialize_from_env",
+    "resolve",
+    "probe_all_reduce",
+    "probe_compute",
+    "probe_devices",
+    "run_ladder",
+]

@@ -448,14 +448,25 @@ def sample_token(logits: jax.Array, key: jax.Array, temperature: jax.Array,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _next_token(logits, key, do_sample: bool, temperature, top_k: int):
+    """The one sample-vs-greedy dispatch, shared by prefill/decode/generate."""
+    return (sample_token(logits, key, temperature, top_k) if do_sample
+            else greedy_token(logits))
+
+
 def _sampling_args(temperature, top_k, key):
     """Resolve the STATIC sample-vs-greedy decision at the python wrapper
-    level (so temperature itself can stay traced) and validate the key."""
+    level (so temperature itself can stay traced) and validate the args."""
     do_sample = not (isinstance(temperature, (int, float)) and temperature == 0.0)
     if do_sample and key is None:
         raise ValueError(
             "temperature > 0 requires an explicit PRNG key — a silent "
             "default would return the identical 'sample' on every call"
+        )
+    if not do_sample and top_k > 0:
+        raise ValueError(
+            "top_k sampling requires temperature > 0 (greedy decoding would "
+            "silently ignore top_k)"
         )
     return do_sample, key if key is not None else jax.random.PRNGKey(0)
 
@@ -491,7 +502,7 @@ def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
     )
     last = logits[:, -1, :]
     if not return_logits:
-        last = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        last = greedy_token(last)
     return caches, last, jnp.int32(S)
 
 
@@ -512,9 +523,7 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
             params, tok[:, None], cfg, attn_fn=attn_fn, positions=positions,
             kv_caches=caches, cache_offset=pos,
         )
-        last = logits[:, -1, :]
-        nxt = (sample_token(last, step_key, temperature, top_k) if do_sample
-               else greedy_token(last))
+        nxt = _next_token(logits[:, -1, :], step_key, do_sample, temperature, top_k)
         return (caches, nxt, pos + 1), nxt
 
     init = (caches, tok, jnp.asarray(pos, jnp.int32))
@@ -531,7 +540,7 @@ def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
     batch decodes in lockstep at one shared position (the cache write index
     and causal mask are batch-wide; ragged prompts need left-padding
     upstream). Greedy by default; ``temperature``/``top_k``/``key`` switch
-    to sampling (:func:`select_token`)."""
+    to sampling (:func:`sample_token`)."""
     cache_len = caches[0].shape[2]
     if steps > cache_len:
         raise ValueError(f"steps={steps} exceeds cache max_len={cache_len}")
@@ -559,8 +568,7 @@ def _generate_impl(params, prompt, cfg, steps, max_len, attn_fn,
     caches, last_logits, pos = prefill(
         params, prompt, cfg, max_len, attn_fn=attn_fn, return_logits=True
     )
-    last = (sample_token(last_logits, k_first, temperature, top_k) if do_sample
-            else greedy_token(last_logits))
+    last = _next_token(last_logits, k_first, do_sample, temperature, top_k)
     if steps == 0:
         return jnp.zeros((B, 0), jnp.int32)
     if steps == 1:

@@ -669,3 +669,55 @@ def test_multislice_flags_emit_megascale_env(tmp_path):
     assert env["MEGASCALE_NUM_SLICES"] == "2"
     assert env["MEGASCALE_SLICE_ID"] == "1"
     assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "coord.svc:8080"
+
+
+class TestGuestDistributed:
+    """Guest-side jax.distributed bridge: the env the plugin injects must
+    resolve to a consistent process group on every worker."""
+
+    def test_single_host_noop(self):
+        from kata_xpu_device_plugin_tpu.guest.distributed import (
+            initialize_from_env,
+            resolve,
+        )
+
+        cfg = resolve({})
+        assert not cfg.multi_host and cfg.coordinator_address is None
+        s = initialize_from_env({"TPU_WORKER_HOSTNAMES": "solo"},)
+        assert s == {
+            "multi_host": False, "num_processes": 1, "process_id": 0,
+            "coordinator_address": None, "initialized": False,
+        }
+
+    def test_multi_host_consistent_across_workers(self):
+        from kata_xpu_device_plugin_tpu.guest.distributed import resolve
+
+        hosts = "tpu-w0,tpu-w1,tpu-w2,tpu-w3"
+        cfgs = [
+            resolve({"TPU_WORKER_HOSTNAMES": hosts, "TPU_WORKER_ID": str(i)})
+            for i in range(4)
+        ]
+        # Every worker derives the SAME coordinator and group size, and its
+        # own distinct process id — no extra coordination channel needed.
+        assert {c.coordinator_address for c in cfgs} == {"tpu-w0:8476"}
+        assert {c.num_processes for c in cfgs} == {4}
+        assert [c.process_id for c in cfgs] == [0, 1, 2, 3]
+
+    def test_dry_run_reports_without_jax(self):
+        from kata_xpu_device_plugin_tpu.guest.distributed import initialize_from_env
+
+        s = initialize_from_env(
+            {"TPU_WORKER_HOSTNAMES": "a,b", "TPU_WORKER_ID": "1"}, dry_run=True
+        )
+        assert s["multi_host"] and s["coordinator_address"] == "a:8476"
+        assert s["process_id"] == 1 and not s["initialized"]
+
+    def test_contradictory_env_fails_closed(self):
+        import pytest as _pytest
+
+        from kata_xpu_device_plugin_tpu.guest.distributed import resolve
+
+        with _pytest.raises(ValueError, match="TPU_WORKER_ID"):
+            resolve({"TPU_WORKER_HOSTNAMES": "a,b"})
+        with _pytest.raises(ValueError, match="out of range"):
+            resolve({"TPU_WORKER_HOSTNAMES": "a,b", "TPU_WORKER_ID": "5"})

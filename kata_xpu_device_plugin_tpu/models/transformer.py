@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.quant import QTensor, weight_matmul
+
 Params = dict[str, Any]
 AttnFn = Callable[..., jax.Array]  # (q, k, v, causal, q_offset) -> out
 
@@ -166,6 +168,11 @@ def fuse_decoder_params(params: Params) -> Params:
     layers = params["layers"]
     if "wqkv" in layers or "router" in layers:
         return params  # already fused, or MoE (no dense ffn to fuse)
+    if any(isinstance(v, QTensor) for v in layers.values()):
+        raise ValueError(
+            "fuse_decoder_params before quantize_decoder_params: fusing "
+            "concatenates raw weight matrices, not int8 QTensors"
+        )
     fused = {
         k: v for k, v in layers.items()
         if k not in ("wq", "wk", "wv", "w_gate", "w_up")
@@ -256,15 +263,16 @@ def _layer(
     if "wqkv" in layer:
         # Fused projection (see fuse_decoder_params): one matmul streams the
         # q/k/v weights in a single pass — fewer kernels on the
-        # bandwidth-bound decode step.
-        qkv = h @ layer["wqkv"].astype(h.dtype)
+        # bandwidth-bound decode step. weight_matmul also accepts int8
+        # QTensors (ops.quant), which halve that stream again.
+        qkv = weight_matmul(h, layer["wqkv"])
         q = qkv[..., : cfg.q_dim]
         k = qkv[..., cfg.q_dim : cfg.q_dim + cfg.kv_dim]
         v = qkv[..., cfg.q_dim + cfg.kv_dim :]
     else:
-        q = h @ layer["wq"].astype(h.dtype)
-        k = h @ layer["wk"].astype(h.dtype)
-        v = h @ layer["wv"].astype(h.dtype)
+        q = weight_matmul(h, layer["wq"])
+        k = weight_matmul(h, layer["wk"])
+        v = weight_matmul(h, layer["wv"])
     q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
     k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
@@ -295,7 +303,7 @@ def _layer(
         new_cache = None
 
     attn_out = attn_out.reshape(B, S, cfg.q_dim)
-    x = x + attn_out @ layer["wo"].astype(x.dtype)
+    x = x + weight_matmul(attn_out, layer["wo"])
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     if cfg.moe:
@@ -316,14 +324,14 @@ def _layer(
             y, aux = moe_mod.moe_ffn(moe_params, h, cfg.moe_cfg(), mesh=moe_mesh)
         x = x + y.astype(x.dtype)
     elif "w_gateup" in layer:
-        gu = h @ layer["w_gateup"].astype(h.dtype)
+        gu = weight_matmul(h, layer["w_gateup"])
         gate = _gate_act(gu[..., : cfg.d_ff], cfg.activation)
-        x = x + (gate * gu[..., cfg.d_ff :]) @ layer["w_down"].astype(x.dtype)
+        x = x + weight_matmul(gate * gu[..., cfg.d_ff :], layer["w_down"])
         aux = jnp.float32(0.0)
     else:
-        gate = _gate_act(h @ layer["w_gate"].astype(h.dtype), cfg.activation)
-        up = h @ layer["w_up"].astype(h.dtype)
-        x = x + (gate * up) @ layer["w_down"].astype(x.dtype)
+        gate = _gate_act(weight_matmul(h, layer["w_gate"]), cfg.activation)
+        up = weight_matmul(h, layer["w_up"])
+        x = x + weight_matmul(gate * up, layer["w_down"])
         aux = jnp.float32(0.0)
     return x, new_cache, aux
 
@@ -588,8 +596,10 @@ def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
 
     ``attn_fn`` defaults to :func:`..ops.attention.flash_attention`, whose
     trace-time dispatch runs the pallas flash kernel for the prefill
-    (self-attention, flash-eligible shapes on TPU) and the fused decode
-    kernel for the tiny-q decode steps."""
+    (self-attention, flash-eligible shapes on TPU) and, for the tiny-q
+    decode steps, XLA's scan-fused path — the pallas fused decode kernel is
+    opt-in via ``KATA_TPU_DECODE_KERNEL=1`` (it measured slower end-to-end;
+    see :func:`..ops.attention.decode_eligible`)."""
     B, S = prompt.shape
     max_len = max_len or S + steps
     if S + steps > max_len:

@@ -1,5 +1,6 @@
 """TPU compute ops: attention implementations (XLA reference, pallas flash),
-collective helpers, and the expert-parallel MoE FFN."""
+collective helpers, the expert-parallel MoE FFN, and weight-only int8
+quantization for the bandwidth-bound decode path."""
 from .attention import best_attention, flash_attention, reference_attention
 from .collectives import (
     all_gather,
@@ -19,8 +20,22 @@ from .moe import (
     moe_param_specs,
     reference_moe,
 )
+from .quant import (
+    QTensor,
+    dequantize,
+    params_hbm_bytes,
+    quantize,
+    quantize_decoder_params,
+    weight_matmul,
+)
 
 __all__ = [
+    "QTensor",
+    "dequantize",
+    "params_hbm_bytes",
+    "quantize",
+    "quantize_decoder_params",
+    "weight_matmul",
     "best_attention",
     "flash_attention",
     "reference_attention",

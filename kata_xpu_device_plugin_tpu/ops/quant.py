@@ -1,0 +1,98 @@
+"""Weight-only int8 quantization for the bandwidth-bound decode path.
+
+Greedy decode streams every weight byte from HBM once per step (BASELINE.md
+roofline), so halving the bytes nearly halves the step time — the classic
+weight-only-quantization serving trade. This module quantizes the decoder's
+layer weight matrices to symmetric per-output-channel int8:
+
+    scale[out] = max(|w[:, out]|) / 127        (fp32)
+    q[in, out] = round(w[in, out] / scale[out])  (int8)
+
+and the matmul applies the scale AFTER the dot — ``x @ (q·s) == (x @ q) · s``
+when ``s`` varies only over the output axis — so the weights are streamed
+from HBM as int8 and cast to bf16 on the fly inside the fused matmul; the
+fp32 scale multiply touches only the tiny ``[B, 1, out]`` activation.
+
+Scope: inference only, dense layers (the norms, embedding, and MoE experts
+stay in their original dtype; the tied unembedding is the embedding and is
+left bf16 so logit quality is unaffected). Quantize AFTER
+:func:`..models.transformer.fuse_decoder_params` — fusing concatenates raw
+weight matrices.
+
+The reference has no quantization (or any ML code — SURVEY §2); this is the
+"actually fast" axis of the TPU-first rebuild, same as the pallas kernels.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# Layer-dict keys eligible for weight-only quantization: 2-D matmul operands
+# streamed every decode step. Norm scales are 1-D (and numerically load-
+# bearing); MoE expert tensors route through ops.moe's einsums which have
+# their own sharding story — both stay unquantized.
+QUANTIZABLE = ("wqkv", "wq", "wk", "wv", "wo", "w_gateup", "w_gate", "w_up",
+               "w_down")
+
+
+class QTensor(NamedTuple):
+    """A symmetric per-channel int8 weight: ``deq = q * scale`` with ``scale``
+    broadcastable against ``q`` (NamedTuple ⇒ automatic pytree, so QTensors
+    ride through jit/scan/device_put like any array pair)."""
+
+    q: jax.Array  # int8, original weight shape [..., in, out]
+    scale: jax.Array  # fp32, [..., 1, out]
+
+
+def quantize(w: jax.Array, axis: int = -2) -> QTensor:
+    """Symmetric int8 quantization, reducing |w| over ``axis`` (default: the
+    input/contraction axis, giving one scale per output channel)."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return QTensor(q.astype(jnp.int8), scale)
+
+
+def dequantize(qt: QTensor, dtype=jnp.float32) -> jax.Array:
+    return (qt.q.astype(jnp.float32) * qt.scale).astype(dtype)
+
+
+def weight_matmul(x: jax.Array, w: Any) -> jax.Array:
+    """The one ``activation @ weight`` used by the decoder layer: a plain
+    cast-to-activation-dtype matmul for arrays, and for :class:`QTensor` the
+    int8-streaming form ``(x @ q) * scale`` — the int8→bf16 cast fuses into
+    the matmul's weight read, so HBM traffic is the int8 bytes."""
+    if isinstance(w, QTensor):
+        y = jnp.matmul(
+            x, w.q.astype(x.dtype), preferred_element_type=jnp.float32
+        )
+        return (y * w.scale[..., 0, :]).astype(x.dtype)
+    return x @ w.astype(x.dtype)
+
+
+def quantize_decoder_params(params: dict) -> dict:
+    """Quantize a decoder param pytree's layer weight matrices to int8
+    (:data:`QUANTIZABLE` keys; everything else passes through). Works on both
+    the training layout (separate wq/wk/wv) and the fused inference layout
+    from :func:`..models.transformer.fuse_decoder_params` — fuse first, the
+    fused layout is both faster and quantizes to fewer tensors."""
+    layers = params["layers"]
+    if any(isinstance(v, QTensor) for v in layers.values()):
+        return params  # already quantized
+    out_layers = {
+        k: (quantize(v) if k in QUANTIZABLE else v) for k, v in layers.items()
+    }
+    out = dict(params)
+    out["layers"] = out_layers
+    return out
+
+
+def params_hbm_bytes(params: Any) -> int:
+    """Bytes a decode step streams for the weights: the actual pytree leaf
+    sizes (int8 payloads + their scales included) — the honest denominator
+    for a quantized roofline, vs assuming 2 bytes/param."""
+    return sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)
+    )

@@ -1,0 +1,150 @@
+"""Weight-only int8 quantization (ops/quant.py).
+
+Reference context: the reference ships no quantization (or any ML code —
+SURVEY §2); this is a perf capability of the TPU-first guest stack, so the
+oracle is the framework's own fp/bf16 path.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import (
+    decode,
+    forward,
+    fuse_decoder_params,
+    init_params,
+    prefill,
+)
+from kata_xpu_device_plugin_tpu.ops.quant import (
+    QTensor,
+    dequantize,
+    params_hbm_bytes,
+    quantize,
+    quantize_decoder_params,
+    weight_matmul,
+)
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32) * 3.0
+    qt = quantize(w)
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (1, 32)
+    err = np.abs(np.asarray(dequantize(qt) - w))
+    # Round-to-nearest: error ≤ scale/2 per element (plus fp slack).
+    bound = np.asarray(qt.scale)[0] / 2 + 1e-6
+    assert (err <= bound[None, :]).all()
+
+
+def test_quantize_zero_column_no_nan():
+    w = jnp.zeros((16, 4), jnp.float32)
+    qt = quantize(w)
+    assert np.isfinite(np.asarray(qt.scale)).all()
+    np.testing.assert_array_equal(np.asarray(dequantize(qt)), 0.0)
+
+
+def test_weight_matmul_matches_dequantized():
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (2, 8, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (64, 48), jnp.float32)
+    qt = quantize(w)
+    out_q = weight_matmul(x, qt)
+    out_deq = x @ dequantize(qt)
+    # Same math up to (x@q)·s vs x@(q·s) association.
+    np.testing.assert_allclose(
+        np.asarray(out_q), np.asarray(out_deq), rtol=1e-5, atol=1e-5
+    )
+    # And close to the unquantized product: per-channel int8 keeps the
+    # relative Frobenius error well under 1% (elementwise bounds are brittle
+    # in the rounding tail, so bound the norm).
+    ref = np.asarray(x @ w)
+    rel = np.linalg.norm(np.asarray(out_q) - ref) / np.linalg.norm(ref)
+    assert rel < 0.01, rel
+
+
+def test_weight_matmul_plain_array_passthrough():
+    x = jnp.ones((1, 4, 8), jnp.float32)
+    w = jnp.ones((8, 16), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(weight_matmul(x, w)), np.asarray(x @ w)
+    )
+
+
+def test_stacked_layer_axis_quantizes_per_layer():
+    # [L, in, out] stacked weights: scales must be per (layer, out) — one
+    # layer's outliers must not coarsen another's resolution.
+    w = jnp.stack(
+        [jnp.ones((8, 4), jnp.float32), 100.0 * jnp.ones((8, 4), jnp.float32)]
+    )
+    qt = quantize(w)
+    assert qt.scale.shape == (2, 1, 4)
+    np.testing.assert_allclose(np.asarray(dequantize(qt)), np.asarray(w), rtol=1e-2)
+
+
+@pytest.fixture(scope="module")
+def quant_setup():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    qparams = quantize_decoder_params(fuse_decoder_params(params))
+    return cfg, params, qparams
+
+
+def test_quantize_decoder_params_layout(quant_setup):
+    _, params, qparams = quant_setup
+    layers = qparams["layers"]
+    assert isinstance(layers["wqkv"], QTensor)
+    assert isinstance(layers["w_gateup"], QTensor)
+    assert isinstance(layers["w_down"], QTensor)
+    assert isinstance(layers["wo"], QTensor)
+    # Norms and the embedding stay full precision.
+    assert not isinstance(layers["attn_norm"], QTensor)
+    assert not isinstance(qparams["embed"], QTensor)
+    # Idempotent.
+    again = quantize_decoder_params(qparams)
+    assert again["layers"]["wqkv"].q is layers["wqkv"].q
+    # The byte accounting sees the int8 payloads.
+    assert params_hbm_bytes(qparams) < params_hbm_bytes(
+        fuse_decoder_params(params)
+    )
+
+
+def test_quantize_before_fuse_rejected(quant_setup):
+    _, params, _ = quant_setup
+    with pytest.raises(ValueError):
+        fuse_decoder_params(quantize_decoder_params(params))
+
+
+def test_quantized_forward_close(quant_setup):
+    cfg, params, qparams = quant_setup
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, cfg.vocab_size)
+    ref = np.asarray(forward(params, tokens, cfg))
+    out = np.asarray(forward(qparams, tokens, cfg))
+    assert out.shape == ref.shape
+    # Per-channel int8 keeps tiny-model logits within a few percent of the
+    # logit scale; the bound is loose but would catch any wiring bug (wrong
+    # scale axis, scale applied twice, dropped scale) by orders of magnitude.
+    scale = np.abs(ref).max()
+    assert np.abs(out - ref).max() <= 0.05 * scale + 1e-3
+
+
+def test_quantized_decode_runs_and_tracks_reference(quant_setup):
+    cfg, params, qparams = quant_setup
+    fparams = fuse_decoder_params(params)
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 0, cfg.vocab_size)
+    max_len = 16
+
+    def gen(p):
+        caches, last, pos = prefill(p, prompt, cfg, max_len)
+        toks = decode(p, caches, last, int(pos), cfg, 8)
+        return np.asarray(toks)
+
+    out_ref = gen(fparams)
+    out_q = gen(qparams)
+    assert out_q.shape == out_ref.shape == (2, 8)
+    assert out_q.dtype == np.int32
+    # Greedy argmax under random weights is not bit-stable to quantization;
+    # require broad agreement, not identity.
+    agreement = (out_q == out_ref).mean()
+    assert agreement >= 0.5, f"token agreement {agreement}"

@@ -31,15 +31,23 @@ NEG_INF = -1e30
 # read back, so keep the HBM footprint at 8 lanes (sublane-aligned) rather
 # than a full 128-lane tile.
 ROW_W = 8
+# Default block edge. Swept on v5e (scripts/exp_flash_blocks.py, Gemma-2B
+# S=2048 prefill): 1024×1024 beat 512×512 by ~1.7% full-model; pick_block
+# descends from here, so shorter sequences still get their largest divisor.
+DEFAULT_BLOCK = 1024
 
 
 def pick_block(seq_len: int, requested: int) -> Optional[int]:
-    """Largest usable block ≤ requested: divides ``seq_len``, multiple of 8,
-    at least 128 (TPU tile constraints). None when no such block exists —
-    callers then take the XLA reference path."""
+    """Largest usable block ≤ requested: divides ``seq_len``, multiple of
+    32, at least 128. None when no such block exists — callers then take
+    the XLA reference path. 32 alignment (not just the fp32 sublane 8)
+    keeps the block a whole number of sublane tiles for every supported
+    dtype (fp32 8, bf16 16, int8 32): an 8-aligned-but-not-16-aligned
+    block (e.g. 1016) is a bf16 tiling violation Mosaic may reject at
+    compile time."""
     start = min(requested, seq_len)
-    start -= start % 8  # descend over 8-aligned candidates only
-    for b in range(start, 127, -8):
+    start -= start % 32  # descend over all-dtype-tileable candidates only
+    for b in range(start, 127, -32):
         if seq_len % b == 0:
             return b
     return None
@@ -49,8 +57,8 @@ def supports(sq: int, sk: int, d: int) -> bool:
     """Whether the pallas kernel can run these self-attention shapes."""
     return (
         (d % 128 == 0 or d == 64)
-        and pick_block(sq, 512) is not None
-        and pick_block(sk, 512) is not None
+        and pick_block(sq, DEFAULT_BLOCK) is not None
+        and pick_block(sk, DEFAULT_BLOCK) is not None
     )
 
 
@@ -449,6 +457,13 @@ def _flash_block_bwd(causal, block_q, block_k, interpret, res, cts):
     KV = k_t.shape[1]
     group = H // KV
     scale = float(1.0 / (D**0.5))
+    Sk = k_t.shape[2]
+    # The backward re-blocks independently of the forward (logsumexp is
+    # per-row, not per-block) and caps at 512: its dq/dkv kernels hold
+    # several fp32 [BQ, BK] intermediates plus scratch in VMEM, a footprint
+    # the 1024 forward default was never swept for on the training path.
+    block_q = pick_block(Sq, min(block_q, 512))
+    block_k = pick_block(Sk, min(block_k, 512))
     do_t = dout.transpose(0, 2, 1, 3)
     # defvjp without symbolic_zeros: the lse cotangent is always a dense
     # array (zeros when lse is unused downstream).
@@ -476,8 +491,8 @@ def flash_block_attention(
     q_offset,  # global position of q[0] (scalar, may be traced)
     k_offset,  # global position of k[0]
     causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """One block-pair's partial attention for ring attention: returns
@@ -503,8 +518,8 @@ def pallas_flash_attention(
     v: jax.Array,
     causal: bool = True,
     q_offset: Optional[jax.Array] = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
     interpret: bool = False,
 ) -> jax.Array:
     """q [B, Sq, H, D]; k/v [B, Sk, KV, D], H % KV == 0. Self-attention only

@@ -1,0 +1,147 @@
+#!/usr/bin/env python3
+"""Decode-step fine ablation: attribute the ~1.1 ms/step of non-weight time.
+
+exp_decode.py showed attention's non-weight cost is only ~0.11 ms/step, so
+the pallas decode kernel had nothing to win. This script strips the fused
+decode step one feature at a time (numerics deliberately wrong in the
+stripped variants — timing only) to find where the rest goes:
+
+  full          the real fused-layout decode step (oracle for bench)
+  no-norms      rms_norm -> identity
+  no-rope       skip rotary embedding on q/k
+  no-cachewrite attend to the pre-filled cache without writing new k/v
+  no-softmax    logits @ v without max/exp/sum normalization
+  matmuls-only  just wqkv/wo/gateup/down/unembed matmuls + residuals
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, "/root/repo")
+
+from kata_xpu_device_plugin_tpu.models import gemma_2b_bench
+from kata_xpu_device_plugin_tpu.models.transformer import (
+    fuse_decoder_params,
+    init_params,
+    rms_norm,
+    rope,
+)
+
+cfg = gemma_2b_bench()
+B, PROMPT, STEPS = 8, 128, 128
+MAX_LEN = PROMPT + STEPS
+
+params = jax.jit(
+    lambda k: fuse_decoder_params(init_params(k, cfg, dtype=jnp.bfloat16))
+)(jax.random.PRNGKey(0))
+jax.block_until_ready(params)
+ideal_ms = cfg.num_params() * 2 / 819e9 * 1e3
+print(f"params {cfg.num_params()/1e9:.3f}G -> ideal {ideal_ms:.3f} ms/step")
+
+
+def make_decode(no_norms=False, no_rope=False, no_cachewrite=False,
+                no_softmax=False, matmuls_only=False):
+    if matmuls_only:
+        no_norms = no_rope = no_cachewrite = no_softmax = True
+
+    def norm(x, scale):
+        return x if no_norms else rms_norm(x, scale, cfg.norm_eps)
+
+    @jax.jit
+    def dec(fp, caches, tok, pos):
+        def step(carry, _):
+            caches, tok, pos = carry
+            positions = jnp.full((B, 1), pos, jnp.int32)
+            x = fp["embed"].astype(cfg.dtype)[tok[:, None]] * jnp.asarray(
+                jnp.sqrt(cfg.d_model), cfg.dtype
+            )
+
+            def body(x, layer_and_cache):
+                layer, (ck, cv) = layer_and_cache
+                h = norm(x, layer["attn_norm"])
+                qkv = h @ layer["wqkv"].astype(h.dtype)
+                q = qkv[..., : cfg.q_dim].reshape(B, 1, cfg.n_heads, cfg.head_dim)
+                k = qkv[..., cfg.q_dim : cfg.q_dim + cfg.kv_dim].reshape(
+                    B, 1, cfg.n_kv_heads, cfg.head_dim
+                )
+                v = qkv[..., cfg.q_dim + cfg.kv_dim :].reshape(
+                    B, 1, cfg.n_kv_heads, cfg.head_dim
+                )
+                if not no_rope:
+                    q = rope(q, positions, cfg.rope_theta)
+                    k = rope(k, positions, cfg.rope_theta)
+                if not no_cachewrite:
+                    ck = lax.dynamic_update_slice(
+                        ck, k.astype(ck.dtype), (0, pos, 0, 0)
+                    )
+                    cv = lax.dynamic_update_slice(
+                        cv, v.astype(cv.dtype), (0, pos, 0, 0)
+                    )
+                if matmuls_only:
+                    attn = q.reshape(B, 1, cfg.q_dim)
+                else:
+                    G = cfg.n_heads // cfg.n_kv_heads
+                    qg = q.reshape(B, cfg.n_kv_heads, G, cfg.head_dim)
+                    logits = jnp.einsum(
+                        "bhgd,bkhd->bhgk", qg, ck,
+                        preferred_element_type=jnp.float32,
+                    ) * (1.0 / float(cfg.head_dim) ** 0.5)
+                    mask = jnp.arange(MAX_LEN)[None, :] <= pos
+                    logits = jnp.where(mask[None, None], logits, -1e30)
+                    p = logits if no_softmax else jax.nn.softmax(logits, axis=-1)
+                    attn = jnp.einsum(
+                        "bhgk,bkhd->bhgd", p.astype(cv.dtype), cv,
+                        preferred_element_type=jnp.float32,
+                    ).astype(x.dtype).reshape(B, 1, cfg.q_dim)
+                x = x + attn @ layer["wo"].astype(x.dtype)
+                h = norm(x, layer["mlp_norm"])
+                gu = h @ layer["w_gateup"].astype(h.dtype)
+                gate = jax.nn.gelu(gu[..., : cfg.d_ff], approximate=True)
+                x = x + (gate * gu[..., cfg.d_ff :]) @ layer["w_down"].astype(x.dtype)
+                return x, (ck, cv)
+
+            x, caches = lax.scan(body, x, (fp["layers"], caches))
+            x = norm(x, fp["final_norm"])
+            logits = jnp.matmul(
+                x, fp["embed"].T.astype(cfg.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            return (caches, nxt, pos + 1), nxt
+
+        (_, _, _), out = lax.scan(step, (caches, tok, pos), None, length=STEPS)
+        return out.T
+
+    return dec
+
+
+def timeit(name, fn):
+    shape = (cfg.n_layers, B, MAX_LEN, cfg.n_kv_heads, cfg.head_dim)
+    caches = (jnp.zeros(shape, jnp.bfloat16), jnp.zeros(shape, jnp.bfloat16))
+    tok = jnp.zeros((B,), jnp.int32)
+    pos = jnp.int32(PROMPT)
+    np.asarray(fn(params, caches, tok, pos))  # compile
+    best = float("inf")
+    for s in range(3):
+        tok2 = jax.random.randint(jax.random.PRNGKey(s), (B,), 0, cfg.vocab_size)
+        np.asarray(tok2)
+        t0 = time.perf_counter()
+        np.asarray(fn(params, caches, tok2, pos))
+        best = min(best, time.perf_counter() - t0)
+    ms = best / STEPS * 1e3
+    print(f"{name:16s} {ms:7.3f} ms/step  roofline_frac={ideal_ms/ms:.3f}")
+    return ms
+
+
+timeit("full", make_decode())
+timeit("no-norms", make_decode(no_norms=True))
+timeit("no-rope", make_decode(no_rope=True))
+timeit("no-cachewrite", make_decode(no_cachewrite=True))
+timeit("no-softmax", make_decode(no_softmax=True))
+timeit("matmuls-only", make_decode(matmuls_only=True))

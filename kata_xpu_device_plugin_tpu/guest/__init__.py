@@ -1,9 +1,13 @@
-"""In-guest validation: the BASELINE config ladder (device probe, compute
-check, all-reduce smoke) run inside the Kata guest the plugin provisioned."""
+"""In-guest workload layer: the BASELINE config ladder (device probe,
+compute check, all-reduce smoke) run inside the Kata guest the plugin
+provisioned, plus the continuous-batching generation server."""
 from .distributed import initialize_from_env, resolve
 from .probe import probe_all_reduce, probe_compute, probe_devices, run_ladder
+from .serving import GenerationServer, serve_batch
 
 __all__ = [
+    "GenerationServer",
+    "serve_batch",
     "initialize_from_env",
     "resolve",
     "probe_all_reduce",

@@ -1,0 +1,219 @@
+"""Continuous-batching generation server (in-guest serving loop).
+
+The reference is host infrastructure and ships no serving stack (SURVEY §2:
+zero ML code); this is the guest-side capability its users actually run on
+the chips the plugin hands out. TPU-first design:
+
+- ONE fixed-shape KV arena ``[L, max_batch, max_len, KV, D]`` and one
+  compiled ragged-decode scan (``transformer.decode`` with [B] per-slot
+  positions) serve every request mix — no shape churn, no recompiles as
+  requests come and go.
+- Admission is slot-based: a finished slot is refilled from the queue by
+  prefilling the new prompt into fresh caches and writing them into the
+  slot (one ``dynamic_update_slice``); all other slots keep decoding.
+- The host loop only inspects tokens every ``chunk`` decode steps, so
+  device dispatch stays one fused scan per chunk, and per-request python
+  cost is amortized 1/chunk.
+
+Greedy decoding matches :func:`..models.transformer.generate` token-for-
+token per request (tested), independent of batching order and slot
+assignment — continuous batching is a scheduling optimization, not a
+numerics change. Sampling (temperature/top_k) is supported per-server; its
+stream differs from single-request ``generate`` (different key schedule).
+
+Prompt lengths compile one prefill executable per distinct length; callers
+wanting a bounded executable count should pad prompts to buckets.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import (
+    DecoderConfig,
+    _decode_scan,
+    _next_token,
+    _sampling_args,
+    init_kv_caches,
+    prefill,
+)
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray  # [S] int32
+    max_new_tokens: int
+    out: list = field(default_factory=list)
+    done: bool = False
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _write_slot(arena_k, arena_v, slot_k, slot_v, slot: jax.Array):
+    """Copy a freshly prefilled single-sequence cache pair into arena slot
+    ``slot`` (traced scalar — one executable serves every slot)."""
+    zero = jnp.int32(0)
+    at = (zero, jnp.asarray(slot, jnp.int32), zero, zero, zero)
+    return (
+        jax.lax.dynamic_update_slice(arena_k, slot_k, at),
+        jax.lax.dynamic_update_slice(arena_v, slot_v, at),
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "do_sample", "top_k"),
+         donate_argnums=(1,))
+def _serve_decode(params, caches, tok, pos, cfg, steps: int, do_sample: bool,
+                  top_k: int, temperature, key):
+    """The server's one decode executable: a fixed-``steps`` ragged chunk
+    with the KV arena DONATED — without donation XLA must copy both
+    [L, B, max_len, KV, D] arena tensors every chunk (the first in-scan
+    cache write would otherwise alias a live buffer), pure HBM traffic
+    charged against the bandwidth decode is bound by."""
+    return _decode_scan(params, caches, tok, pos, cfg, steps, None,
+                        do_sample, top_k, temperature, key,
+                        return_state=True)
+
+
+class GenerationServer:
+    """Slot-based continuous batching over one decode arena.
+
+    >>> srv = GenerationServer(params, cfg, max_batch=4, max_len=512)
+    >>> rid = srv.submit(prompt_tokens, max_new_tokens=64)
+    >>> results = srv.run()          # {rid: np.ndarray of generated tokens}
+
+    ``params`` may be the bf16 pytree or the int8-quantized one
+    (``ops.quant.quantize_decoder_params``) — the decode path is shared.
+    """
+
+    def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
+                 max_len: int = 512, eos_id: Optional[int] = None,
+                 chunk: int = 8, temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0):
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.params, self.cfg = params, cfg
+        self.max_batch, self.max_len = max_batch, max_len
+        self.eos_id, self.chunk = eos_id, chunk
+        self.temperature, self.top_k = temperature, top_k
+        # The one sample-vs-greedy decision (transformer._sampling_args):
+        # also validates top_k-without-temperature.
+        self._do_sample, self._key = _sampling_args(
+            temperature, top_k, jax.random.PRNGKey(seed)
+        )
+        self.arena = init_kv_caches(cfg, max_batch, max_len)
+        # Host-side slot state: which request occupies each slot, its
+        # absolute position (next cache write index), and its last token.
+        self._slot_req: list[Optional[_Request]] = [None] * max_batch
+        self._pos = np.zeros(max_batch, np.int32)
+        self._last = np.zeros(max_batch, np.int32)
+        self._queue: list[_Request] = []
+        self._results: dict[int, np.ndarray] = {}
+        self._next_rid = 0
+
+    # ----- public API ------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 64) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds arena max_len ({self.max_len})"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, prompt, max_new_tokens))
+        return rid
+
+    def run(self) -> dict[int, np.ndarray]:
+        """Drain queue + slots to completion; returns {rid: tokens[new]}."""
+        while self.step():
+            pass
+        out, self._results = self._results, {}
+        return out
+
+    # ----- scheduling ------------------------------------------------------
+
+    def _sample_first(self, logits: jax.Array) -> int:
+        self._key, sub = jax.random.split(self._key)
+        return int(_next_token(logits, sub, self._do_sample,
+                               jnp.float32(self.temperature), self.top_k)[0])
+
+    def _fill_slot(self, b: int, req: _Request) -> None:
+        """Prefill ``req``'s prompt into arena slot ``b``."""
+        caches, last_logits, pos = prefill(
+            self.params, jnp.asarray(req.prompt)[None, :], self.cfg,
+            self.max_len, return_logits=True,
+        )
+        first = self._sample_first(last_logits)
+        req.out.append(first)
+        ak, av = self.arena
+        self.arena = _write_slot(ak, av, caches[0], caches[1], b)
+        self._slot_req[b] = req
+        self._pos[b] = int(pos)
+        self._last[b] = first
+        self._maybe_finish(b, [first])
+
+    def _maybe_finish(self, b: int, new_tokens: list) -> None:
+        req = self._slot_req[b]
+        if req is None:
+            return
+        hit_eos = self.eos_id is not None and self.eos_id in new_tokens
+        if hit_eos:
+            req.out = req.out[: req.out.index(self.eos_id) + 1]
+        if hit_eos or len(req.out) >= req.max_new_tokens:
+            req.out = req.out[: req.max_new_tokens]
+            self._results[req.rid] = np.asarray(req.out, np.int32)
+            req.done = True
+            self._slot_req[b] = None
+
+    def step(self) -> bool:
+        """One scheduler round: refill free slots, then one decode chunk.
+        Returns False when queue and slots are both empty."""
+        for b in range(self.max_batch):
+            if self._slot_req[b] is None and self._queue:
+                self._fill_slot(b, self._queue.pop(0))
+        active = [b for b in range(self.max_batch) if self._slot_req[b] is not None]
+        if not active:
+            return bool(self._queue)
+
+        # Always decode exactly ``chunk`` steps: ``steps`` is a static arg,
+        # so a data-dependent chunk would compile a fresh full-model decode
+        # executable per distinct value (a multi-second latency spike
+        # whenever a request neared its budget). Overrun is harmless by
+        # construction — writes past max_len clamp to the last entry of a
+        # slot that is finished (and refill overwrites the whole slot), and
+        # _maybe_finish trims tokens past eos/budget.
+        self._key, sub = jax.random.split(self._key)
+        toks, caches, last, pos = _serve_decode(
+            self.params, self.arena, jnp.asarray(self._last),
+            jnp.asarray(self._pos), self.cfg, self.chunk, self._do_sample,
+            self.top_k, jnp.float32(self.temperature), sub,
+        )
+        toks = np.asarray(toks)  # [max_batch, chunk]
+        self.arena = caches
+        # np.array (not asarray): device arrays convert read-only, and
+        # _fill_slot writes these rows in place on refill.
+        self._last = np.array(last)
+        self._pos = np.array(pos)
+        for b in active:
+            new = toks[b].tolist()
+            self._slot_req[b].out.extend(new)
+            self._maybe_finish(b, new)
+        return True
+
+
+def serve_batch(params: Any, cfg: DecoderConfig, prompts: list,
+                max_new_tokens: int = 64, **server_kwargs) -> list[np.ndarray]:
+    """Convenience: continuous-batch a list of ragged prompts, returning the
+    generated tokens in input order."""
+    srv = GenerationServer(params, cfg, **server_kwargs)
+    rids = [srv.submit(p, max_new_tokens) for p in prompts]
+    results = srv.run()
+    return [results[r] for r in rids]

@@ -290,6 +290,19 @@ def _layer(
         cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
         attn_out = attn_fn(q, k, v, causal=True, q_offset=None)
         new_cache = (ck, cv)
+    elif kv_cache is not None and jnp.ndim(cache_offset) == 1:
+        # Ragged decode ([B] offsets, S==1): each batch row writes its k/v at
+        # its OWN position — continuous batching, where slots hold sequences
+        # of different lengths. Writes clamp at max_len-1 (a slot past its
+        # budget scribbles on the last entry, which the server never reads).
+        ck, cv = kv_cache
+        assert S == 1, "ragged ([B]) cache offsets are decode-only (S == 1)"
+        idx = jnp.minimum(cache_offset, ck.shape[1] - 1)
+        rows = jnp.arange(B)
+        ck = ck.at[rows, idx].set(k[:, 0].astype(ck.dtype))
+        cv = cv.at[rows, idx].set(v[:, 0].astype(cv.dtype))
+        attn_out = attn_fn(q, ck, cv, causal=True, q_offset=cache_offset)
+        new_cache = (ck, cv)
     elif kv_cache is not None:
         # Decode: write new k/v at cache_offset, attend to the whole cache
         # prefix. Static shapes — XLA-friendly.
@@ -514,19 +527,23 @@ def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
     return caches, last, jnp.int32(S)
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn", "do_sample", "top_k"))
+@partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn", "do_sample",
+                                   "top_k", "return_state"))
 def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
                  cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn],
-                 do_sample: bool, top_k: int, temperature, key: jax.Array):
+                 do_sample: bool, top_k: int, temperature, key: jax.Array,
+                 return_state: bool = False):
     if attn_fn is None:
         from ..ops.attention import flash_attention
 
         attn_fn = flash_attention
     B = tok.shape[0]
+    ragged = jnp.ndim(pos) == 1  # [B] per-slot positions (continuous batching)
 
     def step(carry, step_key):
         caches, tok, pos = carry
-        positions = jnp.full((B, 1), pos, jnp.int32)
+        positions = (pos[:, None] if ragged
+                     else jnp.full((B, 1), pos, jnp.int32))
         logits, caches = forward(
             params, tok[:, None], cfg, attn_fn=attn_fn, positions=positions,
             kv_caches=caches, cache_offset=pos,
@@ -535,25 +552,30 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
         return (caches, nxt, pos + 1), nxt
 
     init = (caches, tok, jnp.asarray(pos, jnp.int32))
-    (_, _, _), out = lax.scan(step, init, jax.random.split(key, steps))
-    return out.T
+    (caches, tok, pos), out = lax.scan(step, init, jax.random.split(key, steps))
+    return (out.T, caches, tok, pos) if return_state else out.T
 
 
 def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
            cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn] = None,
            temperature: float = 0.0, top_k: int = 0,
-           key: Optional[jax.Array] = None):
+           key: Optional[jax.Array] = None, return_state: bool = False):
     """Decode ``steps`` tokens after ``tok`` as one lax.scan — no per-token
-    dispatch overhead. Returns [B, steps]. ``pos`` is a SCALAR: the whole
-    batch decodes in lockstep at one shared position (the cache write index
-    and causal mask are batch-wide; ragged prompts need left-padding
-    upstream). Greedy by default; ``temperature``/``top_k``/``key`` switch
-    to sampling (:func:`sample_token`)."""
+    dispatch overhead. Returns [B, steps] (with ``return_state=True``:
+    ``(tokens, caches, last_token, pos)`` so a server can continue later).
+
+    ``pos`` is either a SCALAR — the whole batch decodes in lockstep at one
+    shared position — or a [B] VECTOR of per-slot positions (ragged decode:
+    each row writes its k/v and masks its attention at its own position —
+    the continuous-batching path, see :mod:`..guest.serving`; per-row
+    writes clamp at max_len-1, the caller owns the budget). Greedy by
+    default; ``temperature``/``top_k``/``key`` switch to sampling
+    (:func:`sample_token`)."""
     cache_len = caches[0].shape[2]
     if steps > cache_len:
         raise ValueError(f"steps={steps} exceeds cache max_len={cache_len}")
     try:
-        pos_concrete = int(pos)
+        pos_concrete = int(pos) if jnp.ndim(pos) == 0 else None
     except Exception:  # traced under an outer jit: that caller owns the bound
         pos_concrete = None
     if pos_concrete is not None and pos_concrete + steps > cache_len:
@@ -564,7 +586,8 @@ def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
         )
     do_sample, key = _sampling_args(temperature, top_k, key)
     return _decode_scan(params, caches, tok, pos, cfg, steps, attn_fn,
-                        do_sample, top_k, jnp.float32(temperature), key)
+                        do_sample, top_k, jnp.float32(temperature), key,
+                        return_state=return_state)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "max_len", "attn_fn",

@@ -61,11 +61,18 @@ def reference_attention(
     logits = logits * (1.0 / float(D) ** 0.5)
     if causal:
         q_pos = jnp.arange(Sq)
-        if q_offset is not None:
-            q_pos = q_pos + q_offset
         k_pos = jnp.arange(Sk)
-        mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
-        logits = jnp.where(mask[None, None, None], logits, -1e30)
+        if q_offset is not None and jnp.ndim(q_offset) == 1:
+            # Per-row offsets ([B]): ragged decode — each batch row sits at
+            # its own position in its KV prefix (continuous batching).
+            q_pos = q_pos[None, :] + q_offset[:, None]  # [B, Sq]
+            mask = k_pos[None, None, :] <= q_pos[..., None]  # [B, Sq, Sk]
+            logits = jnp.where(mask[:, None, None], logits, -1e30)
+        else:
+            if q_offset is not None:
+                q_pos = q_pos + q_offset
+            mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
+            logits = jnp.where(mask[None, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
         "bhgqk,bkhd->bqhgd",
@@ -123,7 +130,11 @@ def decode_eligible(sq: int, sk: int, d: int, causal: bool, q_offset) -> bool:
     if os.environ.get("KATA_TPU_DECODE_KERNEL", "") != "1":
         return False
     return (
-        causal and q_offset is not None and on_tpu() and supports_decode(sq, sk, d)
+        causal
+        and q_offset is not None
+        and jnp.ndim(q_offset) == 0  # kernel wants the lockstep scalar pos
+        and on_tpu()
+        and supports_decode(sq, sk, d)
     )
 
 

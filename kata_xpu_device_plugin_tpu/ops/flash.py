@@ -462,8 +462,10 @@ def _flash_block_bwd(causal, block_q, block_k, interpret, res, cts):
     # per-row, not per-block) and caps at 512: its dq/dkv kernels hold
     # several fp32 [BQ, BK] intermediates plus scratch in VMEM, a footprint
     # the 1024 forward default was never swept for on the training path.
-    block_q = pick_block(Sq, min(block_q, 512))
-    block_k = pick_block(Sk, min(block_k, 512))
+    # Lengths with no divisor ≤512 (e.g. 544 = 32·17) keep the forward's
+    # block — the forward proved it compiles, and a valid block is required.
+    block_q = pick_block(Sq, min(block_q, 512)) or block_q
+    block_k = pick_block(Sk, min(block_k, 512)) or block_k
     do_t = dout.transpose(0, 2, 1, 3)
     # defvjp without symbolic_zeros: the lse cotangent is always a dense
     # array (zeros when lse is unused downstream).

@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""int8 decode floor: is 0.67 of the int8 roofline the compiler ceiling?
+
+Times the real int8 decode step and an int8 matmuls-only variant (weights
+streamed as int8, dequant-scale on the activation, everything else
+stripped) — the int8 analogue of exp_decode3's bf16 floor measurement.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+sys.path.insert(0, "/root/repo")
+
+from kata_xpu_device_plugin_tpu.models import gemma_2b_bench
+from kata_xpu_device_plugin_tpu.models.transformer import (
+    decode,
+    fuse_decoder_params,
+    init_kv_caches,
+    init_params,
+)
+from kata_xpu_device_plugin_tpu.ops.quant import (
+    params_hbm_bytes,
+    quantize_decoder_params,
+    weight_matmul,
+)
+
+cfg = gemma_2b_bench()
+B, PROMPT, STEPS = 8, 128, 128
+MAX_LEN = PROMPT + STEPS
+
+params = jax.jit(
+    lambda k: fuse_decoder_params(init_params(k, cfg, dtype=jnp.bfloat16))
+)(jax.random.PRNGKey(0))
+qparams = jax.jit(quantize_decoder_params)(params)
+jax.block_until_ready(qparams)
+
+ideal_ms = params_hbm_bytes(qparams) / 819e9 * 1e3
+print(f"int8 bytes {params_hbm_bytes(qparams)/1e9:.3f}G -> ideal {ideal_ms:.3f} ms/step")
+
+
+@jax.jit
+def matmuls_only(fp, tok, pos):
+    def step(carry, _):
+        tok, pos = carry
+        x = fp["embed"].astype(cfg.dtype)[tok[:, None]]
+
+        def body(x, layer):
+            qkv = weight_matmul(x, layer["wqkv"])
+            x = x + weight_matmul(qkv[..., : cfg.q_dim], layer["wo"])
+            gu = weight_matmul(x, layer["w_gateup"])
+            x = x + weight_matmul(gu[..., : cfg.d_ff], layer["w_down"])
+            return x, None
+
+        x, _ = lax.scan(body, x, fp["layers"])
+        logits = jnp.matmul(
+            x, fp["embed"].T.astype(cfg.dtype), preferred_element_type=jnp.float32
+        )
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        return (nxt, pos + 1), nxt
+
+    (_, _), out = lax.scan(step, (tok, pos), None, length=STEPS)
+    return out.T
+
+
+def timeit(name, fn):
+    np.asarray(fn(qparams, jnp.zeros((B,), jnp.int32), jnp.int32(PROMPT)))  # compile
+    best = float("inf")
+    for s in range(3):
+        tok2 = jax.random.randint(jax.random.PRNGKey(s), (B,), 0, cfg.vocab_size)
+        np.asarray(tok2)
+        t0 = time.perf_counter()
+        np.asarray(fn(qparams, tok2, jnp.int32(PROMPT)))
+        best = min(best, time.perf_counter() - t0)
+    ms = best / STEPS * 1e3
+    print(f"{name:16s} {ms:7.3f} ms/step  int8_roofline_frac={ideal_ms/ms:.3f}")
+
+
+caches = init_kv_caches(cfg, B, MAX_LEN)
+timeit("full-int8", lambda p, tok, pos: decode(p, caches, tok, int(pos), cfg, STEPS))
+timeit("matmuls-only", matmuls_only)

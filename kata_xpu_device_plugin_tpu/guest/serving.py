@@ -93,7 +93,7 @@ class GenerationServer:
     def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
                  max_len: int = 512, eos_id: Optional[int] = None,
                  chunk: int = 8, temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0):
+                 seed: int = 0, mesh: Any = None):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         self.params, self.cfg = params, cfg
@@ -106,6 +106,8 @@ class GenerationServer:
             temperature, top_k, jax.random.PRNGKey(seed)
         )
         self.arena = init_kv_caches(cfg, max_batch, max_len)
+        if mesh is not None:
+            self._shard_over(mesh)
         # Host-side slot state: which request occupies each slot, its
         # absolute position (next cache write index), and its last token.
         self._slot_req: list[Optional[_Request]] = [None] * max_batch
@@ -114,6 +116,39 @@ class GenerationServer:
         self._queue: list[_Request] = []
         self._results: dict[int, np.ndarray] = {}
         self._next_rid = 0
+
+    def _shard_over(self, mesh) -> None:
+        """Tensor-parallel serving: place params by PARAM_RULES (wide dims
+        over the model axis — GSPMD inserts the tp collectives inside the
+        same jitted prefill/decode executables) and shard the KV arena's
+        head axis over model when the head count divides; otherwise the
+        arena replicates (correct, memory-heavier). Needs the TRAINING
+        param layout (separate wq/wk/wv): the fused/int8 layouts are
+        single-device micro-optimizations with no sharding rules."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.quant import QTensor
+        from ..parallel.mesh import AXIS_MODEL
+        from ..parallel.sharding import shard_params
+
+        layers = self.params.get("layers", {})
+        if any(isinstance(v, QTensor) for v in layers.values()):
+            raise ValueError("mesh serving needs unquantized params")
+        if "wqkv" in layers:
+            raise ValueError(
+                "mesh serving needs the unfused param layout (PARAM_RULES "
+                "has no rule for the concatenated wqkv/w_gateup tensors)"
+            )
+        self.params = shard_params(self.params, mesh)
+        tp = mesh.shape.get(AXIS_MODEL, 1)
+        kv_spec = (
+            P(None, None, None, AXIS_MODEL, None)
+            if self.cfg.n_kv_heads % tp == 0
+            else P()
+        )
+        sh = NamedSharding(mesh, kv_spec)
+        self.arena = tuple(jax.device_put(c, sh) for c in self.arena)
 
     # ----- public API ------------------------------------------------------
 

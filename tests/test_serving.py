@@ -103,6 +103,38 @@ def test_sampling_runs_and_respects_budget(model):
     assert all(o.dtype == np.int32 for o in out)
 
 
+def test_tensor_parallel_serving_matches_single_device(model):
+    # The same server over an 8-device data×fsdp×model mesh: params placed
+    # by PARAM_RULES, KV arena head-sharded over model. Deterministic CPU
+    # mesh + fixed seeds → outputs must equal the single-device run.
+    from kata_xpu_device_plugin_tpu.parallel import build_mesh
+
+    cfg, params = model
+    mesh = build_mesh({"data": 2, "fsdp": 2, "model": 2})
+    prompts = _prompts(cfg, [4, 9, 6], seed=6)
+    ref = serve_batch(params, cfg, prompts, max_new_tokens=8,
+                      max_batch=2, max_len=32)
+    out = serve_batch(params, cfg, prompts, max_new_tokens=8,
+                      max_batch=2, max_len=32, mesh=mesh)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+
+def test_mesh_serving_rejects_fused_and_quantized(model):
+    from kata_xpu_device_plugin_tpu.ops.quant import quantize_decoder_params
+    from kata_xpu_device_plugin_tpu.models.transformer import fuse_decoder_params
+    from kata_xpu_device_plugin_tpu.parallel import build_mesh
+
+    cfg, params = model
+    mesh = build_mesh({"data": 2, "fsdp": 2, "model": 2})
+    with pytest.raises(ValueError, match="unfused"):
+        GenerationServer(fuse_decoder_params(params), cfg, mesh=mesh)
+    with pytest.raises(ValueError, match="unquantized"):
+        GenerationServer(
+            quantize_decoder_params(fuse_decoder_params(params)), cfg, mesh=mesh
+        )
+
+
 def test_submit_validation(model):
     cfg, params = model
     srv = GenerationServer(params, cfg, max_batch=1, max_len=16)

@@ -53,16 +53,19 @@ class _Request:
     done: bool = False
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _write_slot(arena_k, arena_v, slot_k, slot_v, slot: jax.Array):
+@partial(jax.jit, donate_argnums=(0,))
+def _write_slot(arena, slot_caches, slot: jax.Array):
     """Copy a freshly prefilled single-sequence cache pair into arena slot
-    ``slot`` (traced scalar — one executable serves every slot)."""
-    zero = jnp.int32(0)
-    at = (zero, jnp.asarray(slot, jnp.int32), zero, zero, zero)
-    return (
-        jax.lax.dynamic_update_slice(arena_k, slot_k, at),
-        jax.lax.dynamic_update_slice(arena_v, slot_v, at),
-    )
+    ``slot`` (traced scalar — one executable serves every slot). Tree-maps
+    over the cache pytree, so bf16 arrays and int8 QTensor caches (q +
+    scale leaves) both work."""
+    s = jnp.asarray(slot, jnp.int32)
+
+    def write(a, c):
+        at = (jnp.int32(0), s) + (jnp.int32(0),) * (a.ndim - 2)
+        return jax.lax.dynamic_update_slice(a, c, at)
+
+    return jax.tree.map(write, arena, slot_caches)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "do_sample", "top_k"),
@@ -93,19 +96,23 @@ class GenerationServer:
     def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
                  max_len: int = 512, eos_id: Optional[int] = None,
                  chunk: int = 8, temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0, mesh: Any = None):
+                 seed: int = 0, mesh: Any = None, kv_quant: bool = False):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         self.params, self.cfg = params, cfg
         self.max_batch, self.max_len = max_batch, max_len
         self.eos_id, self.chunk = eos_id, chunk
         self.temperature, self.top_k = temperature, top_k
+        self.kv_quant = kv_quant
         # The one sample-vs-greedy decision (transformer._sampling_args):
         # also validates top_k-without-temperature.
         self._do_sample, self._key = _sampling_args(
             temperature, top_k, jax.random.PRNGKey(seed)
         )
-        self.arena = init_kv_caches(cfg, max_batch, max_len)
+        # kv_quant: int8 arena — ~2× less HBM per slot-token, so the same
+        # chip serves ~2× the context/slots (per-vector scales; decode
+        # dequant fuses into the attention dots).
+        self.arena = init_kv_caches(cfg, max_batch, max_len, quantized=kv_quant)
         if mesh is not None:
             self._shard_over(mesh)
         # Host-side slot state: which request occupies each slot, its
@@ -148,7 +155,9 @@ class GenerationServer:
             else P()
         )
         sh = NamedSharding(mesh, kv_spec)
-        self.arena = tuple(jax.device_put(c, sh) for c in self.arena)
+        self.arena = jax.tree.map(
+            lambda c: jax.device_put(c, sh), self.arena
+        )
 
     # ----- public API ------------------------------------------------------
 
@@ -184,12 +193,11 @@ class GenerationServer:
         """Prefill ``req``'s prompt into arena slot ``b``."""
         caches, last_logits, pos = prefill(
             self.params, jnp.asarray(req.prompt)[None, :], self.cfg,
-            self.max_len, return_logits=True,
+            self.max_len, return_logits=True, kv_quantized=self.kv_quant,
         )
         first = self._sample_first(last_logits)
         req.out.append(first)
-        ak, av = self.arena
-        self.arena = _write_slot(ak, av, caches[0], caches[1], b)
+        self.arena = _write_slot(self.arena, caches, b)
         self._slot_req[b] = req
         self._pos[b] = int(pos)
         self._last[b] = first

@@ -27,7 +27,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.quant import QTensor, weight_matmul
+from ..ops.quant import (
+    QTensor,
+    dequantize_kv,
+    quantize_kv,
+    weight_matmul,
+)
 
 Params = dict[str, Any]
 AttnFn = Callable[..., jax.Array]  # (q, k, v, causal, q_offset) -> out
@@ -245,6 +250,32 @@ def unembed(params: Params, x: jax.Array, cfg: DecoderConfig) -> jax.Array:
 # ----- forward pass --------------------------------------------------------
 
 
+def _cache_write_full(cache, x: jax.Array, offset) -> "QTensor | jax.Array":
+    """Write fresh k/v ``x [B, S, KV, D]`` into a cache at sequence offset
+    ``offset`` (prefill / lockstep decode). Quantizes on the way in when the
+    cache is an int8 :class:`QTensor`."""
+    if isinstance(cache, QTensor):
+        qt = quantize_kv(x)
+        at = (0, offset, 0, 0)
+        return QTensor(
+            lax.dynamic_update_slice(cache.q, qt.q, at),
+            lax.dynamic_update_slice(cache.scale, qt.scale, at),
+        )
+    return lax.dynamic_update_slice(cache, x.astype(cache.dtype), (0, offset, 0, 0))
+
+
+def _cache_write_rows(cache, x: jax.Array, rows, idx) -> "QTensor | jax.Array":
+    """Ragged-decode write: row ``b``'s single k/v vector lands at its own
+    position ``idx[b]``. x: [B, 1, KV, D]."""
+    if isinstance(cache, QTensor):
+        qt = quantize_kv(x[:, 0])
+        return QTensor(
+            cache.q.at[rows, idx].set(qt.q),
+            cache.scale.at[rows, idx].set(qt.scale),
+        )
+    return cache.at[rows, idx].set(x[:, 0].astype(cache.dtype))
+
+
 def _layer(
     cfg: DecoderConfig,
     attn_fn: AttnFn,
@@ -286,8 +317,8 @@ def _layer(
         # makes the shapes eligible for the pallas flash kernel (which is
         # self-attention only).
         ck, cv = kv_cache
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        ck = _cache_write_full(ck, k, 0)
+        cv = _cache_write_full(cv, v, 0)
         attn_out = attn_fn(q, k, v, causal=True, q_offset=None)
         new_cache = (ck, cv)
     elif kv_cache is not None and jnp.ndim(cache_offset) == 1:
@@ -297,19 +328,32 @@ def _layer(
         # budget scribbles on the last entry, which the server never reads).
         ck, cv = kv_cache
         assert S == 1, "ragged ([B]) cache offsets are decode-only (S == 1)"
-        idx = jnp.minimum(cache_offset, ck.shape[1] - 1)
+        max_len = (ck.q if isinstance(ck, QTensor) else ck).shape[1]
+        idx = jnp.minimum(cache_offset, max_len - 1)
         rows = jnp.arange(B)
-        ck = ck.at[rows, idx].set(k[:, 0].astype(ck.dtype))
-        cv = cv.at[rows, idx].set(v[:, 0].astype(cv.dtype))
-        attn_out = attn_fn(q, ck, cv, causal=True, q_offset=cache_offset)
+        ck = _cache_write_rows(ck, k, rows, idx)
+        cv = _cache_write_rows(cv, v, rows, idx)
+        attn_out = attn_fn(
+            q, dequantize_kv(ck, x.dtype), dequantize_kv(cv, x.dtype),
+            causal=True, q_offset=cache_offset,
+        )
         new_cache = (ck, cv)
     elif kv_cache is not None:
         # Decode: write new k/v at cache_offset, attend to the whole cache
-        # prefix. Static shapes — XLA-friendly.
+        # prefix. Static shapes — XLA-friendly. dequantize_kv is a no-op on
+        # bf16 caches; on int8 QTensor caches it is an elementwise producer
+        # XLA fuses into the attention dots (the bf16 cache never hits HBM)
+        # — true on the default XLA attention path only: the opt-in pallas
+        # decode kernel (KATA_TPU_DECODE_KERNEL=1) takes materialized
+        # operands, which would write the dequantized cache out each layer.
+        # Don't combine the kernel opt-in with int8 caches.
         ck, cv = kv_cache
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_offset, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_offset, 0, 0))
-        attn_out = attn_fn(q, ck, cv, causal=True, q_offset=cache_offset)
+        ck = _cache_write_full(ck, k, cache_offset)
+        cv = _cache_write_full(cv, v, cache_offset)
+        attn_out = attn_fn(
+            q, dequantize_kv(ck, x.dtype), dequantize_kv(cv, x.dtype),
+            causal=True, q_offset=cache_offset,
+        )
         new_cache = (ck, cv)
     else:
         attn_out = attn_fn(q, k, v, causal=True, q_offset=None)
@@ -493,18 +537,32 @@ def _sampling_args(temperature, top_k, key):
 
 
 def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
-                   dtype=None) -> tuple[jax.Array, jax.Array]:
-    """Stacked caches [L, B, max_len, n_kv_heads, head_dim]."""
-    dtype = dtype or cfg.dtype
+                   dtype=None, quantized: bool = False):
+    """Stacked caches [L, B, max_len, n_kv_heads, head_dim].
+
+    ``quantized=True`` builds int8 :class:`QTensor` caches (per-vector fp32
+    scales, ~2× less HBM than bf16 — the long-context serving memory hog);
+    the cache write/read paths quantize/dequantize transparently."""
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    if quantized:
+        def one():
+            return QTensor(
+                jnp.zeros(shape, jnp.int8),
+                jnp.zeros(shape[:-1] + (1,), jnp.float32),
+            )
+
+        return one(), one()
+    dtype = dtype or cfg.dtype
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_len", "attn_fn", "return_logits"))
+@partial(jax.jit, static_argnames=("cfg", "max_len", "attn_fn", "return_logits",
+                                   "kv_quantized"))
 def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
             max_len: int, attn_fn: Optional[AttnFn] = None,
-            return_logits: bool = False):
-    """Prefill the prompt into fresh KV caches. Returns
+            return_logits: bool = False, kv_quantized: bool = False):
+    """Prefill the prompt into fresh KV caches (``kv_quantized=True``: int8
+    caches, see :func:`init_kv_caches`). Returns
     ``(caches, next_token, pos)`` — the greedy next token and the scalar
     position where decode continues (``return_logits=True`` yields the
     last-position logits instead of the argmax token, for samplers).
@@ -516,7 +574,7 @@ def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
 
         attn_fn = flash_attention
     B, S = prompt.shape
-    caches = init_kv_caches(cfg, B, max_len)
+    caches = init_kv_caches(cfg, B, max_len, quantized=kv_quantized)
     logits, caches = forward(
         params, prompt, cfg, attn_fn=attn_fn, kv_caches=caches,
         cache_offset=jnp.int32(0), prefill=True,
@@ -571,7 +629,8 @@ def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
     writes clamp at max_len-1, the caller owns the budget). Greedy by
     default; ``temperature``/``top_k``/``key`` switch to sampling
     (:func:`sample_token`)."""
-    cache_len = caches[0].shape[2]
+    c0 = caches[0]
+    cache_len = (c0.q if isinstance(c0, QTensor) else c0).shape[2]
     if steps > cache_len:
         raise ValueError(f"steps={steps} exceeds cache max_len={cache_len}")
     try:
@@ -591,13 +650,15 @@ def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "max_len", "attn_fn",
-                                   "do_sample", "top_k"))
+                                   "do_sample", "top_k", "kv_quantized"))
 def _generate_impl(params, prompt, cfg, steps, max_len, attn_fn,
-                   do_sample: bool, top_k: int, temperature, key):
+                   do_sample: bool, top_k: int, temperature, key,
+                   kv_quantized: bool = False):
     B, S = prompt.shape
     k_first, k_rest = jax.random.split(key)
     caches, last_logits, pos = prefill(
-        params, prompt, cfg, max_len, attn_fn=attn_fn, return_logits=True
+        params, prompt, cfg, max_len, attn_fn=attn_fn, return_logits=True,
+        kv_quantized=kv_quantized,
     )
     last = _next_token(last_logits, k_first, do_sample, temperature, top_k)
     if steps == 0:
@@ -612,7 +673,7 @@ def _generate_impl(params, prompt, cfg, steps, max_len, attn_fn,
 def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
              steps: int, max_len: int = 0, attn_fn: Optional[AttnFn] = None,
              temperature: float = 0.0, top_k: int = 0,
-             key: Optional[jax.Array] = None):
+             key: Optional[jax.Array] = None, kv_quantized: bool = False):
     """Generation: :func:`prefill` then :func:`decode`, composed under one
     jit. Greedy by default; ``temperature``/``top_k``/``key`` sample instead
     (``temperature`` is traced — varying it does not recompile).
@@ -631,4 +692,5 @@ def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
         )
     do_sample, key = _sampling_args(temperature, top_k, key)
     return _generate_impl(params, prompt, cfg, steps, max_len, attn_fn,
-                          do_sample, top_k, jnp.float32(temperature), key)
+                          do_sample, top_k, jnp.float32(temperature), key,
+                          kv_quantized=kv_quantized)

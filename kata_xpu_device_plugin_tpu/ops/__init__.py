@@ -23,18 +23,22 @@ from .moe import (
 from .quant import (
     QTensor,
     dequantize,
+    dequantize_kv,
     params_hbm_bytes,
     quantize,
     quantize_decoder_params,
+    quantize_kv,
     weight_matmul,
 )
 
 __all__ = [
     "QTensor",
     "dequantize",
+    "dequantize_kv",
     "params_hbm_bytes",
     "quantize",
     "quantize_decoder_params",
+    "quantize_kv",
     "weight_matmul",
     "best_attention",
     "flash_attention",

@@ -89,6 +89,25 @@ def quantize_decoder_params(params: dict) -> dict:
     return out
 
 
+def quantize_kv(x: jax.Array) -> QTensor:
+    """Quantize fresh k/v vectors for an int8 KV cache: one symmetric scale
+    per (batch, position, kv-head) vector — amax over the head_dim axis.
+    x: [..., KV, D] → QTensor(q [..., KV, D] int8, scale [..., KV, 1])."""
+    return quantize(x, axis=-1)
+
+
+def dequantize_kv(cache: "QTensor | jax.Array", dtype) -> jax.Array:
+    """Read side of the int8 KV cache: a no-op for plain arrays; for
+    QTensors the int8·scale multiply stays an elementwise producer that XLA
+    fuses into the attention dots — the bf16 cache never materializes in
+    HBM, so cache read traffic is the int8 bytes plus scales. The multiply
+    runs in fp32 (like :func:`dequantize`): casting the fp32 scale down to
+    bf16 first would stack ~0.2% scale truncation on the int8 error."""
+    if isinstance(cache, QTensor):
+        return (cache.q.astype(jnp.float32) * cache.scale).astype(dtype)
+    return cache
+
+
 def params_hbm_bytes(params: Any) -> int:
     """Bytes a decode step streams for the weights: the actual pytree leaf
     sizes (int8 payloads + their scales included) — the honest denominator

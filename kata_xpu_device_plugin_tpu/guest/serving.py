@@ -21,8 +21,14 @@ assignment — continuous batching is a scheduling optimization, not a
 numerics change. Sampling (temperature/top_k) is supported per-server; its
 stream differs from single-request ``generate`` (different key schedule).
 
-Prompt lengths compile one prefill executable per distinct length; callers
-wanting a bounded executable count should pad prompts to buckets.
+By default each distinct prompt length compiles its own prefill executable;
+``prefill_buckets=(64, 256, 1024)``-style bucketing right-pads prompts to
+the smallest fitting bucket — exact, not approximate (causal masking plus
+``true_len`` logits indexing; see ``transformer.prefill``). The executable
+count is bounded by ``len(buckets)`` only for prompts that fit a bucket;
+longer prompts fall back to exact-length prefill (one executable per
+distinct length), so the largest bucket should be sized to the longest
+expected prompt.
 """
 from __future__ import annotations
 
@@ -96,9 +102,15 @@ class GenerationServer:
     def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
                  max_len: int = 512, eos_id: Optional[int] = None,
                  chunk: int = 8, temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0, mesh: Any = None, kv_quant: bool = False):
+                 seed: int = 0, mesh: Any = None, kv_quant: bool = False,
+                 prefill_buckets: tuple = ()):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if any(b < 1 or b > max_len for b in prefill_buckets):
+            raise ValueError(
+                f"prefill_buckets {prefill_buckets} must lie in [1, max_len]"
+            )
+        self.prefill_buckets = tuple(sorted(prefill_buckets))
         self.params, self.cfg = params, cfg
         self.max_batch, self.max_len = max_batch, max_len
         self.eos_id, self.chunk = eos_id, chunk
@@ -190,10 +202,19 @@ class GenerationServer:
                                jnp.float32(self.temperature), self.top_k)[0])
 
     def _fill_slot(self, b: int, req: _Request) -> None:
-        """Prefill ``req``'s prompt into arena slot ``b``."""
+        """Prefill ``req``'s prompt into arena slot ``b``. With
+        ``prefill_buckets``, the prompt is right-padded up to the smallest
+        bucket that fits — one prefill executable per bucket rather than
+        one per distinct prompt length (exact: see ``transformer.prefill``'s
+        ``true_len``)."""
+        prompt, true_len = req.prompt, len(req.prompt)
+        bucket = next((k for k in self.prefill_buckets if k >= true_len), None)
+        if bucket is not None and bucket > true_len:
+            prompt = np.pad(prompt, (0, bucket - true_len))
         caches, last_logits, pos = prefill(
-            self.params, jnp.asarray(req.prompt)[None, :], self.cfg,
+            self.params, jnp.asarray(prompt)[None, :], self.cfg,
             self.max_len, return_logits=True, kv_quantized=self.kv_quant,
+            true_len=jnp.int32(true_len) if bucket is not None else None,
         )
         first = self._sample_first(last_logits)
         req.out.append(first)

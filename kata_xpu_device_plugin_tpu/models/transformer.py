@@ -560,12 +560,22 @@ def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
                                    "kv_quantized"))
 def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
             max_len: int, attn_fn: Optional[AttnFn] = None,
-            return_logits: bool = False, kv_quantized: bool = False):
+            return_logits: bool = False, kv_quantized: bool = False,
+            true_len: Optional[jax.Array] = None):
     """Prefill the prompt into fresh KV caches (``kv_quantized=True``: int8
     caches, see :func:`init_kv_caches`). Returns
     ``(caches, next_token, pos)`` — the greedy next token and the scalar
     position where decode continues (``return_logits=True`` yields the
     last-position logits instead of the argmax token, for samplers).
+
+    ``true_len`` (a TRACED scalar — no recompile per value) supports
+    right-padded prompts: logits are taken at ``true_len - 1`` and ``pos``
+    returns ``true_len``. Padding is exact, not approximate: causal
+    attention already hides positions ``>= s`` from prompt token ``s``, and
+    decode's index mask (``k_pos <= pos``) never reads a pad cache entry
+    before the decode scan has overwritten it. One executable per BUCKET of
+    prompt lengths instead of one per length.
+
     Separately jitted from :func:`decode` so the bench can time the
     bandwidth-bound decode loop on its own (prefill is compute-bound;
     folding it into the decode timing understates decode tok/s)."""
@@ -579,10 +589,15 @@ def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
         params, prompt, cfg, attn_fn=attn_fn, kv_caches=caches,
         cache_offset=jnp.int32(0), prefill=True,
     )
-    last = logits[:, -1, :]
+    if true_len is None:
+        last, pos = logits[:, -1, :], jnp.int32(S)
+    else:
+        pos = jnp.asarray(true_len, jnp.int32)
+        last = jax.lax.dynamic_index_in_dim(logits, pos - 1, axis=1,
+                                            keepdims=False)
     if not return_logits:
         last = greedy_token(last)
-    return caches, last, jnp.int32(S)
+    return caches, last, pos
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn", "do_sample",

@@ -135,6 +135,50 @@ def test_mesh_serving_rejects_fused_and_quantized(model):
         )
 
 
+def test_bucketed_prefill_is_exact(model):
+    # Right-padding to buckets must not change a single token: causal
+    # masking hides pads from prompt tokens, and decode's index mask never
+    # reads a pad entry before overwriting it.
+    cfg, params = model
+    prompts = _prompts(cfg, [3, 9, 5, 12, 8], seed=7)
+    ref = serve_batch(params, cfg, prompts, max_new_tokens=10,
+                      max_batch=2, max_len=32)
+    out = serve_batch(params, cfg, prompts, max_new_tokens=10,
+                      max_batch=2, max_len=32, prefill_buckets=(4, 16))
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    # Prompt longer than every bucket: falls back to exact-length prefill.
+    out2 = serve_batch(params, cfg, prompts, max_new_tokens=10,
+                       max_batch=2, max_len=32, prefill_buckets=(4,))
+    for r, o in zip(ref, out2):
+        np.testing.assert_array_equal(o, r)
+    with pytest.raises(ValueError, match="buckets"):
+        GenerationServer(params, cfg, max_len=32, prefill_buckets=(64,))
+
+
+def test_prefill_true_len_matches_exact(model):
+    from kata_xpu_device_plugin_tpu.models.transformer import prefill
+
+    cfg, params = model
+    (p,) = _prompts(cfg, [6], seed=8)
+    caches_e, last_e, pos_e = prefill(params, jnp.asarray(p)[None], cfg, 24,
+                                      return_logits=True)
+    padded = np.pad(p, (0, 10))
+    caches_b, last_b, pos_b = prefill(params, jnp.asarray(padded)[None], cfg,
+                                      24, return_logits=True,
+                                      true_len=jnp.int32(len(p)))
+    assert int(pos_b) == int(pos_e) == len(p)
+    np.testing.assert_allclose(np.asarray(last_b), np.asarray(last_e),
+                               rtol=1e-6)
+    # Cache entries for the real tokens are identical; pad entries differ
+    # but sit at indices the decode mask hides until overwritten.
+    for ce, cb in zip(caches_e, caches_b):
+        np.testing.assert_allclose(
+            np.asarray(ce[:, :, : len(p)]), np.asarray(cb[:, :, : len(p)]),
+            rtol=1e-6,
+        )
+
+
 def test_submit_validation(model):
     cfg, params = model
     srv = GenerationServer(params, cfg, max_batch=1, max_len=16)

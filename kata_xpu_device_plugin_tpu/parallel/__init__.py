@@ -1,7 +1,9 @@
 """Parallelism: mesh construction, dp/fsdp/tp sharding rules + train step,
 sequence-parallel ring attention, GPipe pipeline parallelism, (via ops.moe)
-expert parallelism, and sharding-aware checkpoint/resume."""
+expert parallelism, sharding-aware checkpoint/resume, and the
+deterministic resumable data loader."""
 from .checkpoint import TrainCheckpointer
+from .loader import TokenBatchLoader, make_loader
 from .composed import (
     composed_mesh,
     init_pp_params,
@@ -71,4 +73,6 @@ __all__ = [
     "shard_batch",
     "shard_params",
     "TrainCheckpointer",
+    "TokenBatchLoader",
+    "make_loader",
 ]

@@ -1,0 +1,181 @@
+"""Deterministic, resumable token-batch loader for the training path.
+
+The reference has no input pipeline (it is node infrastructure; SURVEY §2
+lists zero ML code), but a complete training stack needs the third leg next
+to the sharded train step (:mod:`.sharding`) and checkpoint/resume
+(:mod:`.checkpoint`): batches that are
+
+- **deterministic** — a (seed, epoch) pair fixes the sample order exactly;
+- **resumable** — ``state_dict()``/``load_state_dict()`` capture the cursor
+  so a restored run continues with the SAME remaining batches the
+  interrupted run would have seen (tested bit-identical);
+- **mesh-aware** — batches land pre-sharded over the data/fsdp axes via
+  :func:`.sharding.shard_batch` so the train step never re-lays them out;
+- **multihost-aware** — with ``host_count > 1`` each host draws the
+  disjoint ``host_index``-th stride of every global batch (per-host batch
+  = batch // host_count; pair with the plugin-injected worker identity
+  from ``guest.distributed``). Under real multi-process JAX the global
+  array assembles from each process's rows via
+  ``jax.make_array_from_process_local_data``; simulated multihost in one
+  process yields the host-local rows unplaced.
+
+TPU-first shape discipline: every batch is the same static
+``[batch, seq_len + 1]`` int32 array (inputs ``[:, :-1]``, targets
+``[:, 1:]`` — the convention :func:`..models.transformer.next_token_loss`
+expects), so one compiled train step serves the whole run; a trailing
+partial batch is dropped rather than shipped ragged.
+"""
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+
+class TokenBatchLoader:
+    """Iterate ``[batch, seq_len+1]`` windows over a token stream.
+
+    ``tokens`` is any 1-D integer array-like — typically an ``np.memmap``
+    of a tokenized corpus (the loader never copies the stream, only the
+    gathered windows). Windows are non-overlapping and shuffled per epoch
+    with a counter-based PRNG, so the order is a pure function of
+    ``(seed, epoch)`` — no RNG state to persist beyond the cursor.
+    """
+
+    def __init__(self, tokens: Any, batch: int, seq_len: int,
+                 seed: int = 0, shuffle: bool = True,
+                 host_count: int = 1, host_index: int = 0,
+                 mesh: Any = None):
+        # np.asarray on a memmap is a no-copy view — the stream itself is
+        # never copied, only gathered windows.
+        self.tokens = np.asarray(tokens)
+        if self.tokens.ndim != 1:
+            raise ValueError(f"tokens must be 1-D, got {self.tokens.ndim}-D")
+        if batch % host_count != 0:
+            raise ValueError(f"batch {batch} not divisible by host_count {host_count}")
+        if not 0 <= host_index < host_count:
+            raise ValueError(f"host_index {host_index} not in [0, {host_count})")
+        self.batch, self.seq_len = batch, seq_len
+        self.window = seq_len + 1
+        self.n_windows = len(self.tokens) // self.window
+        if self.n_windows < batch:
+            raise ValueError(
+                f"stream has {self.n_windows} windows of {self.window} "
+                f"tokens; need at least batch={batch}"
+            )
+        self.seed, self.shuffle = seed, shuffle
+        self.host_count, self.host_index = host_count, host_index
+        self.mesh = mesh
+        self.epoch = 0
+        self.step_in_epoch = 0  # next GLOBAL batch index within the epoch
+        self._order_cache: Optional[tuple[int, np.ndarray]] = None
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.n_windows // self.batch  # trailing partial batch dropped
+
+    # ----- deterministic order --------------------------------------------
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        # Cached per epoch: at corpus scale the permutation is O(n_windows)
+        # to build and must not be recomputed per batch.
+        if self._order_cache is not None and self._order_cache[0] == epoch:
+            return self._order_cache[1]
+        order = np.arange(self.n_windows, dtype=np.int64)
+        if self.shuffle:
+            # Generator seeded by (seed, epoch): the permutation is a pure
+            # function of both, so resume never needs stored RNG state.
+            np.random.default_rng((self.seed, epoch)).shuffle(order)
+        self._order_cache = (epoch, order)
+        return order
+
+    # ----- iteration -------------------------------------------------------
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if self.step_in_epoch >= self.steps_per_epoch:
+            self.epoch += 1
+            self.step_in_epoch = 0
+        order = self._epoch_order(self.epoch)
+        start = self.step_in_epoch * self.batch
+        rows = order[start : start + self.batch]
+        # Host shard: the host_index-th stride of the GLOBAL batch — every
+        # host computes the same `order`, so shards are disjoint and cover.
+        rows = rows[self.host_index :: self.host_count]
+        batch = np.stack(
+            [self.tokens[r * self.window : (r + 1) * self.window] for r in rows]
+        ).astype(np.int32)
+        self.step_in_epoch += 1
+        if self.mesh is not None:
+            import jax
+
+            from .sharding import BATCH_SPEC, shard_batch
+
+            if self.host_count == 1:
+                return shard_batch(batch, self.mesh)
+            if jax.process_count() > 1:
+                # Real multihost: each process holds only its shard rows;
+                # assemble the global array from process-local data (a
+                # plain device_put of local rows would either fail on
+                # non-addressable devices or ship a 1/host_count batch).
+                from jax.sharding import NamedSharding
+
+                return jax.make_array_from_process_local_data(
+                    NamedSharding(self.mesh, BATCH_SPEC), batch,
+                    global_shape=(self.batch, self.window),
+                )
+            # host_count > 1 simulated inside one process (tests): the
+            # global mesh is fully addressable but this loader only built
+            # its own shard — return it host-local, unplaced.
+            return batch
+        return batch
+
+    # ----- checkpointable cursor ------------------------------------------
+
+    def state_dict(self) -> dict:
+        """The full cursor; small and JSON-able — save it next to the orbax
+        train-state checkpoint (:mod:`.checkpoint`)."""
+        return {
+            "epoch": self.epoch,
+            "step_in_epoch": self.step_in_epoch,
+            "seed": self.seed,
+            "shuffle": self.shuffle,
+            "batch": self.batch,
+            "seq_len": self.seq_len,
+            # Corpus identity: a grown/swapped token stream changes the
+            # permutation, silently repeating/skipping samples on resume.
+            "n_windows": self.n_windows,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        for k in ("seed", "shuffle", "batch", "seq_len", "n_windows"):
+            if state[k] != getattr(self, k):
+                raise ValueError(
+                    f"loader state mismatch on {k!r}: checkpoint has "
+                    f"{state[k]!r}, loader has {getattr(self, k)!r} — "
+                    "resuming with a different data order would silently "
+                    "repeat or skip samples"
+                )
+        self.epoch = state["epoch"]
+        self.step_in_epoch = state["step_in_epoch"]
+
+
+def make_loader(tokens: Any, batch: int, seq_len: int,
+                mesh: Any = None, seed: int = 0, shuffle: bool = True,
+                host_count: Optional[int] = None,
+                host_index: Optional[int] = None) -> TokenBatchLoader:
+    """Build a :class:`TokenBatchLoader`. ``host_count``/``host_index``
+    default to the jax process topology (1/0 single-controller), which in a
+    Kata guest comes from the plugin-injected slice identity
+    (``guest.distributed``)."""
+    if host_count is None or host_index is None:
+        import jax
+
+        host_count = jax.process_count() if host_count is None else host_count
+        host_index = jax.process_index() if host_index is None else host_index
+    return TokenBatchLoader(
+        tokens, batch, seq_len, seed=seed, shuffle=shuffle,
+        host_count=host_count, host_index=host_index, mesh=mesh,
+    )

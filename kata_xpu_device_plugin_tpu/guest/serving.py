@@ -154,6 +154,11 @@ class GenerationServer:
         layers = self.params.get("layers", {})
         if any(isinstance(v, QTensor) for v in layers.values()):
             raise ValueError("mesh serving needs unquantized params")
+        if any(isinstance(v, tuple) for v in layers.values()):
+            raise ValueError(
+                "mesh serving has no sharding rules for wrapped weights "
+                "(LoRA adapters) — merge_lora first"
+            )
         if "wqkv" in layers:
             raise ValueError(
                 "mesh serving needs the unfused param layout (PARAM_RULES "

@@ -173,10 +173,11 @@ def fuse_decoder_params(params: Params) -> Params:
     layers = params["layers"]
     if "wqkv" in layers or "router" in layers:
         return params  # already fused, or MoE (no dense ffn to fuse)
-    if any(isinstance(v, QTensor) for v in layers.values()):
+    if any(isinstance(v, tuple) for v in layers.values()):
         raise ValueError(
-            "fuse_decoder_params before quantize_decoder_params: fusing "
-            "concatenates raw weight matrices, not int8 QTensors"
+            "fuse_decoder_params first: fusing concatenates raw weight "
+            "matrices, not int8 QTensors or LoRA adapters — quantize/adapt "
+            "after fusing (or merge_lora before)"
         )
     fused = {
         k: v for k, v in layers.items()

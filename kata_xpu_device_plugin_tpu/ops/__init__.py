@@ -20,6 +20,14 @@ from .moe import (
     moe_param_specs,
     reference_moe,
 )
+from .lora import (
+    LoRAWeight,
+    apply_lora,
+    lora_trainable_mask,
+    make_lora_train_step,
+    merge_lora,
+    split_trainable,
+)
 from .quant import (
     QTensor,
     dequantize,
@@ -32,6 +40,12 @@ from .quant import (
 )
 
 __all__ = [
+    "LoRAWeight",
+    "apply_lora",
+    "lora_trainable_mask",
+    "make_lora_train_step",
+    "merge_lora",
+    "split_trainable",
     "QTensor",
     "dequantize",
     "dequantize_kv",

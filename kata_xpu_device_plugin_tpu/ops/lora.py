@@ -1,0 +1,187 @@
+"""LoRA: low-rank adapters for parameter-efficient fine-tuning.
+
+The reference ships no training stack at all (SURVEY §2 — zero ML code);
+this is guest-side capability in the same style as :mod:`.quant`: a weight
+is wrapped in a pytree node and the ONE weight-apply hook
+(:func:`.quant.weight_matmul`) dispatches on it, so the decoder layer,
+``lax.scan`` stacking, generation, and serving all work unchanged.
+
+    y = x @ stop_gradient(base) + ((x @ a) @ b) · (alpha / rank)
+
+- ``base`` is frozen via ``stop_gradient`` — XLA dead-code-eliminates the
+  base weight-gradient outer products, so the backward pays only the
+  adapter cost, and the optimizer state covers adapter leaves only
+  (~0.1% of model size at rank 8).
+- ``base`` may itself be an int8 :class:`.quant.QTensor` — QLoRA: frozen
+  int8 weights streamed through the quantized matmul, bf16 adapters on
+  top — with no extra code.
+- ``b`` initializes to zero (standard LoRA), so a freshly adapted model is
+  EXACTLY the base model; tests pin this.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# Layer-dict keys that can take adapters: the same 2-D matmul operands
+# ops.quant can quantize.
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+class LoRAWeight(NamedTuple):
+    """A frozen base weight plus a trainable low-rank delta (NamedTuple ⇒
+    pytree: rides through jit/scan/grad like any array)."""
+
+    base: Any  # [..., in, out] array or QTensor
+    a: jax.Array  # [..., in, r]
+    b: jax.Array  # [..., r, out]
+    scale: jax.Array  # () fp32 — alpha / rank
+
+
+def lora_matmul(x: jax.Array, w: LoRAWeight) -> jax.Array:
+    """``x @ w`` with the base frozen and the low-rank path in the
+    activation dtype (the [.., r] bottleneck is tiny next to the base
+    stream)."""
+    from .quant import weight_matmul
+
+    base = w.base
+    if not isinstance(base, tuple):  # QTensor is a NamedTuple (tuple)
+        base = jax.lax.stop_gradient(base)
+    else:
+        base = type(base)(*(jax.lax.stop_gradient(t) for t in base))
+    y = weight_matmul(x, base)
+    delta = (x @ w.a.astype(x.dtype)) @ w.b.astype(x.dtype)
+    return y + delta * w.scale.astype(x.dtype)
+
+
+def _wrap(w: Any, key: jax.Array, rank: int, alpha: float) -> LoRAWeight:
+    shape = (w.q if hasattr(w, "q") else w).shape  # [..., in, out]
+    *lead, d_in, d_out = shape
+    a = jax.random.normal(key, (*lead, d_in, rank), jnp.float32) / jnp.sqrt(d_in)
+    b = jnp.zeros((*lead, rank, d_out), jnp.float32)
+    # scale broadcast to the leading (layer-stack) dims: every leaf of a
+    # scanned pytree needs the leading L axis for lax.scan to slice.
+    scale = jnp.full(tuple(lead), alpha / rank, jnp.float32)
+    return LoRAWeight(w, a, b, scale)
+
+
+def apply_lora(params: dict, key: jax.Array, rank: int = 8,
+               alpha: float = 16.0,
+               targets: Sequence[str] = DEFAULT_TARGETS) -> dict:
+    """Wrap each present target weight in ``params['layers']`` with a
+    fresh adapter (b = 0 ⇒ the adapted model initially equals the base).
+    Works on the training layout, the fused layout (pass
+    ``targets=('wqkv', ...)``), and int8-quantized bases (QLoRA)."""
+    layers = params["layers"]
+    present = [t for t in targets if t in layers]
+    if not present:
+        raise ValueError(
+            f"no LoRA targets {tuple(targets)} in layers "
+            f"{sorted(layers)} — fused layouts need e.g. targets=('wqkv',)"
+        )
+    keys = jax.random.split(key, len(present))
+    out_layers = dict(layers)
+    for t, k in zip(present, keys):
+        out_layers[t] = _wrap(layers[t], k, rank, alpha)
+    out = dict(params)
+    out["layers"] = out_layers
+    return out
+
+
+def merge_lora(params: dict) -> dict:
+    """Fold trained adapters back into plain weights (for serving /
+    quantization): ``W' = base + (a @ b)·scale``. Float bases keep their
+    dtype; int8 (QLoRA) bases dequantize and merge to FP32 — the
+    pre-quantization dtype is unrecoverable from a QTensor — so re-cast or
+    re-quantize (``quantize_decoder_params``) the result for serving."""
+    from .quant import QTensor, dequantize
+
+    def fold(w):
+        if not isinstance(w, LoRAWeight):
+            return w
+        base = dequantize(w.base) if isinstance(w.base, QTensor) else w.base
+        delta = jnp.einsum(
+            "...ir,...ro->...io", w.a, w.b,
+            preferred_element_type=jnp.float32,
+        ) * w.scale[..., None, None]
+        return (base.astype(jnp.float32) + delta).astype(base.dtype)
+
+    out = dict(params)
+    out["layers"] = {k: fold(v) for k, v in params["layers"].items()}
+    return out
+
+
+def lora_trainable_mask(params: Any) -> Any:
+    """Pytree of bools marking the adapter (a/b) leaves — what
+    :func:`split_trainable` partitions on (base weights and everything
+    else are False); also usable directly as an ``optax.masked`` mask."""
+
+    def mask_node(node):
+        if isinstance(node, LoRAWeight):
+            base_mask = jax.tree.map(lambda _: False, node.base)
+            return LoRAWeight(base_mask, True, True, False)  # noqa: FBT003
+        return jax.tree.map(lambda _: False, node)
+
+    return {
+        k: ({kk: mask_node(vv) for kk, vv in v.items()} if k == "layers"
+            else jax.tree.map(lambda _: False, v))
+        for k, v in params.items()
+    }
+
+
+def split_trainable(params: Any):
+    """Partition an adapted tree into ``(trainable_leaves, rebuild)``:
+    ``trainable_leaves`` is the flat list of adapter (a/b) arrays and
+    ``rebuild(new_leaves)`` reassembles the full tree. Differentiating
+    through ``rebuild`` keeps frozen leaves (including int8 QLoRA bases,
+    which ``jax.grad`` rejects as inputs) out of the grad computation
+    entirely, and the optimizer state covers exactly the adapters."""
+    mask_flat = jax.tree.leaves(lora_trainable_mask(params))
+    flat, treedef = jax.tree.flatten(params)
+    assert len(flat) == len(mask_flat)
+    trainable = [x for x, m in zip(flat, mask_flat) if m]
+    frozen = [x for x, m in zip(flat, mask_flat) if not m]
+
+    def rebuild(trainable_new):
+        it_t, it_f = iter(trainable_new), iter(frozen)
+        return jax.tree.unflatten(
+            treedef, [next(it_t) if m else next(it_f) for m in mask_flat]
+        )
+
+    return trainable, rebuild
+
+
+def make_lora_train_step(cfg, lr: float = 1e-4, attn_fn: Any = None):
+    """Single-device fine-tuning step over an adapted param tree: returns
+    ``(init_state, step)`` like :func:`..parallel.sharding.make_train_step`
+    but differentiating and optimizing ONLY the adapter leaves
+    (:func:`split_trainable`); the frozen base never enters ``jax.grad``
+    — which is also what makes int8 QLoRA bases trainable-over."""
+    import optax
+
+    from ..models.transformer import next_token_loss
+
+    optimizer = optax.adamw(lr)
+
+    def init_state(params):
+        trainable, _ = split_trainable(params)
+        return {"params": params, "opt": optimizer.init(trainable),
+                "step": jnp.int32(0)}
+
+    # NOT donated: state["params"] holds the frozen base, which callers
+    # still reference (donating it would invalidate their arrays for the
+    # ~0.1%-of-model-size adapter update it could save).
+    @jax.jit
+    def step(state, tokens):
+        trainable, rebuild = split_trainable(state["params"])
+        loss, grads = jax.value_and_grad(
+            lambda t: next_token_loss(rebuild(t), tokens, cfg, attn_fn=attn_fn)
+        )(trainable)
+        updates, new_opt = optimizer.update(grads, state["opt"], trainable)
+        new_trainable = optax.apply_updates(trainable, updates)
+        return {"params": rebuild(new_trainable), "opt": new_opt,
+                "step": state["step"] + 1}, loss
+
+    return init_state, step

@@ -61,14 +61,21 @@ def dequantize(qt: QTensor, dtype=jnp.float32) -> jax.Array:
 
 def weight_matmul(x: jax.Array, w: Any) -> jax.Array:
     """The one ``activation @ weight`` used by the decoder layer: a plain
-    cast-to-activation-dtype matmul for arrays, and for :class:`QTensor` the
+    cast-to-activation-dtype matmul for arrays; for :class:`QTensor` the
     int8-streaming form ``(x @ q) * scale`` — the int8→bf16 cast fuses into
-    the matmul's weight read, so HBM traffic is the int8 bytes."""
+    the matmul's weight read, so HBM traffic is the int8 bytes; for
+    :class:`.lora.LoRAWeight` the frozen-base-plus-low-rank-delta form."""
     if isinstance(w, QTensor):
         y = jnp.matmul(
             x, w.q.astype(x.dtype), preferred_element_type=jnp.float32
         )
         return (y * w.scale[..., 0, :]).astype(x.dtype)
+    if isinstance(w, tuple):  # LoRAWeight (import deferred: lora → quant)
+        from .lora import LoRAWeight, lora_matmul
+
+        if isinstance(w, LoRAWeight):
+            return lora_matmul(x, w)
+        raise TypeError(f"unknown weight wrapper {type(w).__name__}")
     return x @ w.astype(x.dtype)
 
 
@@ -81,6 +88,14 @@ def quantize_decoder_params(params: dict) -> dict:
     layers = params["layers"]
     if any(isinstance(v, QTensor) for v in layers.values()):
         return params  # already quantized
+    if any(isinstance(v, tuple) for v in layers.values()):
+        # Quantizing AROUND live adapters would silently leave the wrapped
+        # (dominant) weights unquantized. Both correct orders exist:
+        raise ValueError(
+            "params contain LoRA adapters: for QLoRA quantize FIRST then "
+            "apply_lora; for int8 serving of a tuned model merge_lora "
+            "first, then quantize"
+        )
     out_layers = {
         k: (quantize(v) if k in QUANTIZABLE else v) for k, v in layers.items()
     }

@@ -3,6 +3,7 @@ inference workload) and Llama-3 (BASELINE training workload) configs."""
 from .gemma import gemma_2b, gemma_2b_bench, gemma_7b
 from .llama import llama3_8b, llama3_train_test
 from .mixtral import mixtral_8x7b, mixtral_test_config
+from .speculative import generate_speculative
 from .transformer import (
     DecoderConfig,
     forward,
@@ -17,6 +18,7 @@ __all__ = [
     "DecoderConfig",
     "forward",
     "generate",
+    "generate_speculative",
     "init_kv_caches",
     "init_params",
     "next_token_loss",

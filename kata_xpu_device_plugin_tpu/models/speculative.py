@@ -1,0 +1,134 @@
+"""Speculative decoding with n-gram (prompt-lookup) drafts.
+
+Greedy decode emits one token per full weight stream from HBM; speculative
+decoding drafts ``k`` candidate tokens cheaply and verifies them in ONE
+forward over ``[B, k+1]`` — when ``a`` drafts are accepted, one weight
+stream yields ``a+1`` tokens. Greedy speculative decoding is LOSSLESS: the
+emitted sequence is exactly the vanilla greedy sequence (tested
+token-identical), only the step count changes.
+
+The draft source is n-gram lookup (no draft model): the most recent prior
+occurrence of the current token in the row's own history proposes the
+tokens that followed it — free, and effective exactly when text repeats
+(code, structured output, retrieval-augmented prompts).
+
+TPU-first mechanics: verification reuses the decoder's ragged multi-token
+cache path (:func:`..models.transformer._cache_write_rows` — per-row
+``[B, k+1]`` spans at per-row positions), so one compiled verify
+executable serves every acceptance pattern; drafting is host-side numpy
+(it reads tokens the host already owns). Rejected drafts' cache entries
+are dead until the next verify span overwrites them — the causal index
+mask (``k_pos <= q_pos``) never reads past each row's accepted prefix,
+the same invariant the serving arena and prefill bucketing rely on.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import (
+    AttnFn,
+    DecoderConfig,
+    Params,
+    forward,
+    greedy_token,
+    prefill,
+)
+
+
+@partial(jax.jit, static_argnames=("cfg", "attn_fn"), donate_argnums=(1,))
+def verify_step(params: Params, caches, toks: jax.Array, pos: jax.Array,
+                cfg: DecoderConfig, attn_fn: Optional[AttnFn] = None):
+    """Forward ``toks [B, S]`` (current token + S-1 drafts) with per-row
+    cache offsets ``pos [B]``; returns (greedy next-token ids [B, S],
+    updated caches). Writes all S k/v spans — acceptance decides how many
+    become part of each row's valid prefix (the caller advances ``pos``).
+    ``caches`` is DONATED: at model scale a per-round cache copy would
+    double cache memory and add a full cache read+write per round."""
+    if attn_fn is None:
+        from ..ops.attention import flash_attention
+
+        attn_fn = flash_attention
+    B, S = toks.shape
+    positions = pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    logits, caches = forward(
+        params, toks, cfg, attn_fn=attn_fn, positions=positions,
+        kv_caches=caches, cache_offset=pos,
+    )
+    # greedy_token, not a local argmax: the verifier and vanilla generate()
+    # must pick tokens identically or losslessness breaks.
+    return greedy_token(logits), caches
+
+
+def ngram_propose(history: np.ndarray, cur: int, k: int) -> np.ndarray:
+    """Draft ``k`` tokens for one row: the tokens that followed the most
+    recent prior occurrence of ``cur`` in ``history`` (which ends with the
+    tokens preceding ``cur``); pads by repeating ``cur`` when the match is
+    near the end or absent (bad drafts only cost their rejection)."""
+    matches = np.flatnonzero(history == cur)
+    out = np.full(k, cur, np.int32)
+    if len(matches):
+        start = matches[-1] + 1
+        tail = history[start : start + k]
+        out[: len(tail)] = tail
+    return out
+
+
+def generate_speculative(params: Params, prompt: jax.Array,
+                         cfg: DecoderConfig, steps: int, k: int = 4,
+                         max_len: int = 0,
+                         attn_fn: Optional[AttnFn] = None) -> np.ndarray:
+    """Greedy generation with n-gram speculative decoding — output is
+    token-identical to :func:`..models.transformer.generate` at
+    ``temperature=0``. Returns ``[B, steps]`` int32 plus nothing else;
+    ``k`` is the draft length per verify round."""
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    prompt = np.asarray(prompt, np.int32)
+    B, S = prompt.shape
+    # Each verify round may write up to k tokens past the accepted prefix;
+    # the cache needs headroom for the last round's rejected tail.
+    need = S + steps + k
+    if max_len == 0:
+        max_len = need
+    elif max_len < need:
+        raise ValueError(
+            f"max_len={max_len} < prompt+steps+k={need} (speculative "
+            "verification needs k entries of cache headroom)"
+        )
+    caches, last, pos0 = prefill(params, jnp.asarray(prompt), cfg, max_len)
+    last = np.asarray(last)
+
+    history = [list(prompt[b]) for b in range(B)]
+    out: list[list[int]] = [[int(last[b])] for b in range(B)]
+    pos = np.full(B, int(pos0), np.int32)
+
+    while min(len(o) for o in out) < steps:
+        cur = np.array([o[-1] for o in out], np.int32)
+        drafts = np.stack([
+            ngram_propose(np.asarray(history[b], np.int32), int(cur[b]), k)
+            for b in range(B)
+        ])
+        toks = np.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
+        greedy, caches = verify_step(
+            params, caches, jnp.asarray(toks), jnp.asarray(pos), cfg,
+            attn_fn=attn_fn,
+        )
+        greedy = np.asarray(greedy)  # greedy[b, j] follows toks[b, :j+1]
+        for b in range(B):
+            if len(out[b]) >= steps:
+                # Row already done: its verify round was padding; do not
+                # advance its state (rewrites the same span next round).
+                continue
+            a = 0
+            while a < k and drafts[b, a] == greedy[b, a]:
+                a += 1
+            accepted = list(drafts[b, :a]) + [int(greedy[b, a])]
+            history[b].extend([int(cur[b])] + accepted[:-1])
+            out[b].extend(accepted)
+            pos[b] += 1 + a  # cur + accepted drafts now live in the cache
+    return np.array([o[:steps] for o in out], np.int32)

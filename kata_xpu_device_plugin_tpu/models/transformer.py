@@ -266,15 +266,22 @@ def _cache_write_full(cache, x: jax.Array, offset) -> "QTensor | jax.Array":
 
 
 def _cache_write_rows(cache, x: jax.Array, rows, idx) -> "QTensor | jax.Array":
-    """Ragged-decode write: row ``b``'s single k/v vector lands at its own
-    position ``idx[b]``. x: [B, 1, KV, D]."""
+    """Ragged write: row ``b``'s ``S`` k/v vectors land at its own
+    positions ``idx[b] .. idx[b]+S-1``, each clamped HERE to max_len-1 (an
+    over-bound serving slot scribbles the last entry, which is never read;
+    multi-token callers size the cache so the clamp never engages).
+    x: [B, S, KV, D]; rows [B]; idx [B]."""
+    S = x.shape[1]
+    max_len = (cache.q if isinstance(cache, QTensor) else cache).shape[1]
+    cols = jnp.minimum(idx[:, None] + jnp.arange(S)[None, :], max_len - 1)
+    rows2 = rows[:, None]
     if isinstance(cache, QTensor):
-        qt = quantize_kv(x[:, 0])
+        qt = quantize_kv(x)
         return QTensor(
-            cache.q.at[rows, idx].set(qt.q),
-            cache.scale.at[rows, idx].set(qt.scale),
+            cache.q.at[rows2, cols].set(qt.q),
+            cache.scale.at[rows2, cols].set(qt.scale),
         )
-    return cache.at[rows, idx].set(x[:, 0].astype(cache.dtype))
+    return cache.at[rows2, cols].set(x.astype(cache.dtype))
 
 
 def _layer(
@@ -323,17 +330,16 @@ def _layer(
         attn_out = attn_fn(q, k, v, causal=True, q_offset=None)
         new_cache = (ck, cv)
     elif kv_cache is not None and jnp.ndim(cache_offset) == 1:
-        # Ragged decode ([B] offsets, S==1): each batch row writes its k/v at
-        # its OWN position — continuous batching, where slots hold sequences
-        # of different lengths. Writes clamp at max_len-1 (a slot past its
-        # budget scribbles on the last entry, which the server never reads).
+        # Ragged decode ([B] offsets): each batch row writes its S k/v
+        # vectors at its OWN positions — continuous batching (S == 1) and
+        # speculative verification (S == k+1), where rows sit at different
+        # lengths. Single-token writes clamp at max_len-1 (a serving slot
+        # past its budget scribbles on the last entry, which the server
+        # never reads); multi-token spans are bound-checked by the caller.
         ck, cv = kv_cache
-        assert S == 1, "ragged ([B]) cache offsets are decode-only (S == 1)"
-        max_len = (ck.q if isinstance(ck, QTensor) else ck).shape[1]
-        idx = jnp.minimum(cache_offset, max_len - 1)
         rows = jnp.arange(B)
-        ck = _cache_write_rows(ck, k, rows, idx)
-        cv = _cache_write_rows(cv, v, rows, idx)
+        ck = _cache_write_rows(ck, k, rows, cache_offset)
+        cv = _cache_write_rows(cv, v, rows, cache_offset)
         attn_out = attn_fn(
             q, dequantize_kv(ck, x.dtype), dequantize_kv(cv, x.dtype),
             causal=True, q_offset=cache_offset,

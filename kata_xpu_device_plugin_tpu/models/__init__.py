@@ -2,6 +2,7 @@
 inference workload) and Llama-3 (BASELINE training workload) configs."""
 from .gemma import gemma_2b, gemma_2b_bench, gemma_7b
 from .llama import llama3_8b, llama3_train_test
+from .mistral import mistral_7b, mistral_test_config
 from .mixtral import mixtral_8x7b, mixtral_test_config
 from .speculative import generate_speculative
 from .transformer import (
@@ -28,6 +29,8 @@ __all__ = [
     "gemma_7b",
     "llama3_8b",
     "llama3_train_test",
+    "mistral_7b",
+    "mistral_test_config",
     "mixtral_8x7b",
     "mixtral_test_config",
 ]

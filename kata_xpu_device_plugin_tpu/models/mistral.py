@@ -1,0 +1,47 @@
+"""Mistral-7B family: GQA + SwiGLU + sliding-window attention.
+
+Architecture constants follow the public Mistral-7B-v0.1 release; the
+sliding window (4096) is what distinguishes it from the Llama-3 layout —
+every layer attends only to the last ``sliding_window`` positions
+(``ops.attention.reference_attention``'s band mask). Reference context:
+the reference ships no model code at all (SURVEY §2); model families are
+guest-side capability of the TPU-first rebuild.
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+
+from .transformer import DecoderConfig
+
+
+def mistral_7b(**overrides) -> DecoderConfig:
+    cfg = DecoderConfig(
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=128,
+        d_ff=14336,
+        rope_theta=10000.0,
+        norm_eps=1e-5,
+        activation="swiglu",
+        scale_embeddings=False,
+        tie_embeddings=False,
+        sliding_window=4096,
+    )
+    return replace(cfg, **overrides)
+
+
+def mistral_test_config(**overrides) -> DecoderConfig:
+    """Shapes-only Mistral-style config (8-divisible dims, tiny window so
+    the band mask actually engages at test sequence lengths)."""
+    from .transformer import tiny_test_config
+
+    base = tiny_test_config(
+        activation="swiglu",
+        scale_embeddings=False,
+        tie_embeddings=False,
+        sliding_window=8,
+    )
+    return replace(base, **overrides)

@@ -56,6 +56,11 @@ class DecoderConfig:
     scale_embeddings: bool = True
     tie_embeddings: bool = True
     logits_softcap: float = 0.0  # 0 disables (Gemma-2 uses 30.0)
+    # Sliding-window attention (Mistral-style): every layer sees only the
+    # last `sliding_window` positions; 0 disables. Uniform across layers so
+    # the lax.scan keeps one compiled body (Gemma-2's alternating
+    # global/local pattern would need a two-body scan — not modeled).
+    sliding_window: int = 0
     # MoE: num_experts > 0 replaces the dense FFN with a top-k MoE FFN in
     # EVERY layer (Mixtral layout; uniform layers keep the lax.scan single
     # compiled body). The silu-gated expert MLP comes from ops.moe.
@@ -298,6 +303,10 @@ def _layer(
     """One decoder block. x: [B, S, D]. Returns (x, new_kv, aux) where aux
     is the layer's MoE load-balancing loss (0.0 for dense layers)."""
     B, S, _ = x.shape
+    # Sliding window rides as a kwarg only when configured, so custom
+    # attn_fns (ring/ulysses sequence parallelism) keep their narrower
+    # signature for window-free configs.
+    wkw = {"window": cfg.sliding_window} if cfg.sliding_window else {}
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     if "wqkv" in layer:
         # Fused projection (see fuse_decoder_params): one matmul streams the
@@ -327,7 +336,7 @@ def _layer(
         ck, cv = kv_cache
         ck = _cache_write_full(ck, k, 0)
         cv = _cache_write_full(cv, v, 0)
-        attn_out = attn_fn(q, k, v, causal=True, q_offset=None)
+        attn_out = attn_fn(q, k, v, causal=True, q_offset=None, **wkw)
         new_cache = (ck, cv)
     elif kv_cache is not None and jnp.ndim(cache_offset) == 1:
         # Ragged decode ([B] offsets): each batch row writes its S k/v
@@ -342,7 +351,7 @@ def _layer(
         cv = _cache_write_rows(cv, v, rows, cache_offset)
         attn_out = attn_fn(
             q, dequantize_kv(ck, x.dtype), dequantize_kv(cv, x.dtype),
-            causal=True, q_offset=cache_offset,
+            causal=True, q_offset=cache_offset, **wkw,
         )
         new_cache = (ck, cv)
     elif kv_cache is not None:
@@ -359,11 +368,11 @@ def _layer(
         cv = _cache_write_full(cv, v, cache_offset)
         attn_out = attn_fn(
             q, dequantize_kv(ck, x.dtype), dequantize_kv(cv, x.dtype),
-            causal=True, q_offset=cache_offset,
+            causal=True, q_offset=cache_offset, **wkw,
         )
         new_cache = (ck, cv)
     else:
-        attn_out = attn_fn(q, k, v, causal=True, q_offset=None)
+        attn_out = attn_fn(q, k, v, causal=True, q_offset=None, **wkw)
         new_cache = None
 
     attn_out = attn_out.reshape(B, S, cfg.q_dim)

@@ -42,6 +42,7 @@ def reference_attention(
     v: jax.Array,
     causal: bool = True,
     q_offset: Optional[jax.Array] = None,
+    window: int = 0,
 ) -> jax.Array:
     """XLA attention, GQA-grouped: q's H heads fold into [KV, H/KV] groups so
     K/V are read once per KV head — no ``jnp.repeat`` of the KV cache (on MQA
@@ -49,10 +50,15 @@ def reference_attention(
     the inputs' native dtype (bf16 on TPU: the MXU does bf16×bf16→fp32 at 2×
     fp32 throughput) with fp32 accumulation via ``preferred_element_type``;
     softmax math stays fp32. Used on CPU, in tests, and as the numerics
-    oracle for the pallas kernel."""
+    oracle for the pallas kernel.
+
+    ``window > 0`` (requires ``causal``) restricts each query to the last
+    ``window`` keys — sliding-window attention (Mistral-style; position
+    ``p`` sees keys in ``(p - window, p]``)."""
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
     assert H % KV == 0, (H, KV)
+    assert window == 0 or causal, "sliding window implies causal"
     G = H // KV
     qg = q.reshape(B, Sq, KV, G, D)
     logits = jnp.einsum(
@@ -62,16 +68,23 @@ def reference_attention(
     if causal:
         q_pos = jnp.arange(Sq)
         k_pos = jnp.arange(Sk)
+
+        def band(qp, kp):  # causal upper bound + optional window lower bound
+            m = kp <= qp
+            if window > 0:
+                m &= kp > qp - window
+            return m
+
         if q_offset is not None and jnp.ndim(q_offset) == 1:
             # Per-row offsets ([B]): ragged decode — each batch row sits at
             # its own position in its KV prefix (continuous batching).
             q_pos = q_pos[None, :] + q_offset[:, None]  # [B, Sq]
-            mask = k_pos[None, None, :] <= q_pos[..., None]  # [B, Sq, Sk]
+            mask = band(q_pos[..., None], k_pos[None, None, :])  # [B, Sq, Sk]
             logits = jnp.where(mask[:, None, None], logits, -1e30)
         else:
             if q_offset is not None:
                 q_pos = q_pos + q_offset
-            mask = k_pos[None, :] <= q_pos[:, None]  # [Sq, Sk]
+            mask = band(q_pos[:, None], k_pos[None, :])  # [Sq, Sk]
             logits = jnp.where(mask[None, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
@@ -144,14 +157,26 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     q_offset: Optional[jax.Array] = None,
+    window: int = 0,
 ) -> jax.Array:
     """Trace-time dispatch over the pallas kernels on TPU: the blockwise
     flash kernel for self-attention (prefill/training) and the fused
     single-token kernel for decode-into-cache; the XLA reference elsewhere
     (pallas interpret mode on CPU is far slower than XLA) and for shapes
-    where a kernel launch can't pay for itself."""
+    where a kernel launch can't pay for itself. ``window > 0`` (the
+    sliding-window band) runs the flash kernel too on eligible
+    self-attention shapes — it masks AND block-skips the band in forward
+    and backward — and the reference elsewhere (the fused decode kernel
+    has no lower mask bound, so windowed decode stays on the XLA path)."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
+    if window > 0:
+        if causal and flash_eligible(Sq, Sk, D, q_offset):
+            from .flash import pallas_flash_attention
+
+            return pallas_flash_attention(q, k, v, causal=True, window=window)
+        return reference_attention(q, k, v, causal=causal, q_offset=q_offset,
+                                   window=window)
     if decode_eligible(Sq, Sk, D, causal, q_offset):
         from .decode_attn import pallas_decode_attention
 

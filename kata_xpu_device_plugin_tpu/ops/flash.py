@@ -65,6 +65,7 @@ def supports(sq: int, sk: int, d: int) -> bool:
 def _flash_kernel(
     off_ref, q_ref, k_ref, v_ref, o_ref, *rest,
     scale: float, causal: bool, block_q: int, block_k: int, emit_lse: bool,
+    window: int = 0,
 ):
     if emit_lse:
         lse_ref, m_scr, l_scr, acc_scr = rest
@@ -104,7 +105,10 @@ def _flash_kernel(
             k_pos = k_off + ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+            band = k_pos <= q_pos
+            if window > 0:  # sliding window: keys in (q_pos - window, q_pos]
+                band &= k_pos > q_pos - window
+            logits = jnp.where(band, logits, NEG_INF)
 
         m_prev = m_scr[:, 0:1]  # [BQ, 1]
         l_prev = l_scr[:, 0:1]
@@ -125,9 +129,16 @@ def _flash_kernel(
         acc_scr[...] = acc
 
     if causal:
-        # Skip k-blocks entirely above the (global) causal frontier — ~half
-        # the grid at long sequence; the MXU never sees fully-masked tiles.
-        pl.when(k_off + ki * block_k <= q_off + qi * block_q + block_q - 1)(compute)
+        # Skip k-blocks entirely outside the band: above the (global)
+        # causal frontier, and (with a sliding window) wholly below the
+        # window's lower edge — the MXU never sees fully-masked tiles.
+        live = k_off + ki * block_k <= q_off + qi * block_q + block_q - 1
+        if window > 0:
+            live &= (
+                k_off + (ki + 1) * block_k - 1
+                > q_off + qi * block_q - window
+            )
+        pl.when(live)(compute)
     else:
         compute()
 
@@ -144,7 +155,7 @@ def _flash_kernel(
 
 
 def _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group, interpret, scale,
-              offsets=(0, 0), need_lse=True):
+              offsets=(0, 0), need_lse=True, window=0):
     """[B, H, S, D]-layout forward returning (out, logsumexp[B, H, Sq, ROW_W]
     or None). ``offsets = (q_off, k_off)`` are global sequence offsets (may
     be traced scalars — ring attention passes per-device offsets).
@@ -155,7 +166,7 @@ def _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group, interpret, scale,
     grid = (B, H, Sq // block_q, Sk // block_k)
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, emit_lse=need_lse,
+        block_k=block_k, emit_lse=need_lse, window=window,
     )
     offs = jnp.asarray(offsets, jnp.int32)  # (q_off, k_off) tuple or [2] array
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki, off: (b, h, qi, 0))
@@ -197,7 +208,7 @@ def _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group, interpret, scale,
 
 def _bwd_dq_kernel(
     off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
+    *, scale: float, causal: bool, block_q: int, block_k: int, window: int = 0,
 ):
     qi, ki = pl.program_id(2), pl.program_id(3)
     num_k = pl.num_programs(3)
@@ -221,7 +232,10 @@ def _bwd_dq_kernel(
         if causal:
             q_pos = q_off + qi * block_q + lax.broadcasted_iota(jnp.int32, p.shape, 0)
             k_pos = k_off + ki * block_k + lax.broadcasted_iota(jnp.int32, p.shape, 1)
-            p = jnp.where(k_pos <= q_pos, p, 0.0)
+            band = k_pos <= q_pos
+            if window > 0:
+                band &= k_pos > q_pos - window
+            p = jnp.where(band, p, 0.0)
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [BQ, BK]
@@ -232,9 +246,13 @@ def _bwd_dq_kernel(
         )
 
     if causal:
-        pl.when(
-            k_off + ki * block_k <= q_off + qi * block_q + block_q - 1
-        )(compute)
+        live = k_off + ki * block_k <= q_off + qi * block_q + block_q - 1
+        if window > 0:
+            live &= (
+                k_off + (ki + 1) * block_k - 1
+                > q_off + qi * block_q - window
+            )
+        pl.when(live)(compute)
     else:
         compute()
 
@@ -246,6 +264,7 @@ def _bwd_dq_kernel(
 def _bwd_dkv_kernel(
     off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr, *, scale: float, causal: bool, block_q: int, block_k: int,
+    window: int = 0,
 ):
     ki, qi = pl.program_id(2), pl.program_id(3)
     num_q = pl.num_programs(3)
@@ -270,7 +289,10 @@ def _bwd_dkv_kernel(
         if causal:
             q_pos = q_off + qi * block_q + lax.broadcasted_iota(jnp.int32, p.shape, 0)
             k_pos = k_off + ki * block_k + lax.broadcasted_iota(jnp.int32, p.shape, 1)
-            p = jnp.where(k_pos <= q_pos, p, 0.0)
+            band = k_pos <= q_pos
+            if window > 0:
+                band &= k_pos > q_pos - window
+            p = jnp.where(band, p, 0.0)
         pv = p.astype(do.dtype)
         dv_scr[...] += lax.dot_general(
             pv, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -284,10 +306,15 @@ def _bwd_dkv_kernel(
         )  # [BK, D]
 
     if causal:
-        # This k-block only sees q-blocks at or below the frontier.
-        pl.when(
-            k_off + ki * block_k <= q_off + qi * block_q + block_q - 1
-        )(compute)
+        # This k-block only sees q-blocks at or below the frontier (and,
+        # with a sliding window, within the band's reach).
+        live = k_off + ki * block_k <= q_off + qi * block_q + block_q - 1
+        if window > 0:
+            live &= (
+                k_off + (ki + 1) * block_k - 1
+                > q_off + qi * block_q - window
+            )
+        pl.when(live)(compute)
     else:
         compute()
 
@@ -298,7 +325,7 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_call(q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k,
-              group, interpret, scale, offsets=(0, 0), dlse=None):
+              group, interpret, scale, offsets=(0, 0), dlse=None, window=0):
     """Gradients in the [B, H, S, D] layout. dk/dv are per Q-HEAD here; the
     caller sums head groups down to the KV heads.
 
@@ -326,7 +353,7 @@ def _bwd_call(q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -356,7 +383,7 @@ def _bwd_call(q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k,
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -391,8 +418,8 @@ def _group_kv_grads(dk_h, dv_h, KV, group):
     return dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, window):
     group = q.shape[2] // k.shape[2]
     scale = float(1.0 / (q.shape[3] ** 0.5))
     # Pallas TPU tiles the LAST TWO dims: run kernels in [B, H, S, D] layout
@@ -401,25 +428,28 @@ def _flash(q, k, v, causal, block_q, block_k, interpret):
     out_t, _ = _fwd_call(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
         causal, block_q, block_k, group, interpret, scale, need_lse=False,
+        window=window,
     )
     return out_t.transpose(0, 2, 1, 3)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window):
     """VJP forward rule: the zero-offset case of the block rules — one
     numerical implementation for both the self-attention and ring paths."""
     (out, _lse), res = _flash_block_fwd(
-        q, k, v, jnp.zeros((2,), jnp.int32), causal, block_q, block_k, interpret
+        q, k, v, jnp.zeros((2,), jnp.int32), causal, block_q, block_k,
+        interpret, window=window,
     )
     return out, res
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
+def _flash_bwd(causal, block_q, block_k, interpret, window, res, dout):
     lse = res[4]
     B, H, Sq = lse.shape[:3]
     dlse_zero = jnp.zeros((B, Sq, H), jnp.float32)
     dq, dk, dv, _doffs = _flash_block_bwd(
-        causal, block_q, block_k, interpret, res, (dout, dlse_zero)
+        causal, block_q, block_k, interpret, res, (dout, dlse_zero),
+        window=window,
     )
     return dq, dk, dv
 
@@ -436,19 +466,20 @@ def _flash_block(q, k, v, offs, causal, block_q, block_k, interpret):
     return out
 
 
-def _flash_block_fwd(q, k, v, offs, causal, block_q, block_k, interpret):
+def _flash_block_fwd(q, k, v, offs, causal, block_q, block_k, interpret,
+                     window=0):
     group = q.shape[2] // k.shape[2]
     scale = float(1.0 / (q.shape[3] ** 0.5))
     q_t = q.transpose(0, 2, 1, 3)
     k_t = k.transpose(0, 2, 1, 3)
     v_t = v.transpose(0, 2, 1, 3)
     out_t, lse = _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group,
-                           interpret, scale, offsets=offs)
+                           interpret, scale, offsets=offs, window=window)
     out = (out_t.transpose(0, 2, 1, 3), lse[..., 0].transpose(0, 2, 1))
     return out, (q_t, k_t, v_t, out_t, lse, offs)
 
 
-def _flash_block_bwd(causal, block_q, block_k, interpret, res, cts):
+def _flash_block_bwd(causal, block_q, block_k, interpret, res, cts, window=0):
     import numpy as _np
 
     q_t, k_t, v_t, out_t, lse, offs = res
@@ -472,7 +503,7 @@ def _flash_block_bwd(causal, block_q, block_k, interpret, res, cts):
     dlse = dlse_bsh.transpose(0, 2, 1).astype(jnp.float32)  # [B, H, Sq]
     dq, dk_h, dv_h = _bwd_call(
         q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k, group,
-        interpret, scale, offsets=offs, dlse=dlse,
+        interpret, scale, offsets=offs, dlse=dlse, window=window,
     )
     dk, dv = _group_kv_grads(dk_h, dv_h, KV, group)
     return (
@@ -513,7 +544,7 @@ def flash_block_attention(
     return _flash_block(q, k, v, offs, causal, bq, bk, interpret)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret", "window"))
 def pallas_flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -523,13 +554,19 @@ def pallas_flash_attention(
     block_q: int = DEFAULT_BLOCK,
     block_k: int = DEFAULT_BLOCK,
     interpret: bool = False,
+    window: int = 0,
 ) -> jax.Array:
     """q [B, Sq, H, D]; k/v [B, Sk, KV, D], H % KV == 0. Self-attention only
     (``q_offset`` unsupported here — callers fall back to the reference).
     Differentiable: a custom_vjp recomputes attention blockwise from the
-    saved logsumexp, so training never materializes [Sq, Sk]."""
+    saved logsumexp, so training never materializes [Sq, Sk].
+    ``window > 0`` applies the sliding-window band (requires ``causal``);
+    out-of-band blocks are skipped in forward AND backward, so Mistral-style
+    long-sequence attention costs O(S·window), not O(S²)."""
     if q_offset is not None:
         raise ValueError("pallas_flash_attention is for self-attention (q_offset=None)")
+    if window > 0 and not causal:
+        raise ValueError("sliding window implies causal attention")
     B, Sq, H, D = q.shape
     _, Sk, KV, _ = k.shape
     assert H % KV == 0, (H, KV)
@@ -540,4 +577,4 @@ def pallas_flash_attention(
             f"no valid flash block for Sq={Sq}, Sk={Sk} (need a divisor ≥128, "
             "multiple of 8); use reference_attention"
         )
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, block_q, block_k, interpret, window)

@@ -168,7 +168,14 @@ def make_ring_attention(
             flash_interpret=flash_interpret,
         )
 
-    def ring_attn(q, k, v, causal: bool = True, q_offset: Optional[jax.Array] = None):
+    def ring_attn(q, k, v, causal: bool = True,
+                  q_offset: Optional[jax.Array] = None, window: int = 0):
+        if window:
+            raise ValueError(
+                "ring attention does not support sliding-window configs "
+                "(cfg.sliding_window) — use the single-device attention or "
+                "set sliding_window=0 for the sp path"
+            )
         if not causal or q_offset is not None:
             raise ValueError("ring attention supports causal self-attention only")
         return ring(q, k, v)

@@ -105,7 +105,14 @@ def make_ulysses_attention(
         check_vma=False,
     )
 
-    def ulysses_attn(q, k, v, causal: bool = True, q_offset=None):
+    def ulysses_attn(q, k, v, causal: bool = True, q_offset=None,
+                     window: int = 0):
+        if window:
+            raise ValueError(
+                "ulysses attention does not support sliding-window configs "
+                "(cfg.sliding_window) — use the single-device attention or "
+                "set sliding_window=0 for the sp path"
+            )
         if not causal or q_offset is not None:
             raise ValueError("ulysses attention supports causal self-attention only")
         return mapped(q, k, v)

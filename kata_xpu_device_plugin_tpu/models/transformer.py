@@ -299,9 +299,12 @@ def _layer(
     cache_offset: Optional[jax.Array] = None,
     prefill: bool = False,
     moe_mesh=None,
+    ring: bool = False,
 ):
     """One decoder block. x: [B, S, D]. Returns (x, new_kv, aux) where aux
-    is the layer's MoE load-balancing loss (0.0 for dense layers)."""
+    is the layer's MoE load-balancing loss (0.0 for dense layers).
+    ``ring=True``: the cache is a ``sliding_window``-slot ring buffer
+    (slot = position % window) instead of a max_len array."""
     B, S, _ = x.shape
     # Sliding window rides as a kwarg only when configured, so custom
     # attn_fns (ring/ulysses sequence parallelism) keep their narrower
@@ -337,6 +340,32 @@ def _layer(
         ck = _cache_write_full(ck, k, 0)
         cv = _cache_write_full(cv, v, 0)
         attn_out = attn_fn(q, k, v, causal=True, q_offset=None, **wkw)
+        new_cache = (ck, cv)
+    elif kv_cache is not None and ring:
+        # Ring decode (lockstep scalar position): the cache holds exactly
+        # the live window, written at slot pos % W; attention consumes the
+        # slots' ABSOLUTE positions (ring_positions) so the causal/validity
+        # mask is position-exact even though slots are stored out of order.
+        # Memory and per-step cache traffic are O(window), not O(max_len).
+        assert jnp.ndim(cache_offset) == 0, "ring cache is lockstep-only"
+        assert S == 1, "ring cache writes are decode-only (S == 1)"
+        from ..ops.attention import reference_attention as _ref_attn
+
+        ck, cv = kv_cache
+        W = (ck.q if isinstance(ck, QTensor) else ck).shape[1]
+        assert W == cfg.sliding_window, (
+            f"ring cache has {W} slots but cfg.sliding_window="
+            f"{cfg.sliding_window} — a mismatched buffer silently changes "
+            "the attention span"
+        )
+        slot = cache_offset % W
+        ck = _cache_write_full(ck, k, slot)
+        cv = _cache_write_full(cv, v, slot)
+        attn_out = _ref_attn(
+            q, dequantize_kv(ck, x.dtype), dequantize_kv(cv, x.dtype),
+            causal=True, q_offset=cache_offset,
+            k_positions=ring_positions(cache_offset, W),
+        )
         new_cache = (ck, cv)
     elif kv_cache is not None and jnp.ndim(cache_offset) == 1:
         # Ragged decode ([B] offsets): each batch row writes its S k/v
@@ -421,6 +450,7 @@ def forward(
     moe_mesh=None,
     return_aux: bool = False,
     remat: bool = False,
+    ring: bool = False,
 ):
     """Full forward. tokens: [B, S] int32 → logits [B, S, vocab].
 
@@ -456,7 +486,7 @@ def forward(
             layer, (ck, cv) = layer_and_cache
             x, new_cache, aux = _layer(
                 cfg, attn_fn, x, layer, positions, (ck, cv), cache_offset,
-                prefill=prefill, moe_mesh=moe_mesh,
+                prefill=prefill, moe_mesh=moe_mesh, ring=ring,
             )
             return x, (new_cache, aux)
         layer = layer_and_cache
@@ -572,6 +602,32 @@ def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+def ring_positions(pos: jax.Array, window: int) -> jax.Array:
+    """Absolute position held by each slot of a ring KV buffer after
+    ``pos`` tokens have been written (slot = position % window): the most
+    recent position ≡ s (mod window) that is ≤ pos. Negative ⇒ unwritten
+    (masked by ``reference_attention``'s ``k_positions`` path)."""
+    s = jnp.arange(window, dtype=jnp.int32)
+    return pos - ((pos - s) % window)
+
+
+@partial(jax.jit, static_argnames=("window",))
+def ring_caches_from_prefill(caches, pos: jax.Array, window: int):
+    """Fold a full prefill cache (entries at positions 0..pos-1) into a
+    ring buffer of ``window`` slots: slot s takes the latest position
+    ≡ s (mod window) below ``pos``; slots with no such position zero out
+    (their ring position is negative — never attended)."""
+    src = ring_positions(pos - 1, window)  # [window] absolute positions
+    valid = src >= 0
+
+    def fold(c):
+        g = jnp.take(c, jnp.clip(src, 0), axis=2)  # [L, B, window, ...]
+        mask = valid.reshape((1, 1, window) + (1,) * (g.ndim - 3))
+        return jnp.where(mask, g, jnp.zeros_like(g))
+
+    return jax.tree.map(fold, caches)
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_len", "attn_fn", "return_logits",
                                    "kv_quantized"))
 def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
@@ -617,11 +673,11 @@ def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn", "do_sample",
-                                   "top_k", "return_state"))
+                                   "top_k", "return_state", "ring"))
 def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
                  cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn],
                  do_sample: bool, top_k: int, temperature, key: jax.Array,
-                 return_state: bool = False):
+                 return_state: bool = False, ring: bool = False):
     if attn_fn is None:
         from ..ops.attention import flash_attention
 
@@ -635,7 +691,7 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
                      else jnp.full((B, 1), pos, jnp.int32))
         logits, caches = forward(
             params, tok[:, None], cfg, attn_fn=attn_fn, positions=positions,
-            kv_caches=caches, cache_offset=pos,
+            kv_caches=caches, cache_offset=pos, ring=ring,
         )
         nxt = _next_token(logits[:, -1, :], step_key, do_sample, temperature, top_k)
         return (caches, nxt, pos + 1), nxt
@@ -648,10 +704,17 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
 def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
            cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn] = None,
            temperature: float = 0.0, top_k: int = 0,
-           key: Optional[jax.Array] = None, return_state: bool = False):
+           key: Optional[jax.Array] = None, return_state: bool = False,
+           ring: bool = False):
     """Decode ``steps`` tokens after ``tok`` as one lax.scan — no per-token
     dispatch overhead. Returns [B, steps] (with ``return_state=True``:
     ``(tokens, caches, last_token, pos)`` so a server can continue later).
+
+    ``ring=True``: ``caches`` is a ``cfg.sliding_window``-slot ring buffer
+    (see :func:`ring_caches_from_prefill`); decode wraps forever in
+    O(window) memory. The ring step always attends via the XLA
+    ``reference_attention`` (kernels take no explicit slot positions), so
+    a custom ``attn_fn`` applies to everything EXCEPT the ring reads.
 
     ``pos`` is either a SCALAR — the whole batch decodes in lockstep at one
     shared position — or a [B] VECTOR of per-slot positions (ragged decode:
@@ -662,49 +725,59 @@ def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
     (:func:`sample_token`)."""
     c0 = caches[0]
     cache_len = (c0.q if isinstance(c0, QTensor) else c0).shape[2]
-    if steps > cache_len:
-        raise ValueError(f"steps={steps} exceeds cache max_len={cache_len}")
-    try:
-        pos_concrete = int(pos) if jnp.ndim(pos) == 0 else None
-    except Exception:  # traced under an outer jit: that caller owns the bound
-        pos_concrete = None
-    if pos_concrete is not None and pos_concrete + steps > cache_len:
-        # dynamic_update_slice silently CLAMPS out-of-range writes — an
-        # overrun would corrupt the last cache slot, not raise.
-        raise ValueError(
-            f"pos={pos_concrete} + steps={steps} overruns cache max_len={cache_len}"
-        )
+    if not ring:  # a ring buffer wraps by design — no length bound to check
+        if steps > cache_len:
+            raise ValueError(f"steps={steps} exceeds cache max_len={cache_len}")
+        try:
+            pos_concrete = int(pos) if jnp.ndim(pos) == 0 else None
+        except Exception:  # traced under an outer jit: caller owns the bound
+            pos_concrete = None
+        if pos_concrete is not None and pos_concrete + steps > cache_len:
+            # dynamic_update_slice silently CLAMPS out-of-range writes — an
+            # overrun would corrupt the last cache slot, not raise.
+            raise ValueError(
+                f"pos={pos_concrete} + steps={steps} overruns cache "
+                f"max_len={cache_len}"
+            )
     do_sample, key = _sampling_args(temperature, top_k, key)
     return _decode_scan(params, caches, tok, pos, cfg, steps, attn_fn,
                         do_sample, top_k, jnp.float32(temperature), key,
-                        return_state=return_state)
+                        return_state=return_state, ring=ring)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "max_len", "attn_fn",
-                                   "do_sample", "top_k", "kv_quantized"))
+                                   "do_sample", "top_k", "kv_quantized",
+                                   "ring_kv"))
 def _generate_impl(params, prompt, cfg, steps, max_len, attn_fn,
                    do_sample: bool, top_k: int, temperature, key,
-                   kv_quantized: bool = False):
+                   kv_quantized: bool = False, ring_kv: bool = False):
     B, S = prompt.shape
     k_first, k_rest = jax.random.split(key)
+    # Ring mode prefillls into a prompt-sized cache (transient), then folds
+    # the live window into a ring buffer — steady-state KV memory and
+    # per-step cache traffic are O(sliding_window), independent of steps.
+    prefill_len = S if ring_kv else max_len
     caches, last_logits, pos = prefill(
-        params, prompt, cfg, max_len, attn_fn=attn_fn, return_logits=True,
+        params, prompt, cfg, prefill_len, attn_fn=attn_fn, return_logits=True,
         kv_quantized=kv_quantized,
     )
+    if ring_kv:
+        caches = ring_caches_from_prefill(caches, pos, cfg.sliding_window)
     last = _next_token(last_logits, k_first, do_sample, temperature, top_k)
     if steps == 0:
         return jnp.zeros((B, 0), jnp.int32)
     if steps == 1:
         return last[:, None]
     out = _decode_scan(params, caches, last, pos, cfg, steps - 1, attn_fn,
-                       do_sample, top_k, temperature, k_rest)
+                       do_sample, top_k, temperature, k_rest, ring=ring_kv)
     return jnp.concatenate([last[:, None], out], axis=1)
 
 
 def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
              steps: int, max_len: int = 0, attn_fn: Optional[AttnFn] = None,
              temperature: float = 0.0, top_k: int = 0,
-             key: Optional[jax.Array] = None, kv_quantized: bool = False):
+             key: Optional[jax.Array] = None, kv_quantized: bool = False,
+             ring_kv: bool = False):
     """Generation: :func:`prefill` then :func:`decode`, composed under one
     jit. Greedy by default; ``temperature``/``top_k``/``key`` sample instead
     (``temperature`` is traced — varying it does not recompile).
@@ -716,12 +789,17 @@ def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
     opt-in via ``KATA_TPU_DECODE_KERNEL=1`` (it measured slower end-to-end;
     see :func:`..ops.attention.decode_eligible`)."""
     B, S = prompt.shape
+    if ring_kv and cfg.sliding_window <= 0:
+        raise ValueError(
+            "ring_kv needs a sliding-window config (cfg.sliding_window > 0) "
+            "— a global-attention model must keep its whole prefix"
+        )
     max_len = max_len or S + steps
-    if S + steps > max_len:
+    if not ring_kv and S + steps > max_len:
         raise ValueError(
             f"prompt_len={S} + steps={steps} overruns max_len={max_len}"
         )
     do_sample, key = _sampling_args(temperature, top_k, key)
     return _generate_impl(params, prompt, cfg, steps, max_len, attn_fn,
                           do_sample, top_k, jnp.float32(temperature), key,
-                          kv_quantized=kv_quantized)
+                          kv_quantized=kv_quantized, ring_kv=ring_kv)

@@ -43,6 +43,7 @@ def reference_attention(
     causal: bool = True,
     q_offset: Optional[jax.Array] = None,
     window: int = 0,
+    k_positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """XLA attention, GQA-grouped: q's H heads fold into [KV, H/KV] groups so
     K/V are read once per KV head — no ``jnp.repeat`` of the KV cache (on MQA
@@ -54,11 +55,20 @@ def reference_attention(
 
     ``window > 0`` (requires ``causal``) restricts each query to the last
     ``window`` keys — sliding-window attention (Mistral-style; position
-    ``p`` sees keys in ``(p - window, p]``)."""
+    ``p`` sees keys in ``(p - window, p]``).
+
+    ``k_positions`` overrides the keys' implied positions (``arange(Sk)``)
+    with explicit ABSOLUTE positions, shape [Sk] or [B, Sk] — the ring
+    KV buffer stores its band out of order (slot = position % window) and
+    negative entries mark unwritten slots (always masked)."""
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
     assert H % KV == 0, (H, KV)
     assert window == 0 or causal, "sliding window implies causal"
+    assert k_positions is None or causal, (
+        "k_positions (ring-buffer slot positions) requires causal=True — "
+        "the validity mask for unwritten slots lives in the causal branch"
+    )
     G = H // KV
     qg = q.reshape(B, Sq, KV, G, D)
     logits = jnp.einsum(
@@ -67,19 +77,31 @@ def reference_attention(
     logits = logits * (1.0 / float(D) ** 0.5)
     if causal:
         q_pos = jnp.arange(Sq)
-        k_pos = jnp.arange(Sk)
+        k_pos = jnp.arange(Sk) if k_positions is None else k_positions
 
         def band(qp, kp):  # causal upper bound + optional window lower bound
             m = kp <= qp
             if window > 0:
                 m &= kp > qp - window
+            if k_positions is not None:
+                m &= kp >= 0  # unwritten ring slots carry negative positions
             return m
 
-        if q_offset is not None and jnp.ndim(q_offset) == 1:
+        per_row = (q_offset is not None and jnp.ndim(q_offset) == 1) or (
+            k_positions is not None and k_positions.ndim == 2
+        )
+        if per_row:
             # Per-row offsets ([B]): ragged decode — each batch row sits at
             # its own position in its KV prefix (continuous batching).
-            q_pos = q_pos[None, :] + q_offset[:, None]  # [B, Sq]
-            mask = band(q_pos[..., None], k_pos[None, None, :])  # [B, Sq, Sk]
+            if q_offset is not None:
+                qp = q_pos[None, :] + (
+                    q_offset[:, None] if jnp.ndim(q_offset) == 1
+                    else q_offset
+                )
+            else:
+                qp = jnp.broadcast_to(q_pos[None, :], (B, Sq))
+            kp = k_pos if k_pos.ndim == 2 else k_pos[None, :]
+            mask = band(qp[:, :, None], kp[:, None, :])  # [B, Sq, Sk]
             logits = jnp.where(mask[:, None, None], logits, -1e30)
         else:
             if q_offset is not None:

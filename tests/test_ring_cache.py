@@ -1,0 +1,92 @@
+"""Ring (rolling-buffer) KV cache for sliding-window decode.
+
+Oracle: the full-cache sliding-window path — the ring holds exactly the
+band the full cache masks down to, so outputs must match.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu.models import generate, mistral_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import (
+    init_params,
+    ring_caches_from_prefill,
+    ring_positions,
+    tiny_test_config,
+)
+
+
+def test_ring_positions():
+    # After 10 tokens (positions 0..9) in a 4-slot ring: slot s holds the
+    # latest position ≡ s (mod 4) that is ≤ 9.
+    np.testing.assert_array_equal(
+        np.asarray(ring_positions(jnp.int32(9), 4)), [8, 9, 6, 7]
+    )
+    # Early: position 1 written, slots 2..3 untouched → negative.
+    np.testing.assert_array_equal(
+        np.asarray(ring_positions(jnp.int32(1), 4)), [0, 1, -2, -1]
+    )
+
+
+def test_ring_fold_from_prefill():
+    cfg = tiny_test_config()
+    L, B, S = cfg.n_layers, 1, 10
+    full = (
+        jnp.arange(L * B * S * cfg.n_kv_heads * cfg.head_dim, dtype=jnp.float32)
+        .reshape(L, B, S, cfg.n_kv_heads, cfg.head_dim),
+        jnp.zeros((L, B, S, cfg.n_kv_heads, cfg.head_dim), jnp.float32),
+    )
+    W = 4
+    rk, _ = ring_caches_from_prefill(full, jnp.int32(10), W)
+    assert rk.shape == (L, B, W, cfg.n_kv_heads, cfg.head_dim)
+    # Slot s holds position 9 - ((9 - s) % 4): [8, 9, 6, 7].
+    for s, p in enumerate([8, 9, 6, 7]):
+        np.testing.assert_array_equal(
+            np.asarray(rk[:, :, s]), np.asarray(full[0][:, :, p])
+        )
+    # Short prefill: unwritten slots zero out.
+    rk2, _ = ring_caches_from_prefill(full, jnp.int32(2), W)
+    np.testing.assert_array_equal(np.asarray(rk2[:, :, 2]), 0.0)
+    np.testing.assert_array_equal(np.asarray(rk2[:, :, 3]), 0.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = mistral_test_config(dtype=jnp.float32)  # window=8
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+@pytest.mark.parametrize("prompt_len,steps", [
+    (5, 18),   # short prompt: ring warms up during decode, then wraps
+    (14, 12),  # prompt longer than the window: fold drops old positions
+])
+def test_ring_generate_matches_full_cache(model, prompt_len, steps):
+    cfg, params = model
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (2, prompt_len), 0, cfg.vocab_size
+    )
+    ref = np.asarray(generate(params, prompt, cfg, steps, max_len=64))
+    out = np.asarray(generate(params, prompt, cfg, steps, ring_kv=True))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_ring_decode_unbounded_by_cache_length(model):
+    # steps far beyond the window: a full cache would need max_len >= S+steps;
+    # the ring stays 8 slots and just keeps wrapping.
+    cfg, params = model
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 6), 0, cfg.vocab_size)
+    ref = np.asarray(generate(params, prompt, cfg, 40, max_len=64))
+    out = np.asarray(generate(params, prompt, cfg, 40, ring_kv=True))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_ring_requires_window(model):
+    cfg, params = model
+    from dataclasses import replace
+
+    full_cfg = replace(cfg, sliding_window=0)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="sliding-window"):
+        generate(params, prompt, full_cfg, 4, ring_kv=True)

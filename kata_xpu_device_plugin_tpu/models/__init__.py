@@ -1,6 +1,12 @@
 """Guest-side model families: the TPU-first decoder core plus Gemma (BASELINE
 inference workload) and Llama-3 (BASELINE training workload) configs."""
-from .gemma import gemma_2b, gemma_2b_bench, gemma_7b
+from .gemma import (
+    gemma2_2b,
+    gemma2_test_config,
+    gemma_2b,
+    gemma_2b_bench,
+    gemma_7b,
+)
 from .llama import llama3_8b, llama3_train_test
 from .mistral import mistral_7b, mistral_test_config
 from .mixtral import mixtral_8x7b, mixtral_test_config
@@ -24,6 +30,8 @@ __all__ = [
     "init_params",
     "next_token_loss",
     "tiny_test_config",
+    "gemma2_2b",
+    "gemma2_test_config",
     "gemma_2b",
     "gemma_2b_bench",
     "gemma_7b",

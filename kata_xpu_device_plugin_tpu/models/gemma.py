@@ -52,3 +52,43 @@ def gemma_2b_bench(**overrides) -> DecoderConfig:
     to a throughput benchmark of random weights. Layer compute is identical
     to gemma_2b."""
     return gemma_2b(vocab_size=32128, **overrides)
+
+
+def gemma2_2b(**overrides) -> DecoderConfig:
+    """Gemma-2 2B (public Gemma-2 report): alternating local/global
+    attention (4096-token window on even layers), pre+post RMSNorms per
+    sublayer, soft-capped attention (50.0) and final (30.0) logits, GQA."""
+    cfg = DecoderConfig(
+        vocab_size=256128,
+        d_model=2304,
+        n_layers=26,
+        n_heads=8,
+        n_kv_heads=4,
+        head_dim=256,
+        d_ff=9216,
+        rope_theta=10000.0,
+        activation="geglu",
+        scale_embeddings=True,
+        tie_embeddings=True,
+        logits_softcap=30.0,
+        attn_logits_softcap=50.0,
+        attn_windows=(4096, 0),  # even layers local, odd layers global
+        post_norms=True,
+    )
+    return replace(cfg, **overrides)
+
+
+def gemma2_test_config(**overrides) -> DecoderConfig:
+    """Shapes-only Gemma-2-style config: a short alternating window so the
+    cycle and band both engage at test lengths, post-norms, both softcaps,
+    4 layers (two cycles)."""
+    from .transformer import tiny_test_config
+
+    base = tiny_test_config(
+        n_layers=4,
+        logits_softcap=30.0,
+        attn_logits_softcap=50.0,
+        attn_windows=(6, 0),
+        post_norms=True,
+    )
+    return replace(base, **overrides)

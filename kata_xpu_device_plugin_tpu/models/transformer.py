@@ -57,10 +57,20 @@ class DecoderConfig:
     tie_embeddings: bool = True
     logits_softcap: float = 0.0  # 0 disables (Gemma-2 uses 30.0)
     # Sliding-window attention (Mistral-style): every layer sees only the
-    # last `sliding_window` positions; 0 disables. Uniform across layers so
-    # the lax.scan keeps one compiled body (Gemma-2's alternating
-    # global/local pattern would need a two-body scan — not modeled).
+    # last `sliding_window` positions; 0 disables.
     sliding_window: int = 0
+    # Per-layer attention-window CYCLE (Gemma-2 style alternation): layer i
+    # uses attn_windows[i % len]. () = uniform (sliding_window everywhere).
+    # The scan body unrolls one cycle, so compile cost scales with the
+    # cycle length, not the layer count. n_layers % len must be 0.
+    attn_windows: tuple = ()
+    # Gemma-2 block shape: RMSNorm applied to each sublayer's OUTPUT as
+    # well as its input (post_attn_norm / post_mlp_norm params).
+    post_norms: bool = False
+    # Soft cap on ATTENTION logits (Gemma-2 uses 50.0); 0 disables. Capped
+    # attention runs the XLA reference path (the flash kernels' blockwise
+    # backward does not model the tanh).
+    attn_logits_softcap: float = 0.0
     # MoE: num_experts > 0 replaces the dense FFN with a top-k MoE FFN in
     # EVERY layer (Mixtral layout; uniform layers keep the lax.scan single
     # compiled body). The silu-gated expert MLP comes from ops.moe.
@@ -82,6 +92,18 @@ class DecoderConfig:
     def moe(self) -> bool:
         return self.moe_num_experts > 0
 
+    def layer_window(self, i: int) -> int:
+        """Attention window for layer ``i`` (0 = global)."""
+        if self.attn_windows:
+            return self.attn_windows[i % len(self.attn_windows)]
+        return self.sliding_window
+
+    @property
+    def window_cycle(self) -> tuple:
+        """The per-layer window cycle the scan unrolls (length 1 when
+        uniform)."""
+        return self.attn_windows or (self.sliding_window,)
+
     def moe_cfg(self):
         from ..ops.moe import MoEConfig
 
@@ -101,7 +123,7 @@ class DecoderConfig:
             mlp += self.moe_num_experts * 3 * self.d_model * self.d_ff
         else:
             mlp = 3 * self.d_model * self.d_ff
-        norms = 2 * self.d_model
+        norms = (4 if self.post_norms else 2) * self.d_model
         per_layer = attn + mlp + norms
         unembed = 0 if self.tie_embeddings else embed
         return embed + self.n_layers * per_layer + self.d_model + unembed
@@ -133,6 +155,11 @@ def init_params(key: jax.Array, cfg: DecoderConfig, dtype=jnp.float32) -> Params
         return (jax.random.normal(key, shape, dtype) / jnp.sqrt(fan_in)).astype(dtype)
 
     L = cfg.n_layers
+    if cfg.attn_windows and L % len(cfg.attn_windows) != 0:
+        raise ValueError(
+            f"n_layers={L} not divisible by the attn_windows cycle "
+            f"{cfg.attn_windows}"
+        )
     keys = jax.random.split(k_layers, 8)
     layers: Params = {
         "attn_norm": jnp.ones((L, cfg.d_model), dtype),
@@ -142,6 +169,9 @@ def init_params(key: jax.Array, cfg: DecoderConfig, dtype=jnp.float32) -> Params
         "wo": dense(keys[3], (L, cfg.q_dim, cfg.d_model), cfg.q_dim),
         "mlp_norm": jnp.ones((L, cfg.d_model), dtype),
     }
+    if cfg.post_norms:
+        layers["post_attn_norm"] = jnp.ones((L, cfg.d_model), dtype)
+        layers["post_mlp_norm"] = jnp.ones((L, cfg.d_model), dtype)
     if cfg.moe:
         E, F = cfg.moe_num_experts, cfg.d_ff
         layers.update({
@@ -300,16 +330,37 @@ def _layer(
     prefill: bool = False,
     moe_mesh=None,
     ring: bool = False,
+    window: Optional[int] = None,
 ):
     """One decoder block. x: [B, S, D]. Returns (x, new_kv, aux) where aux
     is the layer's MoE load-balancing loss (0.0 for dense layers).
     ``ring=True``: the cache is a ``sliding_window``-slot ring buffer
-    (slot = position % window) instead of a max_len array."""
+    (slot = position % window) instead of a max_len array. ``window``
+    overrides ``cfg.sliding_window`` for THIS layer (the per-layer
+    attn_windows cycle)."""
     B, S, _ = x.shape
+    eff_window = cfg.sliding_window if window is None else window
     # Sliding window rides as a kwarg only when configured, so custom
     # attn_fns (ring/ulysses sequence parallelism) keep their narrower
     # signature for window-free configs.
-    wkw = {"window": cfg.sliding_window} if cfg.sliding_window else {}
+    wkw = {"window": eff_window} if eff_window else {}
+    if cfg.attn_logits_softcap:
+        # Capped attention logits (Gemma-2): the tanh lives only in the
+        # XLA reference (the flash kernels' blockwise backward does not
+        # model it), so softcap configs pin the reference path. Custom
+        # attn_fns (ring/ulysses sp) would be silently bypassed — refuse.
+        from ..ops.attention import flash_attention, reference_attention
+
+        if attn_fn not in (reference_attention, flash_attention):
+            raise ValueError(
+                "attn_logits_softcap pins the XLA reference attention; a "
+                "custom attn_fn (e.g. ring/ulysses sequence parallelism) "
+                "would be silently ignored — unset the softcap or drop "
+                "the custom attention"
+            )
+        attn_fn = partial(
+            reference_attention, logits_softcap=cfg.attn_logits_softcap
+        )
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     if "wqkv" in layer:
         # Fused projection (see fuse_decoder_params): one matmul streams the
@@ -365,6 +416,7 @@ def _layer(
             q, dequantize_kv(ck, x.dtype), dequantize_kv(cv, x.dtype),
             causal=True, q_offset=cache_offset,
             k_positions=ring_positions(cache_offset, W),
+            logits_softcap=cfg.attn_logits_softcap,
         )
         new_cache = (ck, cv)
     elif kv_cache is not None and jnp.ndim(cache_offset) == 1:
@@ -405,7 +457,10 @@ def _layer(
         new_cache = None
 
     attn_out = attn_out.reshape(B, S, cfg.q_dim)
-    x = x + weight_matmul(attn_out, layer["wo"])
+    attn_proj = weight_matmul(attn_out, layer["wo"])
+    if "post_attn_norm" in layer:  # Gemma-2: norm the sublayer OUTPUT too
+        attn_proj = rms_norm(attn_proj, layer["post_attn_norm"], cfg.norm_eps)
+    x = x + attn_proj
 
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
     if cfg.moe:
@@ -424,17 +479,20 @@ def _layer(
             # Indivisible token count (or no mesh): GSPMD global dispatch —
             # correct on any batch, just not dispatch-sharded.
             y, aux = moe_mod.moe_ffn(moe_params, h, cfg.moe_cfg(), mesh=moe_mesh)
-        x = x + y.astype(x.dtype)
+        mlp_out = y.astype(x.dtype)
     elif "w_gateup" in layer:
         gu = weight_matmul(h, layer["w_gateup"])
         gate = _gate_act(gu[..., : cfg.d_ff], cfg.activation)
-        x = x + weight_matmul(gate * gu[..., cfg.d_ff :], layer["w_down"])
+        mlp_out = weight_matmul(gate * gu[..., cfg.d_ff :], layer["w_down"])
         aux = jnp.float32(0.0)
     else:
         gate = _gate_act(weight_matmul(h, layer["w_gate"]), cfg.activation)
         up = weight_matmul(h, layer["w_up"])
-        x = x + weight_matmul(gate * up, layer["w_down"])
+        mlp_out = weight_matmul(gate * up, layer["w_down"])
         aux = jnp.float32(0.0)
+    if "post_mlp_norm" in layer:
+        mlp_out = rms_norm(mlp_out, layer["post_mlp_norm"], cfg.norm_eps)
+    x = x + mlp_out
     return x, new_cache, aux
 
 
@@ -480,28 +538,67 @@ def forward(
 
     x = embed(params, tokens, cfg)
 
-    def body(carry, layer_and_cache):
+    # The scan body covers one WINDOW CYCLE (length 1 for uniform configs):
+    # Gemma-2-style alternating local/global layers unroll the cycle inside
+    # the body, so compile cost scales with the cycle, not the depth.
+    cycle = cfg.window_cycle
+    P = len(cycle)
+
+    def one_layer(x, layer, cache, w):
+        return _layer(
+            cfg, attn_fn, x, layer, positions, cache, cache_offset,
+            prefill=prefill, moe_mesh=moe_mesh, ring=ring, window=w,
+        )
+
+    def body(carry, group_and_cache):
         x = carry
-        if kv_caches is not None:
-            layer, (ck, cv) = layer_and_cache
-            x, new_cache, aux = _layer(
-                cfg, attn_fn, x, layer, positions, (ck, cv), cache_offset,
-                prefill=prefill, moe_mesh=moe_mesh, ring=ring,
+        group, cache_group = (
+            group_and_cache if kv_caches is not None else (group_and_cache, None)
+        )
+        if P == 1:
+            x, new_cache, aux = one_layer(x, group, cache_group, cycle[0])
+            if kv_caches is not None:
+                return x, (new_cache, aux)
+            return x, aux
+        new_caches, auxes = [], []
+        for i in range(P):
+            sub_layer = jax.tree.map(lambda a: a[i], group)
+            sub_cache = (
+                jax.tree.map(lambda a: a[i], cache_group)
+                if cache_group is not None else None
             )
-            return x, (new_cache, aux)
-        layer = layer_and_cache
-        x, _, aux = _layer(cfg, attn_fn, x, layer, positions, moe_mesh=moe_mesh)
+            x, nc, a = one_layer(x, sub_layer, sub_cache, cycle[i])
+            new_caches.append(nc)
+            auxes.append(a)
+        aux = jnp.mean(jnp.stack(auxes))
+        if kv_caches is not None:
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *new_caches)
+            return x, (stacked, aux)
         return x, aux
 
     if remat and kv_caches is None:
         body = jax.checkpoint(body)
 
+    def group_leaves(tree):  # [L, ...] → [L//P, P, ...] for the cycle scan
+        return jax.tree.map(
+            lambda a: a.reshape((a.shape[0] // P, P) + a.shape[1:]), tree
+        )
+
+    def ungroup_leaves(tree):
+        return jax.tree.map(
+            lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), tree
+        )
+
+    layers_xs = params["layers"] if P == 1 else group_leaves(params["layers"])
     if kv_caches is not None:
-        x, (new_caches, auxes) = lax.scan(body, x, (params["layers"], kv_caches))
+        caches_xs = kv_caches if P == 1 else group_leaves(kv_caches)
+        x, (new_caches, auxes) = lax.scan(body, x, (layers_xs, caches_xs))
+        if P > 1:
+            new_caches = ungroup_leaves(new_caches)
     else:
-        x, auxes = lax.scan(body, x, params["layers"])
+        x, auxes = lax.scan(body, x, layers_xs)
         new_caches = None
-    aux = jnp.mean(auxes)  # [L] per-layer load-balance losses
+    aux = jnp.mean(auxes)  # per-layer load-balance losses
 
     logits = unembed(params, x, cfg)
     out = (logits, new_caches) if kv_caches is not None else (logits,)

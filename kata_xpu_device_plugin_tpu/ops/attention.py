@@ -44,6 +44,7 @@ def reference_attention(
     q_offset: Optional[jax.Array] = None,
     window: int = 0,
     k_positions: Optional[jax.Array] = None,
+    logits_softcap: float = 0.0,
 ) -> jax.Array:
     """XLA attention, GQA-grouped: q's H heads fold into [KV, H/KV] groups so
     K/V are read once per KV head — no ``jnp.repeat`` of the KV cache (on MQA
@@ -60,7 +61,10 @@ def reference_attention(
     ``k_positions`` overrides the keys' implied positions (``arange(Sk)``)
     with explicit ABSOLUTE positions, shape [Sk] or [B, Sk] — the ring
     KV buffer stores its band out of order (slot = position % window) and
-    negative entries mark unwritten slots (always masked)."""
+    negative entries mark unwritten slots (always masked).
+
+    ``logits_softcap > 0`` (Gemma-2: 50.0) caps pre-mask attention logits
+    to ``tanh(l / c) · c``."""
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
     assert H % KV == 0, (H, KV)
@@ -75,6 +79,8 @@ def reference_attention(
         "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
     )
     logits = logits * (1.0 / float(D) ** 0.5)
+    if logits_softcap:
+        logits = jnp.tanh(logits / logits_softcap) * logits_softcap
     if causal:
         q_pos = jnp.arange(Sq)
         k_pos = jnp.arange(Sk) if k_positions is None else k_positions

@@ -127,6 +127,11 @@ def make_pp_loss(
     :func:`..models.transformer.next_token_loss` on the flattened batch."""
     if cfg.n_layers % n_stages:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by {n_stages}")
+    if cfg.attn_windows:
+        raise ValueError(
+            "pipeline stages apply one uniform attention window; per-layer "
+            "attn_windows cycles (Gemma-2 style) are not supported here"
+        )
     if num_microbatches % n_stages:
         raise ValueError(
             f"num_microbatches={num_microbatches} not divisible by {n_stages} "

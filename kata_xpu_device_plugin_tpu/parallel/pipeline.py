@@ -179,6 +179,11 @@ def make_transformer_pipeline(
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by {n_stages} stages"
         )
+    if cfg.attn_windows:
+        raise ValueError(
+            "pipeline stages apply one uniform attention window; per-layer "
+            "attn_windows cycles (Gemma-2 style) are not supported here"
+        )
     layers_per_stage = cfg.n_layers // n_stages
 
     pipe = make_pipeline(transformer_stage_fn(cfg, attn_fn), n_stages, mesh, axis)

@@ -31,6 +31,8 @@ PARAM_RULES: dict[str, P] = {
     "unembed": P(AXIS_FSDP, AXIS_MODEL),
     "layers.attn_norm": P(None, None),
     "layers.mlp_norm": P(None, None),
+    "layers.post_attn_norm": P(None, None),  # Gemma-2 post-sublayer norms
+    "layers.post_mlp_norm": P(None, None),
     "layers.wq": P(None, AXIS_FSDP, AXIS_MODEL),
     "layers.wk": P(None, AXIS_FSDP, AXIS_MODEL),
     "layers.wv": P(None, AXIS_FSDP, AXIS_MODEL),

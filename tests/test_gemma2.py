@@ -1,0 +1,179 @@
+"""Gemma-2-style features: alternating attention windows (cycle scan),
+pre+post norms, and soft-capped attention logits.
+
+Oracle for the cycle scan: an unscanned python loop over layers calling
+the same `_layer` with each layer's own window.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu.guest.serving import serve_batch
+from kata_xpu_device_plugin_tpu.models import (
+    gemma2_2b,
+    gemma2_test_config,
+    generate,
+    generate_speculative,
+)
+from kata_xpu_device_plugin_tpu.models.transformer import (
+    _layer,
+    embed,
+    forward,
+    init_params,
+    next_token_loss,
+    unembed,
+)
+from kata_xpu_device_plugin_tpu.ops.attention import reference_attention
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = gemma2_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def test_post_norm_params_exist(model):
+    cfg, params = model
+    assert params["layers"]["post_attn_norm"].shape == (cfg.n_layers, cfg.d_model)
+    assert params["layers"]["post_mlp_norm"].shape == (cfg.n_layers, cfg.d_model)
+    assert cfg.num_params() > gemma2_test_config(post_norms=False).num_params()
+
+
+def test_cycle_scan_matches_layer_loop(model):
+    # forward()'s grouped scan vs an explicit per-layer loop with each
+    # layer's own window — must agree exactly.
+    cfg, params = model
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size)
+    out = np.asarray(forward(params, tokens, cfg))
+
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = embed(params, tokens, cfg)
+    for i in range(cfg.n_layers):
+        layer_i = jax.tree.map(lambda a: a[i], params["layers"])
+        x, _, _ = _layer(cfg, reference_attention, x, layer_i, positions,
+                         window=cfg.layer_window(i))
+    ref = np.asarray(unembed(params, x, cfg))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_alternation_matters(model):
+    # Windowed-everywhere and global-everywhere must both differ from the
+    # alternating config once the sequence exceeds the window.
+    cfg, params = model
+    from dataclasses import replace
+
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 14), 0, cfg.vocab_size)
+    alt = np.asarray(forward(params, tokens, cfg))
+    all_local = np.asarray(
+        forward(params, tokens, replace(cfg, attn_windows=(6, 6)))
+    )
+    all_global = np.asarray(
+        forward(params, tokens, replace(cfg, attn_windows=(0, 0)))
+    )
+    assert np.abs(alt - all_local).max() > 1e-4
+    assert np.abs(alt - all_global).max() > 1e-4
+
+
+def test_attn_softcap_matters(model):
+    cfg, params = model
+    from dataclasses import replace
+
+    # Blow up one q/k pair so raw logits far exceed the cap.
+    big = dict(params)
+    big["layers"] = dict(params["layers"])
+    big["layers"]["wq"] = params["layers"]["wq"] * 30.0
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 8), 0, cfg.vocab_size)
+    capped = np.asarray(forward(big, tokens, cfg))
+    uncapped = np.asarray(
+        forward(big, tokens, replace(cfg, attn_logits_softcap=0.0))
+    )
+    assert np.abs(capped - uncapped).max() > 1e-3
+
+
+def test_generate_decode_matches_uncached_loop(model):
+    # Cached decode through the cycle scan vs cache-free re-forward.
+    cfg, params = model
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 5), 0, cfg.vocab_size)
+    steps = 10
+    out = np.asarray(generate(params, prompt, cfg, steps, max_len=24))
+
+    seq = np.asarray(prompt)
+    for _ in range(steps):
+        logits = forward(params, jnp.asarray(seq), cfg)
+        nxt = int(np.asarray(jnp.argmax(logits[:, -1], axis=-1))[0])
+        seq = np.concatenate([seq, [[nxt]]], axis=1)
+    np.testing.assert_array_equal(out[0], seq[0, 5:])
+
+
+def test_serving_and_speculative_gemma2(model):
+    cfg, params = model
+    key = jax.random.PRNGKey(5)
+    prompts = [
+        np.asarray(jax.random.randint(jax.random.fold_in(key, i), (n,), 0,
+                                      cfg.vocab_size), np.int32)
+        for i, n in enumerate((4, 9))
+    ]
+    served = serve_batch(params, cfg, prompts, max_new_tokens=7,
+                         max_batch=2, max_len=24)
+    for p, o in zip(prompts, served):
+        ref = np.asarray(
+            generate(params, jnp.asarray(p)[None], cfg, 7, max_len=24)
+        )[0]
+        np.testing.assert_array_equal(o, ref)
+    prompt = jnp.asarray(np.tile(np.array([3, 7], np.int32), 5)[None, :])
+    ref = np.asarray(generate(params, prompt, cfg, 8, max_len=32))
+    out = generate_speculative(params, prompt, cfg, 8, k=3, max_len=32)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_training_grads_flow_through_post_norms(model):
+    cfg, params = model
+    toks = jax.random.randint(jax.random.PRNGKey(6), (2, 12), 0, cfg.vocab_size)
+    loss, grads = jax.value_and_grad(
+        lambda p: next_token_loss(p, toks, cfg)
+    )(params)
+    assert np.isfinite(float(loss))
+    for k in ("post_attn_norm", "post_mlp_norm"):
+        assert float(jnp.abs(grads["layers"][k]).max()) > 0
+
+
+def test_sharded_train_step_with_post_norms(model):
+    # PARAM_RULES must cover the Gemma-2 post-norm params or GSPMD init
+    # dies with a KeyError before the first step.
+    from kata_xpu_device_plugin_tpu.parallel import build_mesh, make_train_step
+
+    cfg, _ = model
+    mesh = build_mesh({"data": 2, "fsdp": 2, "model": 2})
+    init_state, step = make_train_step(cfg, mesh)
+    state = init_state(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size)
+    from kata_xpu_device_plugin_tpu.parallel import shard_batch
+
+    state, loss = step(state, shard_batch(toks, mesh))
+    assert np.isfinite(float(loss))
+
+
+def test_softcap_rejects_custom_attn_fn(model):
+    cfg, params = model
+    toks = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0, cfg.vocab_size)
+
+    def custom_attn(q, k, v, causal=True, q_offset=None, window=0):
+        return jnp.zeros_like(q)
+
+    with pytest.raises(ValueError, match="softcap"):
+        forward(params, toks, cfg, attn_fn=custom_attn)
+
+
+def test_layer_count_must_divide_cycle():
+    with pytest.raises(ValueError, match="divisible"):
+        init_params(jax.random.PRNGKey(0), gemma2_test_config(n_layers=3))
+
+
+def test_gemma2_2b_shape():
+    cfg = gemma2_2b()
+    assert cfg.attn_windows == (4096, 0)
+    assert cfg.post_norms and cfg.attn_logits_softcap == 50.0
+    assert 2.4e9 < cfg.num_params() < 2.9e9

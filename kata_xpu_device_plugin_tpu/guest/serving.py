@@ -103,9 +103,17 @@ class GenerationServer:
                  max_len: int = 512, eos_id: Optional[int] = None,
                  chunk: int = 8, temperature: float = 0.0, top_k: int = 0,
                  seed: int = 0, mesh: Any = None, kv_quant: bool = False,
-                 prefill_buckets: tuple = ()):
+                 prefill_buckets: tuple = (), speculative_k: int = 0):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if speculative_k < 0:
+            raise ValueError(f"speculative_k must be >= 0, got {speculative_k}")
+        if speculative_k and temperature != 0.0:
+            raise ValueError(
+                "speculative serving is greedy-only (lossless acceptance "
+                "compares against the argmax token) — set temperature=0"
+            )
+        self.speculative_k = speculative_k
         if any(b < 1 or b > max_len for b in prefill_buckets):
             raise ValueError(
                 f"prefill_buckets {prefill_buckets} must lie in [1, max_len]"
@@ -252,6 +260,9 @@ class GenerationServer:
         if not active:
             return bool(self._queue)
 
+        if self.speculative_k:
+            return self._step_speculative(active)
+
         # Always decode exactly ``chunk`` steps: ``steps`` is a static arg,
         # so a data-dependent chunk would compile a fresh full-model decode
         # executable per distinct value (a multi-second latency spike
@@ -275,6 +286,44 @@ class GenerationServer:
             new = toks[b].tolist()
             self._slot_req[b].out.extend(new)
             self._maybe_finish(b, new)
+        return True
+
+    def _step_speculative(self, active: list) -> bool:
+        """One speculative round over the whole arena: n-gram drafts per
+        active slot from its own request history, verified in ONE [B, k+1]
+        forward at per-slot positions — up to k+1 tokens per slot per
+        weight stream, token-identical to the plain greedy server (the
+        same losslessness :mod:`..models.speculative` proves for
+        generate). Out-of-bound tail writes clamp to the arena's last
+        entry, which no valid prefix ever includes (submit guarantees
+        prompt + budget <= max_len, so live prefixes end at max_len-2)."""
+        from ..models.speculative import (
+            accept_drafts,
+            ngram_propose,
+            verify_step,
+        )
+
+        k = self.speculative_k
+        cur = self._last.copy()
+        drafts = np.zeros((self.max_batch, k), np.int32)
+        for b in active:
+            req = self._slot_req[b]
+            hist = np.concatenate(
+                [req.prompt, np.asarray(req.out[:-1], np.int32)]
+            )
+            drafts[b] = ngram_propose(hist, int(cur[b]), k)
+        toks = np.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
+        greedy, self.arena = verify_step(
+            self.params, self.arena, jnp.asarray(toks),
+            jnp.asarray(self._pos), self.cfg,
+        )
+        greedy = np.asarray(greedy)
+        for b in active:
+            accepted = accept_drafts(drafts[b], greedy[b], k)
+            self._slot_req[b].out.extend(accepted)
+            self._last[b] = accepted[-1]
+            self._pos[b] += len(accepted)
+            self._maybe_finish(b, accepted)
         return True
 
 

@@ -78,6 +78,20 @@ def ngram_propose(history: np.ndarray, cur: int, k: int) -> np.ndarray:
     return out
 
 
+def accept_drafts(drafts_row: np.ndarray, greedy_row: np.ndarray,
+                  k: int) -> list:
+    """The lossless acceptance rule, shared by :func:`generate_speculative`
+    and the serving integration (``guest.serving._step_speculative``) so the
+    token-identity guarantee lives in ONE place: accept the longest draft
+    prefix the model's own greedy choices reproduce, then the model's
+    correction token. Returns the accepted token list (length 1..k+1);
+    the caller advances its position by ``len(accepted)``."""
+    a = 0
+    while a < k and drafts_row[a] == greedy_row[a]:
+        a += 1
+    return list(drafts_row[:a]) + [int(greedy_row[a])]
+
+
 def generate_speculative(params: Params, prompt: jax.Array,
                          cfg: DecoderConfig, steps: int, k: int = 4,
                          max_len: int = 0,
@@ -124,11 +138,8 @@ def generate_speculative(params: Params, prompt: jax.Array,
                 # Row already done: its verify round was padding; do not
                 # advance its state (rewrites the same span next round).
                 continue
-            a = 0
-            while a < k and drafts[b, a] == greedy[b, a]:
-                a += 1
-            accepted = list(drafts[b, :a]) + [int(greedy[b, a])]
+            accepted = accept_drafts(drafts[b], greedy[b], k)
             history[b].extend([int(cur[b])] + accepted[:-1])
             out[b].extend(accepted)
-            pos[b] += 1 + a  # cur + accepted drafts now live in the cache
+            pos[b] += len(accepted)  # cur + accepted drafts are now cached
     return np.array([o[:steps] for o in out], np.int32)

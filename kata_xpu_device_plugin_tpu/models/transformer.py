@@ -543,6 +543,14 @@ def forward(
     # the body, so compile cost scales with the cycle, not the depth.
     cycle = cfg.window_cycle
     P = len(cycle)
+    if cfg.n_layers % P:
+        # Checked here (not just init_params): checkpoint-loaded or
+        # converted params skip init_params, and the reshape below would
+        # otherwise die with an opaque error.
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by the attn_windows "
+            f"cycle {cfg.attn_windows}"
+        )
 
     def one_layer(x, layer, cache, w):
         return _layer(
@@ -890,6 +898,11 @@ def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
         raise ValueError(
             "ring_kv needs a sliding-window config (cfg.sliding_window > 0) "
             "— a global-attention model must keep its whole prefix"
+        )
+    if ring_kv and cfg.attn_windows:
+        raise ValueError(
+            "ring_kv applies ONE uniform window; per-layer attn_windows "
+            "cycles include global layers that must keep their whole prefix"
         )
     max_len = max_len or S + steps
     if not ring_kv and S + steps > max_len:

@@ -179,6 +179,43 @@ def test_prefill_true_len_matches_exact(model):
         )
 
 
+def test_speculative_serving_matches_plain_greedy(model):
+    # speculative_k changes only the SCHEDULE (verify rounds instead of
+    # decode chunks): results must equal the plain greedy server — and thus
+    # the per-request generate() oracle — under queue pressure and slot
+    # reuse, for both accept-friendly (repetitive) and random prompts.
+    cfg, params = model
+    rep = np.tile(np.array([5, 17, 3], np.int32), 4)
+    prompts = _prompts(cfg, [4, 9, 6], seed=9) + [rep]
+    ref = serve_batch(params, cfg, prompts, max_new_tokens=9,
+                      max_batch=2, max_len=32)
+    out = serve_batch(params, cfg, prompts, max_new_tokens=9,
+                      max_batch=2, max_len=32, speculative_k=3)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+
+def test_speculative_serving_eos_and_budget(model):
+    cfg, params = model
+    (p,) = _prompts(cfg, [5], seed=10)
+    ref = _oracle(params, cfg, p, 16, 32)
+    eos = int(ref[4])
+    out = serve_batch(params, cfg, [p], max_new_tokens=16, max_batch=1,
+                      max_len=32, eos_id=eos, speculative_k=4)
+    stop = int(np.where(ref == eos)[0][0])
+    np.testing.assert_array_equal(out[0], ref[: stop + 1])
+    # Tight budget: a verify round can overshoot; output must trim exactly.
+    out2 = serve_batch(params, cfg, [p], max_new_tokens=2, max_batch=1,
+                       max_len=32, speculative_k=4)
+    np.testing.assert_array_equal(out2[0], ref[:2])
+
+
+def test_speculative_serving_rejects_sampling(model):
+    cfg, params = model
+    with pytest.raises(ValueError, match="greedy-only"):
+        GenerationServer(params, cfg, temperature=0.7, speculative_k=3)
+
+
 def test_submit_validation(model):
     cfg, params = model
     srv = GenerationServer(params, cfg, max_batch=1, max_len=16)

@@ -32,7 +32,10 @@ measurement runs in a KILLABLE WORKER SUBPROCESS under a supervisor:
 Besides the headline bf16 number, the worker also measures int8 weight-only
 decode (ops/quant.py) — reported as ``int8_tok_per_s`` against its own
 actual-bytes roofline (``int8_vs_baseline``), so the quantized win shows up
-in absolute tok/s without muddying the bf16 round-over-round series.
+in absolute tok/s without muddying the bf16 round-over-round series — and
+continuous-batching serving throughput (guest/serving.py, 16 mixed-length
+requests through an 8-slot arena, ``serving_tok_per_s``). Both are
+crash-guarded side sections emitted AFTER the banked headline line.
 
 Flags: --profile-dir DIR dumps a jax.profiler (xplane) trace of the measured
 decode runs. --smoke runs tiny shapes (harness validation, not the metric).
@@ -85,10 +88,11 @@ def supervise(args: argparse.Namespace) -> int:
             # but if attempt 1 hung or crashed, force it hard-off so an
             # opted-in kernel/runtime incompatibility can't cost the round.
             env["KATA_TPU_DECODE_KERNEL"] = "0"
-            # Likewise drop the int8 side-measurement on retries: if its
-            # compile/run hung attempt 1 (a hang can't be caught in-process),
-            # the retry must still deliver the bf16 headline number.
+            # Likewise drop the side-measurements on retries: if one hung
+            # attempt 1 (a hang can't be caught in-process), the retry must
+            # still deliver the bf16 headline number.
             env["KATA_TPU_BENCH_INT8"] = "0"
+            env["KATA_TPU_BENCH_SERVING"] = "0"
         if attempt == MAX_ATTEMPTS - 1 and attempt > 0 and not args.smoke:
             # Last resort: a labeled CPU smoke figure beats an empty round.
             env["JAX_PLATFORMS"] = "cpu"
@@ -347,6 +351,57 @@ def worker(args: argparse.Namespace) -> None:
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"int8_error": f"{type(exc).__name__}: {exc}"[:200]}
 
+    def measure_serving() -> dict:
+        # Continuous-batching throughput (guest/serving.py): 16 mixed-length
+        # requests through an 8-slot arena. A SIDE measurement with the same
+        # protections as int8: runs after the banked headline line, crashes
+        # report as serving_error, KATA_TPU_BENCH_SERVING=0 disables.
+        if args.smoke or os.environ.get("KATA_TPU_BENCH_SERVING", "1") == "0":
+            return {}
+        try:
+            from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+
+            def make_server():
+                return GenerationServer(
+                    params, cfg, max_batch=BATCH, max_len=PROMPT_LEN + 72,
+                    chunk=16, prefill_buckets=(PROMPT_LEN,),
+                )
+
+            rng = jax.random.PRNGKey(42)
+            new_per_req = 64
+
+            def reqs(srv, count):
+                out = []
+                for i in range(count):
+                    n = PROMPT_LEN - (i % 4) * 16  # mixed lengths, one bucket
+                    p = jax.random.randint(
+                        jax.random.fold_in(rng, i), (n,), 0, cfg.vocab_size,
+                        dtype=jnp.int32,
+                    )
+                    out.append(srv.submit(np.asarray(p), new_per_req))
+                return out
+
+            # Warm-up server: same shapes → the timed run reuses the
+            # compiled prefill/decode/_write_slot executables (every other
+            # measurement here excludes compiles; this one must too).
+            warm = make_server()
+            reqs(warm, 1)
+            warm.run()
+
+            srv = make_server()
+            rids = reqs(srv, 2 * BATCH)
+            t0 = time.perf_counter()
+            results = srv.run()
+            dt_s = time.perf_counter() - t0
+            total = sum(len(results[r]) for r in rids)
+            return {
+                "serving_tok_per_s": round(total / dt_s, 1),
+                "serving_requests": len(rids),
+                "serving_s": round(dt_s, 3),
+            }
+        except Exception as exc:  # noqa: BLE001 — headline must survive
+            return {"serving_error": f"{type(exc).__name__}: {exc}"[:200]}
+
     out = {
         "metric": METRIC,
         "value": round(tok_per_s, 1),
@@ -381,6 +436,10 @@ def worker(args: argparse.Namespace) -> None:
     int8_out = measure_int8()
     if int8_out:
         out.update(int8_out)
+        print(json.dumps(out), flush=True)
+    serving_out = measure_serving()
+    if serving_out:
+        out.update(serving_out)
         print(json.dumps(out), flush=True)
 
 

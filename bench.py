@@ -370,22 +370,24 @@ def worker(args: argparse.Namespace) -> None:
             rng = jax.random.PRNGKey(42)
             new_per_req = 64
 
-            def reqs(srv, count):
+            def reqs(srv, count, salt=0):
                 out = []
                 for i in range(count):
                     n = PROMPT_LEN - (i % 4) * 16  # mixed lengths, one bucket
                     p = jax.random.randint(
-                        jax.random.fold_in(rng, i), (n,), 0, cfg.vocab_size,
-                        dtype=jnp.int32,
+                        jax.random.fold_in(rng, salt + i), (n,), 0,
+                        cfg.vocab_size, dtype=jnp.int32,
                     )
                     out.append(srv.submit(np.asarray(p), new_per_req))
                 return out
 
             # Warm-up server: same shapes → the timed run reuses the
             # compiled prefill/decode/_write_slot executables (every other
-            # measurement here excludes compiles; this one must too).
+            # measurement here excludes compiles; this one must too). The
+            # warm-up PROMPT differs (salt) so the remote tunnel's
+            # identical-execution cache cannot serve the timed request.
             warm = make_server()
-            reqs(warm, 1)
+            reqs(warm, 1, salt=1000)
             warm.run()
 
             srv = make_server()

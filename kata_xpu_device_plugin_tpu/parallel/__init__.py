@@ -4,6 +4,7 @@ expert parallelism, sharding-aware checkpoint/resume, and the
 deterministic resumable data loader."""
 from .checkpoint import TrainCheckpointer
 from .loader import TokenBatchLoader, make_loader
+from .trainer import fit
 from .composed import (
     composed_mesh,
     init_pp_params,
@@ -75,4 +76,5 @@ __all__ = [
     "TrainCheckpointer",
     "TokenBatchLoader",
     "make_loader",
+    "fit",
 ]

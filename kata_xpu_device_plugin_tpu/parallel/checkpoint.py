@@ -87,6 +87,10 @@ class TrainCheckpointer:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def steps(self):
+        """All retained checkpoint steps (after max_to_keep pruning)."""
+        return self._mngr.all_steps()
+
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the same shapes/dtypes/shardings as ``state_like``
         (a live or abstract state tree). ``step=None`` means latest."""

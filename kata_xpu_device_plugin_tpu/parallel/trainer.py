@@ -1,0 +1,135 @@
+"""The training loop: loader → sharded step → checkpoint/resume, as one
+callable.
+
+The reference ships no training stack (SURVEY §2/§5); the framework has the
+three legs — :func:`.sharding.make_train_step` (GSPMD dp×fsdp×tp),
+:class:`.loader.TokenBatchLoader` (deterministic, resumable), and
+:class:`.checkpoint.TrainCheckpointer` (orbax, sharding-aware) — and this
+module is the glue users otherwise hand-write: a preemption-safe ``fit()``
+whose resumed run replays EXACTLY the interrupted one (same batches, same
+losses, bit-identical states — tested), because the loader cursor is saved
+next to the train state and both restore together.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from ..utils import log
+from .checkpoint import TrainCheckpointer
+from .loader import TokenBatchLoader
+
+LOG = log.get("trainer")
+
+
+def _loader_state_path(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), f"loader_{step}.json")
+
+
+def fit(
+    init_state: Callable,
+    step_fn: Callable,
+    loader: TokenBatchLoader,
+    steps: int,
+    key: Optional[jax.Array] = None,
+    ckpt_dir: Optional[str] = None,
+    ckpt_every: int = 0,
+    log_every: int = 0,
+    on_step: Optional[Callable] = None,
+) -> tuple[Any, list]:
+    """Train for ``steps`` optimizer steps; returns ``(state, losses)``.
+
+    ``init_state``/``step_fn`` are :func:`.sharding.make_train_step`'s pair
+    (or any pair of the same shape). With ``ckpt_dir``:
+
+    - every ``ckpt_every`` steps the train state is checkpointed (orbax,
+      atomic) and the loader cursor written next to it;
+    - on startup, if a checkpoint exists, BOTH restore and training
+      continues at the exact batch the interrupted run would have drawn
+      next — the resumed loss sequence equals the uninterrupted one.
+
+    ``on_step(step, loss)`` is a host callback (metrics, early stop via
+    raising); ``log_every`` emits structured log lines.
+    """
+    if ckpt_every and not ckpt_dir:
+        raise ValueError("ckpt_every needs ckpt_dir")
+    state = init_state(key if key is not None else jax.random.PRNGKey(0))
+
+    ckpt: Optional[TrainCheckpointer] = None
+    start_step = 0
+    if ckpt_dir:
+        ckpt = TrainCheckpointer(ckpt_dir, save_interval_steps=1)
+        latest = ckpt.latest_step()
+        if latest is not None:
+            # Free the freshly-initialized buffers BEFORE restore (the init
+            # tree only supplies shapes/dtypes/shardings): without this,
+            # resume transiently holds init + restored trees and can OOM a
+            # model a fresh run fits.
+            spec = jax.tree.map(
+                lambda x: (
+                    jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+                    if isinstance(x, jax.Array) else x
+                ),
+                state,
+            )
+            jax.tree.map(
+                lambda x: x.delete() if isinstance(x, jax.Array) else None,
+                state,
+            )
+            state = ckpt.restore(spec, step=latest)
+            with open(_loader_state_path(ckpt_dir, latest)) as f:
+                loader.load_state_dict(json.load(f))
+            start_step = latest
+            LOG.info(
+                "resumed", extra=log.kv(step=latest, dir=ckpt_dir)
+            )
+
+    losses: list = []
+    try:
+        for s in range(start_step, steps):
+            state, loss = step_fn(state, next(loader))
+            if log_every and (s + 1) % log_every == 0:
+                LOG.info(
+                    "step", extra=log.kv(step=s + 1, loss=float(loss))
+                )
+            if on_step is not None:
+                on_step(s + 1, loss)
+            losses.append(loss)
+            if ckpt is not None and ckpt_every and (s + 1) % ckpt_every == 0:
+                # Loader cursor FIRST (tiny json), then the state; a kill
+                # between the two leaves the previous step as orbax-latest
+                # and its cursor file intact — never a state/cursor mismatch.
+                with open(_loader_state_path(ckpt_dir, s + 1), "w") as f:
+                    json.dump(loader.state_dict(), f)
+                ckpt.save(s + 1, state)
+                _prune_cursors(ckpt_dir, ckpt.steps())
+    finally:
+        # on_step may raise to stop early (documented): in-flight async
+        # orbax writes must still be finalized or the 'saved' checkpoint
+        # is discarded by atomicity and resume falls back further.
+        if ckpt is not None:
+            ckpt.wait()
+            ckpt.close()
+    # Device scalars → host floats once, at the end (per-step .item() would
+    # serialize the async dispatch pipeline).
+    return state, [float(np.asarray(l)) for l in losses]
+
+
+def _prune_cursors(directory: str, live_steps) -> None:
+    """Drop loader_*.json cursors whose orbax step was pruned by
+    max_to_keep — stale cursors would otherwise accumulate unboundedly and
+    outlive their checkpoints."""
+    live = {int(s) for s in live_steps}
+    directory = os.path.abspath(directory)
+    for name in os.listdir(directory):
+        if name.startswith("loader_") and name.endswith(".json"):
+            try:
+                step = int(name[len("loader_") : -len(".json")])
+            except ValueError:
+                continue
+            if step not in live:
+                os.unlink(os.path.join(directory, name))

@@ -2,6 +2,7 @@
 inference workload) and Llama-3 (BASELINE training workload) configs."""
 from .gemma import (
     gemma2_2b,
+    gemma2_9b,
     gemma2_test_config,
     gemma_2b,
     gemma_2b_bench,
@@ -31,6 +32,7 @@ __all__ = [
     "next_token_loss",
     "tiny_test_config",
     "gemma2_2b",
+    "gemma2_9b",
     "gemma2_test_config",
     "gemma_2b",
     "gemma_2b_bench",

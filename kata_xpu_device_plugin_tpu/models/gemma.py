@@ -78,6 +78,30 @@ def gemma2_2b(**overrides) -> DecoderConfig:
     return replace(cfg, **overrides)
 
 
+def gemma2_9b(**overrides) -> DecoderConfig:
+    """Gemma-2 9B (public Gemma-2 report): same block STRUCTURE as 2B
+    (alternating windows, post-norms, both softcaps) at larger dims —
+    d_model 3584, 42 layers, GQA 16/8, d_ff 14336."""
+    cfg = DecoderConfig(
+        vocab_size=256128,
+        d_model=3584,
+        n_layers=42,
+        n_heads=16,
+        n_kv_heads=8,
+        head_dim=256,
+        d_ff=14336,
+        rope_theta=10000.0,
+        activation="geglu",
+        scale_embeddings=True,
+        tie_embeddings=True,
+        logits_softcap=30.0,
+        attn_logits_softcap=50.0,
+        attn_windows=(4096, 0),
+        post_norms=True,
+    )
+    return replace(cfg, **overrides)
+
+
 def gemma2_test_config(**overrides) -> DecoderConfig:
     """Shapes-only Gemma-2-style config: a short alternating window so the
     cycle and band both engage at test lengths, post-norms, both softcaps,

@@ -12,6 +12,7 @@ import pytest
 from kata_xpu_device_plugin_tpu.guest.serving import serve_batch
 from kata_xpu_device_plugin_tpu.models import (
     gemma2_2b,
+    gemma2_9b,
     gemma2_test_config,
     generate,
     generate_speculative,
@@ -177,3 +178,9 @@ def test_gemma2_2b_shape():
     assert cfg.attn_windows == (4096, 0)
     assert cfg.post_norms and cfg.attn_logits_softcap == 50.0
     assert 2.4e9 < cfg.num_params() < 2.9e9
+
+
+def test_gemma2_9b_shape():
+    cfg = gemma2_9b()
+    assert cfg.n_layers % len(cfg.attn_windows) == 0
+    assert 8.5e9 < cfg.num_params() < 10.0e9

@@ -143,6 +143,13 @@ class GenerationServer:
         self._queue: list[_Request] = []
         self._results: dict[int, np.ndarray] = {}
         self._next_rid = 0
+        # Counters for stats(): device rounds dispatched, tokens emitted
+        # (pre-trim), speculative drafts offered/accepted.
+        self._rounds = 0
+        self._emitted = 0
+        self._prefills = 0
+        self._drafts_offered = 0
+        self._drafts_accepted = 0
 
     def _shard_over(self, mesh) -> None:
         """Tensor-parallel serving: place params by PARAM_RULES (wide dims
@@ -207,6 +214,28 @@ class GenerationServer:
         out, self._results = self._results, {}
         return out
 
+    def stats(self) -> dict:
+        """Serving counters: device rounds, tokens emitted (pre-trim),
+        mean tokens per round, and — under ``speculative_k`` — the draft
+        acceptance rate (the number the k parameter should be tuned by)."""
+        decoded = self._emitted - self._prefills
+        out = {
+            "rounds": self._rounds,
+            "prefills": self._prefills,
+            "tokens_emitted": self._emitted,  # incl. one prefill token/request
+            "tokens_per_round": (
+                round(decoded / self._rounds, 3) if self._rounds else 0.0
+            ),
+            "slots_busy": sum(r is not None for r in self._slot_req),
+            "queued": len(self._queue),
+        }
+        if self.speculative_k:
+            out["draft_acceptance"] = (
+                round(self._drafts_accepted / self._drafts_offered, 4)
+                if self._drafts_offered else 0.0
+            )
+        return out
+
     # ----- scheduling ------------------------------------------------------
 
     def _sample_first(self, logits: jax.Array) -> int:
@@ -231,6 +260,8 @@ class GenerationServer:
         )
         first = self._sample_first(last_logits)
         req.out.append(first)
+        self._prefills += 1
+        self._emitted += 1  # the prefill forward emits each request's first token
         self.arena = _write_slot(self.arena, caches, b)
         self._slot_req[b] = req
         self._pos[b] = int(pos)
@@ -282,9 +313,11 @@ class GenerationServer:
         # _fill_slot writes these rows in place on refill.
         self._last = np.array(last)
         self._pos = np.array(pos)
+        self._rounds += 1
         for b in active:
             new = toks[b].tolist()
             self._slot_req[b].out.extend(new)
+            self._emitted += len(new)
             self._maybe_finish(b, new)
         return True
 
@@ -318,11 +351,15 @@ class GenerationServer:
             jnp.asarray(self._pos), self.cfg,
         )
         greedy = np.asarray(greedy)
+        self._rounds += 1
         for b in active:
             accepted = accept_drafts(drafts[b], greedy[b], k)
             self._slot_req[b].out.extend(accepted)
             self._last[b] = accepted[-1]
             self._pos[b] += len(accepted)
+            self._emitted += len(accepted)
+            self._drafts_offered += k
+            self._drafts_accepted += len(accepted) - 1
             self._maybe_finish(b, accepted)
         return True
 

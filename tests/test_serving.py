@@ -210,6 +210,36 @@ def test_speculative_serving_eos_and_budget(model):
     np.testing.assert_array_equal(out2[0], ref[:2])
 
 
+def test_stats_counters(model):
+    cfg, params = model
+    rep = np.tile(np.array([5, 17], np.int32), 6)
+    srv = GenerationServer(params, cfg, max_batch=1, max_len=40,
+                           speculative_k=3)
+    srv.submit(rep, max_new_tokens=10)
+    srv.run()
+    st = srv.stats()
+    assert st["tokens_emitted"] >= 10
+    assert st["prefills"] == 1
+    assert st["slots_busy"] == 0 and st["queued"] == 0
+    # Edge: a request satisfied entirely by its prefill token still counts.
+    srv0 = GenerationServer(params, cfg, max_batch=1, max_len=40)
+    srv0.submit(rep, max_new_tokens=1)
+    srv0.run()
+    assert srv0.stats()["tokens_emitted"] == 1
+    assert srv0.stats()["rounds"] == 0
+    assert 0.0 <= st["draft_acceptance"] <= 1.0
+    # Repetitive input must accept SOME drafts → fewer rounds than tokens.
+    assert st["rounds"] < st["tokens_emitted"]
+    assert st["tokens_per_round"] > 1.0
+    # Plain greedy server: no acceptance key, one token per slot per round.
+    srv2 = GenerationServer(params, cfg, max_batch=1, max_len=40, chunk=4)
+    srv2.submit(rep, max_new_tokens=8)
+    srv2.run()
+    st2 = srv2.stats()
+    assert "draft_acceptance" not in st2
+    assert st2["tokens_emitted"] >= 8
+
+
 def test_speculative_serving_rejects_sampling(model):
     cfg, params = model
     with pytest.raises(ValueError, match="greedy-only"):

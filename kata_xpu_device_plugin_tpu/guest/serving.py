@@ -74,10 +74,11 @@ def _write_slot(arena, slot_caches, slot: jax.Array):
     return jax.tree.map(write, arena, slot_caches)
 
 
-@partial(jax.jit, static_argnames=("cfg", "steps", "do_sample", "top_k"),
+@partial(jax.jit, static_argnames=("cfg", "steps", "do_sample", "top_k",
+                                   "top_p"),
          donate_argnums=(1,))
 def _serve_decode(params, caches, tok, pos, cfg, steps: int, do_sample: bool,
-                  top_k: int, temperature, key):
+                  top_k: int, temperature, key, top_p: float = 0.0):
     """The server's one decode executable: a fixed-``steps`` ragged chunk
     with the KV arena DONATED — without donation XLA must copy both
     [L, B, max_len, KV, D] arena tensors every chunk (the first in-scan
@@ -85,7 +86,7 @@ def _serve_decode(params, caches, tok, pos, cfg, steps: int, do_sample: bool,
     charged against the bandwidth decode is bound by."""
     return _decode_scan(params, caches, tok, pos, cfg, steps, None,
                         do_sample, top_k, temperature, key,
-                        return_state=True)
+                        return_state=True, top_p=top_p)
 
 
 class GenerationServer:
@@ -102,8 +103,9 @@ class GenerationServer:
     def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
                  max_len: int = 512, eos_id: Optional[int] = None,
                  chunk: int = 8, temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0, mesh: Any = None, kv_quant: bool = False,
-                 prefill_buckets: tuple = (), speculative_k: int = 0):
+                 top_p: float = 0.0, seed: int = 0, mesh: Any = None,
+                 kv_quant: bool = False, prefill_buckets: tuple = (),
+                 speculative_k: int = 0):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
@@ -122,12 +124,12 @@ class GenerationServer:
         self.params, self.cfg = params, cfg
         self.max_batch, self.max_len = max_batch, max_len
         self.eos_id, self.chunk = eos_id, chunk
-        self.temperature, self.top_k = temperature, top_k
+        self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
         self.kv_quant = kv_quant
         # The one sample-vs-greedy decision (transformer._sampling_args):
-        # also validates top_k-without-temperature.
+        # also validates top_k/top_p-without-temperature.
         self._do_sample, self._key = _sampling_args(
-            temperature, top_k, jax.random.PRNGKey(seed)
+            temperature, top_k, jax.random.PRNGKey(seed), top_p
         )
         # kv_quant: int8 arena — ~2× less HBM per slot-token, so the same
         # chip serves ~2× the context/slots (per-vector scales; decode
@@ -241,7 +243,8 @@ class GenerationServer:
     def _sample_first(self, logits: jax.Array) -> int:
         self._key, sub = jax.random.split(self._key)
         return int(_next_token(logits, sub, self._do_sample,
-                               jnp.float32(self.temperature), self.top_k)[0])
+                               jnp.float32(self.temperature), self.top_k,
+                               self.top_p)[0])
 
     def _fill_slot(self, b: int, req: _Request) -> None:
         """Prefill ``req``'s prompt into arena slot ``b``. With
@@ -305,7 +308,7 @@ class GenerationServer:
         toks, caches, last, pos = _serve_decode(
             self.params, self.arena, jnp.asarray(self._last),
             jnp.asarray(self._pos), self.cfg, self.chunk, self._do_sample,
-            self.top_k, jnp.float32(self.temperature), sub,
+            self.top_k, jnp.float32(self.temperature), sub, top_p=self.top_p,
         )
         toks = np.asarray(toks)  # [max_batch, chunk]
         self.arena = caches

@@ -652,25 +652,40 @@ def greedy_token(logits: jax.Array) -> jax.Array:
 
 
 def sample_token(logits: jax.Array, key: jax.Array, temperature: jax.Array,
-                 top_k: int) -> jax.Array:
+                 top_k: int, top_p: float = 0.0) -> jax.Array:
     """Temperature sampling from [B, vocab] fp32 logits, optionally
-    truncated to the ``top_k`` most likely tokens. ``temperature`` is a
-    TRACED scalar — changing it between calls does not recompile (only the
-    static ``top_k`` does)."""
+    truncated to the ``top_k`` most likely tokens and/or the smallest
+    nucleus whose probability mass reaches ``top_p`` (the argmax token is
+    always kept). ``temperature`` is a TRACED scalar — changing it between
+    calls does not recompile (only the static ``top_k``/``top_p`` do)."""
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
+    if 0.0 < top_p < 1.0:  # 1.0 keeps everything: skip the vocab sort
+        order = jnp.flip(jnp.argsort(logits, axis=-1), axis=-1)
+        srt = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep tokens whose cumulative mass BEFORE them is < top_p: the
+        # smallest prefix reaching top_p, never empty. Scattering the
+        # sorted mask back through argsort keeps EXACTLY that prefix — a
+        # threshold compare would also keep tokens tied with the boundary.
+        keep_sorted = (cum - probs) < top_p
+        inv = jnp.argsort(order, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        logits = jnp.where(keep, logits, -1e30)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-def _next_token(logits, key, do_sample: bool, temperature, top_k: int):
+def _next_token(logits, key, do_sample: bool, temperature, top_k: int,
+                top_p: float = 0.0):
     """The one sample-vs-greedy dispatch, shared by prefill/decode/generate."""
-    return (sample_token(logits, key, temperature, top_k) if do_sample
+    return (sample_token(logits, key, temperature, top_k, top_p) if do_sample
             else greedy_token(logits))
 
 
-def _sampling_args(temperature, top_k, key):
+def _sampling_args(temperature, top_k, key, top_p: float = 0.0):
     """Resolve the STATIC sample-vs-greedy decision at the python wrapper
     level (so temperature itself can stay traced) and validate the args."""
     do_sample = not (isinstance(temperature, (int, float)) and temperature == 0.0)
@@ -679,11 +694,13 @@ def _sampling_args(temperature, top_k, key):
             "temperature > 0 requires an explicit PRNG key — a silent "
             "default would return the identical 'sample' on every call"
         )
-    if not do_sample and top_k > 0:
+    if not do_sample and (top_k > 0 or top_p > 0.0):
         raise ValueError(
-            "top_k sampling requires temperature > 0 (greedy decoding would "
-            "silently ignore top_k)"
+            "top_k/top_p sampling requires temperature > 0 (greedy decoding "
+            "would silently ignore them)"
         )
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
     return do_sample, key if key is not None else jax.random.PRNGKey(0)
 
 
@@ -778,11 +795,12 @@ def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn", "do_sample",
-                                   "top_k", "return_state", "ring"))
+                                   "top_k", "top_p", "return_state", "ring"))
 def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
                  cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn],
                  do_sample: bool, top_k: int, temperature, key: jax.Array,
-                 return_state: bool = False, ring: bool = False):
+                 return_state: bool = False, ring: bool = False,
+                 top_p: float = 0.0):
     if attn_fn is None:
         from ..ops.attention import flash_attention
 
@@ -798,7 +816,8 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
             params, tok[:, None], cfg, attn_fn=attn_fn, positions=positions,
             kv_caches=caches, cache_offset=pos, ring=ring,
         )
-        nxt = _next_token(logits[:, -1, :], step_key, do_sample, temperature, top_k)
+        nxt = _next_token(logits[:, -1, :], step_key, do_sample, temperature,
+                          top_k, top_p)
         return (caches, nxt, pos + 1), nxt
 
     init = (caches, tok, jnp.asarray(pos, jnp.int32))
@@ -808,7 +827,7 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
 
 def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
            cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn] = None,
-           temperature: float = 0.0, top_k: int = 0,
+           temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
            key: Optional[jax.Array] = None, return_state: bool = False,
            ring: bool = False):
     """Decode ``steps`` tokens after ``tok`` as one lax.scan — no per-token
@@ -844,18 +863,19 @@ def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
                 f"pos={pos_concrete} + steps={steps} overruns cache "
                 f"max_len={cache_len}"
             )
-    do_sample, key = _sampling_args(temperature, top_k, key)
+    do_sample, key = _sampling_args(temperature, top_k, key, top_p)
     return _decode_scan(params, caches, tok, pos, cfg, steps, attn_fn,
                         do_sample, top_k, jnp.float32(temperature), key,
-                        return_state=return_state, ring=ring)
+                        return_state=return_state, ring=ring, top_p=top_p)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "max_len", "attn_fn",
-                                   "do_sample", "top_k", "kv_quantized",
-                                   "ring_kv"))
+                                   "do_sample", "top_k", "top_p",
+                                   "kv_quantized", "ring_kv"))
 def _generate_impl(params, prompt, cfg, steps, max_len, attn_fn,
                    do_sample: bool, top_k: int, temperature, key,
-                   kv_quantized: bool = False, ring_kv: bool = False):
+                   kv_quantized: bool = False, ring_kv: bool = False,
+                   top_p: float = 0.0):
     B, S = prompt.shape
     k_first, k_rest = jax.random.split(key)
     # Ring mode prefillls into a prompt-sized cache (transient), then folds
@@ -868,19 +888,21 @@ def _generate_impl(params, prompt, cfg, steps, max_len, attn_fn,
     )
     if ring_kv:
         caches = ring_caches_from_prefill(caches, pos, cfg.sliding_window)
-    last = _next_token(last_logits, k_first, do_sample, temperature, top_k)
+    last = _next_token(last_logits, k_first, do_sample, temperature, top_k,
+                       top_p)
     if steps == 0:
         return jnp.zeros((B, 0), jnp.int32)
     if steps == 1:
         return last[:, None]
     out = _decode_scan(params, caches, last, pos, cfg, steps - 1, attn_fn,
-                       do_sample, top_k, temperature, k_rest, ring=ring_kv)
+                       do_sample, top_k, temperature, k_rest, ring=ring_kv,
+                       top_p=top_p)
     return jnp.concatenate([last[:, None], out], axis=1)
 
 
 def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
              steps: int, max_len: int = 0, attn_fn: Optional[AttnFn] = None,
-             temperature: float = 0.0, top_k: int = 0,
+             temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
              key: Optional[jax.Array] = None, kv_quantized: bool = False,
              ring_kv: bool = False):
     """Generation: :func:`prefill` then :func:`decode`, composed under one
@@ -909,7 +931,8 @@ def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
         raise ValueError(
             f"prompt_len={S} + steps={steps} overruns max_len={max_len}"
         )
-    do_sample, key = _sampling_args(temperature, top_k, key)
+    do_sample, key = _sampling_args(temperature, top_k, key, top_p)
     return _generate_impl(params, prompt, cfg, steps, max_len, attn_fn,
                           do_sample, top_k, jnp.float32(temperature), key,
-                          kv_quantized=kv_quantized, ring_kv=ring_kv)
+                          kv_quantized=kv_quantized, ring_kv=ring_kv,
+                          top_p=top_p)

@@ -1,0 +1,77 @@
+"""Sampling controls: temperature, top_k, top_p (nucleus).
+
+top_p's oracle is constructed distributions where the nucleus membership
+is known exactly.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer, serve_batch
+from kata_xpu_device_plugin_tpu.models import generate, tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import init_params, sample_token
+
+
+def _dist_logits(probs):
+    return jnp.log(jnp.asarray([probs], jnp.float32))
+
+
+def _draws(logits, n=200, **kw):
+    return {
+        int(sample_token(logits, jax.random.PRNGKey(s),
+                         jnp.float32(1.0), **kw)[0])
+        for s in range(n)
+    }
+
+
+def test_top_p_nucleus_membership():
+    logits = _dist_logits([0.5, 0.3, 0.15, 0.05])
+    # top_p=0.6: cumulative-before = [0, .5, .8, .95] → nucleus {0, 1}.
+    assert _draws(logits, top_k=0, top_p=0.6) == {0, 1}
+    # top_p=0.4: only the argmax survives (nucleus is never empty).
+    assert _draws(logits, top_k=0, top_p=0.4) == {0}
+    # top_p=1.0: everything stays reachable.
+    assert _draws(logits, top_k=0, top_p=1.0) == {0, 1, 2, 3}
+
+
+def test_top_p_composes_with_top_k():
+    logits = _dist_logits([0.4, 0.3, 0.2, 0.1])
+    # top_k=3 removes token 3; top_p=0.75 over the REMAINING mass keeps the
+    # smallest prefix reaching 0.75 of the renormalized {0,1,2} ≈ {0, 1}.
+    assert _draws(logits, top_k=3, top_p=0.75) == {0, 1}
+
+
+def test_top_p_exact_prefix_under_ties():
+    # Flat distribution: 4 tokens at identical logits, top_p=0.3 → the
+    # smallest prefix reaching 0.3 is exactly TWO tokens (0.25, then 0.5);
+    # a threshold compare at the boundary logit would keep all four ties.
+    logits = _dist_logits([0.25, 0.25, 0.25, 0.25])
+    assert len(_draws(logits, top_k=0, top_p=0.3)) == 2
+
+
+def test_top_p_validation():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="top_p must be"):
+        generate(params, prompt, cfg, 4, temperature=0.5, top_p=1.5,
+                 key=jax.random.PRNGKey(1))
+    with pytest.raises(ValueError, match="temperature > 0"):
+        generate(params, prompt, cfg, 4, top_p=0.9)
+    with pytest.raises(ValueError, match="temperature > 0"):
+        GenerationServer(params, cfg, top_p=0.9)
+
+
+def test_generate_and_serving_with_top_p():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, cfg.vocab_size)
+    out = np.asarray(generate(params, prompt, cfg, 8, max_len=16,
+                              temperature=0.8, top_p=0.9,
+                              key=jax.random.PRNGKey(2)))
+    assert out.shape == (2, 8) and out.dtype == np.int32
+    prompts = [np.asarray(prompt[0]), np.asarray(prompt[1, :4])]
+    served = serve_batch(params, cfg, prompts, max_new_tokens=6, max_batch=2,
+                         max_len=16, temperature=0.8, top_p=0.9, seed=3)
+    assert all(len(o) == 6 for o in served)

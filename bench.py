@@ -16,17 +16,28 @@ across rounds move the ratio up. Runs single-chip (the only hardware here);
 multi-chip scaling is validated by __graft_entry__.dryrun_multichip.
 
 Hardening (round-1 lesson: one transient backend failure must not cost the
-round's perf evidence). A hung remote-TPU tunnel blocks *inside a native
-call*, where no in-process watchdog (SIGALRM included) can fire — so the
+round's perf evidence; round-3 lesson: the supervisor itself must fit the
+driver's budget). A hung remote-TPU tunnel blocks *inside a native call*,
+where no in-process watchdog (SIGALRM included) can fire — so the
 measurement runs in a KILLABLE WORKER SUBPROCESS under a supervisor:
 
-- the supervisor enforces a hard wall-clock budget per attempt and SIGKILLs
-  a hung worker;
-- failures retry with backoff in a fresh interpreter (a failed PJRT init is
-  sticky in-process);
-- the final attempt pins ``JAX_PLATFORMS=cpu`` with smoke shapes so the
+- a GLOBAL wall-clock budget (default 23 min, ``KATA_TPU_BENCH_TOTAL_BUDGET_S``)
+  bounds everything the supervisor does; each stage's timeout is clipped to
+  the time remaining minus a reserve for the CPU fallback, so the worst
+  case — probe hang + attempt hang + fallback — still lands one JSON line
+  inside the budget (r3 regression: 3×1500 s of TPU retries outlived the
+  driver and the round recorded nothing);
+- a short subprocess TUNNEL PROBE (one tiny dispatch, default 90 s) runs
+  before attempt 1: a hung probe means the tunnel is wedged — sticky state,
+  not a transient crash — so TPU attempts are skipped entirely;
+- the supervisor SIGKILLs a hung worker, and classifies the hang as sticky:
+  no further TPU retries (re-dispatching into a wedged tunnel at full
+  timeout is how r3 died), straight to the labeled CPU fallback;
+- fast *crashes* (nonzero rc) still retry in a fresh interpreter (a failed
+  PJRT init is sticky in-process, not across processes);
+- the CPU fallback pins ``JAX_PLATFORMS=cpu`` with smoke shapes so the
   round records *something*, clearly labeled with platform + config;
-- after all retries the supervisor still prints a machine-readable
+- if even the fallback fails the supervisor prints a machine-readable
   diagnostic JSON line and exits nonzero — never a bare stack trace.
 
 Besides the headline bf16 number, the worker also measures int8 weight-only
@@ -60,8 +71,15 @@ PREFILL_LEN = 2048  # separate prefill metric: long enough for flash to matter
 METRIC = "gemma2b_decode_tok_per_s_per_chip"
 
 MAX_ATTEMPTS = int(os.environ.get("KATA_TPU_BENCH_ATTEMPTS", "3"))
-ATTEMPT_TIMEOUT_S = int(os.environ.get("KATA_TPU_BENCH_ATTEMPT_TIMEOUT_S", "1500"))
-SMOKE_TIMEOUT_S = int(os.environ.get("KATA_TPU_BENCH_SMOKE_TIMEOUT_S", "600"))
+ATTEMPT_TIMEOUT_S = int(os.environ.get("KATA_TPU_BENCH_ATTEMPT_TIMEOUT_S", "780"))
+SMOKE_TIMEOUT_S = int(os.environ.get("KATA_TPU_BENCH_SMOKE_TIMEOUT_S", "300"))
+PROBE_TIMEOUT_S = int(os.environ.get("KATA_TPU_BENCH_PROBE_TIMEOUT_S", "90"))
+# Hard ceiling on EVERYTHING the supervisor does (probe + attempts +
+# fallback). 23 min keeps the worst case inside the driver's budget with
+# margin; a full Gemma-2B attempt needs ~6-10 min including compiles.
+TOTAL_BUDGET_S = int(os.environ.get("KATA_TPU_BENCH_TOTAL_BUDGET_S", "1380"))
+# Time held back from TPU attempts so the CPU fallback can always run.
+FALLBACK_RESERVE_S = SMOKE_TIMEOUT_S + 30
 
 
 # --------------------------------------------------------------------------
@@ -70,7 +88,39 @@ SMOKE_TIMEOUT_S = int(os.environ.get("KATA_TPU_BENCH_SMOKE_TIMEOUT_S", "600"))
 # --------------------------------------------------------------------------
 
 
+def probe_tunnel(deadline: float) -> tuple[bool, bool, str]:
+    """One tiny dispatch in a killable subprocess: (ok, hung, message).
+
+    ``jax.devices()`` can succeed while the transport is dead, so the probe
+    round-trips an actual computation. A probe that must be SIGKILLed means
+    the tunnel is in sticky wedged state (observed: hours-long), not a
+    transient failure — the caller should skip TPU attempts entirely.
+    """
+    timeout = max(10.0, min(PROBE_TIMEOUT_S, deadline - time.monotonic()))
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np\n"
+        "np.asarray(jnp.ones((8,)) + 1)\n"
+        "print('probe-ok')\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return False, True, f"probe: hung (killed after {timeout:.0f}s)"
+    if proc.returncode == 0 and "probe-ok" in (out or ""):
+        return True, False, ""
+    return False, False, f"probe: rc={proc.returncode}, tail={_tail(out)}"
+
+
 def supervise(args: argparse.Namespace) -> int:
+    deadline = time.monotonic() + TOTAL_BUDGET_S
     worker_cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
     if args.profile_dir:
         worker_cmd += ["--profile-dir", args.profile_dir]
@@ -78,64 +128,125 @@ def supervise(args: argparse.Namespace) -> int:
         worker_cmd += ["--smoke"]
 
     errors: list[str] = []
-    for attempt in range(MAX_ATTEMPTS):
-        env = dict(os.environ)
-        cmd = list(worker_cmd)
-        timeout = SMOKE_TIMEOUT_S if args.smoke else ATTEMPT_TIMEOUT_S
-        if attempt >= 1:
-            # Belt and braces: the pallas decode kernel is already opt-in
-            # (it measured slower than XLA — see ops.attention.decode_eligible),
-            # but if attempt 1 hung or crashed, force it hard-off so an
-            # opted-in kernel/runtime incompatibility can't cost the round.
-            env["KATA_TPU_DECODE_KERNEL"] = "0"
-            # Likewise drop the side-measurements on retries: if one hung
-            # attempt 1 (a hang can't be caught in-process), the retry must
-            # still deliver the bf16 headline number.
-            env["KATA_TPU_BENCH_INT8"] = "0"
-            env["KATA_TPU_BENCH_SERVING"] = "0"
-        if attempt == MAX_ATTEMPTS - 1 and attempt > 0 and not args.smoke:
-            # Last resort: a labeled CPU smoke figure beats an empty round.
-            env["JAX_PLATFORMS"] = "cpu"
-            cmd += ["--smoke", "--fallback"]
-            timeout = SMOKE_TIMEOUT_S
+
+    def run_once(cmd, env, timeout, label, configured=None):
+        """Run one killable worker; returns (metric_line | None, hung).
+
+        ``configured`` is the stage's un-clipped timeout — used only to label
+        a kill honestly when ``timeout`` was budget-clipped below it.
+        """
+        configured = configured if configured is not None else timeout
+        timeout = max(10.0, min(timeout, deadline - time.monotonic()))
         proc = subprocess.Popen(
             cmd, env=env, stdout=subprocess.PIPE, stderr=sys.stderr, text=True
         )
+        hung = False
         try:
             out, _ = proc.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             proc.kill()
             out, _ = proc.communicate()
-            errors.append(f"attempt {attempt + 1}: killed after {timeout}s (hung)")
+            hung = True
+            # A kill at a budget-clipped timeout is NOT evidence of a wedge —
+            # label it distinctly so the post-mortem can't misread it.
+            kind = "hung" if timeout >= configured else "budget clip, not a hang"
+            errors.append(f"{label}: killed after {timeout:.0f}s ({kind})")
             out = out or ""
         line = _last_json_line(out)
-        if line is not None:
+        if line is None and not hung:
+            errors.append(f"{label}: rc={proc.returncode}, tail={_tail(out)}")
+        if line is not None and proc.returncode != 0:
             # A printed metric line is by construction a COMPLETED headline
             # measurement — the worker banks the bf16-only line before the
-            # int8 extras — so accept it even from a worker that then hung
-            # or crashed (annotated, so the partial run is visible).
-            line["attempts"] = attempt + 1
-            if proc.returncode != 0:
-                line["note"] = (
-                    f"worker rc={proc.returncode} after the headline "
-                    "measurement (extras section hung or crashed)"
-                )
+            # extras — so accept it even from a worker that then hung or
+            # crashed (annotated, so the partial run is visible).
+            line["note"] = (
+                f"worker rc={proc.returncode} after the headline "
+                "measurement (extras section hung or crashed)"
+            )
+        return line, hung
+
+    attempts = 0
+    tunnel_dead = False
+    cpu_pinned = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    # The smoke-shaped fallback applies to any FULL bench run (even one the
+    # caller pinned to CPU — full Gemma-2B shapes can time out there too);
+    # --smoke runs are themselves harness validation and get no fallback.
+    has_fallback = not args.smoke
+    # Full attempts are pointless below this window (a real attempt needs
+    # ~6-10 min incl. compiles); dispatching a doomed budget-clipped attempt
+    # both wastes the reserve and gets misread as a hang when killed.
+    min_attempt_s = 60 if args.smoke else 360
+    if not cpu_pinned:
+        ok, hung, msg = probe_tunnel(deadline)
+        if not ok:
+            errors.append(msg)
+        if hung:
+            # Sticky wedge: re-dispatching at full timeout is how r3 lost
+            # its round. Go straight to the labeled CPU fallback.
+            tunnel_dead = True
+            print(f"bench: {msg}; skipping TPU attempts", file=sys.stderr, flush=True)
+
+    while not tunnel_dead and attempts < MAX_ATTEMPTS:
+        remaining = deadline - time.monotonic() - (
+            FALLBACK_RESERVE_S if has_fallback else 0
+        )
+        if remaining < min_attempt_s:
+            errors.append(f"budget: {remaining:.0f}s left before fallback reserve")
+            break
+        env = dict(os.environ)
+        if attempts >= 1:
+            # Belt and braces: the pallas decode kernel is already opt-in
+            # (it measured slower than XLA — see ops.attention.decode_eligible),
+            # but if attempt 1 crashed, force it hard-off so an opted-in
+            # kernel/runtime incompatibility can't cost the round; likewise
+            # drop the side-measurements so the retry still delivers the
+            # bf16 headline number.
+            env["KATA_TPU_DECODE_KERNEL"] = "0"
+            env["KATA_TPU_BENCH_INT8"] = "0"
+            env["KATA_TPU_BENCH_SERVING"] = "0"
+        attempts += 1
+        stage_timeout = SMOKE_TIMEOUT_S if args.smoke else ATTEMPT_TIMEOUT_S
+        line, hung = run_once(
+            list(worker_cmd),
+            env,
+            min(stage_timeout, remaining),
+            f"attempt {attempts}",
+            configured=stage_timeout,
+        )
+        if line is not None:
+            line["attempts"] = attempts
             print(json.dumps(line), flush=True)
             return 0
-        if not errors or not errors[-1].startswith(f"attempt {attempt + 1}"):
-            errors.append(
-                f"attempt {attempt + 1}: rc={proc.returncode}, "
-                f"tail={out.strip().splitlines()[-1][:200] if out.strip() else ''}"
-            )
-        if attempt + 1 < MAX_ATTEMPTS:
-            delay = 5.0 * (2**attempt)
+        if hung:
+            # Never re-dispatch after a kill: on the tunnel a hang is sticky
+            # wedged state (r3's fatal retry loop); on CPU it means the
+            # shapes are too slow for the budget and a retry changes nothing.
+            break
+        if attempts < MAX_ATTEMPTS:
+            delay = min(5.0 * (2 ** (attempts - 1)), 30.0)
             print(
                 f"bench: {errors[-1]}; retrying in {delay:.0f}s "
-                f"({attempt + 2}/{MAX_ATTEMPTS})",
+                f"({attempts + 1}/{MAX_ATTEMPTS})",
                 file=sys.stderr,
                 flush=True,
             )
             time.sleep(delay)
+
+    if has_fallback:
+        # Last resort: a labeled CPU smoke figure beats an empty round.
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["KATA_TPU_DECODE_KERNEL"] = "0"
+        env["KATA_TPU_BENCH_INT8"] = "0"
+        env["KATA_TPU_BENCH_SERVING"] = "0"
+        cmd = list(worker_cmd) + ["--smoke", "--fallback"]
+        line, _hung = run_once(cmd, env, SMOKE_TIMEOUT_S, "cpu-fallback")
+        if line is not None:
+            line["attempts"] = attempts
+            line["error"] = "; ".join(errors)[-600:]
+            print(json.dumps(line), flush=True)
+            return 0
 
     print(
         json.dumps(
@@ -145,13 +256,18 @@ def supervise(args: argparse.Namespace) -> int:
                 "unit": "tok/s",
                 "vs_baseline": None,
                 "error": "; ".join(errors)[-1000:],
-                "attempts": MAX_ATTEMPTS,
+                "attempts": attempts,
                 "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
             }
         ),
         flush=True,
     )
     return 1
+
+
+def _tail(out) -> str:
+    out = (out or "").strip()
+    return out.splitlines()[-1][:200] if out else ""
 
 
 def _last_json_line(out: str):

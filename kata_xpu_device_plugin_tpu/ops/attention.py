@@ -186,6 +186,7 @@ def flash_attention(
     causal: bool = True,
     q_offset: Optional[jax.Array] = None,
     window: int = 0,
+    logits_softcap: float = 0.0,
 ) -> jax.Array:
     """Trace-time dispatch over the pallas kernels on TPU: the blockwise
     flash kernel for self-attention (prefill/training) and the fused
@@ -195,25 +196,31 @@ def flash_attention(
     sliding-window band) runs the flash kernel too on eligible
     self-attention shapes — it masks AND block-skips the band in forward
     and backward — and the reference elsewhere (the fused decode kernel
-    has no lower mask bound, so windowed decode stays on the XLA path)."""
+    has no lower mask bound, so windowed decode stays on the XLA path).
+    ``logits_softcap > 0`` (Gemma-2) is modeled by the flash kernels in
+    forward AND backward, so softcap configs keep the pallas prefill; the
+    fused decode kernel does not model it, so softcap decode stays XLA."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     if window > 0:
         if causal and flash_eligible(Sq, Sk, D, q_offset):
             from .flash import pallas_flash_attention
 
-            return pallas_flash_attention(q, k, v, causal=True, window=window)
+            return pallas_flash_attention(q, k, v, causal=True, window=window,
+                                          softcap=logits_softcap)
         return reference_attention(q, k, v, causal=causal, q_offset=q_offset,
-                                   window=window)
-    if decode_eligible(Sq, Sk, D, causal, q_offset):
+                                   window=window, logits_softcap=logits_softcap)
+    if logits_softcap == 0.0 and decode_eligible(Sq, Sk, D, causal, q_offset):
         from .decode_attn import pallas_decode_attention
 
         return pallas_decode_attention(q, k, v, q_offset)
     if not flash_eligible(Sq, Sk, D, q_offset):
-        return reference_attention(q, k, v, causal=causal, q_offset=q_offset)
+        return reference_attention(q, k, v, causal=causal, q_offset=q_offset,
+                                   logits_softcap=logits_softcap)
     from .flash import pallas_flash_attention
 
-    return pallas_flash_attention(q, k, v, causal=causal)
+    return pallas_flash_attention(q, k, v, causal=causal,
+                                  softcap=logits_softcap)
 
 
 def best_attention(*args, **kwargs):

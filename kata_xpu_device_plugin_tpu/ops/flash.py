@@ -65,7 +65,7 @@ def supports(sq: int, sk: int, d: int) -> bool:
 def _flash_kernel(
     off_ref, q_ref, k_ref, v_ref, o_ref, *rest,
     scale: float, causal: bool, block_q: int, block_k: int, emit_lse: bool,
-    window: int = 0,
+    window: int = 0, softcap: float = 0.0,
 ):
     if emit_lse:
         lse_ref, m_scr, l_scr, acc_scr = rest
@@ -97,6 +97,11 @@ def _flash_kernel(
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [BQ, BK] fp32
+        if softcap > 0.0:
+            # Gemma-2 logit cap, applied pre-mask exactly like the XLA
+            # reference: cap·tanh(s/cap). Elementwise, so the blockwise
+            # online softmax is unaffected.
+            logits = jnp.tanh(logits / softcap) * softcap
 
         if causal:
             q_pos = q_off + qi * block_q + lax.broadcasted_iota(
@@ -155,7 +160,7 @@ def _flash_kernel(
 
 
 def _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group, interpret, scale,
-              offsets=(0, 0), need_lse=True, window=0):
+              offsets=(0, 0), need_lse=True, window=0, softcap=0.0):
     """[B, H, S, D]-layout forward returning (out, logsumexp[B, H, Sq, ROW_W]
     or None). ``offsets = (q_off, k_off)`` are global sequence offsets (may
     be traced scalars — ring attention passes per-device offsets).
@@ -166,7 +171,7 @@ def _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group, interpret, scale,
     grid = (B, H, Sq // block_q, Sk // block_k)
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, emit_lse=need_lse, window=window,
+        block_k=block_k, emit_lse=need_lse, window=window, softcap=softcap,
     )
     offs = jnp.asarray(offsets, jnp.int32)  # (q_off, k_off) tuple or [2] array
     q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki, off: (b, h, qi, 0))
@@ -209,6 +214,7 @@ def _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group, interpret, scale,
 def _bwd_dq_kernel(
     off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
     *, scale: float, causal: bool, block_q: int, block_k: int, window: int = 0,
+    softcap: float = 0.0,
 ):
     qi, ki = pl.program_id(2), pl.program_id(3)
     num_k = pl.num_programs(3)
@@ -228,6 +234,12 @@ def _bwd_dq_kernel(
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if softcap > 0.0:
+            # Recompute the cap exactly as the forward did: p comes from
+            # the CAPPED logits, and d(cap·tanh(s/cap))/ds = 1 − tanh²
+            # joins the ds bracket below.
+            t = jnp.tanh(s / softcap)
+            s = t * softcap
         p = jnp.exp(s - lse)  # [BQ, BK]
         if causal:
             q_pos = q_off + qi * block_q + lax.broadcasted_iota(jnp.int32, p.shape, 0)
@@ -240,6 +252,8 @@ def _bwd_dq_kernel(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [BQ, BK]
         ds = p * (dp - delta) * scale
+        if softcap > 0.0:
+            ds = ds * (1.0 - t * t)
         dq_scr[...] += lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -264,7 +278,7 @@ def _bwd_dq_kernel(
 def _bwd_dkv_kernel(
     off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr, *, scale: float, causal: bool, block_q: int, block_k: int,
-    window: int = 0,
+    window: int = 0, softcap: float = 0.0,
 ):
     ki, qi = pl.program_id(2), pl.program_id(3)
     num_q = pl.num_programs(3)
@@ -285,6 +299,9 @@ def _bwd_dkv_kernel(
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        if softcap > 0.0:
+            t = jnp.tanh(s / softcap)
+            s = t * softcap
         p = jnp.exp(s - lse)  # [BQ, BK]
         if causal:
             q_pos = q_off + qi * block_q + lax.broadcasted_iota(jnp.int32, p.shape, 0)
@@ -300,7 +317,10 @@ def _bwd_dkv_kernel(
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        ds = p * (dp - delta) * scale
+        if softcap > 0.0:
+            ds = ds * (1.0 - t * t)
+        ds = ds.astype(q.dtype)
         dk_scr[...] += lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # [BK, D]
@@ -325,7 +345,8 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_call(q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k,
-              group, interpret, scale, offsets=(0, 0), dlse=None, window=0):
+              group, interpret, scale, offsets=(0, 0), dlse=None, window=0,
+              softcap=0.0):
     """Gradients in the [B, H, S, D] layout. dk/dv are per Q-HEAD here; the
     caller sums head groups down to the KV heads.
 
@@ -353,7 +374,7 @@ def _bwd_call(q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, window=window,
+            block_q=block_q, block_k=block_k, window=window, softcap=softcap,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -383,7 +404,7 @@ def _bwd_call(q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k,
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, window=window,
+            block_q=block_q, block_k=block_k, window=window, softcap=softcap,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -418,8 +439,8 @@ def _group_kv_grads(dk_h, dv_h, KV, group):
     return dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, block_q, block_k, interpret, window):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, block_q, block_k, interpret, window, softcap):
     group = q.shape[2] // k.shape[2]
     scale = float(1.0 / (q.shape[3] ** 0.5))
     # Pallas TPU tiles the LAST TWO dims: run kernels in [B, H, S, D] layout
@@ -428,27 +449,27 @@ def _flash(q, k, v, causal, block_q, block_k, interpret, window):
     out_t, _ = _fwd_call(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
         causal, block_q, block_k, group, interpret, scale, need_lse=False,
-        window=window,
+        window=window, softcap=softcap,
     )
     return out_t.transpose(0, 2, 1, 3)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window):
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window, softcap):
     """VJP forward rule: the zero-offset case of the block rules — one
     numerical implementation for both the self-attention and ring paths."""
     (out, _lse), res = _flash_block_fwd(
         q, k, v, jnp.zeros((2,), jnp.int32), causal, block_q, block_k,
-        interpret, window=window,
+        interpret, window=window, softcap=softcap,
     )
     return out, res
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, window, res, dout):
+def _flash_bwd(causal, block_q, block_k, interpret, window, softcap, res, dout):
     lse = res[4]
     B, H, Sq = lse.shape[:3]
     dlse_zero = jnp.zeros((B, Sq, H), jnp.float32)
     dq, dk, dv, _doffs = _flash_block_bwd(
-        causal, block_q, block_k, interpret, res, (dout, dlse_zero),
+        causal, block_q, block_k, interpret, softcap, res, (dout, dlse_zero),
         window=window,
     )
     return dq, dk, dv
@@ -460,26 +481,30 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # ----- ring-attention block API (differentiable) ---------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_block(q, k, v, offs, causal, block_q, block_k, interpret):
-    out, _ = _flash_block_fwd(q, k, v, offs, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_block(q, k, v, offs, causal, block_q, block_k, interpret,
+                 softcap=0.0):
+    out, _ = _flash_block_fwd(q, k, v, offs, causal, block_q, block_k,
+                              interpret, softcap=softcap)
     return out
 
 
 def _flash_block_fwd(q, k, v, offs, causal, block_q, block_k, interpret,
-                     window=0):
+                     softcap=0.0, window=0):
     group = q.shape[2] // k.shape[2]
     scale = float(1.0 / (q.shape[3] ** 0.5))
     q_t = q.transpose(0, 2, 1, 3)
     k_t = k.transpose(0, 2, 1, 3)
     v_t = v.transpose(0, 2, 1, 3)
     out_t, lse = _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group,
-                           interpret, scale, offsets=offs, window=window)
+                           interpret, scale, offsets=offs, window=window,
+                           softcap=softcap)
     out = (out_t.transpose(0, 2, 1, 3), lse[..., 0].transpose(0, 2, 1))
     return out, (q_t, k_t, v_t, out_t, lse, offs)
 
 
-def _flash_block_bwd(causal, block_q, block_k, interpret, res, cts, window=0):
+def _flash_block_bwd(causal, block_q, block_k, interpret, softcap, res, cts,
+                     window=0):
     import numpy as _np
 
     q_t, k_t, v_t, out_t, lse, offs = res
@@ -504,6 +529,7 @@ def _flash_block_bwd(causal, block_q, block_k, interpret, res, cts, window=0):
     dq, dk_h, dv_h = _bwd_call(
         q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k, group,
         interpret, scale, offsets=offs, dlse=dlse, window=window,
+        softcap=softcap,
     )
     dk, dv = _group_kv_grads(dk_h, dv_h, KV, group)
     return (
@@ -527,13 +553,16 @@ def flash_block_attention(
     block_q: int = DEFAULT_BLOCK,
     block_k: int = DEFAULT_BLOCK,
     interpret: bool = False,
+    softcap: float = 0.0,
 ) -> tuple[jax.Array, jax.Array]:
     """One block-pair's partial attention for ring attention: returns
     ``(out, lse)`` where ``out`` is softmax-normalized WITHIN the block and
     ``lse [B, S_q, H]`` is its log-sum-exp — exactly what the ring's running
     (m, l, acc) merge needs to combine blocks across ``ppermute`` steps.
     Differentiable (custom_vjp recomputes blockwise; the lse cotangent joins
-    the ds bracket), so the fused sp path trains."""
+    the ds bracket), so the fused sp path trains. ``softcap`` applies the
+    Gemma-2 logit cap inside each block (elementwise pre-softmax, so the
+    cross-block lse merge is unaffected)."""
     assert q.shape[3] == k.shape[3] and q.shape[2] % k.shape[2] == 0, (
         q.shape, k.shape)
     bq = pick_block(q.shape[1], block_q)
@@ -541,10 +570,10 @@ def flash_block_attention(
     if bq is None or bk is None:
         raise ValueError(f"no valid flash block for Sq={q.shape[1]}, Sk={k.shape[1]}")
     offs = jnp.stack([jnp.int32(q_offset), jnp.int32(k_offset)])
-    return _flash_block(q, k, v, offs, causal, bq, bk, interpret)
+    return _flash_block(q, k, v, offs, causal, bq, bk, interpret, softcap)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret", "window"))
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret", "window", "softcap"))
 def pallas_flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -555,6 +584,7 @@ def pallas_flash_attention(
     block_k: int = DEFAULT_BLOCK,
     interpret: bool = False,
     window: int = 0,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """q [B, Sq, H, D]; k/v [B, Sk, KV, D], H % KV == 0. Self-attention only
     (``q_offset`` unsupported here — callers fall back to the reference).
@@ -562,7 +592,9 @@ def pallas_flash_attention(
     saved logsumexp, so training never materializes [Sq, Sk].
     ``window > 0`` applies the sliding-window band (requires ``causal``);
     out-of-band blocks are skipped in forward AND backward, so Mistral-style
-    long-sequence attention costs O(S·window), not O(S²)."""
+    long-sequence attention costs O(S·window), not O(S²). ``softcap > 0``
+    applies the Gemma-2 logit cap (forward and both backward kernels model
+    the tanh, so softcap configs train on the flash path too)."""
     if q_offset is not None:
         raise ValueError("pallas_flash_attention is for self-attention (q_offset=None)")
     if window > 0 and not causal:
@@ -577,4 +609,4 @@ def pallas_flash_attention(
             f"no valid flash block for Sq={Sq}, Sk={Sk} (need a divisor ≥128, "
             "multiple of 8); use reference_attention"
         )
-    return _flash(q, k, v, causal, block_q, block_k, interpret, window)
+    return _flash(q, k, v, causal, block_q, block_k, interpret, window, softcap)

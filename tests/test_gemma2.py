@@ -157,6 +157,25 @@ def test_sharded_train_step_with_post_norms(model):
     assert np.isfinite(float(loss))
 
 
+def test_softcap_runs_flash_and_best_attention(model):
+    # The softcap no longer pins the reference path: flash_attention and
+    # the best_attention alias (the documented framework default) both
+    # carry the cap — on CPU they dispatch to the reference internally,
+    # and all three must agree exactly.
+    from kata_xpu_device_plugin_tpu.ops.attention import (
+        best_attention,
+        flash_attention,
+        reference_attention,
+    )
+
+    cfg, params = model
+    toks = jax.random.randint(jax.random.PRNGKey(3), (1, 16), 0, cfg.vocab_size)
+    ref = forward(params, toks, cfg, attn_fn=reference_attention)
+    for fn in (flash_attention, best_attention):
+        out = forward(params, toks, cfg, attn_fn=fn)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 def test_softcap_rejects_custom_attn_fn(model):
     cfg, params = model
     toks = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0, cfg.vocab_size)

@@ -24,6 +24,48 @@ def test_flash_matches_reference(kv_heads, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("window", [0, 96])
+def test_flash_softcap_matches_reference_fwd_and_grads(window):
+    """Gemma-2 logit softcap on the flash path: forward AND q/k/v gradients
+    must match the reference's cap (the backward kernels model the 1−tanh²
+    factor), including combined with the sliding-window band."""
+    B, S, H, D, cap = 1, 256, 2, 64, 4.0  # small cap so tanh bites hard
+    keys = jax.random.split(jax.random.PRNGKey(9), 4)
+    q = jax.random.normal(keys[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(keys[1], (B, S, 1, D), jnp.float32)
+    v = jax.random.normal(keys[2], (B, S, 1, D), jnp.float32)
+    dout = jax.random.normal(keys[3], q.shape, jnp.float32)
+
+    out = pallas_flash_attention(
+        q, k, v, causal=True, block_q=128, block_k=128, interpret=True,
+        window=window, softcap=cap,
+    )
+    ref = reference_attention(q, k, v, causal=True, window=window,
+                              logits_softcap=cap)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+    def f_flash(q, k, v):
+        o = pallas_flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=128, interpret=True,
+            window=window, softcap=cap,
+        )
+        return jnp.sum(o * dout)
+
+    def f_ref(q, k, v):
+        o = reference_attention(q, k, v, causal=True, window=window,
+                                logits_softcap=cap)
+        return jnp.sum(o * dout)
+
+    gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+            err_msg=f"d{name} (window={window})"
+        )
+
+
 def test_flash_rejects_offset():
     q = jnp.zeros((1, 128, 2, 64))
     with pytest.raises(ValueError):
@@ -163,7 +205,10 @@ def test_training_through_flash_attention():
     cfg = tiny_test_config(n_layers=1, n_heads=2, n_kv_heads=1, head_dim=64,
                            d_ff=64, dtype=jnp.float32)
     params = init_params(jax.random.PRNGKey(0), cfg)
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 257), 0, cfg.vocab_size)
+    # next_token_loss forwards the FULL sequence (last logit dropped), so a
+    # flash-tileable length is passed directly — under the old sliced-input
+    # formulation a power-of-2 batch would silently lose flash eligibility.
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 256), 0, cfg.vocab_size)
 
     flash = partial(pallas_flash_attention, block_q=128, block_k=128, interpret=True)
     lf, gf = jax.value_and_grad(

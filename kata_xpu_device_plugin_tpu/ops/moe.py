@@ -191,18 +191,33 @@ def _dispatch(tokens, top_e, gates, E: int, capacity: int):
     return expert_in, slot, sorted_tok, weight, counts
 
 
+def _expert_einsum(subs: str, x: jax.Array, w) -> jax.Array:
+    """Expert-major ``einsum(subs, x, w)`` that also streams int8
+    :class:`..quant.QTensor` weights: the dot runs on the int8 payload cast
+    to the activation dtype (the cast fuses into the weight read, so HBM
+    traffic is the int8 bytes) and the per-expert fp32 scale — one per
+    output channel, ``[E, 1, out]`` — multiplies the einsum RESULT, exactly
+    the post-dot form ``quant.weight_matmul`` uses for dense layers."""
+    from .quant import QTensor
+
+    if isinstance(w, QTensor):
+        y = jnp.einsum(
+            subs, x, w.q.astype(x.dtype), preferred_element_type=jnp.float32
+        )
+        return (y * w.scale.astype(jnp.float32)).astype(x.dtype)
+    return jnp.einsum(subs, x, w.astype(x.dtype))
+
+
 def _expert_mlp(params: Params, expert_in: jax.Array) -> jax.Array:
     """[E, C, d] → [E, C, d] silu-gated MLP, expert-major. Weights cast to
     the activation dtype (bf16-compute/fp32-params convention of the dense
     FFN path — and the sharded variant's return all_to_all must carry bf16
-    buffers, not fp32-promoted ones)."""
-    wg = params["w_gate"].astype(expert_in.dtype)
-    wi = params["w_in"].astype(expert_in.dtype)
-    wo = params["w_out"].astype(expert_in.dtype)
-    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, wg)) * (
-        jnp.einsum("ecd,edf->ecf", expert_in, wi)
-    )
-    return jnp.einsum("ecf,efd->ecd", h, wo)
+    buffers, not fp32-promoted ones); int8 QTensor experts stream their
+    int8 payload with post-dot per-expert scales (``_expert_einsum``)."""
+    h = jax.nn.silu(
+        _expert_einsum("ecd,edf->ecf", expert_in, params["w_gate"])
+    ) * _expert_einsum("ecd,edf->ecf", expert_in, params["w_in"])
+    return _expert_einsum("ecf,efd->ecd", h, params["w_out"])
 
 
 def _combine(expert_out, slot, sorted_tok, weight, T: int, d: int) -> jax.Array:
@@ -217,14 +232,65 @@ def _combine(expert_out, slot, sorted_tok, weight, T: int, d: int) -> jax.Array:
     )
 
 
+def _assign_token_axes(lead, axes, mesh: Mesh, expert_axis: str):
+    """Statically place each mesh axis on the batch or sequence dim of a
+    [B, S, d] activation so the shard_map token sharding MATCHES the layout
+    the surrounding ops already use: data-ish axes prefer the batch dim
+    (that's ``parallel.sharding.batch_spec``'s batch placement), while the
+    seq axis and the expert axis prefer the sequence dim (seq because the
+    activations are already S-sharded there; the expert axis because
+    splitting S is a local dynamic-slice, not a cross-dim reshuffle).
+    Falls back to the other dim when sizes don't divide; returns
+    ``(b_axes, s_axes)`` or ``None`` when no placement covers every axis —
+    misaligned boundaries are exactly what makes SPMD fall back to
+    involuntary full rematerialization in the grad path.
+    """
+    try:  # lazy: ops must not import parallel at module load (cycle)
+        from ..parallel.mesh import AXIS_SEQ
+    except ImportError:  # pragma: no cover
+        AXIS_SEQ = "seq"
+
+    b_rem, s_rem = lead
+    b_axes, s_axes = [], []
+    for a in axes:
+        n = mesh.shape[a]
+        if n == 1:
+            continue  # size-1 axes shard nothing — leave them off the spec
+        prefer_s = a == expert_axis or a == AXIS_SEQ
+        choices = ("s", "b") if prefer_s else ("b", "s")
+        for dim in choices:
+            if dim == "b" and b_rem % n == 0:
+                b_axes.append(a)
+                b_rem //= n
+                break
+            if dim == "s" and s_rem % n == 0:
+                s_axes.append(a)
+                s_rem //= n
+                break
+        else:
+            return None
+    return tuple(b_axes), tuple(s_axes)
+
+
 def dispatch_shardable(
-    n_tokens: int, num_experts: int, mesh: Mesh, expert_axis: Optional[str] = None
+    tokens_shape, num_experts: int, mesh: Mesh, expert_axis: Optional[str] = None
 ) -> bool:
     """Whether :func:`moe_ffn_sharded`'s divisibility constraints hold for
-    this token count/mesh (trace-time static)."""
+    this token count/mesh (trace-time static). ``tokens_shape`` is the
+    activation's leading shape ``(B, S)`` — the layout-aligned check — or a
+    flat token count (legacy flattened dispatch)."""
     expert_axis = expert_axis or expert_axis_for(mesh)
+    if num_experts % mesh.shape[expert_axis]:
+        return False
+    all_axes = tuple(a for a in mesh.axis_names if a != expert_axis) + (
+        expert_axis,
+    )
+    if isinstance(tokens_shape, (tuple, list)):
+        return _assign_token_axes(
+            tuple(tokens_shape), all_axes, mesh, expert_axis
+        ) is not None
     n_total = math.prod(mesh.shape[a] for a in mesh.axis_names)
-    return n_tokens % n_total == 0 and num_experts % mesh.shape[expert_axis] == 0
+    return tokens_shape % n_total == 0
 
 
 def moe_ffn_sharded(
@@ -262,17 +328,47 @@ def moe_ffn_sharded(
     ep = mesh.shape[expert_axis]
 
     orig_shape = x.shape
-    tokens = x.reshape(-1, cfg.d_model)
-    T, E, K = tokens.shape[0], cfg.num_experts, cfg.top_k
-    if T % n_total:
-        raise ValueError(f"token count {T} not divisible by mesh size {n_total}")
+    T = math.prod(orig_shape[:-1])
+    E, K = cfg.num_experts, cfg.top_k
     if E % ep:
         raise ValueError(f"{E} experts not divisible by {expert_axis}={ep}")
+    # [B, S, d] activations keep their 2-D token layout at the shard_map
+    # boundary (batch axes on B, seq/expert axes on S — _assign_token_axes)
+    # so entering/leaving the dispatch never crosses dims; a flattened
+    # [T, d] input falls back to sharding T over every axis.
+    placement = (
+        _assign_token_axes(orig_shape[:2], all_axes, mesh, expert_axis)
+        if x.ndim == 3 else None
+    )
+    if x.ndim == 3 and placement is None and T % n_total == 0:
+        # (B, S) has no aligned per-dim placement but the flat count still
+        # divides: fall back to the legacy flattened layout (correct, just
+        # pays the cross-dim reshard) — callers pre-checking with a flat
+        # dispatch_shardable(int) count must keep working.
+        x = x.reshape(-1, cfg.d_model)
+    if x.ndim == 3 and placement is None:
+        raise ValueError(
+            f"tokens {orig_shape[:-1]} not divisible by mesh size {n_total}"
+        )
+    if placement is not None:
+        b_axes, s_axes = placement
+        tokens = x
+        tok_spec = P(b_axes or None, s_axes or None, None)
+    else:
+        tokens = x.reshape(-1, cfg.d_model)
+        if T % n_total:
+            raise ValueError(
+                f"token count {T} not divisible by mesh size {n_total}"
+            )
+        tok_spec = P(all_axes, None)
     t_loc = T // n_total
     capacity = max(1, math.ceil(t_loc * K / E * cfg.capacity_factor))
 
     def per_device(router, w_gate, w_in, w_out, tok_blk):
-        # tok_blk [T_loc, d]; w_* [E_loc, ...] local expert shard.
+        # tok_blk [T_loc, d] (or [B_loc, S_loc, d] in the aligned layout);
+        # w_* [E_loc, ...] local expert shard.
+        blk_shape = tok_blk.shape
+        tok_blk = tok_blk.reshape(-1, cfg.d_model)
         gates, top_e, probs = _route({"router": router}, tok_blk, cfg)
         expert_in, slot, sorted_tok, weight, counts = _dispatch(
             tok_blk, top_e, gates, E, capacity
@@ -297,7 +393,7 @@ def moe_ffn_sharded(
         frac_routed = counts_g.astype(jnp.float32) / total
         mean_prob = probs_g / T
         aux = E * jnp.sum(frac_routed * mean_prob)
-        return y, aux
+        return y.reshape(blk_shape), aux
 
     mapped = shard_map(
         per_device,
@@ -305,9 +401,9 @@ def moe_ffn_sharded(
         in_specs=(
             P(),  # router replicated
             P(expert_axis), P(expert_axis), P(expert_axis),  # expert-major
-            P(all_axes),  # tokens sharded over every axis
+            tok_spec,
         ),
-        out_specs=(P(all_axes), P()),
+        out_specs=(tok_spec, P()),
         check_vma=False,  # aux is psum-replicated; weights invariant over token axes
     )
     y, aux = mapped(

@@ -13,9 +13,11 @@ when ``s`` varies only over the output axis — so the weights are streamed
 from HBM as int8 and cast to bf16 on the fly inside the fused matmul; the
 fp32 scale multiply touches only the tiny ``[B, 1, out]`` activation.
 
-Scope: inference only, dense layers (the norms, embedding, and MoE experts
-stay in their original dtype; the tied unembedding is the embedding and is
-left bf16 so logit quality is unaffected). Quantize AFTER
+Scope: inference only, the layer weight stacks — dense matrices AND MoE
+expert stacks (per-expert scales; the router stays fp so routing is
+untouched). The norms and embedding keep their original dtype; the tied
+unembedding is the embedding and is left bf16 so logit quality is
+unaffected. Quantize AFTER
 :func:`..models.transformer.fuse_decoder_params` — fusing concatenates raw
 weight matrices.
 
@@ -29,12 +31,14 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-# Layer-dict keys eligible for weight-only quantization: 2-D matmul operands
-# streamed every decode step. Norm scales are 1-D (and numerically load-
-# bearing); MoE expert tensors route through ops.moe's einsums which have
-# their own sharding story — both stay unquantized.
+# Layer-dict keys eligible for weight-only quantization: matmul/einsum
+# weight operands streamed every decode step. MoE expert stacks quantize
+# with per-expert, per-output-channel scales ([L, E, 1, out] — the default
+# axis=-2 reduction); the tiny router stays fp so top-k routing decisions
+# are untouched by quantization error. Norm scales are 1-D (and numerically
+# load-bearing) — never quantized.
 QUANTIZABLE = ("wqkv", "wq", "wk", "wv", "wo", "w_gateup", "w_gate", "w_up",
-               "w_down")
+               "w_down", "moe_w_gate", "moe_w_in", "moe_w_out")
 
 
 class QTensor(NamedTuple):
@@ -59,13 +63,38 @@ def dequantize(qt: QTensor, dtype=jnp.float32) -> jax.Array:
     return (qt.q.astype(jnp.float32) * qt.scale).astype(dtype)
 
 
+def w8a8_enabled() -> bool:
+    """Opt-in int8×int8 decode dots (``KATA_TPU_W8A8=1``): activations
+    quantize per-vector on the fly and the dot runs int8×int8→int32 on the
+    MXU's int8 mode, removing the int8→bf16 weight-convert from the
+    streamed path (VERDICT r3: the convert tax is ~10 points of the int8
+    roofline). Costs activation-quantization error — measure quality per
+    model before enabling in production."""
+    import os
+
+    return os.environ.get("KATA_TPU_W8A8", "") == "1"
+
+
 def weight_matmul(x: jax.Array, w: Any) -> jax.Array:
     """The one ``activation @ weight`` used by the decoder layer: a plain
     cast-to-activation-dtype matmul for arrays; for :class:`QTensor` the
     int8-streaming form ``(x @ q) * scale`` — the int8→bf16 cast fuses into
-    the matmul's weight read, so HBM traffic is the int8 bytes; for
-    :class:`.lora.LoRAWeight` the frozen-base-plus-low-rank-delta form."""
+    the matmul's weight read, so HBM traffic is the int8 bytes (or, under
+    :func:`w8a8_enabled`, a full int8×int8 dot with both scales applied
+    post-hoc); for :class:`.lora.LoRAWeight` the
+    frozen-base-plus-low-rank-delta form."""
     if isinstance(w, QTensor):
+        if w8a8_enabled():
+            xq = quantize(x, axis=-1)  # per-vector activation scales
+            y = jax.lax.dot_general(
+                xq.q, w.q,
+                (((xq.q.ndim - 1,), (w.q.ndim - 2,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            # x-scale broadcasts over the out axis, w-scale over the rows.
+            return (
+                y.astype(jnp.float32) * xq.scale * w.scale[..., 0, :]
+            ).astype(x.dtype)
         y = jnp.matmul(
             x, w.q.astype(x.dtype), preferred_element_type=jnp.float32
         )

@@ -75,18 +75,20 @@ def _write_slot(arena, slot_caches, slot: jax.Array):
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "do_sample", "top_k",
-                                   "top_p"),
+                                   "top_p", "ring"),
          donate_argnums=(1,))
 def _serve_decode(params, caches, tok, pos, cfg, steps: int, do_sample: bool,
-                  top_k: int, temperature, key, top_p: float = 0.0):
+                  top_k: int, temperature, key, top_p: float = 0.0,
+                  ring: bool = False):
     """The server's one decode executable: a fixed-``steps`` ragged chunk
     with the KV arena DONATED — without donation XLA must copy both
     [L, B, max_len, KV, D] arena tensors every chunk (the first in-scan
     cache write would otherwise alias a live buffer), pure HBM traffic
-    charged against the bandwidth decode is bound by."""
+    charged against the bandwidth decode is bound by. ``ring``: the arena
+    is a per-slot ring buffer (see ``GenerationServer(ring_kv=True)``)."""
     return _decode_scan(params, caches, tok, pos, cfg, steps, None,
                         do_sample, top_k, temperature, key,
-                        return_state=True, top_p=top_p)
+                        return_state=True, top_p=top_p, ring=ring)
 
 
 class GenerationServer:
@@ -105,7 +107,7 @@ class GenerationServer:
                  chunk: int = 8, temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, seed: int = 0, mesh: Any = None,
                  kv_quant: bool = False, prefill_buckets: tuple = (),
-                 speculative_k: int = 0):
+                 speculative_k: int = 0, ring_kv: bool = False):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
@@ -115,6 +117,27 @@ class GenerationServer:
                 "speculative serving is greedy-only (lossless acceptance "
                 "compares against the argmax token) — set temperature=0"
             )
+        if ring_kv:
+            # Per-slot ring arena: each slot wraps at its OWN position
+            # (slot = pos[b] % window), so ragged continuous batching keeps
+            # KV memory at O(window) per slot regardless of stream length.
+            if cfg.sliding_window <= 0:
+                raise ValueError(
+                    "ring_kv needs a sliding-window config "
+                    "(cfg.sliding_window > 0)"
+                )
+            if cfg.attn_windows:
+                raise ValueError(
+                    "ring_kv applies ONE uniform window; per-layer "
+                    "attn_windows cycles include global layers that need "
+                    "the full-length arena"
+                )
+            if speculative_k:
+                raise ValueError(
+                    "ring_kv serving is chunked-decode only: speculative "
+                    "verification writes multi-token spans, whose ring "
+                    "overwrites would hide window keys from earlier drafts"
+                )
         self.speculative_k = speculative_k
         if any(b < 1 or b > max_len for b in prefill_buckets):
             raise ValueError(
@@ -133,8 +156,11 @@ class GenerationServer:
         )
         # kv_quant: int8 arena — ~2× less HBM per slot-token, so the same
         # chip serves ~2× the context/slots (per-vector scales; decode
-        # dequant fuses into the attention dots).
-        self.arena = init_kv_caches(cfg, max_batch, max_len, quantized=kv_quant)
+        # dequant fuses into the attention dots). ring_kv: the arena holds
+        # ``sliding_window`` slots per sequence instead of max_len.
+        self.ring_kv = ring_kv
+        arena_len = cfg.sliding_window if ring_kv else max_len
+        self.arena = init_kv_caches(cfg, max_batch, arena_len, quantized=kv_quant)
         if mesh is not None:
             self._shard_over(mesh)
         # Host-side slot state: which request occupies each slot, its
@@ -154,33 +180,21 @@ class GenerationServer:
         self._drafts_accepted = 0
 
     def _shard_over(self, mesh) -> None:
-        """Tensor-parallel serving: place params by PARAM_RULES (wide dims
-        over the model axis — GSPMD inserts the tp collectives inside the
-        same jitted prefill/decode executables) and shard the KV arena's
-        head axis over model when the head count divides; otherwise the
-        arena replicates (correct, memory-heavier). Needs the TRAINING
-        param layout (separate wq/wk/wv): the fused/int8 layouts are
-        single-device micro-optimizations with no sharding rules."""
+        """Tensor-parallel serving: place params by their layout-aware
+        PartitionSpecs (``parallel.sharding.param_specs`` — wide dims over
+        the model axis; GSPMD inserts the tp collectives inside the same
+        jitted prefill/decode executables) and shard the KV arena's head
+        axis over model when the head count divides; otherwise the arena
+        replicates (correct, memory-heavier). All serving layouts shard:
+        the training layout, fused wqkv/w_gateup, int8 QTensors (q and
+        scale consistently), and live LoRA adapters — so the production
+        shape (tp × fused × int8) runs on a slice without merging."""
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
-        from ..ops.quant import QTensor
         from ..parallel.mesh import AXIS_MODEL
         from ..parallel.sharding import shard_params
 
-        layers = self.params.get("layers", {})
-        if any(isinstance(v, QTensor) for v in layers.values()):
-            raise ValueError("mesh serving needs unquantized params")
-        if any(isinstance(v, tuple) for v in layers.values()):
-            raise ValueError(
-                "mesh serving has no sharding rules for wrapped weights "
-                "(LoRA adapters) — merge_lora first"
-            )
-        if "wqkv" in layers:
-            raise ValueError(
-                "mesh serving needs the unfused param layout (PARAM_RULES "
-                "has no rule for the concatenated wqkv/w_gateup tensors)"
-            )
         self.params = shard_params(self.params, mesh)
         tp = mesh.shape.get(AXIS_MODEL, 1)
         kv_spec = (
@@ -256,11 +270,21 @@ class GenerationServer:
         bucket = next((k for k in self.prefill_buckets if k >= true_len), None)
         if bucket is not None and bucket > true_len:
             prompt = np.pad(prompt, (0, bucket - true_len))
+        # ring_kv: prefill into a transient prompt-length cache, then fold
+        # the live window into the slot's ring (slot s ← the latest
+        # position ≡ s mod W) — the arena itself never grows past W.
+        cache_len = len(prompt) if self.ring_kv else self.max_len
         caches, last_logits, pos = prefill(
             self.params, jnp.asarray(prompt)[None, :], self.cfg,
-            self.max_len, return_logits=True, kv_quantized=self.kv_quant,
+            cache_len, return_logits=True, kv_quantized=self.kv_quant,
             true_len=jnp.int32(true_len) if bucket is not None else None,
         )
+        if self.ring_kv:
+            from ..models.transformer import ring_caches_from_prefill
+
+            caches = ring_caches_from_prefill(
+                caches, pos, self.cfg.sliding_window
+            )
         first = self._sample_first(last_logits)
         req.out.append(first)
         self._prefills += 1
@@ -288,7 +312,11 @@ class GenerationServer:
         """One scheduler round: refill free slots, then one decode chunk.
         Returns False when queue and slots are both empty."""
         for b in range(self.max_batch):
-            if self._slot_req[b] is None and self._queue:
+            # Loop, don't just check once: a request can finish during its
+            # own prefill (eos or a 1-token budget on the first token), and
+            # the freed slot should be re-offered to the queue immediately
+            # rather than idling for a whole decode chunk.
+            while self._slot_req[b] is None and self._queue:
                 self._fill_slot(b, self._queue.pop(0))
         active = [b for b in range(self.max_batch) if self._slot_req[b] is not None]
         if not active:
@@ -309,6 +337,7 @@ class GenerationServer:
             self.params, self.arena, jnp.asarray(self._last),
             jnp.asarray(self._pos), self.cfg, self.chunk, self._do_sample,
             self.top_k, jnp.float32(self.temperature), sub, top_p=self.top_p,
+            ring=self.ring_kv,
         )
         toks = np.asarray(toks)  # [max_batch, chunk]
         self.arena = caches

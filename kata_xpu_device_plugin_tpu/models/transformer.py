@@ -345,22 +345,31 @@ def _layer(
     # signature for window-free configs.
     wkw = {"window": eff_window} if eff_window else {}
     if cfg.attn_logits_softcap:
-        # Capped attention logits (Gemma-2): the tanh lives only in the
-        # XLA reference (the flash kernels' blockwise backward does not
-        # model it), so softcap configs pin the reference path. Custom
-        # attn_fns (ring/ulysses sp) would be silently bypassed — refuse.
-        from ..ops.attention import flash_attention, reference_attention
+        # Capped attention logits (Gemma-2): both the XLA reference and
+        # the pallas flash kernels (forward + backward) model the tanh, so
+        # softcap configs keep the fast path — the dispatchers take it as
+        # a kwarg. Custom attn_fns (ring/ulysses sp wrappers) that do not
+        # declare the kwarg would silently skip the cap — refuse those.
+        import inspect
 
-        if attn_fn not in (reference_attention, flash_attention):
-            raise ValueError(
-                "attn_logits_softcap pins the XLA reference attention; a "
-                "custom attn_fn (e.g. ring/ulysses sequence parallelism) "
-                "would be silently ignored — unset the softcap or drop "
-                "the custom attention"
-            )
-        attn_fn = partial(
-            reference_attention, logits_softcap=cfg.attn_logits_softcap
+        from ..ops.attention import (
+            best_attention,
+            flash_attention,
+            reference_attention,
         )
+
+        if attn_fn not in (reference_attention, flash_attention, best_attention):
+            try:
+                accepts = "logits_softcap" in inspect.signature(attn_fn).parameters
+            except (TypeError, ValueError):
+                accepts = False
+            if not accepts:
+                raise ValueError(
+                    "attn_logits_softcap needs an attention fn that models "
+                    "the cap; this custom attn_fn does not take "
+                    "logits_softcap, so the cap would be silently ignored"
+                )
+        attn_fn = partial(attn_fn, logits_softcap=cfg.attn_logits_softcap)
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     if "wqkv" in layer:
         # Fused projection (see fuse_decoder_params): one matmul streams the
@@ -393,12 +402,14 @@ def _layer(
         attn_out = attn_fn(q, k, v, causal=True, q_offset=None, **wkw)
         new_cache = (ck, cv)
     elif kv_cache is not None and ring:
-        # Ring decode (lockstep scalar position): the cache holds exactly
-        # the live window, written at slot pos % W; attention consumes the
-        # slots' ABSOLUTE positions (ring_positions) so the causal/validity
-        # mask is position-exact even though slots are stored out of order.
-        # Memory and per-step cache traffic are O(window), not O(max_len).
-        assert jnp.ndim(cache_offset) == 0, "ring cache is lockstep-only"
+        # Ring decode: the cache holds exactly the live window, written at
+        # slot pos % W; attention consumes the slots' ABSOLUTE positions
+        # (ring_positions) so the causal/validity mask is position-exact
+        # even though slots are stored out of order. Memory and per-step
+        # cache traffic are O(window), not O(max_len). ``cache_offset``
+        # may be a lockstep scalar (generate) or a [B] vector of per-slot
+        # positions — continuous batching with ragged requests keeps the
+        # same O(window) arena, each row wrapping independently.
         assert S == 1, "ring cache writes are decode-only (S == 1)"
         from ..ops.attention import reference_attention as _ref_attn
 
@@ -410,12 +421,22 @@ def _layer(
             "the attention span"
         )
         slot = cache_offset % W
-        ck = _cache_write_full(ck, k, slot)
-        cv = _cache_write_full(cv, v, slot)
+        if jnp.ndim(cache_offset) == 0:
+            ck = _cache_write_full(ck, k, slot)
+            cv = _cache_write_full(cv, v, slot)
+            k_pos = ring_positions(cache_offset, W)  # [W]
+        else:
+            # Ragged: row b writes its single k/v at its own slot. S == 1
+            # means the clamp inside _cache_write_rows never engages
+            # (slot < W), so this is a pure modulo write.
+            rows = jnp.arange(B)
+            ck = _cache_write_rows(ck, k, rows, slot)
+            cv = _cache_write_rows(cv, v, rows, slot)
+            k_pos = ring_positions(cache_offset[:, None], W)  # [B, W]
         attn_out = _ref_attn(
             q, dequantize_kv(ck, x.dtype), dequantize_kv(cv, x.dtype),
             causal=True, q_offset=cache_offset,
-            k_positions=ring_positions(cache_offset, W),
+            k_positions=k_pos,
             logits_softcap=cfg.attn_logits_softcap,
         )
         new_cache = (ck, cv)
@@ -468,9 +489,8 @@ def _layer(
 
         moe_params = {"router": layer["router"], "w_gate": layer["moe_w_gate"],
                       "w_in": layer["moe_w_in"], "w_out": layer["moe_w_out"]}
-        n_tokens = h.shape[0] * h.shape[1]
         if moe_mesh is not None and moe_mod.dispatch_shardable(
-            n_tokens, cfg.moe_num_experts, moe_mesh
+            h.shape[:2], cfg.moe_num_experts, moe_mesh
         ):
             # Data-sharded dispatch: sort/scatter run per token shard and
             # the all-to-all carries only capacity buffers over ICI.
@@ -632,13 +652,22 @@ def next_token_loss(params: Params, tokens: jax.Array, cfg: DecoderConfig,
                     remat: bool = False) -> jax.Array:
     """Mean cross-entropy of predicting tokens[:, 1:] from tokens[:, :-1],
     plus ``cfg.moe_aux_weight`` × the MoE load-balancing loss when the
-    config is MoE (the aux term is what keeps the router from collapsing)."""
+    config is MoE (the aux term is what keeps the router from collapsing).
+
+    The forward runs on the FULL sequence and the last position's logits
+    are dropped — the cross-entropy term is value-identical under causal
+    masking to slicing the inputs first, and the sequence length stays
+    unchanged so seq-sharded activations (ring attention over a mesh seq
+    axis) stay evenly divisible through the whole step. For MoE configs the
+    aux load-balancing term now also counts the last position's routing
+    (one more token in frac_routed/mean_prob) — a deliberate, slightly
+    different regularizer, not a changed objective."""
     logits, aux = forward(
-        params, tokens[:, :-1], cfg, attn_fn=attn_fn, moe_mesh=moe_mesh,
+        params, tokens, cfg, attn_fn=attn_fn, moe_mesh=moe_mesh,
         return_aux=True, remat=remat,
     )
     targets = tokens[:, 1:]
-    loss = token_nll_sum(logits, targets) / targets.size
+    loss = token_nll_sum(logits[:, :-1], targets) / targets.size
     if cfg.moe:
         loss = loss + cfg.moe_aux_weight * aux
     return loss

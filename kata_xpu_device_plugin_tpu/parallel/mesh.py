@@ -50,10 +50,17 @@ def default_mesh_shape(n_devices: int) -> dict[str, int]:
 def build_mesh(
     shape: Optional[dict[str, int]] = None,
     devices: Optional[Sequence[jax.Device]] = None,
-    axis_names: Sequence[str] = MESH_AXES,
+    axis_names: Optional[Sequence[str]] = None,
 ) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     shape = shape or default_mesh_shape(len(devices))
+    if axis_names is None:
+        # The seq axis joins the mesh when the shape asks for it, so
+        # sequence parallelism composes with dp/fsdp/tp on ONE mesh
+        # instead of living on a private 1-D mesh.
+        axis_names = MESH_AXES + (
+            (AXIS_SEQ,) if shape.get(AXIS_SEQ, 1) > 1 else ()
+        )
     dims = [shape.get(a, 1) for a in axis_names]
     if int(np.prod(dims)) != len(devices):
         raise ValueError(f"mesh shape {shape} does not cover {len(devices)} devices")

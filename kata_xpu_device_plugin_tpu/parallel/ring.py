@@ -138,6 +138,9 @@ def make_ring_attention(
     axis: str = AXIS_SEQ,
     use_flash: Optional[bool] = None,
     flash_interpret: bool = False,
+    batch_axes=None,
+    head_axis: Optional[str] = None,
+    kv_head_axis: Optional[str] = None,
 ):
     """Returns ``ring_attn(q, k, v)`` operating on GLOBAL [B, S, H, D] arrays
     sharded over ``axis`` in S. Drop-in for the attention seam when the model
@@ -145,13 +148,25 @@ def make_ring_attention(
 
     ``use_flash=None`` auto-engages the pallas block kernel per ring step on
     TPU when the local shard shapes support it (``flash_interpret`` forces
-    the interpret-mode kernel for CPU tests)."""
+    the interpret-mode kernel for CPU tests).
+
+    Composition with the training mesh (seq × dp/fsdp × tp on ONE mesh):
+    ``batch_axes`` shards the batch dim of q/k/v across the data axes and
+    ``head_axis``/``kv_head_axis`` keep the q/kv head dims on the tensor
+    axis — matching the shardings the surrounding GSPMD matmuls already
+    produce, so entering the shard_map inserts no gather. Only the ring
+    itself communicates (ppermute over ``axis``); the other axes just
+    partition the local block."""
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(None, axis, None, None),) * 3,
-        out_specs=P(None, axis, None, None),
+        in_specs=(
+            P(batch_axes, axis, head_axis, None),
+            P(batch_axes, axis, kv_head_axis, None),
+            P(batch_axes, axis, kv_head_axis, None),
+        ),
+        out_specs=P(batch_axes, axis, head_axis, None),
         check_vma=False,  # online-softmax carries start axis-invariant
     )
     def ring(q, k, v):

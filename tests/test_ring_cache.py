@@ -90,3 +90,63 @@ def test_ring_requires_window(model):
     prompt = jnp.zeros((1, 4), jnp.int32)
     with pytest.raises(ValueError, match="sliding-window"):
         generate(params, prompt, full_cfg, 4, ring_kv=True)
+
+
+@pytest.mark.parametrize("prompt_len,steps", [
+    (4, 14),   # ring warms up during decode, wraps past window=6
+    (11, 9),   # prompt longer than the local window: fold drops positions
+])
+def test_cycle_arena_gemma2_matches_full_cache(prompt_len, steps):
+    """Gemma-2's alternating local/global cycle under ring_kv: local layers
+    decode from a window-slot ring, global layers from a max_len arena —
+    tokens must equal the full-cache run exactly (the full cache's band
+    mask hides exactly what the ring dropped)."""
+    from kata_xpu_device_plugin_tpu.models import gemma2_test_config
+
+    cfg = gemma2_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(4), (2, prompt_len), 0, cfg.vocab_size
+    )
+    ref = np.asarray(generate(params, prompt, cfg, steps, max_len=64))
+    out = np.asarray(generate(params, prompt, cfg, steps, max_len=64,
+                              ring_kv=True))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_cycle_arena_degenerate_cycles():
+    from dataclasses import replace
+
+    from kata_xpu_device_plugin_tpu.models import gemma2_test_config
+
+    # Length-1 attn_windows cycle == a uniform window: forward runs P == 1
+    # (no cycle arena), so the fold must take the uniform-ring path.
+    cfg1 = replace(gemma2_test_config(dtype=jnp.float32), attn_windows=(6,))
+    p1 = init_params(jax.random.PRNGKey(7), cfg1, dtype=jnp.float32)
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (1, 5), 0, cfg1.vocab_size)
+    ref = np.asarray(generate(p1, prompt, cfg1, 12, max_len=32))
+    out = np.asarray(generate(p1, prompt, cfg1, 12, ring_kv=True))
+    np.testing.assert_array_equal(out, ref)
+
+    # All-windowed cycle (no global layers): every position is a ring, so
+    # decode is unbounded by max_len — steps far beyond it must work.
+    cfg2 = replace(gemma2_test_config(dtype=jnp.float32), attn_windows=(4, 8))
+    p2 = init_params(jax.random.PRNGKey(9), cfg2, dtype=jnp.float32)
+    ref2 = np.asarray(generate(p2, prompt, cfg2, 40, max_len=64))
+    out2 = np.asarray(generate(p2, prompt, cfg2, 40, max_len=16, ring_kv=True))
+    np.testing.assert_array_equal(out2, ref2)
+
+
+def test_cycle_arena_kv_quant_matches_quantized_full_cache():
+    # int8 KV caches ride the cycle arena too (QTensor leaves fold/pad
+    # through the same tree maps).
+    from kata_xpu_device_plugin_tpu.models import gemma2_test_config
+
+    cfg = gemma2_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(5), cfg, dtype=jnp.float32)
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (1, 7), 0, cfg.vocab_size)
+    ref = np.asarray(generate(params, prompt, cfg, 10, max_len=32,
+                              kv_quantized=True))
+    out = np.asarray(generate(params, prompt, cfg, 10, max_len=32,
+                              kv_quantized=True, ring_kv=True))
+    np.testing.assert_array_equal(out, ref)

@@ -4,10 +4,12 @@ The reference is host infrastructure and ships no serving stack (SURVEY §2:
 zero ML code); this is the guest-side capability its users actually run on
 the chips the plugin hands out. TPU-first design:
 
-- ONE fixed-shape KV arena ``[L, max_batch, max_len, KV, D]`` and one
-  compiled ragged-decode scan (``transformer.decode`` with [B] per-slot
-  positions) serve every request mix — no shape churn, no recompiles as
-  requests come and go.
+- A fixed-shape KV arena — ``[L, max_batch, max_len, KV, D]``, or with
+  ``ring_kv`` a per-slot ring of ``window`` slots (window cycles: a tuple
+  of per-position stacks, local layers at their window, global layers at
+  max_len) — and one compiled ragged-decode scan (``transformer.decode``
+  with [B] per-slot positions) serve every request mix — no shape churn,
+  no recompiles as requests come and go.
 - Admission is slot-based: a finished slot is refilled from the queue by
   prefilling the new prompt into fresh caches and writing them into the
   slot (one ``dynamic_update_slice``); all other slots keep decoding.
@@ -45,8 +47,11 @@ from ..models.transformer import (
     _decode_scan,
     _next_token,
     _sampling_args,
+    cycle_ring_caches_from_prefill,
+    init_cycle_kv_caches,
     init_kv_caches,
     prefill,
+    ring_caches_from_prefill,
 )
 
 
@@ -81,11 +86,12 @@ def _serve_decode(params, caches, tok, pos, cfg, steps: int, do_sample: bool,
                   top_k: int, temperature, key, top_p: float = 0.0,
                   ring: bool = False):
     """The server's one decode executable: a fixed-``steps`` ragged chunk
-    with the KV arena DONATED — without donation XLA must copy both
-    [L, B, max_len, KV, D] arena tensors every chunk (the first in-scan
-    cache write would otherwise alias a live buffer), pure HBM traffic
-    charged against the bandwidth decode is bound by. ``ring``: the arena
-    is a per-slot ring buffer (see ``GenerationServer(ring_kv=True)``)."""
+    with the KV arena DONATED — without donation XLA must copy every arena
+    tensor each chunk (the first in-scan cache write would otherwise alias
+    a live buffer), pure HBM traffic charged against the bandwidth decode
+    is bound by. ``ring``: the arena is a per-slot ring buffer — one
+    ``window``-slot pair, or the window-cycle tuple layout (see
+    ``GenerationServer(ring_kv=True)``)."""
     return _decode_scan(params, caches, tok, pos, cfg, steps, None,
                         do_sample, top_k, temperature, key,
                         return_state=True, top_p=top_p, ring=ring)
@@ -121,16 +127,13 @@ class GenerationServer:
             # Per-slot ring arena: each slot wraps at its OWN position
             # (slot = pos[b] % window), so ragged continuous batching keeps
             # KV memory at O(window) per slot regardless of stream length.
-            if cfg.sliding_window <= 0:
+            # Window CYCLES (Gemma-2) get the cycle arena: local layers
+            # ring at their window, global layers keep a max_len arena.
+            if not any(w > 0 for w in cfg.window_cycle):
                 raise ValueError(
                     "ring_kv needs a sliding-window config "
-                    "(cfg.sliding_window > 0)"
-                )
-            if cfg.attn_windows:
-                raise ValueError(
-                    "ring_kv applies ONE uniform window; per-layer "
-                    "attn_windows cycles include global layers that need "
-                    "the full-length arena"
+                    "(cfg.sliding_window > 0 or a windowed attn_windows "
+                    "cycle)"
                 )
             if speculative_k:
                 raise ValueError(
@@ -156,11 +159,19 @@ class GenerationServer:
         )
         # kv_quant: int8 arena — ~2× less HBM per slot-token, so the same
         # chip serves ~2× the context/slots (per-vector scales; decode
-        # dequant fuses into the attention dots). ring_kv: the arena holds
-        # ``sliding_window`` slots per sequence instead of max_len.
+        # dequant fuses into the attention dots). ring_kv: windowed layers
+        # hold ``window`` slots per sequence instead of max_len.
         self.ring_kv = ring_kv
-        arena_len = cfg.sliding_window if ring_kv else max_len
-        self.arena = init_kv_caches(cfg, max_batch, arena_len, quantized=kv_quant)
+        self._cycle = ring_kv and len(cfg.window_cycle) > 1
+        if self._cycle:
+            self.arena = init_cycle_kv_caches(
+                cfg, max_batch, max_len, quantized=kv_quant
+            )
+        else:
+            arena_len = cfg.window_cycle[0] if ring_kv else max_len
+            self.arena = init_kv_caches(
+                cfg, max_batch, arena_len, quantized=kv_quant
+            )
         if mesh is not None:
             self._shard_over(mesh)
         # Host-side slot state: which request occupies each slot, its
@@ -279,11 +290,13 @@ class GenerationServer:
             cache_len, return_logits=True, kv_quantized=self.kv_quant,
             true_len=jnp.int32(true_len) if bucket is not None else None,
         )
-        if self.ring_kv:
-            from ..models.transformer import ring_caches_from_prefill
-
+        if self._cycle:
+            caches = cycle_ring_caches_from_prefill(
+                caches, pos, self.cfg, self.max_len
+            )
+        elif self.ring_kv:
             caches = ring_caches_from_prefill(
-                caches, pos, self.cfg.sliding_window
+                caches, pos, self.cfg.window_cycle[0]
             )
         first = self._sample_first(last_logits)
         req.out.append(first)

@@ -748,14 +748,9 @@ def _sampling_args(temperature, top_k, key, top_p: float = 0.0):
     return do_sample, key if key is not None else jax.random.PRNGKey(0)
 
 
-def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
-                   dtype=None, quantized: bool = False):
-    """Stacked caches [L, B, max_len, n_kv_heads, head_dim].
-
-    ``quantized=True`` builds int8 :class:`QTensor` caches (per-vector fp32
-    scales, ~2× less HBM than bf16 — the long-context serving memory hog);
-    the cache write/read paths quantize/dequantize transparently."""
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+def _kv_stack(cfg: DecoderConfig, n_layers: int, batch: int, length: int,
+              dtype, quantized: bool):
+    shape = (n_layers, batch, length, cfg.n_kv_heads, cfg.head_dim)
     if quantized:
         def one():
             return QTensor(
@@ -764,8 +759,33 @@ def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
             )
 
         return one(), one()
-    dtype = dtype or cfg.dtype
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
+                   dtype=None, quantized: bool = False):
+    """Stacked caches [L, B, max_len, n_kv_heads, head_dim].
+
+    ``quantized=True`` builds int8 :class:`QTensor` caches (per-vector fp32
+    scales, ~2× less HBM than bf16 — the long-context serving memory hog);
+    the cache write/read paths quantize/dequantize transparently."""
+    return _kv_stack(cfg, cfg.n_layers, batch, max_len, dtype or cfg.dtype,
+                     quantized)
+
+
+def init_cycle_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
+                         dtype=None, quantized: bool = False):
+    """The CYCLE ARENA layout for mixed local/global window cycles: a tuple
+    over cycle positions, each a [L/P, B, len_i, KV, D] cache pair where
+    ``len_i`` is the position's window (local) or ``max_len`` (global) —
+    the decode-side counterpart of :func:`cycle_ring_caches_from_prefill`."""
+    cycle = cfg.window_cycle
+    P = len(cycle)
+    return tuple(
+        _kv_stack(cfg, cfg.n_layers // P, batch, w if w > 0 else max_len,
+                  dtype or cfg.dtype, quantized)
+        for w in cycle
+    )
 
 
 def ring_positions(pos: jax.Array, window: int) -> jax.Array:

@@ -183,6 +183,34 @@ def test_ring_kv_serving_matches_full_cache_arena():
         np.testing.assert_array_equal(o, r)
 
 
+def test_cycle_arena_serving_gemma2_matches_full_arena():
+    # Gemma-2's alternating local/global cycle under continuous batching:
+    # ring_kv builds the cycle arena (local layers at window slots, global
+    # layers at max_len) and must emit exactly the full-arena tokens.
+    from kata_xpu_device_plugin_tpu.models import gemma2_test_config
+
+    cfg = gemma2_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(14), cfg, dtype=jnp.float32)
+    prompts = _prompts(cfg, [4, 9, 6, 3], seed=31)
+    budgets = [15, 8, 12, 18]
+
+    def run(**kw):
+        srv = GenerationServer(params, cfg, max_batch=2, max_len=48,
+                               chunk=4, **kw)
+        rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+        res = srv.run()
+        return [res[r] for r in rids], srv
+
+    ref, _ = run()
+    out, srv = run(ring_kv=True)
+    # Local positions hold window slots, global positions max_len.
+    local, glob = srv.arena[0], srv.arena[1]
+    assert local[0].shape[2] == cfg.attn_windows[0]
+    assert glob[0].shape[2] == 48
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+
 def test_ring_kv_serving_rejects_bad_configs(model):
     from kata_xpu_device_plugin_tpu.models import mistral_test_config
 

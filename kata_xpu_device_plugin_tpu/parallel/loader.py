@@ -111,7 +111,7 @@ class TokenBatchLoader:
         if self.mesh is not None:
             import jax
 
-            from .sharding import BATCH_SPEC, shard_batch
+            from .sharding import batch_spec, shard_batch
 
             if self.host_count == 1:
                 return shard_batch(batch, self.mesh)
@@ -120,10 +120,12 @@ class TokenBatchLoader:
                 # assemble the global array from process-local data (a
                 # plain device_put of local rows would either fail on
                 # non-addressable devices or ship a 1/host_count batch).
+                # batch_spec is mesh-aware: a seq axis shards the sequence
+                # dim too, matching what the train step expects.
                 from jax.sharding import NamedSharding
 
                 return jax.make_array_from_process_local_data(
-                    NamedSharding(self.mesh, BATCH_SPEC), batch,
+                    NamedSharding(self.mesh, batch_spec(self.mesh)), batch,
                     global_shape=(self.batch, self.window),
                 )
             # host_count > 1 simulated inside one process (tests): the

@@ -43,10 +43,16 @@ measurement runs in a KILLABLE WORKER SUBPROCESS under a supervisor:
 Besides the headline bf16 number, the worker also measures int8 weight-only
 decode (ops/quant.py) — reported as ``int8_tok_per_s`` against its own
 actual-bytes roofline (``int8_vs_baseline``), so the quantized win shows up
-in absolute tok/s without muddying the bf16 round-over-round series — and
+in absolute tok/s without muddying the bf16 round-over-round series —
 continuous-batching serving throughput (guest/serving.py, 16 mixed-length
-requests through an 8-slot arena, ``serving_tok_per_s``). Both are
-crash-guarded side sections emitted AFTER the banked headline line.
+requests through an 8-slot arena, ``serving_tok_per_s``), and Gemma-2-style
+softcap prefill on the pallas flash path vs the XLA reference
+(``softcap_prefill_flash_speedup``). All three are crash-guarded side
+sections emitted AFTER the banked headline line, each with its own
+``KATA_TPU_BENCH_{INT8,SERVING,SOFTCAP}=0`` kill switch (the supervisor
+flips all of them off on retries and in the CPU fallback); the optional
+``KATA_TPU_BENCH_W8A8=1`` adds the int8×int8-dot decode variant inside the
+int8 section.
 
 Flags: --profile-dir DIR dumps a jax.profiler (xplane) trace of the measured
 decode runs. --smoke runs tiny shapes (harness validation, not the metric).
@@ -205,6 +211,7 @@ def supervise(args: argparse.Namespace) -> int:
             env["KATA_TPU_DECODE_KERNEL"] = "0"
             env["KATA_TPU_BENCH_INT8"] = "0"
             env["KATA_TPU_BENCH_SERVING"] = "0"
+            env["KATA_TPU_BENCH_SOFTCAP"] = "0"
         attempts += 1
         stage_timeout = SMOKE_TIMEOUT_S if args.smoke else ATTEMPT_TIMEOUT_S
         line, hung = run_once(
@@ -240,6 +247,7 @@ def supervise(args: argparse.Namespace) -> int:
         env["KATA_TPU_DECODE_KERNEL"] = "0"
         env["KATA_TPU_BENCH_INT8"] = "0"
         env["KATA_TPU_BENCH_SERVING"] = "0"
+        env["KATA_TPU_BENCH_SOFTCAP"] = "0"
         cmd = list(worker_cmd) + ["--smoke", "--fallback"]
         line, _hung = run_once(cmd, env, SMOKE_TIMEOUT_S, "cpu-fallback")
         if line is not None:
@@ -488,6 +496,44 @@ def worker(args: argparse.Namespace) -> None:
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"int8_error": f"{type(exc).__name__}: {exc}"[:200]}
 
+    def measure_softcap_prefill() -> dict:
+        # Gemma-2's attn-logit softcap on the pallas flash path (r4): same
+        # bench architecture with the cap enabled, flash vs XLA reference —
+        # the number that shows the softcap no longer forfeits the kernel.
+        # SIDE measurement: runs after the banked headline, crash-guarded,
+        # KATA_TPU_BENCH_SOFTCAP=0 disables.
+        if (
+            args.smoke
+            or not prefill_flash
+            or os.environ.get("KATA_TPU_BENCH_SOFTCAP", "1") == "0"
+        ):
+            return {}
+        try:
+            from dataclasses import replace as _replace
+
+            cfg_sc = _replace(cfg, attn_logits_softcap=50.0)
+            ref_s = time_prefill(
+                jax.jit(
+                    lambda p, t: forward(
+                        p, t, cfg_sc, attn_fn=reference_attention
+                    )[:, -1]
+                )
+            )
+            fl_s = time_prefill(
+                jax.jit(
+                    lambda p, t: forward(p, t, cfg_sc, attn_fn=flash_attention)[
+                        :, -1
+                    ]
+                )
+            )
+            return {
+                "softcap_prefill_flash_s": round(fl_s, 4),
+                "softcap_prefill_reference_s": round(ref_s, 4),
+                "softcap_prefill_flash_speedup": round(ref_s / fl_s, 3),
+            }
+        except Exception as exc:  # noqa: BLE001 — headline must survive
+            return {"softcap_error": f"{type(exc).__name__}: {exc}"[:200]}
+
     def measure_serving() -> dict:
         # Continuous-batching throughput (guest/serving.py): 16 mixed-length
         # requests through an 8-slot arena. A SIDE measurement with the same
@@ -579,6 +625,13 @@ def worker(args: argparse.Namespace) -> None:
     serving_out = measure_serving()
     if serving_out:
         out.update(serving_out)
+        print(json.dumps(out), flush=True)
+    # Softcap runs LAST: an overrun in the newest, most experimental
+    # section must cost only itself, never the established int8/serving
+    # round-over-round series.
+    softcap_out = measure_softcap_prefill()
+    if softcap_out:
+        out.update(softcap_out)
         print(json.dumps(out), flush=True)
 
 

@@ -153,12 +153,22 @@ def split_trainable(params: Any):
     return trainable, rebuild
 
 
-def make_lora_train_step(cfg, lr: float = 1e-4, attn_fn: Any = None):
-    """Single-device fine-tuning step over an adapted param tree: returns
+def make_lora_train_step(cfg, lr: float = 1e-4, attn_fn: Any = None,
+                         mesh: Any = None):
+    """Fine-tuning step over an adapted param tree: returns
     ``(init_state, step)`` like :func:`..parallel.sharding.make_train_step`
     but differentiating and optimizing ONLY the adapter leaves
     (:func:`split_trainable`); the frozen base never enters ``jax.grad``
-    — which is also what makes int8 QLoRA bases trainable-over."""
+    — which is also what makes int8 QLoRA bases trainable-over.
+
+    ``mesh``: multi-chip fine-tuning. ``init_state`` places the adapted
+    tree by its layout-aware specs (``parallel.sharding.param_specs`` —
+    bases by PARAM_RULES including int8 QTensors, ``a`` on the in-axis,
+    ``b`` on the out-axis sharding), the Adam moments inherit the adapter
+    shardings through ``optimizer.init`` on the sharded leaves, and the
+    jitted step runs GSPMD — fine-tune Llama-scale bases on a slice with
+    the base fsdp-sharded instead of replicated. Shard token batches with
+    ``parallel.shard_batch``."""
     import optax
 
     from ..models.transformer import next_token_loss
@@ -166,6 +176,25 @@ def make_lora_train_step(cfg, lr: float = 1e-4, attn_fn: Any = None):
     optimizer = optax.adamw(lr)
 
     def init_state(params):
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..parallel.sharding import shard_params
+
+            params = shard_params(params, mesh)
+            trainable, _ = split_trainable(params)
+            opt = optimizer.init(trainable)  # moments inherit leaf shardings
+            # Scalar leaves (adamw count, the step counter) must be
+            # mesh-REPLICATED like make_train_step's: a restored checkpoint
+            # otherwise mixes single-device and mesh-committed arrays,
+            # which jit rejects.
+            rep = NamedSharding(mesh, PartitionSpec())
+            opt = jax.tree.map(
+                lambda x: jax.device_put(x, rep) if jnp.ndim(x) == 0 else x,
+                opt,
+            )
+            step0 = jax.device_put(jnp.zeros((), jnp.int32), rep)
+            return {"params": params, "opt": opt, "step": step0}
         trainable, _ = split_trainable(params)
         return {"params": params, "opt": optimizer.init(trainable),
                 "step": jnp.int32(0)}

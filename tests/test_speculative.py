@@ -68,6 +68,21 @@ def test_lossless_and_faster_on_repetitive_prompt(model, monkeypatch):
     assert calls["n"] < steps, calls
 
 
+@pytest.mark.parametrize("k", [2, 4])
+def test_lossless_on_gemma2_softcap_window_cycle(k):
+    """Speculative verification on a Gemma-2-style config: the [B, k+1]
+    multi-token verify forward crosses both softcaps AND the alternating
+    local/global window cycle — still token-for-token equal to greedy."""
+    from kata_xpu_device_plugin_tpu.models import gemma2_test_config
+
+    cfg = gemma2_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(11), cfg, dtype=jnp.float32)
+    prompt = jax.random.randint(jax.random.PRNGKey(12), (2, 8), 0, cfg.vocab_size)
+    ref = np.asarray(generate(params, prompt, cfg, 12, max_len=40))
+    out = generate_speculative(params, prompt, cfg, 12, k=k, max_len=40)
+    np.testing.assert_array_equal(out, ref)
+
+
 def test_ragged_acceptance_across_batch(model):
     # One repetitive row (drafts accept) + one random row (drafts mostly
     # reject): rows advance at different rates — the ragged position path.

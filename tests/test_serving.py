@@ -182,6 +182,16 @@ def test_ring_kv_serving_matches_full_cache_arena():
     for r, o in zip(ref, out):
         np.testing.assert_array_equal(o, r)
 
+    # int8 arenas compose with the per-slot ring: each k/v vector
+    # quantizes identically whether it lands in a ring slot or the full
+    # arena, so the combination is bit-exact against int8-full-cache.
+    ref_q, _ = run(kv_quant=True)
+    out_q, srv_q = run(ring_kv=True, kv_quant=True)
+    q_leaf = jax.tree_util.tree_leaves(srv_q.arena)[0]
+    assert q_leaf.dtype == jnp.int8 and q_leaf.shape[2] == cfg.sliding_window
+    for r, o in zip(ref_q, out_q):
+        np.testing.assert_array_equal(o, r)
+
 
 def test_cycle_arena_serving_gemma2_matches_full_arena():
     # Gemma-2's alternating local/global cycle under continuous batching:

@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto test test-fast bench image clean deploy
+.PHONY: all build proto test test-fast bench demo dryrun image clean deploy
 
 all: build
 
@@ -30,6 +30,19 @@ test-fast:
 
 bench:
 	$(PY) bench.py
+
+# End-to-end user journey (train -> preempt -> resume -> LoRA -> merge ->
+# quantize -> speculative serving) on the virtual 8-device CPU mesh; drop
+# the env pins to run on attached TPU hardware.
+demo:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PY) scripts/train_demo.py
+
+# The driver's multi-chip validation, runnable locally: all parallelism
+# axes + serving verified on an 8-device virtual CPU mesh.
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PY) -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
 image:
 	docker build -t $(IMAGE) .

@@ -255,6 +255,12 @@ class GenerationServer:
             ),
             "slots_busy": sum(r is not None for r in self._slot_req),
             "queued": len(self._queue),
+            # KV arena footprint — the number ring/cycle arenas and int8
+            # caches exist to shrink (sum over leaves: int8 payloads and
+            # quant scales both counted).
+            "arena_bytes": sum(
+                leaf.nbytes for leaf in jax.tree_util.tree_leaves(self.arena)
+            ),
         }
         if self.speculative_k:
             out["draft_acceptance"] = (

@@ -175,12 +175,15 @@ def test_ring_kv_serving_matches_full_cache_arena():
         res = srv.run()
         return [res[r] for r in rids], srv
 
-    ref, _ = run()
+    ref, srv_full = run()
     out, srv = run(ring_kv=True)
     arena_leaf = jax.tree_util.tree_leaves(srv.arena)[0]
     assert arena_leaf.shape[2] == cfg.sliding_window  # O(window), not max_len
     for r, o in zip(ref, out):
         np.testing.assert_array_equal(o, r)
+
+    # stats() reports the footprint the ring exists to shrink.
+    assert srv.stats()["arena_bytes"] < srv_full.stats()["arena_bytes"]
 
     # int8 arenas compose with the per-slot ring: each k/v vector
     # quantizes identically whether it lands in a ring slot or the full

@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto test test-fast bench demo dryrun image clean deploy
+.PHONY: all build proto test test-fast bench bench-watch demo dryrun image clean deploy
 
 all: build
 
@@ -30,6 +30,12 @@ test-fast:
 
 bench:
 	$(PY) bench.py
+
+# Opportunistic TPU bench: probe the tunnel every few minutes and run the
+# full bench on the first healthy probe, banking a dated committed JSON
+# (see scripts/bench_when_healthy.py for why end-of-round-only is not enough).
+bench-watch:
+	$(PY) scripts/bench_when_healthy.py
 
 # End-to-end user journey (train -> preempt -> resume -> LoRA -> merge ->
 # quantize -> speculative serving) on the virtual 8-device CPU mesh; drop

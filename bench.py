@@ -104,12 +104,16 @@ def probe_tunnel(deadline: float) -> tuple[bool, bool, str]:
     round-trips an actual computation. A probe that must be SIGKILLed means
     the tunnel is in sticky wedged state (observed: hours-long), not a
     transient failure — the caller should skip TPU attempts entirely.
+
+    The probe also reports the backend platform: a JAX that comes up on CPU
+    (plugin missing, env leak) completes the dispatch fine but means there is
+    no tunnel to measure through — that is "down", not "healthy".
     """
     timeout = max(10.0, min(PROBE_TIMEOUT_S, deadline - time.monotonic()))
     code = (
         "import jax, jax.numpy as jnp, numpy as np\n"
         "np.asarray(jnp.ones((8,)) + 1)\n"
-        "print('probe-ok')\n"
+        "print('probe-ok', jax.devices()[0].platform)\n"
     )
     proc = subprocess.Popen(
         [sys.executable, "-c", code],
@@ -123,8 +127,11 @@ def probe_tunnel(deadline: float) -> tuple[bool, bool, str]:
         proc.kill()
         proc.communicate()
         return False, True, f"probe: hung (killed after {timeout:.0f}s)"
-    if proc.returncode == 0 and "probe-ok" in (out or ""):
+    if proc.returncode == 0 and "probe-ok tpu" in (out or ""):
         return True, False, ""
+    if proc.returncode == 0 and "probe-ok" in (out or ""):
+        plat = (out or "").rsplit("probe-ok", 1)[-1].strip()
+        return False, False, f"probe: completed but platform={plat!r}, not tpu"
     return False, False, f"probe: rc={proc.returncode}, tail={_tail(out)}"
 
 

@@ -1,0 +1,163 @@
+#!/usr/bin/env python3
+"""Opportunistic TPU bench watchdog (VERDICT r4 next-step #1).
+
+The driver runs ``bench.py`` exactly once, at end-of-round; with a tunnel
+that wedges for hours at a time that policy maximises the chance of
+measuring nothing (rounds 3 and 4 both ended with CPU-fallback bench
+lines despite two rounds of unmeasured perf work). This watchdog inverts
+the schedule: it probes the tunnel cheaply every few minutes for the
+whole round and, on the FIRST healthy probe, runs the full ``bench.py``
+and banks the JSON to a dated, committed file — so a single healthy
+window at any point in the round is enough to put a driver-verifiable
+TPU number on record.
+
+Design notes:
+- The probe is a killable subprocess doing one tiny dispatch (same shape
+  as bench.py's supervisor probe): a hang means the tunnel is wedged —
+  we SIGKILL the probe and sleep, we do NOT launch the full bench.
+- A healthy probe immediately runs ``python bench.py`` with a generous
+  timeout (the tunnel may re-wedge mid-bench; bench.py's own supervisor
+  budget bounds it). Only a line with ``platform == "tpu"`` counts.
+- Success banks ``BENCH_TPU_<utcstamp>.json`` at the repo root and
+  git-commits it, then keeps watching at a long interval so later,
+  faster code can bank improved numbers (every bank is a separate file;
+  nothing is overwritten).
+- All activity appends to ``bench_watch.log`` so the round's tunnel
+  health history is reconstructable.
+
+Usage: ``python scripts/bench_when_healthy.py [--interval 300] [--once]``
+or ``make bench-watch``.
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "bench_watch.log")
+
+sys.path.insert(0, REPO)
+import bench as _bench  # reuse probe_tunnel: one probe implementation, not two
+
+
+def log(msg: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+    line = f"[{stamp}] {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout_s: float) -> tuple[bool, str]:
+    """bench.py's tunnel probe: tiny dispatch, platform must be tpu."""
+    ok, hung, msg = _bench.probe_tunnel(time.monotonic() + timeout_s)
+    if hung:
+        return False, "hung"
+    return ok, msg or "ok"
+
+
+def run_bench(timeout_s: float) -> dict | None:
+    """Run the full bench; return the parsed headline dict iff platform is tpu."""
+    env = dict(os.environ)
+    env.setdefault("KATA_TPU_BENCH_W8A8", "1")  # verdict: W8A8 has never been measured
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        log("bench.py exceeded watchdog timeout (tunnel likely re-wedged mid-run)")
+        return None
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    if not lines:
+        log(f"bench.py produced no JSON line (rc={r.returncode}); stderr tail: "
+            + r.stderr[-300:].replace("\n", " | "))
+        return None
+    try:
+        head = json.loads(lines[0])
+    except json.JSONDecodeError:
+        log(f"unparseable bench line: {lines[0][:200]}")
+        return None
+    if head.get("platform") != "tpu":
+        log(f"bench completed but platform={head.get('platform')!r} — not banking")
+        return None
+    head["_all_lines"] = [json.loads(ln) for ln in lines]
+    return head
+
+
+def bank(head: dict) -> str:
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    path = os.path.join(REPO, f"BENCH_TPU_{stamp}.json")
+    with open(path, "w") as f:
+        json.dump(head, f, indent=2)
+        f.write("\n")
+    # Commit ONLY the banked JSON (pathspec'd: the watchdog shares this
+    # checkout with the builder, and a bare `git commit` could sweep the
+    # builder's staged work under the wrong message). The log is *.log-
+    # gitignored, so it needs -f. A failed commit (lock contention with the
+    # builder's own git ops) is logged but not fatal: the JSON exists on
+    # disk and the driver's end-of-round sweep commits leftovers.
+    rel = os.path.basename(path)
+    subprocess.run(["git", "add", "-f", rel, os.path.basename(LOG)],
+                   cwd=REPO, capture_output=True)
+    r = subprocess.run(
+        ["git", "commit", "-m", f"Bank opportunistic TPU bench capture {stamp}",
+         "--", rel, os.path.basename(LOG)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    if r.returncode != 0:
+        log(f"git commit of {rel} failed (rc={r.returncode}): "
+            + (r.stderr or r.stdout)[-200:].replace("\n", " | "))
+    return path
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=300.0,
+                    help="seconds between probes while the tunnel is down")
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    ap.add_argument("--bench-timeout", type=float, default=1800.0,
+                    help="hard cap on one bench.py run (its own budget is 23 min)")
+    ap.add_argument("--settle-interval", type=float, default=3600.0,
+                    help="probe cadence after a successful bank")
+    ap.add_argument("--once", action="store_true",
+                    help="exit after the first successful bank")
+    args = ap.parse_args()
+
+    log(f"watchdog start (interval={args.interval}s probe={args.probe_timeout}s)")
+    banked = 0
+    while True:
+        t0 = time.monotonic()
+        ok, why = probe(args.probe_timeout)
+        if ok:
+            log("probe HEALTHY — launching full bench")
+            head = run_bench(args.bench_timeout)
+            if head is not None:
+                path = bank(head)
+                banked += 1
+                log(f"BANKED {path}: {head.get('value')} {head.get('unit')} "
+                    f"vs_baseline={head.get('vs_baseline')}")
+                if args.once:
+                    return 0
+            else:
+                log("bench attempt did not yield a TPU line")
+        else:
+            log(f"probe not healthy ({why}) — tunnel down")
+        interval = args.settle_interval if banked else args.interval
+        elapsed = time.monotonic() - t0
+        time.sleep(max(10.0, interval - elapsed))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,7 @@ from .ulysses import make_ulysses_attention
 from .sharding import (
     BATCH_SPEC,
     PARAM_RULES,
+    batch_spec,
     init_sharded_params,
     make_optimizer,
     make_train_step,
@@ -66,6 +67,7 @@ __all__ = [
     "make_ring_attention",
     "make_ulysses_attention",
     "BATCH_SPEC",
+    "batch_spec",
     "PARAM_RULES",
     "init_sharded_params",
     "make_optimizer",

@@ -469,8 +469,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, window, softcap, res, dout):
     B, H, Sq = lse.shape[:3]
     dlse_zero = jnp.zeros((B, Sq, H), jnp.float32)
     dq, dk, dv, _doffs = _flash_block_bwd(
-        causal, block_q, block_k, interpret, softcap, res, (dout, dlse_zero),
-        window=window,
+        causal, block_q, block_k, interpret, softcap, window, res,
+        (dout, dlse_zero),
     )
     return dq, dk, dv
 
@@ -481,11 +481,11 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # ----- ring-attention block API (differentiable) ---------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def _flash_block(q, k, v, offs, causal, block_q, block_k, interpret,
-                 softcap=0.0):
+                 softcap=0.0, window=0):
     out, _ = _flash_block_fwd(q, k, v, offs, causal, block_q, block_k,
-                              interpret, softcap=softcap)
+                              interpret, softcap=softcap, window=window)
     return out
 
 
@@ -503,8 +503,8 @@ def _flash_block_fwd(q, k, v, offs, causal, block_q, block_k, interpret,
     return out, (q_t, k_t, v_t, out_t, lse, offs)
 
 
-def _flash_block_bwd(causal, block_q, block_k, interpret, softcap, res, cts,
-                     window=0):
+def _flash_block_bwd(causal, block_q, block_k, interpret, softcap, window,
+                     res, cts):
     import numpy as _np
 
     q_t, k_t, v_t, out_t, lse, offs = res
@@ -554,6 +554,7 @@ def flash_block_attention(
     block_k: int = DEFAULT_BLOCK,
     interpret: bool = False,
     softcap: float = 0.0,
+    window: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """One block-pair's partial attention for ring attention: returns
     ``(out, lse)`` where ``out`` is softmax-normalized WITHIN the block and
@@ -562,7 +563,9 @@ def flash_block_attention(
     Differentiable (custom_vjp recomputes blockwise; the lse cotangent joins
     the ds bracket), so the fused sp path trains. ``softcap`` applies the
     Gemma-2 logit cap inside each block (elementwise pre-softmax, so the
-    cross-block lse merge is unaffected)."""
+    cross-block lse merge is unaffected). ``window`` applies the sliding-
+    window band on GLOBAL positions (``q_offset``/``k_offset`` aware), so a
+    sequence-parallel ring can run Mistral/Gemma-2 windowed layers."""
     assert q.shape[3] == k.shape[3] and q.shape[2] % k.shape[2] == 0, (
         q.shape, k.shape)
     bq = pick_block(q.shape[1], block_q)
@@ -570,7 +573,8 @@ def flash_block_attention(
     if bq is None or bk is None:
         raise ValueError(f"no valid flash block for Sq={q.shape[1]}, Sk={k.shape[1]}")
     offs = jnp.stack([jnp.int32(q_offset), jnp.int32(k_offset)])
-    return _flash_block(q, k, v, offs, causal, bq, bk, interpret, softcap)
+    return _flash_block(q, k, v, offs, causal, bq, bk, interpret, softcap,
+                        window)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret", "window", "softcap"))

@@ -30,6 +30,7 @@ from .pipeline import (
     sequential_reference,
     stack_stage_params,
 )
+from .flash_spmd import make_sharded_attention
 from .ring import make_ring_attention
 from .ulysses import make_ulysses_attention
 from .sharding import (
@@ -65,6 +66,7 @@ __all__ = [
     "sequential_reference",
     "stack_stage_params",
     "make_ring_attention",
+    "make_sharded_attention",
     "make_ulysses_attention",
     "BATCH_SPEC",
     "batch_spec",

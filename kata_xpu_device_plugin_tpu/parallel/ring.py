@@ -44,6 +44,7 @@ def _local_ring_attention(
     use_flash: bool = False,
     flash_interpret: bool = False,
     softcap: float = 0.0,
+    window: int = 0,
 ) -> jax.Array:
     """Runs INSIDE shard_map over ``axis_name``.
 
@@ -53,6 +54,14 @@ def _local_ring_attention(
     the sp path and the kernel compose instead of being two features that
     can't be used together (VERDICT r2 weak 6). Blocks entirely above the
     causal frontier are skipped without launching the kernel.
+
+    ``window > 0`` (Mistral sliding window / Gemma-2 window cycles) masks
+    keys to the global band ``(q_pos − window, q_pos]`` — and makes the
+    ring CHEAPER, not unsupported: a non-wrapped block at hop ``t`` covers
+    keys down to ``(idx−t)·S``, which falls out of every local query's band
+    once ``t·S > S + window − 2``, so the rotation loop runs only
+    ``min(n−1, (S + window − 2)//S)`` hops — both the kernel launches and
+    the ppermute ICI traffic beyond the band are never emitted.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -81,6 +90,7 @@ def _local_ring_attention(
             out_blk, lse = flash_block_attention(
                 q, k_blk, v_blk, q_offset=idx * S, k_offset=src * S,
                 causal=causal, interpret=flash_interpret, softcap=softcap,
+                window=window,
             )
             lse = lse.transpose(0, 2, 1)[..., None]  # [B, H, S, 1]
             m_new = jnp.maximum(m, lse)
@@ -109,6 +119,8 @@ def _local_ring_attention(
             logits = jnp.tanh(logits / softcap) * softcap
         if causal:
             mask = k_pos[None, :] <= q_pos[:, None]  # [S, S] global causal
+            if window > 0:  # sliding band: keys in (q_pos − window, q_pos]
+                mask &= k_pos[None, :] > q_pos[:, None] - window
             logits = jnp.where(mask[None, None], logits, NEG_INF)
         m_cur = jnp.max(logits, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
@@ -128,12 +140,17 @@ def _local_ring_attention(
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
         return k_nxt, v_nxt, m, l, acc
 
-    # The block arriving for step n-1 is consumed OUTSIDE the loop so the
+    # The block arriving for the last hop is consumed OUTSIDE the loop so the
     # final (dead) ppermute rotation is never emitted — fori_loop bodies are
     # traced once, so a trailing in-loop rotate would cost a full K+V ICI hop
-    # every call.
-    k_blk, v_blk, m, l, acc = lax.fori_loop(0, n - 1, step, (k, v, m, l, acc))
-    m, l, acc = accumulate(n - 1, k_blk, v_blk, m, l, acc)
+    # every call. With a window, hops stop once non-wrapped blocks leave the
+    # band (wrapped blocks, src > idx, are causal-dead on every device), so
+    # the windowed ring does ceil-bounded work instead of n−1 rotations.
+    t_last = n - 1
+    if causal and window > 0:
+        t_last = min(n - 1, (S + window - 2) // S)
+    k_blk, v_blk, m, l, acc = lax.fori_loop(0, t_last, step, (k, v, m, l, acc))
+    m, l, acc = accumulate(t_last, k_blk, v_blk, m, l, acc)
     denom = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1, 3)  # [B, S, H, 1]
     return (acc / denom).astype(q.dtype)
 
@@ -163,8 +180,8 @@ def make_ring_attention(
     itself communicates (ppermute over ``axis``); the other axes just
     partition the local block."""
 
-    @lru_cache(maxsize=None)  # one shard_map per distinct softcap value
-    def ring_for(softcap: float):
+    @lru_cache(maxsize=None)  # one shard_map per distinct (softcap, window)
+    def ring_for(softcap: float, window: int):
         @partial(
             shard_map,
             mesh=mesh,
@@ -188,6 +205,7 @@ def make_ring_attention(
             return _local_ring_attention(
                 q, k, v, axis_name=axis, causal=True, use_flash=engage,
                 flash_interpret=flash_interpret, softcap=softcap,
+                window=window,
             )
 
         return ring
@@ -195,17 +213,13 @@ def make_ring_attention(
     def ring_attn(q, k, v, causal: bool = True,
                   q_offset: Optional[jax.Array] = None, window: int = 0,
                   logits_softcap: float = 0.0):
-        if window:
-            raise ValueError(
-                "ring attention does not support sliding-window configs "
-                "(cfg.sliding_window) — use the single-device attention or "
-                "set sliding_window=0 for the sp path"
-            )
         if not causal or q_offset is not None:
             raise ValueError("ring attention supports causal self-attention only")
         # logits_softcap (Gemma-2) is modeled inside the ring accumulate —
         # einsum AND flash-block paths — so softcap configs train
         # sequence-parallel; _layer's softcap gate sees the kwarg here.
-        return ring_for(float(logits_softcap))(q, k, v)
+        # window (Mistral sliding window / Gemma-2 cycles) bounds both the
+        # band mask and the number of ring hops — see _local_ring_attention.
+        return ring_for(float(logits_softcap), int(window))(q, k, v)
 
     return ring_attn

@@ -171,29 +171,29 @@ def make_train_step(
     """Returns (init_state, step). ``step(state, tokens) -> (state, loss)``,
     jitted over the mesh with donated state.
 
-    ``attn_fn`` defaults to the XLA reference — except on a mesh with a
-    ``seq`` axis, where it defaults to ring attention over that axis
-    (shard_map composes with the surrounding GSPMD step: batch stays on
-    the data axes, heads on the model axis when they divide, and only the
-    ring's ppermute moves K/V between seq neighbors), so long-context
-    training (BASELINE configs[4]) runs as ONE program with fsdp/tp. The
-    differentiable pallas flash kernel
-    (``ops.attention.flash_attention``) can be passed instead,
-    but note the step is plain-jit GSPMD: a pallas custom call has no SPMD
-    partitioning rule, so on a sharded mesh XLA may replicate its operands —
-    wrap it in shard_map over the batch axes before making it the default
-    (single-device training benefits today)."""
+    ``attn_fn`` defaults by mesh: on a mesh with a ``seq`` axis, ring
+    attention over that axis (shard_map composes with the surrounding GSPMD
+    step: batch stays on the data axes, heads on the model axis when they
+    divide, and only the ring's ppermute moves K/V between seq neighbors),
+    so long-context training (BASELINE configs[4]) runs as ONE program with
+    fsdp/tp. On non-seq meshes ON TPU, the differentiable pallas flash
+    kernel wrapped in shard_map over the same batch/head axes
+    (``.flash_spmd.make_sharded_attention``) — a pallas custom call has no
+    SPMD partitioning rule, so the shard_map is what lets the kernel
+    partition instead of replicating; per-local-block eligibility still
+    falls back to the XLA reference for unsupported shapes. Elsewhere
+    (CPU test meshes), the XLA reference."""
     optimizer = optimizer or make_optimizer()
+    tp = mesh.shape.get(AXIS_MODEL, 1)
+    # Shard the head dims over model only when BOTH divide: splitting q
+    # heads without their KV heads (or vice versa) would break the GQA
+    # group structure inside each shard.
+    heads_divide = (
+        tp > 1 and cfg.n_heads % tp == 0 and cfg.n_kv_heads % tp == 0
+    )
     if attn_fn is None and _seq_size(mesh) > 1:
         from .ring import make_ring_attention
 
-        tp = mesh.shape.get(AXIS_MODEL, 1)
-        # Shard the head dims over model only when BOTH divide: splitting q
-        # heads without their KV heads (or vice versa) would break the GQA
-        # group structure inside each shard.
-        heads_divide = (
-            tp > 1 and cfg.n_heads % tp == 0 and cfg.n_kv_heads % tp == 0
-        )
         attn_fn = make_ring_attention(
             mesh,
             axis=AXIS_SEQ,
@@ -201,6 +201,18 @@ def make_train_step(
             head_axis=AXIS_MODEL if heads_divide else None,
             kv_head_axis=AXIS_MODEL if heads_divide else None,
         )
+    elif attn_fn is None:
+        from ..ops.attention import on_tpu
+
+        if on_tpu():
+            from .flash_spmd import make_sharded_attention
+
+            attn_fn = make_sharded_attention(
+                mesh,
+                batch_axes=(AXIS_DATA, AXIS_FSDP),
+                head_axis=AXIS_MODEL if heads_divide else None,
+                kv_head_axis=AXIS_MODEL if heads_divide else None,
+            )
 
     def init_state(key: jax.Array):
         params = init_sharded_params(key, cfg, mesh)

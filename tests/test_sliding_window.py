@@ -165,7 +165,13 @@ def test_flash_kernel_window_interpret():
                                    rtol=3e-4, atol=3e-5)
 
 
-def test_sp_paths_reject_window(model):
+def test_sp_window_support(model):
+    """Ring attention ACCEPTS windowed configs (r5: the r4 rejection was
+    lifted — the window band is masked on global positions and the hop
+    count is bounded; tests/test_parallel.py verifies numerics vs the
+    reference). Ulysses still rejects: its all-to-all layout has no
+    windowed path."""
+    from kata_xpu_device_plugin_tpu.ops.attention import reference_attention
     from kata_xpu_device_plugin_tpu.parallel import (
         make_ring_attention,
         make_ulysses_attention,
@@ -175,11 +181,16 @@ def test_sp_paths_reject_window(model):
     mesh = seq_mesh(8)
     ring = make_ring_attention(mesh)
     ulysses = make_ulysses_attention(mesh)
-    q = jnp.zeros((1, 16, 8, 16), jnp.float32)
-    k = v = jnp.zeros((1, 16, 2, 16), jnp.float32)
-    for fn in (ring, ulysses):
-        with pytest.raises(ValueError, match="sliding-window"):
-            fn(q, k, v, window=8)
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(keys[0], (1, 16, 8, 16), jnp.float32)
+    k = jax.random.normal(keys[1], (1, 16, 2, 16), jnp.float32)
+    v = jax.random.normal(keys[2], (1, 16, 2, 16), jnp.float32)
+    out = ring(q, k, v, window=8)
+    ref = reference_attention(q, k, v, causal=True, window=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    with pytest.raises(ValueError, match="sliding-window"):
+        ulysses(q, k, v, window=8)
 
 
 def test_mistral_7b_shape():

@@ -113,11 +113,21 @@ class GenerationServer:
                  chunk: int = 8, temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, seed: int = 0, mesh: Any = None,
                  kv_quant: bool = False, prefill_buckets: tuple = (),
-                 speculative_k: int = 0, ring_kv: bool = False):
+                 speculative_k: int = 0, ring_kv: bool = False,
+                 draft: Optional[tuple] = None):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
             raise ValueError(f"speculative_k must be >= 0, got {speculative_k}")
+        if draft is not None and not speculative_k:
+            raise ValueError(
+                "draft=(draft_params, draft_cfg) requires speculative_k > 0"
+            )
+        if draft is not None and draft[1].vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft[1].vocab_size} != target vocab "
+                f"{cfg.vocab_size} — draft tokens would be meaningless"
+            )
         if speculative_k and temperature != 0.0:
             raise ValueError(
                 "speculative serving is greedy-only (lossless acceptance "
@@ -142,6 +152,13 @@ class GenerationServer:
                     "overwrites would hide window keys from earlier drafts"
                 )
         self.speculative_k = speculative_k
+        # Draft-model speculation (production shape for non-repetitive
+        # text): the draft keeps its OWN full-length arena at the same
+        # per-slot positions as the target; see models.speculative for the
+        # cache-consistency argument. ``draft=None`` keeps n-gram drafts.
+        self.draft = draft
+        if draft is not None:
+            self.draft_arena = init_kv_caches(draft[1], max_batch, max_len)
         if any(b < 1 or b > max_len for b in prefill_buckets):
             raise ValueError(
                 f"prefill_buckets {prefill_buckets} must lie in [1, max_len]"
@@ -217,6 +234,18 @@ class GenerationServer:
         self.arena = jax.tree.map(
             lambda c: jax.device_put(c, sh), self.arena
         )
+        if self.draft is not None:
+            d_params, d_cfg = self.draft
+            d_spec = (
+                P(None, None, None, AXIS_MODEL, None)
+                if d_cfg.n_kv_heads % tp == 0
+                else P()
+            )
+            self.draft = (shard_params(d_params, mesh), d_cfg)
+            d_sh = NamedSharding(mesh, d_spec)
+            self.draft_arena = jax.tree.map(
+                lambda c: jax.device_put(c, d_sh), self.draft_arena
+            )
 
     # ----- public API ------------------------------------------------------
 
@@ -309,6 +338,17 @@ class GenerationServer:
         self._prefills += 1
         self._emitted += 1  # the prefill forward emits each request's first token
         self.arena = _write_slot(self.arena, caches, b)
+        if self.draft is not None:
+            # The draft prefills the same prompt into its own arena slot
+            # (cheap: the draft is a fraction of the target), so its cache
+            # tracks the slot's positions from the first verify round on.
+            d_params, d_cfg = self.draft
+            d_caches, _dl, _dp = prefill(
+                d_params, jnp.asarray(prompt)[None, :], d_cfg,
+                self.max_len, return_logits=True,
+                true_len=jnp.int32(true_len) if bucket is not None else None,
+            )
+            self.draft_arena = _write_slot(self.draft_arena, d_caches, b)
         self._slot_req[b] = req
         self._pos[b] = int(pos)
         self._last[b] = first
@@ -373,13 +413,14 @@ class GenerationServer:
         return True
 
     def _step_speculative(self, active: list) -> bool:
-        """One speculative round over the whole arena: n-gram drafts per
-        active slot from its own request history, verified in ONE [B, k+1]
-        forward at per-slot positions — up to k+1 tokens per slot per
-        weight stream, token-identical to the plain greedy server (the
-        same losslessness :mod:`..models.speculative` proves for
-        generate). Out-of-bound tail writes clamp to the arena's last
-        entry, which no valid prefix ever includes (submit guarantees
+        """One speculative round over the whole arena: drafts per active
+        slot — n-gram from its own request history, or a k-step draft-model
+        scan over the draft arena — verified in ONE [B, k+1] forward at
+        per-slot positions; up to k+1 tokens per slot per weight stream,
+        token-identical to the plain greedy server (the same losslessness
+        :mod:`..models.speculative` proves for generate, independent of
+        the draft source). Out-of-bound tail writes clamp to the arena's
+        last entry, which no valid prefix ever includes (submit guarantees
         prompt + budget <= max_len, so live prefixes end at max_len-2)."""
         from ..models.speculative import (
             accept_drafts,
@@ -389,13 +430,27 @@ class GenerationServer:
 
         k = self.speculative_k
         cur = self._last.copy()
-        drafts = np.zeros((self.max_batch, k), np.int32)
-        for b in active:
-            req = self._slot_req[b]
-            hist = np.concatenate(
-                [req.prompt, np.asarray(req.out[:-1], np.int32)]
+        if self.draft is not None:
+            # k+1 steps, first k kept — the same cache-hole avoidance as
+            # models.speculative.draft_propose (its docstring has the
+            # argument); _serve_decode rather than draft_propose so the
+            # draft arena is DONATED like the main arena (an undonated
+            # draft scan would copy the whole draft cache every round).
+            d_params, d_cfg = self.draft
+            toks_dev, self.draft_arena, _dl, _dp = _serve_decode(
+                d_params, self.draft_arena, jnp.asarray(cur),
+                jnp.asarray(self._pos), d_cfg, k + 1, False, 0,
+                jnp.float32(0.0), jax.random.PRNGKey(0),
             )
-            drafts[b] = ngram_propose(hist, int(cur[b]), k)
+            drafts = np.asarray(toks_dev)[:, :k]
+        else:
+            drafts = np.zeros((self.max_batch, k), np.int32)
+            for b in active:
+                req = self._slot_req[b]
+                hist = np.concatenate(
+                    [req.prompt, np.asarray(req.out[:-1], np.int32)]
+                )
+                drafts[b] = ngram_propose(hist, int(cur[b]), k)
         toks = np.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
         greedy, self.arena = verify_step(
             self.params, self.arena, jnp.asarray(toks),

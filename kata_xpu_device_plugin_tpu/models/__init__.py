@@ -11,7 +11,7 @@ from .gemma import (
 from .llama import llama3_8b, llama3_train_test
 from .mistral import mistral_7b, mistral_test_config
 from .mixtral import mixtral_8x7b, mixtral_test_config
-from .speculative import generate_speculative
+from .speculative import draft_propose, generate_speculative, self_draft
 from .transformer import (
     DecoderConfig,
     forward,
@@ -26,7 +26,9 @@ __all__ = [
     "DecoderConfig",
     "forward",
     "generate",
+    "draft_propose",
     "generate_speculative",
+    "self_draft",
     "init_kv_caches",
     "init_params",
     "next_token_loss",

@@ -1,22 +1,35 @@
-"""Speculative decoding with n-gram (prompt-lookup) drafts.
+"""Speculative decoding: n-gram (prompt-lookup) and draft-model drafts.
 
 Greedy decode emits one token per full weight stream from HBM; speculative
 decoding drafts ``k`` candidate tokens cheaply and verifies them in ONE
 forward over ``[B, k+1]`` — when ``a`` drafts are accepted, one weight
 stream yields ``a+1`` tokens. Greedy speculative decoding is LOSSLESS: the
 emitted sequence is exactly the vanilla greedy sequence (tested
-token-identical), only the step count changes.
+token-identical), only the step count changes — regardless of where the
+drafts come from (draft quality moves the acceptance rate, never tokens).
 
-The draft source is n-gram lookup (no draft model): the most recent prior
-occurrence of the current token in the row's own history proposes the
-tokens that followed it — free, and effective exactly when text repeats
-(code, structured output, retrieval-augmented prompts).
+Two draft sources:
+
+- **n-gram lookup** (no draft model): the most recent prior occurrence of
+  the current token in the row's own history proposes the tokens that
+  followed it — free, and effective exactly when text repeats (code,
+  structured output, retrieval-augmented prompts).
+- **a draft model** (``draft=(draft_params, draft_cfg)``): any smaller
+  decoder sharing the target's vocabulary — the production shape for
+  non-repetitive text. The draft keeps its OWN KV cache at the same
+  per-row positions as the target; because each round's draft decode
+  starts by writing the correction token at the first rejected slot, the
+  stale entries from rejected drafts are overwritten (or sit beyond the
+  causal frontier) and the draft cache stays consistent with the accepted
+  history without any rollback pass. :func:`self_draft` builds a
+  zero-training draft by depth-truncating the target itself.
 
 TPU-first mechanics: verification reuses the decoder's ragged multi-token
 cache path (:func:`..models.transformer._cache_write_rows` — per-row
 ``[B, k+1]`` spans at per-row positions), so one compiled verify
-executable serves every acceptance pattern; drafting is host-side numpy
-(it reads tokens the host already owns). Rejected drafts' cache entries
+executable serves every acceptance pattern; n-gram drafting is host-side
+numpy (it reads tokens the host already owns), draft-model drafting is
+one k-step ``lax.scan`` decode executable. Rejected drafts' cache entries
 are dead until the next verify span overwrites them — the causal index
 mask (``k_pos <= q_pos``) never reads past each row's accepted prefix,
 the same invariant the serving arena and prefill bucketing rely on.
@@ -34,6 +47,7 @@ from .transformer import (
     AttnFn,
     DecoderConfig,
     Params,
+    _decode_scan,
     forward,
     greedy_token,
     prefill,
@@ -62,6 +76,61 @@ def verify_step(params: Params, caches, toks: jax.Array, pos: jax.Array,
     # greedy_token, not a local argmax: the verifier and vanilla generate()
     # must pick tokens identically or losslessness breaks.
     return greedy_token(logits), caches
+
+
+def self_draft(params: Params, cfg: DecoderConfig,
+               n_layers: int) -> tuple[Params, DecoderConfig]:
+    """A zero-training draft model: the target's FIRST ``n_layers`` decoder
+    layers with its own embedding/final-norm/unembedding. Crude (the
+    truncated trunk was never trained to feed the head directly), but it
+    shares the vocabulary by construction, costs ``n_layers/L`` of a target
+    step to draft, and exercises the exact draft-model plumbing a trained
+    draft (e.g. a distilled 2-layer companion) would use.
+
+    Layer-stacked params slice cleanly: every ``layers.*`` leaf is
+    ``[L, ...]``, and window cycles interleave in layer order, so a prefix
+    that is a multiple of the cycle length stays cycle-aligned."""
+    from dataclasses import replace
+
+    if not 0 < n_layers < cfg.n_layers:
+        raise ValueError(
+            f"self-draft depth {n_layers} must be in (0, {cfg.n_layers})"
+        )
+    cycle = len(cfg.window_cycle)
+    if n_layers % cycle:
+        raise ValueError(
+            f"self-draft depth {n_layers} must be a multiple of the "
+            f"attn_windows cycle length {cycle}"
+        )
+    draft_params = dict(params)
+    draft_params["layers"] = jax.tree.map(
+        lambda a: a[:n_layers], params["layers"]
+    )
+    return draft_params, replace(cfg, n_layers=n_layers)
+
+
+def draft_propose(draft_params: Params, draft_caches, cur: jax.Array,
+                  pos: jax.Array, draft_cfg: DecoderConfig, k: int,
+                  attn_fn: Optional[AttnFn] = None):
+    """Draft ``k`` greedy tokens per row with the draft model: one scan
+    decode at per-row positions ``pos [B]``. Returns
+    ``(drafts [B, k], updated draft caches)``.
+
+    The scan runs ``k+1`` steps (one more than the draft length) so the
+    cache entries ``pos .. pos+k`` are ALL written — a k-step scan never
+    writes the k/v of its last emitted token, which would leave a
+    permanent hole at ``pos+k`` whenever every draft is accepted (the
+    next round resumes at ``pos+k+1``). The k+1-th emitted token is
+    discarded. Rejected drafts' entries self-heal: the next round's scan
+    starts by overwriting the first rejected slot, and stale entries
+    beyond it sit above the causal frontier until overwritten (see
+    module docstring)."""
+    drafts, caches, _last, _pos = _decode_scan(
+        draft_params, draft_caches, cur, pos, draft_cfg, k + 1, attn_fn,
+        False, 0, jnp.float32(0.0), jax.random.PRNGKey(0),
+        return_state=True,
+    )
+    return drafts[:, :k], caches
 
 
 def ngram_propose(history: np.ndarray, cur: int, k: int) -> np.ndarray:
@@ -95,11 +164,17 @@ def accept_drafts(drafts_row: np.ndarray, greedy_row: np.ndarray,
 def generate_speculative(params: Params, prompt: jax.Array,
                          cfg: DecoderConfig, steps: int, k: int = 4,
                          max_len: int = 0,
-                         attn_fn: Optional[AttnFn] = None) -> np.ndarray:
-    """Greedy generation with n-gram speculative decoding — output is
+                         attn_fn: Optional[AttnFn] = None,
+                         draft: Optional[tuple] = None) -> np.ndarray:
+    """Greedy generation with speculative decoding — output is
     token-identical to :func:`..models.transformer.generate` at
     ``temperature=0``. Returns ``[B, steps]`` int32 plus nothing else;
-    ``k`` is the draft length per verify round."""
+    ``k`` is the draft length per verify round.
+
+    ``draft=(draft_params, draft_cfg)`` switches the draft source from
+    n-gram lookup to a draft model (see module docstring); the draft
+    prefills its own cache over the same prompt and tracks the same
+    per-row positions as the target."""
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
     prompt = np.asarray(prompt, np.int32)
@@ -116,6 +191,16 @@ def generate_speculative(params: Params, prompt: jax.Array,
         )
     caches, last, pos0 = prefill(params, jnp.asarray(prompt), cfg, max_len)
     last = np.asarray(last)
+    if draft is not None:
+        draft_params, draft_cfg = draft
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                f"{cfg.vocab_size} — draft tokens would be meaningless"
+            )
+        draft_caches, _d_last, _d_pos = prefill(
+            draft_params, jnp.asarray(prompt), draft_cfg, max_len
+        )
 
     history = [list(prompt[b]) for b in range(B)]
     out: list[list[int]] = [[int(last[b])] for b in range(B)]
@@ -123,10 +208,17 @@ def generate_speculative(params: Params, prompt: jax.Array,
 
     while min(len(o) for o in out) < steps:
         cur = np.array([o[-1] for o in out], np.int32)
-        drafts = np.stack([
-            ngram_propose(np.asarray(history[b], np.int32), int(cur[b]), k)
-            for b in range(B)
-        ])
+        if draft is not None:
+            drafts, draft_caches = draft_propose(
+                draft_params, draft_caches, jnp.asarray(cur),
+                jnp.asarray(pos), draft_cfg, k, attn_fn=attn_fn,
+            )
+            drafts = np.asarray(drafts)
+        else:
+            drafts = np.stack([
+                ngram_propose(np.asarray(history[b], np.int32), int(cur[b]), k)
+                for b in range(B)
+            ])
         toks = np.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
         greedy, caches = verify_step(
             params, caches, jnp.asarray(toks), jnp.asarray(pos), cfg,

@@ -347,6 +347,58 @@ def test_speculative_serving_rejects_sampling(model):
         GenerationServer(params, cfg, temperature=0.7, speculative_k=3)
 
 
+def test_draft_model_serving_matches_plain_greedy(model):
+    """Draft-MODEL speculative serving (VERDICT r4 weak #4): a depth-
+    truncated self-draft proposes via its own arena; results must equal
+    the plain greedy server under queue pressure and slot reuse, and the
+    acceptance rate must be reported."""
+    from kata_xpu_device_plugin_tpu.models import self_draft
+
+    cfg, params = model
+    draft = self_draft(params, cfg, 1)
+    prompts = _prompts(cfg, [4, 9, 6, 5], seed=11)
+    ref = serve_batch(params, cfg, prompts, max_new_tokens=9,
+                      max_batch=2, max_len=32)
+    srv = GenerationServer(params, cfg, max_batch=2, max_len=32,
+                           speculative_k=3, draft=draft)
+    rids = [srv.submit(p, 9) for p in prompts]
+    results = srv.run()
+    for r, rid in zip(ref, rids):
+        np.testing.assert_array_equal(results[rid], r)
+    st = srv.stats()
+    assert 0.0 <= st["draft_acceptance"] <= 1.0
+
+
+def test_draft_model_serving_perfect_draft_accepts_everything(model):
+    """Target-as-draft: every draft must be accepted (acceptance == 1.0)
+    and rounds collapse to ceil(tokens / (k+1)) — locks both the draft
+    arena's position bookkeeping (any cache skew would reject) and the
+    acceptance counters."""
+    cfg, params = model
+    (p,) = _prompts(cfg, [6], seed=12)
+    ref = _oracle(params, cfg, p, 12, 40)
+    srv = GenerationServer(params, cfg, max_batch=1, max_len=40,
+                           speculative_k=3, draft=(params, cfg))
+    rid = srv.submit(p, 12)
+    results = srv.run()
+    np.testing.assert_array_equal(results[rid], ref)
+    st = srv.stats()
+    assert st["draft_acceptance"] == 1.0, st
+    # prefill emits 1 token; 11 decode tokens in k+1=4-token rounds → 3.
+    assert st["rounds"] == 3, st
+
+
+def test_draft_serving_validation(model):
+    from dataclasses import replace
+
+    cfg, params = model
+    with pytest.raises(ValueError, match="speculative_k"):
+        GenerationServer(params, cfg, draft=(params, cfg))
+    bad = replace(cfg, vocab_size=cfg.vocab_size + 1)
+    with pytest.raises(ValueError, match="vocab"):
+        GenerationServer(params, cfg, speculative_k=2, draft=(params, bad))
+
+
 def test_submit_validation(model):
     cfg, params = model
     srv = GenerationServer(params, cfg, max_batch=1, max_len=16)

@@ -135,21 +135,20 @@ class GenerationServer:
             )
         if ring_kv:
             # Per-slot ring arena: each slot wraps at its OWN position
-            # (slot = pos[b] % window), so ragged continuous batching keeps
-            # KV memory at O(window) per slot regardless of stream length.
-            # Window CYCLES (Gemma-2) get the cycle arena: local layers
-            # ring at their window, global layers keep a max_len arena.
+            # (slot = pos[b] % arena_len), so ragged continuous batching
+            # keeps KV memory at O(window) per slot regardless of stream
+            # length. Window CYCLES (Gemma-2) get the cycle arena: local
+            # layers ring at their window, global layers keep a max_len
+            # arena. With ``speculative_k`` the windowed rings carry k
+            # extra SAFETY-MARGIN slots, so a verify round's k+1-token
+            # span can never evict a key still inside any live window —
+            # bounded KV memory and multi-token steps compose (the r4
+            # rejection is gone; O(window + k) is still O(window)).
             if not any(w > 0 for w in cfg.window_cycle):
                 raise ValueError(
                     "ring_kv needs a sliding-window config "
                     "(cfg.sliding_window > 0 or a windowed attn_windows "
                     "cycle)"
-                )
-            if speculative_k:
-                raise ValueError(
-                    "ring_kv serving is chunked-decode only: speculative "
-                    "verification writes multi-token spans, whose ring "
-                    "overwrites would hide window keys from earlier drafts"
                 )
         self.speculative_k = speculative_k
         # Draft-model speculation (production shape for non-repetitive
@@ -180,12 +179,18 @@ class GenerationServer:
         # hold ``window`` slots per sequence instead of max_len.
         self.ring_kv = ring_kv
         self._cycle = ring_kv and len(cfg.window_cycle) > 1
+        # Windowed rings get speculative_k margin slots (see the ring_kv
+        # comment above); plain decode (k=0) keeps exactly window slots.
+        self._ring_margin = speculative_k if ring_kv else 0
         if self._cycle:
             self.arena = init_cycle_kv_caches(
-                cfg, max_batch, max_len, quantized=kv_quant
+                cfg, max_batch, max_len, quantized=kv_quant,
+                margin=self._ring_margin,
             )
         else:
-            arena_len = cfg.window_cycle[0] if ring_kv else max_len
+            arena_len = (
+                cfg.window_cycle[0] + self._ring_margin if ring_kv else max_len
+            )
             self.arena = init_kv_caches(
                 cfg, max_batch, arena_len, quantized=kv_quant
             )
@@ -327,11 +332,12 @@ class GenerationServer:
         )
         if self._cycle:
             caches = cycle_ring_caches_from_prefill(
-                caches, pos, self.cfg, self.max_len
+                caches, pos, self.cfg, self.max_len,
+                margin=self._ring_margin,
             )
         elif self.ring_kv:
             caches = ring_caches_from_prefill(
-                caches, pos, self.cfg.window_cycle[0]
+                caches, pos, self.cfg.window_cycle[0] + self._ring_margin
             )
         first = self._sample_first(last_logits)
         req.out.append(first)
@@ -454,7 +460,7 @@ class GenerationServer:
         toks = np.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
         greedy, self.arena = verify_step(
             self.params, self.arena, jnp.asarray(toks),
-            jnp.asarray(self._pos), self.cfg,
+            jnp.asarray(self._pos), self.cfg, ring=self.ring_kv,
         )
         greedy = np.asarray(greedy)
         self._rounds += 1

@@ -54,15 +54,21 @@ from .transformer import (
 )
 
 
-@partial(jax.jit, static_argnames=("cfg", "attn_fn"), donate_argnums=(1,))
+@partial(jax.jit, static_argnames=("cfg", "attn_fn", "ring"),
+         donate_argnums=(1,))
 def verify_step(params: Params, caches, toks: jax.Array, pos: jax.Array,
-                cfg: DecoderConfig, attn_fn: Optional[AttnFn] = None):
+                cfg: DecoderConfig, attn_fn: Optional[AttnFn] = None,
+                ring: bool = False):
     """Forward ``toks [B, S]`` (current token + S-1 drafts) with per-row
     cache offsets ``pos [B]``; returns (greedy next-token ids [B, S],
     updated caches). Writes all S k/v spans — acceptance decides how many
     become part of each row's valid prefix (the caller advances ``pos``).
     ``caches`` is DONATED: at model scale a per-round cache copy would
-    double cache memory and add a full cache read+write per round."""
+    double cache memory and add a full cache read+write per round.
+    ``ring=True``: ``caches`` is a ring/cycle arena whose windowed layers
+    must carry ≥ S−1 slots of safety margin over their window (the
+    serving side sizes arenas as window + speculative_k — see
+    ``_layer``'s ring branch for the eviction argument)."""
     if attn_fn is None:
         from ..ops.attention import flash_attention
 
@@ -71,7 +77,7 @@ def verify_step(params: Params, caches, toks: jax.Array, pos: jax.Array,
     positions = pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
     logits, caches = forward(
         params, toks, cfg, attn_fn=attn_fn, positions=positions,
-        kv_caches=caches, cache_offset=pos,
+        kv_caches=caches, cache_offset=pos, ring=ring,
     )
     # greedy_token, not a local argmax: the verifier and vanilla generate()
     # must pick tokens identically or losslessness breaks.

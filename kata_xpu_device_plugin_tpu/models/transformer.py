@@ -300,15 +300,20 @@ def _cache_write_full(cache, x: jax.Array, offset) -> "QTensor | jax.Array":
     return lax.dynamic_update_slice(cache, x.astype(cache.dtype), (0, offset, 0, 0))
 
 
-def _cache_write_rows(cache, x: jax.Array, rows, idx) -> "QTensor | jax.Array":
+def _cache_write_rows(cache, x: jax.Array, rows, idx,
+                      wrap: int = 0) -> "QTensor | jax.Array":
     """Ragged write: row ``b``'s ``S`` k/v vectors land at its own
     positions ``idx[b] .. idx[b]+S-1``, each clamped HERE to max_len-1 (an
     over-bound serving slot scribbles the last entry, which is never read;
     multi-token callers size the cache so the clamp never engages).
+    ``wrap > 0``: ring-buffer semantics instead — each position lands at
+    slot ``position % wrap`` (a span crossing the wrap boundary scatters
+    non-contiguously, which the positionwise ``.at[]`` write handles).
     x: [B, S, KV, D]; rows [B]; idx [B]."""
     S = x.shape[1]
     max_len = (cache.q if isinstance(cache, QTensor) else cache).shape[1]
-    cols = jnp.minimum(idx[:, None] + jnp.arange(S)[None, :], max_len - 1)
+    span = idx[:, None] + jnp.arange(S)[None, :]
+    cols = span % wrap if wrap else jnp.minimum(span, max_len - 1)
     rows2 = rows[:, None]
     if isinstance(cache, QTensor):
         qt = quantize_kv(x)
@@ -402,41 +407,47 @@ def _layer(
         attn_out = attn_fn(q, k, v, causal=True, q_offset=None, **wkw)
         new_cache = (ck, cv)
     elif kv_cache is not None and ring:
-        # Ring decode: the cache holds exactly the live window, written at
-        # slot pos % W; attention consumes the slots' ABSOLUTE positions
+        # Ring decode: the cache holds the live window, written at slot
+        # pos % W; attention consumes the slots' ABSOLUTE positions
         # (ring_positions) so the causal/validity mask is position-exact
         # even though slots are stored out of order. Memory and per-step
         # cache traffic are O(window), not O(max_len). ``cache_offset``
         # may be a lockstep scalar (generate) or a [B] vector of per-slot
         # positions — continuous batching with ragged requests keeps the
         # same O(window) arena, each row wrapping independently.
-        assert S == 1, "ring cache writes are decode-only (S == 1)"
+        #
+        # The arena may carry MORE slots than the window (W ≥ window +
+        # S − 1): speculative verification writes [B, S=k+1] spans, and
+        # without the k-slot safety margin a span's later writes would
+        # evict keys still inside the span's EARLIER queries' windows
+        # (write at p evicts p−W ≤ pos−window only when W ≥ window+k).
+        # The window band is enforced by the explicit ``window=`` mask,
+        # not by the arena size.
         from ..ops.attention import reference_attention as _ref_attn
 
         ck, cv = kv_cache
         W = (ck.q if isinstance(ck, QTensor) else ck).shape[1]
-        assert W == eff_window, (
-            f"ring cache has {W} slots but this layer's window is "
-            f"{eff_window} — a mismatched buffer silently changes the "
-            "attention span"
+        assert W >= eff_window + S - 1, (
+            f"ring cache has {W} slots but this layer needs "
+            f"window {eff_window} + span {S} - 1 — an undersized ring "
+            "would evict keys still inside a live window"
         )
-        slot = cache_offset % W
         if jnp.ndim(cache_offset) == 0:
-            ck = _cache_write_full(ck, k, slot)
-            cv = _cache_write_full(cv, v, slot)
+            assert S == 1, "lockstep ring decode is single-token"
+            ck = _cache_write_full(ck, k, cache_offset % W)
+            cv = _cache_write_full(cv, v, cache_offset % W)
             k_pos = ring_positions(cache_offset, W)  # [W]
         else:
-            # Ragged: row b writes its single k/v at its own slot. S == 1
-            # means the clamp inside _cache_write_rows never engages
-            # (slot < W), so this is a pure modulo write.
+            # Ragged: row b writes its S k/v vectors at its own slots
+            # (position % W — spans wrap non-contiguously, wrap= handles).
             rows = jnp.arange(B)
-            ck = _cache_write_rows(ck, k, rows, slot)
-            cv = _cache_write_rows(cv, v, rows, slot)
-            k_pos = ring_positions(cache_offset[:, None], W)  # [B, W]
+            ck = _cache_write_rows(ck, k, rows, cache_offset, wrap=W)
+            cv = _cache_write_rows(cv, v, rows, cache_offset, wrap=W)
+            k_pos = ring_positions(cache_offset[:, None] + (S - 1), W)
         attn_out = _ref_attn(
             q, dequantize_kv(ck, x.dtype), dequantize_kv(cv, x.dtype),
             causal=True, q_offset=cache_offset,
-            k_positions=k_pos,
+            k_positions=k_pos, window=eff_window,
             logits_softcap=cfg.attn_logits_softcap,
         )
         new_cache = (ck, cv)
@@ -774,15 +785,19 @@ def init_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
 
 
 def init_cycle_kv_caches(cfg: DecoderConfig, batch: int, max_len: int,
-                         dtype=None, quantized: bool = False):
+                         dtype=None, quantized: bool = False,
+                         margin: int = 0):
     """The CYCLE ARENA layout for mixed local/global window cycles: a tuple
     over cycle positions, each a [L/P, B, len_i, KV, D] cache pair where
     ``len_i`` is the position's window (local) or ``max_len`` (global) —
-    the decode-side counterpart of :func:`cycle_ring_caches_from_prefill`."""
+    the decode-side counterpart of :func:`cycle_ring_caches_from_prefill`.
+    ``margin`` adds safety slots to each windowed ring (speculative
+    verification writes k+1-token spans; see ``_layer``'s ring branch)."""
     cycle = cfg.window_cycle
     P = len(cycle)
     return tuple(
-        _kv_stack(cfg, cfg.n_layers // P, batch, w if w > 0 else max_len,
+        _kv_stack(cfg, cfg.n_layers // P, batch,
+                  w + margin if w > 0 else max_len,
                   dtype or cfg.dtype, quantized)
         for w in cycle
     )
@@ -814,9 +829,10 @@ def ring_caches_from_prefill(caches, pos: jax.Array, window: int):
     return jax.tree.map(fold, caches)
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_len"))
+@partial(jax.jit, static_argnames=("cfg", "max_len", "margin"))
 def cycle_ring_caches_from_prefill(caches, pos: jax.Array,
-                                   cfg: DecoderConfig, max_len: int):
+                                   cfg: DecoderConfig, max_len: int,
+                                   margin: int = 0):
     """Split a full prefill cache into the CYCLE ARENA for mixed
     local/global configs (Gemma-2's alternating ``attn_windows``): a tuple
     over the window cycle, where position ``i``'s layers (``i::P``) get a
@@ -830,7 +846,7 @@ def cycle_ring_caches_from_prefill(caches, pos: jax.Array,
     for i, w in enumerate(cycle):
         sub = jax.tree.map(lambda a: a[i::P], caches)  # [L/P, B, S, ...]
         if w > 0:
-            arena.append(ring_caches_from_prefill(sub, pos, w))
+            arena.append(ring_caches_from_prefill(sub, pos, w + margin))
         else:
             def pad(c):
                 full = jnp.zeros(c.shape[:2] + (max_len,) + c.shape[3:], c.dtype)

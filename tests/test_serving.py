@@ -225,15 +225,70 @@ def test_cycle_arena_serving_gemma2_matches_full_arena():
 
 
 def test_ring_kv_serving_rejects_bad_configs(model):
-    from kata_xpu_device_plugin_tpu.models import mistral_test_config
-
     cfg_plain, params = model
     with pytest.raises(ValueError, match="sliding-window"):
         GenerationServer(params, cfg_plain, ring_kv=True)
-    cfg_sw = mistral_test_config(dtype=jnp.float32)
-    p_sw = init_params(jax.random.PRNGKey(0), cfg_sw, dtype=jnp.float32)
-    with pytest.raises(ValueError, match="speculative"):
-        GenerationServer(p_sw, cfg_sw, ring_kv=True, speculative_k=2)
+
+
+def test_ring_kv_speculative_serving_matches_plain_greedy():
+    """ring_kv × speculative (VERDICT r4 next #6): bounded KV memory AND
+    multi-token verify rounds compose — the windowed ring carries k
+    margin slots so a verify span can never evict a key inside a live
+    window. Tokens must equal the plain full-arena greedy server; the
+    arena stays O(window + k)."""
+    from kata_xpu_device_plugin_tpu.models import mistral_test_config
+
+    cfg = mistral_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(4), cfg, dtype=jnp.float32)
+    prompts = _prompts(cfg, [5, 11, 3, 8], seed=41)
+    budgets = [17, 9, 21, 13]  # push well past window=8, ragged wrap points
+
+    def run(**kw):
+        srv = GenerationServer(params, cfg, max_batch=2, max_len=64, **kw)
+        rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+        res = srv.run()
+        return [res[r] for r in rids], srv
+
+    k = 3
+    ref, _ = run(chunk=4)
+    out, srv = run(ring_kv=True, speculative_k=k)
+    arena_leaf = jax.tree_util.tree_leaves(srv.arena)[0]
+    assert arena_leaf.shape[2] == cfg.sliding_window + k  # margin, not max_len
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    assert 0.0 <= srv.stats()["draft_acceptance"] <= 1.0
+
+
+def test_cycle_arena_speculative_serving_matches_plain_greedy():
+    """Gemma-2 cycle arena × speculative: local rings carry the margin,
+    global layers keep max_len; tokens equal the full-arena greedy server
+    — and a perfect draft composes on top (ring + draft model + cycle)."""
+    from kata_xpu_device_plugin_tpu.models import gemma2_test_config
+
+    cfg = gemma2_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(14), cfg, dtype=jnp.float32)
+    prompts = _prompts(cfg, [4, 9, 6, 3], seed=51)
+    budgets = [15, 8, 12, 18]
+
+    def run(**kw):
+        srv = GenerationServer(params, cfg, max_batch=2, max_len=48, **kw)
+        rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+        res = srv.run()
+        return [res[r] for r in rids], srv
+
+    k = 2
+    ref, _ = run(chunk=4)
+    out, srv = run(ring_kv=True, speculative_k=k)
+    local = srv.arena[0]
+    assert local[0].shape[2] == cfg.attn_windows[0] + k
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+    # Full composition: ring arena + DRAFT MODEL speculation.
+    out_d, srv_d = run(ring_kv=True, speculative_k=k, draft=(params, cfg))
+    for r, o in zip(ref, out_d):
+        np.testing.assert_array_equal(o, r)
+    assert srv_d.stats()["draft_acceptance"] == 1.0
 
 
 def test_bucketed_prefill_is_exact(model):

@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto test test-fast bench bench-watch demo dryrun image clean deploy
+.PHONY: all build proto test test-fast bench bench-watch eval demo dryrun image clean deploy
 
 all: build
 
@@ -36,6 +36,12 @@ bench:
 # (see scripts/bench_when_healthy.py for why end-of-round-only is not enough).
 bench-watch:
 	$(PY) scripts/bench_when_healthy.py
+
+# Quantization quality ladder (bf16 vs int8 vs W8A8 vs int8-KV): the
+# measurement ops/quant.py's W8A8 docstring prescribes before production.
+# On the attached TPU: python scripts/eval_quality.py --config gemma2b --dtype bfloat16
+eval:
+	$(PY) scripts/eval_quality.py --cpu
 
 # End-to-end user journey (train -> preempt -> resume -> LoRA -> merge ->
 # quantize -> speculative serving) on the virtual 8-device CPU mesh; drop

@@ -231,3 +231,30 @@ def test_quantized_mixtral_decode_runs_and_tracks_reference():
     assert out_q.shape == out_ref.shape == (2, 8)
     agreement = (out_q == out_ref).mean()
     assert agreement >= 0.5, f"token agreement {agreement}"
+
+
+def test_eval_quality_harness_runs_and_reports():
+    """The quantization quality harness (scripts/eval_quality.py — the
+    tool W8A8's docstring prescribes) runs the full ladder and emits one
+    parseable JSON line per variant with the go/no-go fields."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "eval_quality.py"),
+         "--cpu", "--batch", "2", "--seq-len", "32", "--decode-steps", "8"],
+        capture_output=True, text=True, timeout=480, cwd=repo,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(l) for l in r.stdout.splitlines() if l.startswith("{")]
+    variants = {l["variant"] for l in lines}
+    assert variants == {"baseline", "int8", "w8a8", "int8_kv"}, variants
+    by = {l["variant"]: l for l in lines}
+    assert by["baseline"]["max_logit_drift"] == 0.0
+    for v in ("int8", "w8a8"):
+        assert 0.0 <= by[v]["top1_agree"] <= 1.0
+        assert by[v]["max_logit_drift"] > 0.0  # quantization is not a no-op
+    assert 0.0 <= by["int8_kv"]["kv_agree"] <= 1.0

@@ -5,6 +5,29 @@
 # by installing one console script from one source of truth (pyproject).
 FROM python:3.12-slim
 
+# Full pci.ids database at the discovery ladder's first system path
+# (discovery/pciids.py:SYSTEM_PCIIDS_PATHS — same location the reference
+# installs it, its Dockerfile:66), so VFIO resource naming covers arbitrary
+# non-TPU devices without --pci-ids-path. The repo itself ships only the
+# 24-line authored TPU table (data/pci.ids) as the committed fallback —
+# vendoring the full 38k-line DB in git buys nothing over fetching it here.
+# For a REPRODUCIBLE build, pin an immutable snapshot and its digest, e.g.:
+#   docker build \
+#     --build-arg PCI_IDS_URL=https://raw.githubusercontent.com/pciutils/pciids/<commit>/pci.ids \
+#     --build-arg PCI_IDS_SHA256=<sha256> .
+# The default rolling URL keeps offline/air-gapped builds possible via
+# PCI_IDS_FETCH=0 (the in-package authored table then serves as fallback).
+ARG PCI_IDS_FETCH=1
+ARG PCI_IDS_URL=https://pci-ids.ucw.cz/v2.2/pci.ids
+ARG PCI_IDS_SHA256=""
+RUN if [ "$PCI_IDS_FETCH" = "1" ]; then \
+      python -c "import urllib.request; urllib.request.urlretrieve('$PCI_IDS_URL', '/usr/pci.ids')" && \
+      if [ -n "$PCI_IDS_SHA256" ]; then \
+        echo "$PCI_IDS_SHA256  /usr/pci.ids" | sha256sum -c -; \
+      fi && \
+      grep -q "^1ae0" /usr/pci.ids; \
+    fi
+
 RUN pip install --no-cache-dir grpcio protobuf PyYAML prometheus_client
 
 WORKDIR /opt/kata-tpu-device-plugin

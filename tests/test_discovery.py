@@ -131,6 +131,33 @@ def test_sanitize_name():
     assert pciids.sanitize_name("  weird--name!! ") == "WEIRD_NAME"
 
 
+def test_load_prefers_system_db_then_authored_fallback(tmp_path, monkeypatch):
+    """The image build installs the full pci.ids at the ladder's first
+    system path (Dockerfile); load() must prefer it over the 24-line
+    authored table — and fall back to the authored table when no system
+    DB exists (offline / PCI_IDS_FETCH=0 builds)."""
+    system = tmp_path / "pci.ids"
+    system.write_text(
+        "8086  Intel Corporation\n"
+        "\t10fb  82599ES 10-Gigabit SFI/SFP+\n"
+        "1ae0  Google, Inc.\n"
+    )
+    monkeypatch.setattr(pciids, "SYSTEM_PCIIDS_PATHS", (str(system),))
+    db = pciids.PciIds.load()
+    # Content only the (fake) full system DB has — proves which file won.
+    assert db.vendor_name("8086") == "Intel Corporation"
+    assert pciids.resource_suffix("8086", "10fb", db) == "82599ES_10_GIGABIT_SFI_SFP"
+
+    # No system DB → the authored in-package table serves.
+    monkeypatch.setattr(
+        pciids, "SYSTEM_PCIIDS_PATHS", (str(tmp_path / "missing"),)
+    )
+    fallback = pciids.PciIds.load()
+    # The authored table names vendors but carries no non-TPU devices.
+    assert fallback.device_name("8086", "10fb") is None
+    assert fallback.device_name("1ae0", "0063") == "Cloud TPU v5e"
+
+
 def test_scan_tpus_env_isolation(fake, monkeypatch):
     # An explicit empty env must NOT fall back to os.environ.
     monkeypatch.setenv("TPU_WORKER_ID", "3")

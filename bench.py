@@ -485,11 +485,15 @@ def worker(args: argparse.Namespace) -> None:
             if os.environ.get("KATA_TPU_BENCH_W8A8", "") == "1":
                 # Opt-in: int8×int8 MXU dots (ops.quant.w8a8_enabled) — the
                 # candidate for closing the int8 convert-tax gap
-                # (BASELINE.md ablation). The env flag binds at TRACE time,
-                # so jax.clear_caches() forces fresh traces — it also wipes
-                # every other cached executable (the serving section after
-                # this re-warms itself, so that is only recompile time).
-                os.environ["KATA_TPU_W8A8"] = "1"
+                # (BASELINE.md ablation). The flag binds at TRACE time
+                # (explicit set_w8a8, not env mutation — the env snapshot
+                # is import-time), so jax.clear_caches() forces fresh
+                # traces — it also wipes every other cached executable
+                # (the serving section after this re-warms itself, so that
+                # is only recompile time).
+                from kata_xpu_device_plugin_tpu.ops.quant import set_w8a8
+
+                set_w8a8(True)
                 try:
                     jax.clear_caches()
                     run(qparams, 10)  # warm-up under the W8A8 trace
@@ -501,7 +505,7 @@ def worker(args: argparse.Namespace) -> None:
                         total_tokens / w_dt / int8_roofline_tok_s, 4
                     )
                 finally:
-                    os.environ.pop("KATA_TPU_W8A8", None)
+                    set_w8a8(False)
             return out
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"int8_error": f"{type(exc).__name__}: {exc}"[:200]}

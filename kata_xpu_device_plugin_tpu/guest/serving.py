@@ -55,6 +55,18 @@ from ..models.transformer import (
 )
 
 
+def _hbm_bytes(leaf) -> int:
+    """Total device memory a (possibly sharded or replicated) array holds
+    across all addressable devices — shard sizes summed, so a replicated
+    array costs devices × nbytes and a sharded one its logical nbytes."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards:
+        return sum(
+            int(np.prod(s.data.shape)) * leaf.dtype.itemsize for s in shards
+        )
+    return leaf.nbytes
+
+
 @dataclass
 class _Request:
     rid: int
@@ -106,6 +118,13 @@ class GenerationServer:
 
     ``params`` may be the bf16 pytree or the int8-quantized one
     (``ops.quant.quantize_decoder_params``) — the decode path is shared.
+
+    ``ring_kv=True`` prefills each admission into a PROMPT-LENGTH
+    transient cache before folding the live window into the slot's ring,
+    so without ``prefill_buckets`` every distinct prompt length compiles
+    its own prefill executable — pair ring_kv with a bucket ladder (e.g.
+    ``prefill_buckets=(256, 1024, 4096)``) to keep the
+    one-executable-per-bucket property the module header promises.
     """
 
     def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
@@ -291,9 +310,14 @@ class GenerationServer:
             "queued": len(self._queue),
             # KV arena footprint — the number ring/cycle arenas and int8
             # caches exist to shrink (sum over leaves: int8 payloads and
-            # quant scales both counted).
+            # quant scales both counted). Summed over ADDRESSABLE SHARDS,
+            # not logical nbytes: when the arena replicates under tensor
+            # parallelism (n_kv_heads % tp != 0 → kv_spec = P()), every
+            # device holds a full copy and real HBM is mesh-size × the
+            # logical figure — the stat reports the real cost.
             "arena_bytes": sum(
-                leaf.nbytes for leaf in jax.tree_util.tree_leaves(self.arena)
+                _hbm_bytes(leaf)
+                for leaf in jax.tree_util.tree_leaves(self.arena)
             ),
         }
         if self.speculative_k:

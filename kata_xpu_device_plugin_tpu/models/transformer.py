@@ -681,13 +681,16 @@ def next_token_loss(params: Params, tokens: jax.Array, cfg: DecoderConfig,
     config is MoE (the aux term is what keeps the router from collapsing).
 
     The forward runs on the FULL sequence and the last position's logits
-    are dropped — the cross-entropy term is value-identical under causal
-    masking to slicing the inputs first, and the sequence length stays
-    unchanged so seq-sharded activations (ring attention over a mesh seq
-    axis) stay evenly divisible through the whole step. For MoE configs the
-    aux load-balancing term now also counts the last position's routing
-    (one more token in frac_routed/mean_prob) — a deliberate, slightly
-    different regularizer, not a changed objective."""
+    are dropped — for DENSE configs the cross-entropy term is
+    value-identical under causal masking to slicing the inputs first, and
+    the sequence length stays unchanged so seq-sharded activations (ring
+    attention over a mesh seq axis) stay evenly divisible through the
+    whole step. For MoE configs the equivalence is approximate, not exact:
+    the extra last token competes for finite expert-capacity slots (and
+    changes the capacity ceil), which can evict earlier tokens and shift
+    their logits slightly; the aux load-balancing term also counts the
+    last position's routing (one more token in frac_routed/mean_prob) — a
+    deliberate, slightly different regularizer, not a changed objective."""
     logits, aux = forward(
         params, tokens, cfg, attn_fn=attn_fn, moe_mesh=moe_mesh,
         return_aux=True, remat=remat,

@@ -63,18 +63,35 @@ def dequantize(qt: QTensor, dtype=jnp.float32) -> jax.Array:
     return (qt.q.astype(jnp.float32) * qt.scale).astype(dtype)
 
 
-def w8a8_enabled() -> bool:
-    """Opt-in int8×int8 decode dots (``KATA_TPU_W8A8=1``): activations
-    quantize per-vector on the fly and the dot runs int8×int8→int32 on the
-    MXU's int8 mode, removing the int8→bf16 weight-convert from the
-    streamed path (VERDICT r3: the convert tax is ~10 points of the int8
-    roofline). Costs activation-quantization error — measure quality per
-    model before enabling in production: ``scripts/eval_quality.py``
-    (``make eval``) runs the bf16/int8/W8A8/int8-KV ladder and reports
-    delta-CE, logit drift, and top-1 agreement vs the bf16 baseline."""
-    import os
+# Snapshotted at import, NOT read per trace: a per-trace env read means
+# toggling the variable after the first compile silently has no effect on
+# cached executables while newly traced call sites pick it up — mixed-mode
+# programs with no error. One snapshot per process is unambiguous; in-
+# process harnesses toggle explicitly via set_w8a8() (which documents the
+# retrace requirement) instead of mutating the environment.
+_W8A8 = __import__("os").environ.get("KATA_TPU_W8A8", "") == "1"
 
-    return os.environ.get("KATA_TPU_W8A8", "") == "1"
+
+def set_w8a8(on: bool) -> None:
+    """Programmatic W8A8 toggle for harnesses (bench, eval_quality).
+    Affects only executables traced AFTER the call — jit-cached
+    executables keep the mode they were traced with, so flip the flag
+    before building the variant's (fresh) jitted callables."""
+    global _W8A8
+    _W8A8 = bool(on)
+
+
+def w8a8_enabled() -> bool:
+    """Opt-in int8×int8 decode dots (``KATA_TPU_W8A8=1`` at process start,
+    or :func:`set_w8a8`): activations quantize per-vector on the fly and
+    the dot runs int8×int8→int32 on the MXU's int8 mode, removing the
+    int8→bf16 weight-convert from the streamed path (VERDICT r3: the
+    convert tax is ~10 points of the int8 roofline). Costs activation-
+    quantization error — measure quality per model before enabling in
+    production: ``scripts/eval_quality.py`` (``make eval``) runs the
+    bf16/int8/W8A8/int8-KV ladder and reports delta-CE, logit drift, and
+    top-1 agreement vs the bf16 baseline."""
+    return _W8A8
 
 
 def weight_matmul(x: jax.Array, w: Any) -> jax.Array:

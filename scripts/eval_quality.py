@@ -121,12 +121,14 @@ def main() -> int:
     int8_ce, int8_lg = ce_and_logits(qparams)
     report("int8", int8_ce, int8_lg, base_ce, base_lg)
 
-    os.environ["KATA_TPU_W8A8"] = "1"
+    from kata_xpu_device_plugin_tpu.ops.quant import set_w8a8
+
+    set_w8a8(True)  # the env snapshot is import-time; toggle explicitly
     try:
         w8_ce, w8_lg = ce_and_logits(qparams)
         report("w8a8", w8_ce, w8_lg, base_ce, base_lg)
     finally:
-        os.environ.pop("KATA_TPU_W8A8", None)
+        set_w8a8(False)
 
     # int8 KV cache: only decode-from-cache reads differ, so measure where
     # it bites — greedy token agreement over a decode run.

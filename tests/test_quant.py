@@ -154,22 +154,28 @@ def test_w8a8_optin_tracks_weight_only(monkeypatch, quant_setup):
     # KATA_TPU_W8A8=1: int8×int8 dots with per-vector activation scales.
     # Adds activation-quant error on top of weight-only — bounded, and the
     # full decode path still produces mostly the same greedy tokens.
+    from kata_xpu_device_plugin_tpu.ops.quant import set_w8a8
+
     cfg, params, qparams = quant_setup
     x = jax.random.normal(jax.random.PRNGKey(11), (2, 4, cfg.d_model))
     w = qparams["layers"]["wqkv"][0]
     ref = np.asarray(weight_matmul(x, w))
-    monkeypatch.setenv("KATA_TPU_W8A8", "1")
-    out = np.asarray(weight_matmul(x, w))
-    scale = np.abs(ref).max()
-    assert np.abs(out - ref).max() <= 0.05 * scale + 1e-3
+    set_w8a8(True)  # explicit toggle: the env snapshot is import-time only
+    try:
+        out = np.asarray(weight_matmul(x, w))
+        scale = np.abs(ref).max()
+        assert np.abs(out - ref).max() <= 0.05 * scale + 1e-3
 
-    # Batch 3 is a shape no earlier test traced: the decode scan is jitted
-    # and the env flag is read at TRACE time, so a cached executable from a
-    # weight-only test would silently bypass the W8A8 path.
-    prompt = jax.random.randint(jax.random.PRNGKey(12), (3, 8), 0, cfg.vocab_size)
-    caches, last, pos = prefill(qparams, prompt, cfg, 16)
-    toks = np.asarray(decode(qparams, caches, last, int(pos), cfg, 8))
-    assert toks.shape == (3, 8) and toks.dtype == np.int32
+        # Batch 3 is a shape no earlier test traced: the decode scan is
+        # jitted and the flag binds at TRACE time, so a cached executable
+        # from a weight-only test would silently bypass the W8A8 path.
+        prompt = jax.random.randint(jax.random.PRNGKey(12), (3, 8), 0,
+                                    cfg.vocab_size)
+        caches, last, pos = prefill(qparams, prompt, cfg, 16)
+        toks = np.asarray(decode(qparams, caches, last, int(pos), cfg, 8))
+        assert toks.shape == (3, 8) and toks.dtype == np.int32
+    finally:
+        set_w8a8(False)
 
 
 def test_quantized_moe_experts_per_expert_scales():

@@ -45,10 +45,12 @@ decode (ops/quant.py) — reported as ``int8_tok_per_s`` against its own
 actual-bytes roofline (``int8_vs_baseline``), so the quantized win shows up
 in absolute tok/s without muddying the bf16 round-over-round series —
 continuous-batching serving throughput (guest/serving.py, 16 mixed-length
-requests through an 8-slot arena, ``serving_tok_per_s``), and Gemma-2-style
-softcap prefill on the pallas flash path vs the XLA reference
-(``softcap_prefill_flash_speedup``). All three are crash-guarded side
-sections emitted AFTER the banked headline line, each with its own
+requests through an 8-slot arena, ``serving_tok_per_s`` — plus a
+draft-model speculative variant reporting ``serving_spec_tok_per_s`` and
+the draft acceptance rate; ``KATA_TPU_BENCH_SPEC=0`` skips it), and
+Gemma-2-style softcap prefill on the pallas flash path vs the XLA
+reference (``softcap_prefill_flash_speedup``). All three are crash-guarded
+side sections emitted AFTER the banked headline line, each with its own
 ``KATA_TPU_BENCH_{INT8,SERVING,SOFTCAP}=0`` kill switch (the supervisor
 flips all of them off on retries and in the CPU fallback); the optional
 ``KATA_TPU_BENCH_W8A8=1`` adds the int8×int8-dot decode variant inside the
@@ -593,11 +595,47 @@ def worker(args: argparse.Namespace) -> None:
             results = srv.run()
             dt_s = time.perf_counter() - t0
             total = sum(len(results[r]) for r in rids)
-            return {
+            out = {
                 "serving_tok_per_s": round(total / dt_s, 1),
                 "serving_requests": len(rids),
                 "serving_s": round(dt_s, 3),
             }
+            if os.environ.get("KATA_TPU_BENCH_SPEC", "1") == "1":
+                # Draft-model speculative serving: a depth-truncated
+                # self-draft (zero extra weights to load) through the same
+                # arena; reports throughput AND the acceptance rate — the
+                # number k should be tuned by (VERDICT r4 next #5).
+                from kata_xpu_device_plugin_tpu.models import self_draft
+
+                cyc = max(1, len(cfg.window_cycle))
+                depth = max(cyc, (cfg.n_layers // 4) // cyc * cyc)
+                draft = self_draft(params, cfg, depth)
+
+                def make_spec_server():
+                    return GenerationServer(
+                        params, cfg, max_batch=BATCH,
+                        max_len=PROMPT_LEN + 72 + 4, chunk=16,
+                        prefill_buckets=(PROMPT_LEN,), speculative_k=4,
+                        draft=draft,
+                    )
+
+                warm_s = make_spec_server()
+                reqs(warm_s, 1, salt=2000)
+                warm_s.run()
+                spec = make_spec_server()
+                s_rids = reqs(spec, 2 * BATCH, salt=3000)
+                t1 = time.perf_counter()
+                s_results = spec.run()
+                s_dt = time.perf_counter() - t1
+                s_total = sum(len(s_results[r]) for r in s_rids)
+                st = spec.stats()
+                out.update({
+                    "serving_spec_tok_per_s": round(s_total / s_dt, 1),
+                    "serving_spec_draft_depth": depth,
+                    "serving_spec_draft_acceptance": st.get(
+                        "draft_acceptance", 0.0),
+                })
+            return out
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"serving_error": f"{type(exc).__name__}: {exc}"[:200]}
 

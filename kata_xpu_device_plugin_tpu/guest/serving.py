@@ -147,10 +147,13 @@ class GenerationServer:
                 f"draft vocab {draft[1].vocab_size} != target vocab "
                 f"{cfg.vocab_size} — draft tokens would be meaningless"
             )
-        if speculative_k and temperature != 0.0:
+        if speculative_k and (top_k or top_p):
             raise ValueError(
-                "speculative serving is greedy-only (lossless acceptance "
-                "compares against the argmax token) — set temperature=0"
+                "speculative serving supports greedy (temperature=0, exact "
+                "token identity) and plain temperature sampling (lossless "
+                "rejection scheme — models.speculative.sample_accept_row); "
+                "top_k/top_p truncation is not modeled in the acceptance "
+                "math — disable them with speculative_k"
             )
         if ring_kv:
             # Per-slot ring arena: each slot wraps at its OWN position
@@ -192,6 +195,9 @@ class GenerationServer:
         self._do_sample, self._key = _sampling_args(
             temperature, top_k, jax.random.PRNGKey(seed), top_p
         )
+        # Host-side RNG for speculative SAMPLING's accept/residual draws
+        # (models.speculative.sample_accept_row); seeded so runs reproduce.
+        self._np_rng = np.random.default_rng(seed)
         # kv_quant: int8 arena — ~2× less HBM per slot-token, so the same
         # chip serves ~2× the context/slots (per-vector scales; decode
         # dequant fuses into the attention dots). ring_kv: windowed layers
@@ -453,14 +459,33 @@ class GenerationServer:
         last entry, which no valid prefix ever includes (submit guarantees
         prompt + budget <= max_len, so live prefixes end at max_len-2)."""
         from ..models.speculative import (
+            _one_hot_q,
+            _softmax_np,
             accept_drafts,
+            draft_sample_propose,
             ngram_propose,
+            sample_accept_row,
+            verify_logits_step,
             verify_step,
         )
 
         k = self.speculative_k
+        sampling = self._do_sample
         cur = self._last.copy()
-        if self.draft is not None:
+        q = None
+        if self.draft is not None and sampling:
+            # Sampling mode draws drafts from the draft's own distribution
+            # (the rejection-sampling proof requires proposals from the
+            # reported q); the arena is donated inside the jitted scan.
+            d_params, d_cfg = self.draft
+            self._key, sub = jax.random.split(self._key)
+            drafts_dev, q_dev, self.draft_arena = draft_sample_propose(
+                d_params, self.draft_arena, jnp.asarray(cur),
+                jnp.asarray(self._pos), d_cfg, k,
+                jnp.float32(self.temperature), sub,
+            )
+            drafts, q = np.asarray(drafts_dev), np.asarray(q_dev)
+        elif self.draft is not None:
             # k+1 steps, first k kept — the same cache-hole avoidance as
             # models.speculative.draft_propose (its docstring has the
             # argument); _serve_decode rather than draft_propose so the
@@ -482,14 +507,27 @@ class GenerationServer:
                 )
                 drafts[b] = ngram_propose(hist, int(cur[b]), k)
         toks = np.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
-        greedy, self.arena = verify_step(
-            self.params, self.arena, jnp.asarray(toks),
-            jnp.asarray(self._pos), self.cfg, ring=self.ring_kv,
-        )
-        greedy = np.asarray(greedy)
+        if sampling:
+            logits, self.arena = verify_logits_step(
+                self.params, self.arena, jnp.asarray(toks),
+                jnp.asarray(self._pos), self.cfg, ring=self.ring_kv,
+            )
+            p = _softmax_np(np.asarray(logits, np.float32) / self.temperature)
+            if q is None:  # n-gram proposal in rejection-sampling form
+                q = _one_hot_q(drafts, self.cfg.vocab_size)
+        else:
+            greedy, self.arena = verify_step(
+                self.params, self.arena, jnp.asarray(toks),
+                jnp.asarray(self._pos), self.cfg, ring=self.ring_kv,
+            )
+            greedy = np.asarray(greedy)
         self._rounds += 1
         for b in active:
-            accepted = accept_drafts(drafts[b], greedy[b], k)
+            if sampling:
+                accepted = sample_accept_row(drafts[b], q[b], p[b],
+                                             self._np_rng)
+            else:
+                accepted = accept_drafts(drafts[b], greedy[b], k)
             self._slot_req[b].out.extend(accepted)
             self._last[b] = accepted[-1]
             self._pos[b] += len(accepted)

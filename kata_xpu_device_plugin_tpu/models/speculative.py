@@ -84,6 +84,99 @@ def verify_step(params: Params, caches, toks: jax.Array, pos: jax.Array,
     return greedy_token(logits), caches
 
 
+@partial(jax.jit, static_argnames=("cfg", "attn_fn", "ring"),
+         donate_argnums=(1,))
+def verify_logits_step(params: Params, caches, toks: jax.Array,
+                       pos: jax.Array, cfg: DecoderConfig,
+                       attn_fn: Optional[AttnFn] = None,
+                       ring: bool = False):
+    """:func:`verify_step`'s sampling sibling: returns the fp32 logits
+    ``[B, S, V]`` themselves instead of their argmax — speculative
+    SAMPLING needs the full target distribution at every span position
+    for the accept/residual test. Cache semantics identical."""
+    if attn_fn is None:
+        from ..ops.attention import flash_attention
+
+        attn_fn = flash_attention
+    B, S = toks.shape
+    positions = pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    logits, caches = forward(
+        params, toks, cfg, attn_fn=attn_fn, positions=positions,
+        kv_caches=caches, cache_offset=pos, ring=ring,
+    )
+    return logits.astype(jnp.float32), caches
+
+
+@partial(jax.jit, static_argnames=("draft_cfg", "k", "attn_fn"),
+         donate_argnums=(1,))
+def draft_sample_propose(draft_params: Params, draft_caches,
+                         cur: jax.Array, pos: jax.Array,
+                         draft_cfg: DecoderConfig, k: int,
+                         temperature, key: jax.Array,
+                         attn_fn: Optional[AttnFn] = None):
+    """Sampling counterpart of :func:`draft_propose`: draft ``k`` tokens
+    per row by SAMPLING from the draft's temperature-scaled distribution
+    (the rejection-sampling proof requires proposals drawn from the
+    reported ``q``), returning ``(drafts [B, k], q [B, k, V], caches)``
+    where ``q[b, i]`` is the exact distribution ``drafts[b, i]`` was
+    drawn from. Runs k+1 steps for the same cache-hole reason as
+    :func:`draft_propose`; the k+1-th sample is discarded."""
+    if attn_fn is None:
+        from ..ops.attention import flash_attention
+
+        attn_fn = flash_attention
+    B = cur.shape[0]
+
+    def step(carry, key_i):
+        caches, tok, p = carry
+        logits, caches = forward(
+            draft_params, tok[:, None], draft_cfg, attn_fn=attn_fn,
+            positions=p[:, None], kv_caches=caches, cache_offset=p,
+        )
+        lg = logits[:, -1, :].astype(jnp.float32) / temperature
+        nxt = jax.random.categorical(key_i, lg, axis=-1).astype(jnp.int32)
+        return (caches, nxt, p + 1), (nxt, jax.nn.softmax(lg, axis=-1))
+
+    init = (draft_caches, cur, jnp.asarray(pos, jnp.int32))
+    (caches, _tok, _p), (toks, probs) = jax.lax.scan(
+        step, init, jax.random.split(key, k + 1)
+    )
+    # scan stacks on axis 0: [k+1, B] / [k+1, B, V] → batch-major, drop
+    # the cache-hole step's sample.
+    return toks[:k].T, probs[:k].transpose(1, 0, 2), caches
+
+
+def sample_accept_row(drafts_row: np.ndarray, q_row: np.ndarray,
+                      p_row: np.ndarray, rng: np.random.Generator) -> list:
+    """Lossless speculative SAMPLING acceptance for one row (Leviathan/
+    Chen rejection scheme): accept draft ``x_i`` with probability
+    ``min(1, p_i(x_i)/q_i(x_i))``; on the first rejection, emit a sample
+    from the residual ``normalize(max(p_i − q_i, 0))`` and stop; if all
+    ``k`` drafts are accepted, emit a bonus sample from ``p_k``. The
+    emitted tokens are distributed EXACTLY as ancestral sampling from
+    ``p`` — draft quality moves the acceptance rate, never the
+    distribution. ``q_row [k, V]``, ``p_row [k+1, V]``; returns 1..k+1
+    accepted tokens (the same contract as :func:`accept_drafts`)."""
+    k = len(drafts_row)
+    out: list[int] = []
+    for i in range(k):
+        x = int(drafts_row[i])
+        q_x = float(q_row[i, x])
+        p_x = float(p_row[i, x])
+        if q_x > 0.0 and rng.random() < min(1.0, p_x / q_x):
+            out.append(x)
+            continue
+        resid = np.maximum(p_row[i] - q_row[i], 0.0)
+        total = resid.sum()
+        if total <= 0.0:  # p == q numerically: any p-sample is exact
+            resid, total = p_row[i], p_row[i].sum()
+        out.append(int(rng.choice(len(resid), p=resid / total)))
+        return out
+    p_last = p_row[k]
+    out.append(int(rng.choice(len(p_last), p=p_last / p_last.sum())))
+    return out
+
+
 def self_draft(params: Params, cfg: DecoderConfig,
                n_layers: int) -> tuple[Params, DecoderConfig]:
     """A zero-training draft model: the target's FIRST ``n_layers`` decoder
@@ -171,18 +264,27 @@ def generate_speculative(params: Params, prompt: jax.Array,
                          cfg: DecoderConfig, steps: int, k: int = 4,
                          max_len: int = 0,
                          attn_fn: Optional[AttnFn] = None,
-                         draft: Optional[tuple] = None) -> np.ndarray:
-    """Greedy generation with speculative decoding — output is
-    token-identical to :func:`..models.transformer.generate` at
-    ``temperature=0``. Returns ``[B, steps]`` int32 plus nothing else;
-    ``k`` is the draft length per verify round.
+                         draft: Optional[tuple] = None,
+                         temperature: float = 0.0,
+                         seed: int = 0) -> np.ndarray:
+    """Speculative generation. At ``temperature=0`` the output is
+    token-identical to greedy :func:`..models.transformer.generate`; at
+    ``temperature>0`` it is lossless speculative SAMPLING — the emitted
+    stream is distributed exactly as ancestral sampling from the
+    temperature-scaled target (:func:`sample_accept_row`), though not the
+    same stream as ``generate``'s (different randomness consumption;
+    ``seed`` makes it reproducible). Returns ``[B, steps]`` int32; ``k``
+    is the draft length per verify round.
 
     ``draft=(draft_params, draft_cfg)`` switches the draft source from
     n-gram lookup to a draft model (see module docstring); the draft
     prefills its own cache over the same prompt and tracks the same
-    per-row positions as the target."""
+    per-row positions as the target. Sampling mode draws the drafts from
+    the draft's own distribution (n-gram drafts act as a one-hot
+    proposal — valid, just lower acceptance)."""
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
+    sampling = temperature > 0.0
     prompt = np.asarray(prompt, np.int32)
     B, S = prompt.shape
     # Each verify round may write up to k tokens past the accepted prefix;
@@ -195,8 +297,18 @@ def generate_speculative(params: Params, prompt: jax.Array,
             f"max_len={max_len} < prompt+steps+k={need} (speculative "
             "verification needs k entries of cache headroom)"
         )
-    caches, last, pos0 = prefill(params, jnp.asarray(prompt), cfg, max_len)
-    last = np.asarray(last)
+    rng = np.random.default_rng(seed)
+    d_key = jax.random.PRNGKey(seed)
+    caches, last, pos0 = prefill(params, jnp.asarray(prompt), cfg, max_len,
+                                 return_logits=sampling)
+    if sampling:
+        p0 = _softmax_np(np.asarray(last, np.float32) / temperature)
+        last = np.array([
+            rng.choice(cfg.vocab_size, p=p0[b] / p0[b].sum())
+            for b in range(B)
+        ], np.int32)
+    else:
+        last = np.asarray(last)
     if draft is not None:
         draft_params, draft_cfg = draft
         if draft_cfg.vocab_size != cfg.vocab_size:
@@ -214,7 +326,16 @@ def generate_speculative(params: Params, prompt: jax.Array,
 
     while min(len(o) for o in out) < steps:
         cur = np.array([o[-1] for o in out], np.int32)
-        if draft is not None:
+        q = None
+        if draft is not None and sampling:
+            d_key, sub = jax.random.split(d_key)
+            drafts, q, draft_caches = draft_sample_propose(
+                draft_params, draft_caches, jnp.asarray(cur),
+                jnp.asarray(pos), draft_cfg, k,
+                jnp.float32(temperature), sub, attn_fn=attn_fn,
+            )
+            drafts, q = np.asarray(drafts), np.asarray(q)
+        elif draft is not None:
             drafts, draft_caches = draft_propose(
                 draft_params, draft_caches, jnp.asarray(cur),
                 jnp.asarray(pos), draft_cfg, k, attn_fn=attn_fn,
@@ -226,18 +347,44 @@ def generate_speculative(params: Params, prompt: jax.Array,
                 for b in range(B)
             ])
         toks = np.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
-        greedy, caches = verify_step(
-            params, caches, jnp.asarray(toks), jnp.asarray(pos), cfg,
-            attn_fn=attn_fn,
-        )
-        greedy = np.asarray(greedy)  # greedy[b, j] follows toks[b, :j+1]
+        if sampling:
+            logits, caches = verify_logits_step(
+                params, caches, jnp.asarray(toks), jnp.asarray(pos), cfg,
+                attn_fn=attn_fn,
+            )
+            p = _softmax_np(np.asarray(logits, np.float32) / temperature)
+            if q is None:  # n-gram proposal: a one-hot q per draft
+                q = _one_hot_q(drafts, cfg.vocab_size)
+        else:
+            greedy, caches = verify_step(
+                params, caches, jnp.asarray(toks), jnp.asarray(pos), cfg,
+                attn_fn=attn_fn,
+            )
+            greedy = np.asarray(greedy)  # greedy[b, j] follows toks[b, :j+1]
         for b in range(B):
             if len(out[b]) >= steps:
                 # Row already done: its verify round was padding; do not
                 # advance its state (rewrites the same span next round).
                 continue
-            accepted = accept_drafts(drafts[b], greedy[b], k)
+            if sampling:
+                accepted = sample_accept_row(drafts[b], q[b], p[b], rng)
+            else:
+                accepted = accept_drafts(drafts[b], greedy[b], k)
             history[b].extend([int(cur[b])] + accepted[:-1])
             out[b].extend(accepted)
             pos[b] += len(accepted)  # cur + accepted drafts are now cached
     return np.array([o[:steps] for o in out], np.int32)
+
+
+def _softmax_np(x: np.ndarray) -> np.ndarray:
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def _one_hot_q(drafts: np.ndarray, vocab: int) -> np.ndarray:
+    """[B, k] draft ids → [B, k, V] one-hot proposal distributions (the
+    deterministic n-gram proposal in rejection-sampling form)."""
+    B, k = drafts.shape
+    q = np.zeros((B, k, vocab), np.float32)
+    q[np.arange(B)[:, None], np.arange(k)[None, :], drafts] = 1.0
+    return q

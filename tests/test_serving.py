@@ -396,10 +396,15 @@ def test_stats_counters(model):
     assert st2["tokens_emitted"] >= 8
 
 
-def test_speculative_serving_rejects_sampling(model):
+def test_speculative_serving_sampling_contract(model):
+    """r5: plain temperature sampling now composes with speculation (the
+    lossless rejection scheme); only top_k/top_p truncation — which the
+    acceptance math does not model — is rejected."""
     cfg, params = model
-    with pytest.raises(ValueError, match="greedy-only"):
-        GenerationServer(params, cfg, temperature=0.7, speculative_k=3)
+    GenerationServer(params, cfg, temperature=0.7, speculative_k=3)  # ok
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        GenerationServer(params, cfg, temperature=0.7, top_p=0.9,
+                         speculative_k=3)
 
 
 def test_draft_model_serving_matches_plain_greedy(model):
@@ -463,3 +468,41 @@ def test_submit_validation(model):
         srv.submit(np.zeros(10, np.int32), max_new_tokens=10)  # 20 > 16
     with pytest.raises(ValueError):
         GenerationServer(params, cfg, top_k=5)  # top_k without temperature
+
+
+def test_speculative_sampling_serving(model):
+    """temperature>0 + speculative_k: lossless speculative SAMPLING
+    (rejection scheme) — reproducible per seed, varies across seeds,
+    budget respected, acceptance reported; top_k/top_p still rejected."""
+    from kata_xpu_device_plugin_tpu.models import self_draft
+
+    cfg, params = model
+    draft = self_draft(params, cfg, 1)
+    prompts = _prompts(cfg, [5, 8, 4], seed=61)
+
+    def run(seed):
+        srv = GenerationServer(params, cfg, max_batch=2, max_len=40,
+                               temperature=0.9, speculative_k=3,
+                               draft=draft, seed=seed)
+        rids = [srv.submit(p, 10) for p in prompts]
+        res = srv.run()
+        return [res[r] for r in rids], srv.stats()
+
+    a, st = run(3)
+    b, _ = run(3)
+    c, _ = run(4)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    assert not all(np.array_equal(x, y) for x, y in zip(a, c))
+    assert all(len(x) == 10 for x in a)
+    assert 0.0 <= st["draft_acceptance"] <= 1.0
+
+    # n-gram proposal works in sampling mode too (one-hot q).
+    srv = GenerationServer(params, cfg, max_batch=2, max_len=40,
+                           temperature=0.9, speculative_k=3, seed=3)
+    rid = srv.submit(prompts[0], 8)
+    assert len(srv.run()[rid]) == 8
+
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        GenerationServer(params, cfg, temperature=0.9, top_k=5,
+                         speculative_k=3)

@@ -167,6 +167,7 @@ def make_train_step(
     optimizer: Optional[optax.GradientTransformation] = None,
     attn_fn: Optional[Callable] = None,
     remat: bool = False,
+    accum_steps: int = 1,
 ):
     """Returns (init_state, step). ``step(state, tokens) -> (state, loss)``,
     jitted over the mesh with donated state.
@@ -182,7 +183,17 @@ def make_train_step(
     SPMD partitioning rule, so the shard_map is what lets the kernel
     partition instead of replicating; per-local-block eligibility still
     falls back to the XLA reference for unsupported shapes. Elsewhere
-    (CPU test meshes), the XLA reference."""
+    (CPU test meshes), the XLA reference.
+
+    ``accum_steps > 1``: gradient accumulation — ``tokens
+    [accum_steps·B, S]`` is split into ``accum_steps`` microbatches, a
+    ``lax.scan`` accumulates their mean gradients (one live microbatch
+    of activations at a time — activation memory drops ~accum_steps×),
+    and ONE optimizer update applies the mean. For dense configs the
+    result equals the full-batch step exactly (mean of equal-sized
+    microbatch means); MoE capacity dispatch makes it approximate, like
+    every other batch-size change. The caller keeps each microbatch
+    divisible by the mesh's batch axes."""
     optimizer = optimizer or make_optimizer()
     tp = mesh.shape.get(AXIS_MODEL, 1)
     # Shard the head dims over model only when BOTH divide: splitting q
@@ -236,9 +247,33 @@ def make_train_step(
 
     from functools import partial
 
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
     @partial(jax.jit, donate_argnums=(0,))
     def step(state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens)
+        else:
+            B = tokens.shape[0]
+            if B % accum_steps:
+                raise ValueError(
+                    f"batch {B} not divisible by accum_steps={accum_steps}"
+                )
+            micros = tokens.reshape(accum_steps, B // accum_steps,
+                                    tokens.shape[1])
+
+            def micro(carry, mb):
+                g_sum, l_sum = carry
+                l, g = jax.value_and_grad(loss_fn)(state["params"], mb)
+                return (jax.tree.map(jnp.add, g_sum, g), l_sum + l), None
+
+            zeros = jax.tree.map(jnp.zeros_like, state["params"])
+            (g_sum, l_sum), _ = jax.lax.scan(
+                micro, (zeros, jnp.float32(0.0)), micros
+            )
+            grads = jax.tree.map(lambda g: g / accum_steps, g_sum)
+            loss = l_sum / accum_steps
         updates, new_opt = optimizer.update(grads, state["opt"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
         return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss

@@ -143,6 +143,37 @@ def test_seq_composed_train_step_matches_unsharded():
     assert losses[-1] < losses[0], losses
 
 
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps=2 over [8, S] must produce the same loss and updated
+    params as the full-batch step on identical tokens (dense config:
+    mean of equal-sized microbatch means == full-batch mean), while only
+    one microbatch of activations is ever live."""
+    cfg = llama3_train_test()
+    mesh = parallel.build_mesh({"data": 1, "fsdp": 2, "model": 2},
+                               devices=jax.devices()[:4])
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
+                              cfg.vocab_size)
+
+    def run(accum):
+        init_state, step = parallel.make_train_step(cfg, mesh,
+                                                    accum_steps=accum)
+        state = init_state(jax.random.PRNGKey(0))
+        state, loss = step(state, parallel.shard_batch(toks, mesh))
+        fp = sum(
+            float(jnp.sum(jnp.abs(x.astype(jnp.float32))))
+            for x in jax.tree.leaves(state["params"])
+        )
+        return float(loss), fp
+
+    loss1, fp1 = run(1)
+    loss2, fp2 = run(2)
+    np.testing.assert_allclose(loss2, loss1, rtol=1e-5)
+    np.testing.assert_allclose(fp2, fp1, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="accum_steps"):
+        parallel.make_train_step(cfg, mesh, accum_steps=0)
+
+
 # ----- pipeline parallelism (pp) -------------------------------------------
 
 

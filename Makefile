@@ -39,7 +39,7 @@ bench-watch:
 
 # Quantization quality ladder (bf16 vs int8 vs W8A8 vs int8-KV): the
 # measurement ops/quant.py's W8A8 docstring prescribes before production.
-# On the attached TPU: python scripts/eval_quality.py --config gemma2b --dtype bfloat16
+# On the attached TPU: python scripts/eval_quality.py --config gemma2_2b --dtype bfloat16
 eval:
 	$(PY) scripts/eval_quality.py --cpu
 

@@ -67,6 +67,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 # Per-chip HBM bandwidth (GB/s) by TPU generation — public spec-sheet numbers.
 HBM_GBPS = {"v5e": 819.0, "v5p": 2765.0, "v4": 1228.0, "v6e": 1640.0, "cpu": 50.0}
@@ -99,8 +100,12 @@ FALLBACK_RESERVE_S = SMOKE_TIMEOUT_S + 30
 # --------------------------------------------------------------------------
 
 
-def probe_tunnel(deadline: float) -> tuple[bool, bool, str]:
+def probe_tunnel(deadline: float,
+                 timeout_s: Optional[float] = None) -> tuple[bool, bool, str]:
     """One tiny dispatch in a killable subprocess: (ok, hung, message).
+    ``timeout_s`` overrides the PROBE_TIMEOUT_S cap (the watchdog passes
+    its --probe-timeout through; without the override, values above the
+    env default would be silently clamped).
 
     ``jax.devices()`` can succeed while the transport is dead, so the probe
     round-trips an actual computation. A probe that must be SIGKILLed means
@@ -111,7 +116,8 @@ def probe_tunnel(deadline: float) -> tuple[bool, bool, str]:
     (plugin missing, env leak) completes the dispatch fine but means there is
     no tunnel to measure through — that is "down", not "healthy".
     """
-    timeout = max(10.0, min(PROBE_TIMEOUT_S, deadline - time.monotonic()))
+    cap = PROBE_TIMEOUT_S if timeout_s is None else timeout_s
+    timeout = max(10.0, min(cap, deadline - time.monotonic()))
     code = (
         "import jax, jax.numpy as jnp, numpy as np\n"
         "np.asarray(jnp.ones((8,)) + 1)\n"

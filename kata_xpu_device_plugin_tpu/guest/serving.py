@@ -195,9 +195,6 @@ class GenerationServer:
         self._do_sample, self._key = _sampling_args(
             temperature, top_k, jax.random.PRNGKey(seed), top_p
         )
-        # Host-side RNG for speculative SAMPLING's accept/residual draws
-        # (models.speculative.sample_accept_row); seeded so runs reproduce.
-        self._np_rng = np.random.default_rng(seed)
         # kv_quant: int8 arena — ~2× less HBM per slot-token, so the same
         # chip serves ~2× the context/slots (per-vector scales; decode
         # dequant fuses into the attention dots). ring_kv: windowed layers
@@ -459,12 +456,10 @@ class GenerationServer:
         last entry, which no valid prefix ever includes (submit guarantees
         prompt + budget <= max_len, so live prefixes end at max_len-2)."""
         from ..models.speculative import (
-            _one_hot_q,
-            _softmax_np,
             accept_drafts,
             draft_sample_propose,
             ngram_propose,
-            sample_accept_row,
+            sample_accept_device,
             verify_logits_step,
             verify_step,
         )
@@ -472,11 +467,12 @@ class GenerationServer:
         k = self.speculative_k
         sampling = self._do_sample
         cur = self._last.copy()
-        q = None
+        q_dev = None
         if self.draft is not None and sampling:
             # Sampling mode draws drafts from the draft's own distribution
             # (the rejection-sampling proof requires proposals from the
             # reported q); the arena is donated inside the jitted scan.
+            # q stays ON DEVICE — sample_accept_device consumes it there.
             d_params, d_cfg = self.draft
             self._key, sub = jax.random.split(self._key)
             drafts_dev, q_dev, self.draft_arena = draft_sample_propose(
@@ -484,7 +480,7 @@ class GenerationServer:
                 jnp.asarray(self._pos), d_cfg, k,
                 jnp.float32(self.temperature), sub,
             )
-            drafts, q = np.asarray(drafts_dev), np.asarray(q_dev)
+            drafts = np.asarray(drafts_dev)
         elif self.draft is not None:
             # k+1 steps, first k kept — the same cache-hole avoidance as
             # models.speculative.draft_propose (its docstring has the
@@ -508,13 +504,20 @@ class GenerationServer:
                 drafts[b] = ngram_propose(hist, int(cur[b]), k)
         toks = np.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
         if sampling:
+            # Accept/residual runs ON DEVICE: only token ids and counts
+            # cross the transport, never [B, k+1, V] distributions (at
+            # production vocab that transfer would dominate the round).
             logits, self.arena = verify_logits_step(
                 self.params, self.arena, jnp.asarray(toks),
                 jnp.asarray(self._pos), self.cfg, ring=self.ring_kv,
             )
-            p = _softmax_np(np.asarray(logits, np.float32) / self.temperature)
-            if q is None:  # n-gram proposal in rejection-sampling form
-                q = _one_hot_q(drafts, self.cfg.vocab_size)
+            self._key, sub = jax.random.split(self._key)
+            tok_acc, counts = sample_accept_device(
+                jnp.asarray(drafts), q_dev, logits,
+                jnp.float32(self.temperature), sub, k,
+                has_q=q_dev is not None,
+            )
+            tok_acc, counts = np.asarray(tok_acc), np.asarray(counts)
         else:
             greedy, self.arena = verify_step(
                 self.params, self.arena, jnp.asarray(toks),
@@ -524,8 +527,7 @@ class GenerationServer:
         self._rounds += 1
         for b in active:
             if sampling:
-                accepted = sample_accept_row(drafts[b], q[b], p[b],
-                                             self._np_rng)
+                accepted = tok_acc[b, : counts[b]].tolist()
             else:
                 accepted = accept_drafts(drafts[b], greedy[b], k)
             self._slot_req[b].out.extend(accepted)

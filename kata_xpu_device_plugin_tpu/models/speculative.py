@@ -177,6 +177,57 @@ def sample_accept_row(drafts_row: np.ndarray, q_row: np.ndarray,
     return out
 
 
+NEG_INF = -1e30
+
+
+@partial(jax.jit, static_argnames=("k", "has_q"))
+def sample_accept_device(drafts: jax.Array, q, logits: jax.Array,
+                         temperature, key: jax.Array, k: int,
+                         has_q: bool = True):
+    """:func:`sample_accept_row`'s on-device twin: the same rejection
+    scheme, vectorized over the batch, so each verify round transfers
+    only token ids and counts to the host — never the ``[B, k+1, V]``
+    target distribution (at production vocab sizes that transfer would
+    dominate round latency and erase the speculative win).
+
+    ``logits [B, k+1, V]`` are the verify round's fp32 logits; ``q
+    [B, k, V]`` the draft's proposal distributions (``has_q=False``
+    treats the drafts as a one-hot proposal — the n-gram case — and
+    ignores ``q``). Returns ``(tokens [B, k+1], count [B])`` where row
+    ``b`` emits ``tokens[b, :count[b]]``: its accepted draft prefix,
+    then the residual sample (first rejection) or the bonus sample from
+    ``p_k`` (full acceptance) — both unified as a categorical over
+    ``max(p_at − q_at, 0)`` with ``q`` zero-padded at position k."""
+    B = drafts.shape[0]
+    V = logits.shape[-1]
+    p = jax.nn.softmax(logits.astype(jnp.float32) / temperature, axis=-1)
+    if not has_q:
+        q = jax.nn.one_hot(drafts, V, dtype=jnp.float32)
+    k_u, k_r = jax.random.split(key)
+    u = jax.random.uniform(k_u, (B, k))
+    p_x = jnp.take_along_axis(p[:, :k], drafts[..., None], axis=-1)[..., 0]
+    q_x = jnp.take_along_axis(q, drafts[..., None], axis=-1)[..., 0]
+    accept = (q_x > 0.0) & (u * q_x < p_x)  # u < p/q without the divide
+    first = jnp.min(
+        jnp.where(~accept, jnp.arange(k)[None, :], k), axis=1
+    )  # [B] index of the first rejection, k when all accepted
+    p_at = jnp.take_along_axis(p, first[:, None, None], axis=1)[:, 0]
+    q_pad = jnp.concatenate([q, jnp.zeros((B, 1, V), jnp.float32)], axis=1)
+    q_at = jnp.take_along_axis(q_pad, first[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(p_at - q_at, 0.0)
+    degenerate = resid.sum(-1, keepdims=True) <= 0.0  # p == q numerically
+    resid = jnp.where(degenerate, p_at, resid)
+    resid_logits = jnp.where(resid > 0.0, jnp.log(resid), NEG_INF)
+    correction = jax.random.categorical(k_r, resid_logits, axis=-1)
+    tokens = jnp.concatenate(
+        [drafts, jnp.zeros((B, 1), drafts.dtype)], axis=1
+    )
+    tokens = tokens.at[jnp.arange(B), first].set(
+        correction.astype(drafts.dtype)
+    )
+    return tokens, (first + 1).astype(jnp.int32)
+
+
 def self_draft(params: Params, cfg: DecoderConfig,
                n_layers: int) -> tuple[Params, DecoderConfig]:
     """A zero-training draft model: the target's FIRST ``n_layers`` decoder
@@ -297,16 +348,15 @@ def generate_speculative(params: Params, prompt: jax.Array,
             f"max_len={max_len} < prompt+steps+k={need} (speculative "
             "verification needs k entries of cache headroom)"
         )
-    rng = np.random.default_rng(seed)
     d_key = jax.random.PRNGKey(seed)
     caches, last, pos0 = prefill(params, jnp.asarray(prompt), cfg, max_len,
                                  return_logits=sampling)
     if sampling:
-        p0 = _softmax_np(np.asarray(last, np.float32) / temperature)
-        last = np.array([
-            rng.choice(cfg.vocab_size, p=p0[b] / p0[b].sum())
-            for b in range(B)
-        ], np.int32)
+        from .transformer import _next_token
+
+        d_key, k0 = jax.random.split(d_key)
+        last = np.asarray(_next_token(last, k0, True,
+                                      jnp.float32(temperature), 0))
     else:
         last = np.asarray(last)
     if draft is not None:
@@ -326,15 +376,15 @@ def generate_speculative(params: Params, prompt: jax.Array,
 
     while min(len(o) for o in out) < steps:
         cur = np.array([o[-1] for o in out], np.int32)
-        q = None
+        q_dev = None
         if draft is not None and sampling:
             d_key, sub = jax.random.split(d_key)
-            drafts, q, draft_caches = draft_sample_propose(
+            drafts_dev, q_dev, draft_caches = draft_sample_propose(
                 draft_params, draft_caches, jnp.asarray(cur),
                 jnp.asarray(pos), draft_cfg, k,
                 jnp.float32(temperature), sub, attn_fn=attn_fn,
             )
-            drafts, q = np.asarray(drafts), np.asarray(q)
+            drafts = np.asarray(drafts_dev)
         elif draft is not None:
             drafts, draft_caches = draft_propose(
                 draft_params, draft_caches, jnp.asarray(cur),
@@ -348,13 +398,19 @@ def generate_speculative(params: Params, prompt: jax.Array,
             ])
         toks = np.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
         if sampling:
+            # Accept/residual runs ON DEVICE (sample_accept_device):
+            # only token ids and counts cross the transport, never the
+            # [B, k+1, V] target distribution.
             logits, caches = verify_logits_step(
                 params, caches, jnp.asarray(toks), jnp.asarray(pos), cfg,
                 attn_fn=attn_fn,
             )
-            p = _softmax_np(np.asarray(logits, np.float32) / temperature)
-            if q is None:  # n-gram proposal: a one-hot q per draft
-                q = _one_hot_q(drafts, cfg.vocab_size)
+            d_key, sub = jax.random.split(d_key)
+            tok_acc, counts = sample_accept_device(
+                jnp.asarray(drafts), q_dev, logits,
+                jnp.float32(temperature), sub, k, has_q=q_dev is not None,
+            )
+            tok_acc, counts = np.asarray(tok_acc), np.asarray(counts)
         else:
             greedy, caches = verify_step(
                 params, caches, jnp.asarray(toks), jnp.asarray(pos), cfg,
@@ -367,24 +423,10 @@ def generate_speculative(params: Params, prompt: jax.Array,
                 # advance its state (rewrites the same span next round).
                 continue
             if sampling:
-                accepted = sample_accept_row(drafts[b], q[b], p[b], rng)
+                accepted = tok_acc[b, : counts[b]].tolist()
             else:
                 accepted = accept_drafts(drafts[b], greedy[b], k)
             history[b].extend([int(cur[b])] + accepted[:-1])
             out[b].extend(accepted)
             pos[b] += len(accepted)  # cur + accepted drafts are now cached
     return np.array([o[:steps] for o in out], np.int32)
-
-
-def _softmax_np(x: np.ndarray) -> np.ndarray:
-    e = np.exp(x - x.max(axis=-1, keepdims=True))
-    return e / e.sum(axis=-1, keepdims=True)
-
-
-def _one_hot_q(drafts: np.ndarray, vocab: int) -> np.ndarray:
-    """[B, k] draft ids → [B, k, V] one-hot proposal distributions (the
-    deterministic n-gram proposal in rejection-sampling form)."""
-    B, k = drafts.shape
-    q = np.zeros((B, k, vocab), np.float32)
-    q[np.arange(B)[:, None], np.arange(k)[None, :], drafts] = 1.0
-    return q

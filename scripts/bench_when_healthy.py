@@ -54,8 +54,13 @@ def log(msg: str) -> None:
 
 
 def probe(timeout_s: float) -> tuple[bool, str]:
-    """bench.py's tunnel probe: tiny dispatch, platform must be tpu."""
-    ok, hung, msg = _bench.probe_tunnel(time.monotonic() + timeout_s)
+    """bench.py's tunnel probe: tiny dispatch, platform must be tpu.
+    ``timeout_s`` is passed through as the probe's own cap — without the
+    override, bench's env default (90 s) would silently clamp larger
+    --probe-timeout values."""
+    ok, hung, msg = _bench.probe_tunnel(
+        time.monotonic() + timeout_s, timeout_s=timeout_s
+    )
     if hung:
         return False, "hung"
     return ok, msg or "ok"
@@ -90,7 +95,18 @@ def run_bench(timeout_s: float) -> dict | None:
     if head.get("platform") != "tpu":
         log(f"bench completed but platform={head.get('platform')!r} — not banking")
         return None
-    head["_all_lines"] = [json.loads(ln) for ln in lines]
+    # Side-section lines are parsed best-effort: a worker killed mid-print
+    # (tunnel re-wedge — the exact scenario this watchdog exists for) can
+    # leave a truncated line, which must not crash the long-running loop.
+    parsed, bad = [], 0
+    for ln in lines:
+        try:
+            parsed.append(json.loads(ln))
+        except json.JSONDecodeError:
+            bad += 1
+    if bad:
+        log(f"dropped {bad} truncated side-section line(s)")
+    head["_all_lines"] = parsed
     return head
 
 

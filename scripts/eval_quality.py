@@ -27,7 +27,7 @@ tokens:
 
 CPU-runnable on the test configs (default); on the attached TPU the same
 command evaluates the bench model: ``python scripts/eval_quality.py
---config gemma2b --dtype bfloat16``. ``make eval`` runs the CPU ladder.
+--config gemma2_2b --dtype bfloat16``. ``make eval`` runs the CPU ladder.
 
 One JSON line per variant on stdout; human summary on stderr.
 """

@@ -244,3 +244,39 @@ def test_speculative_sampling_generate(model):
     assert not np.array_equal(a, c)
     d = generate_speculative(params, prompt, cfg, seed=5, **kw)  # n-gram q
     assert d.shape == (2, 12)
+
+
+def test_sample_accept_device_matches_target_distribution():
+    """The on-device rejection kernel (what serving actually runs): over
+    40k vectorized rows of one fixed (p, q), the first emitted token's
+    empirical distribution must match p[0] — same lemma, device RNG."""
+    from kata_xpu_device_plugin_tpu.models.speculative import (
+        sample_accept_device,
+    )
+
+    V, k, N = 6, 2, 40000
+    p = np.array([[.4, .3, .1, .1, .05, .05],
+                  [.1, .1, .5, .1, .1, .1],
+                  [.2, .2, .2, .2, .1, .1]], np.float32)
+    q = np.array([[.3, .3, .2, .1, .05, .05],
+                  [.25, .25, .1, .2, .1, .1]], np.float32)
+    key = jax.random.PRNGKey(0)
+    k_d, k_a = jax.random.split(key)
+    # Drafts sampled from q per row (the proposal the proof requires).
+    drafts = jnp.stack([
+        jax.random.categorical(jax.random.fold_in(k_d, i),
+                               jnp.log(jnp.asarray(q[i]))[None, :]
+                               .repeat(N, 0))
+        for i in range(k)
+    ], axis=1).astype(jnp.int32)  # [N, k]
+    # logits whose temperature-1 softmax is exactly p, tiled per row.
+    logits = jnp.log(jnp.asarray(p))[None].repeat(N, 0)  # [N, k+1, V]
+    toks, counts = sample_accept_device(
+        drafts, jnp.asarray(q)[None].repeat(N, 0), logits,
+        jnp.float32(1.0), k_a, k,
+    )
+    first = np.asarray(toks[:, 0])
+    emp = np.bincount(first, minlength=V) / N
+    tv = 0.5 * np.abs(emp - p[0]).sum()
+    assert tv < 0.025, tv
+    assert np.all((np.asarray(counts) >= 1) & (np.asarray(counts) <= k + 1))

@@ -66,55 +66,63 @@ def make_ulysses_attention(
         attn_fn = flash_attention
     n = mesh.shape[axis]
 
-    def local(q, k, v):
-        # q [B, S_loc, H, D]; k/v [B, S_loc, KV, D]
-        B, S_loc, H, D = q.shape
-        KV = k.shape[2]
-        if H % n:
-            raise ValueError(f"Ulysses needs n_heads % sp == 0, got H={H}, sp={n}")
-        qh = _seq_to_heads(q, axis)  # [B, S, H/n, D]
-        if KV % n == 0:
-            kh = _seq_to_heads(k, axis)
-            vh = _seq_to_heads(v, axis)
-        elif n % KV == 0:
-            # Few KV heads (GQA/MQA), several devices per kv head: gather
-            # the full sequence of all KV heads and slice the ONE kv head
-            # this device's q-head group maps to (h_loc divides group here,
-            # so the group never straddles a kv boundary; the slice count is
-            # static). KV cache is small next to q at this point.
-            k_full = lax.all_gather(k, axis, axis=1, tiled=True)  # [B, S, KV, D]
-            v_full = lax.all_gather(v, axis, axis=1, tiled=True)
-            group = H // KV  # q heads per kv head (global)
-            h_loc = H // n
-            kv_start = (lax.axis_index(axis) * h_loc) // group
-            kh = lax.dynamic_slice_in_dim(k_full, kv_start, 1, axis=2)
-            vh = lax.dynamic_slice_in_dim(v_full, kv_start, 1, axis=2)
-        else:
-            raise ValueError(
-                f"Ulysses sp degree {n} must divide n_kv_heads={KV} or be a "
-                f"multiple of it (ring attention has no such constraint)"
-            )
-        out = attn_fn(qh, kh, vh, causal=True, q_offset=None)
-        return _heads_to_seq(out, axis)
+    from functools import lru_cache
 
-    mapped = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(None, axis, None, None),) * 3,
-        out_specs=P(None, axis, None, None),
-        check_vma=False,
-    )
+    @lru_cache(maxsize=None)  # one shard_map per (window, softcap)
+    def mapped_for(window: int, softcap: float):
+        def local(q, k, v):
+            # q [B, S_loc, H, D]; k/v [B, S_loc, KV, D]
+            B, S_loc, H, D = q.shape
+            KV = k.shape[2]
+            if H % n:
+                raise ValueError(
+                    f"Ulysses needs n_heads % sp == 0, got H={H}, sp={n}"
+                )
+            qh = _seq_to_heads(q, axis)  # [B, S, H/n, D]
+            if KV % n == 0:
+                kh = _seq_to_heads(k, axis)
+                vh = _seq_to_heads(v, axis)
+            elif n % KV == 0:
+                # Few KV heads (GQA/MQA), several devices per kv head: gather
+                # the full sequence of all KV heads and slice the ONE kv head
+                # this device's q-head group maps to (h_loc divides group here,
+                # so the group never straddles a kv boundary; the slice count is
+                # static). KV cache is small next to q at this point.
+                k_full = lax.all_gather(k, axis, axis=1, tiled=True)  # [B, S, KV, D]
+                v_full = lax.all_gather(v, axis, axis=1, tiled=True)
+                group = H // KV  # q heads per kv head (global)
+                h_loc = H // n
+                kv_start = (lax.axis_index(axis) * h_loc) // group
+                kh = lax.dynamic_slice_in_dim(k_full, kv_start, 1, axis=2)
+                vh = lax.dynamic_slice_in_dim(v_full, kv_start, 1, axis=2)
+            else:
+                raise ValueError(
+                    f"Ulysses sp degree {n} must divide n_kv_heads={KV} or be a "
+                    f"multiple of it (ring attention has no such constraint)"
+                )
+            # Each device sees the FULL sequence for its head group, so the
+            # sliding-window band and the Gemma-2 softcap forward straight
+            # into the inner attention (flash block-skips the band on TPU).
+            kw = {}
+            if window:
+                kw["window"] = window
+            if softcap:
+                kw["logits_softcap"] = softcap
+            out = attn_fn(qh, kh, vh, causal=True, q_offset=None, **kw)
+            return _heads_to_seq(out, axis)
+
+        return shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(None, axis, None, None),) * 3,
+            out_specs=P(None, axis, None, None),
+            check_vma=False,
+        )
 
     def ulysses_attn(q, k, v, causal: bool = True, q_offset=None,
-                     window: int = 0):
-        if window:
-            raise ValueError(
-                "ulysses attention does not support sliding-window configs "
-                "(cfg.sliding_window) — use the single-device attention or "
-                "set sliding_window=0 for the sp path"
-            )
+                     window: int = 0, logits_softcap: float = 0.0):
         if not causal or q_offset is not None:
             raise ValueError("ulysses attention supports causal self-attention only")
-        return mapped(q, k, v)
+        return mapped_for(int(window), float(logits_softcap))(q, k, v)
 
     return ulysses_attn

@@ -648,6 +648,30 @@ def test_ulysses_attention_matches_reference(n, kv):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
 
 
+def test_ulysses_window_and_softcap_match_reference():
+    """r5: Ulysses forwards the sliding-window band and Gemma-2 softcap
+    into its full-sequence inner attention — both must match the
+    reference (ring gained the same support; sp strategy choice should
+    not constrain the model family)."""
+    from functools import partial
+
+    from kata_xpu_device_plugin_tpu.ops.attention import reference_attention
+    from kata_xpu_device_plugin_tpu.parallel import make_ulysses_attention, seq_mesh
+
+    B, S, H, KV, D = 2, 64, 8, 4, 16
+    keys = jax.random.split(jax.random.PRNGKey(19), 3)
+    q = jax.random.normal(keys[0], (B, S, H, D), jnp.float32)
+    k = jax.random.normal(keys[1], (B, S, KV, D), jnp.float32)
+    v = jax.random.normal(keys[2], (B, S, KV, D), jnp.float32)
+    ua = make_ulysses_attention(seq_mesh(4), attn_fn=reference_attention)
+    for kw in ({"window": 20}, {"logits_softcap": 4.0},
+               {"window": 12, "logits_softcap": 4.0}):
+        out = jax.jit(partial(ua, **kw))(q, k, v)
+        ref = reference_attention(q, k, v, causal=True, **kw)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5, err_msg=str(kw))
+
+
 def test_ulysses_rejects_bad_degrees():
     from kata_xpu_device_plugin_tpu.parallel import make_ulysses_attention, seq_mesh
 

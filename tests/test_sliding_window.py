@@ -166,11 +166,10 @@ def test_flash_kernel_window_interpret():
 
 
 def test_sp_window_support(model):
-    """Ring attention ACCEPTS windowed configs (r5: the r4 rejection was
-    lifted — the window band is masked on global positions and the hop
-    count is bounded; tests/test_parallel.py verifies numerics vs the
-    reference). Ulysses still rejects: its all-to-all layout has no
-    windowed path."""
+    """BOTH sp strategies accept windowed configs (r5: the r4 rejections
+    were lifted). Ring masks the global band and bounds its hops;
+    Ulysses forwards the window into the full-sequence inner attention
+    its all-to-all produces. Each must match the windowed reference."""
     from kata_xpu_device_plugin_tpu.ops.attention import reference_attention
     from kata_xpu_device_plugin_tpu.parallel import (
         make_ring_attention,
@@ -180,17 +179,16 @@ def test_sp_window_support(model):
 
     mesh = seq_mesh(8)
     ring = make_ring_attention(mesh)
-    ulysses = make_ulysses_attention(mesh)
+    ulysses = make_ulysses_attention(mesh, attn_fn=reference_attention)
     keys = jax.random.split(jax.random.PRNGKey(3), 3)
     q = jax.random.normal(keys[0], (1, 16, 8, 16), jnp.float32)
     k = jax.random.normal(keys[1], (1, 16, 2, 16), jnp.float32)
     v = jax.random.normal(keys[2], (1, 16, 2, 16), jnp.float32)
-    out = ring(q, k, v, window=8)
     ref = reference_attention(q, k, v, causal=True, window=8)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-4, atol=2e-5)
-    with pytest.raises(ValueError, match="sliding-window"):
-        ulysses(q, k, v, window=8)
+    for name, fn in (("ring", ring), ("ulysses", ulysses)):
+        out = fn(q, k, v, window=8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
 
 
 def test_mistral_7b_shape():

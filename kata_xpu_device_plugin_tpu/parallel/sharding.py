@@ -157,8 +157,37 @@ def init_sharded_params(
 # ----- training ------------------------------------------------------------
 
 
-def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01) -> optax.GradientTransformation:
-    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
+def make_optimizer(
+    lr: float = 3e-4,
+    weight_decay: float = 0.01,
+    warmup_steps: int = 0,
+    total_steps: int = 0,
+    min_lr_ratio: float = 0.1,
+    grad_clip: float = 0.0,
+) -> optax.GradientTransformation:
+    """AdamW with the standard LLM-training extras, all opt-in:
+
+    - ``total_steps > 0``: linear warmup over ``warmup_steps`` then cosine
+      decay to ``lr · min_lr_ratio`` at ``total_steps`` (the Llama/Gemma
+      recipe); otherwise constant ``lr``.
+    - ``grad_clip > 0``: global-norm gradient clipping BEFORE the Adam
+      update (sharded grads: optax's global norm is a psum XLA inserts —
+      no host round-trip).
+    """
+    if total_steps:
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=warmup_steps,
+            decay_steps=total_steps,
+            end_value=lr * min_lr_ratio,
+        )
+    else:
+        schedule = lr
+    tx = optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay)
+    if grad_clip > 0.0:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+    return tx
 
 
 def make_train_step(

@@ -143,6 +143,49 @@ def test_seq_composed_train_step_matches_unsharded():
     assert losses[-1] < losses[0], losses
 
 
+def test_optimizer_schedule_and_clipping():
+    """make_optimizer's warmup-cosine schedule and global-norm clipping
+    through the sharded train step: warmup step 1 must move params LESS
+    than the constant-lr step (lr ramps from 0), clipping must bound the
+    update, and the chained optimizer's state still shards (fsdp rules
+    apply through optax.chain's tuple state)."""
+    cfg = llama3_train_test()
+    mesh = parallel.build_mesh({"data": 1, "fsdp": 2, "model": 2},
+                               devices=jax.devices()[:4])
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                              cfg.vocab_size)
+
+    def delta_after_one_step(opt):
+        init_state, step = parallel.make_train_step(cfg, mesh, optimizer=opt)
+        state = init_state(jax.random.PRNGKey(0))
+        w0 = np.asarray(jax.device_get(state["params"]["layers"]["wq"]))
+        state, loss = step(state, parallel.shard_batch(toks, mesh))
+        w1 = np.asarray(jax.device_get(state["params"]["layers"]["wq"]))
+        return float(np.abs(w1 - w0).sum()), state
+
+    base, state = delta_after_one_step(parallel.make_optimizer(lr=3e-4))
+    warm, _ = delta_after_one_step(
+        parallel.make_optimizer(lr=3e-4, warmup_steps=100, total_steps=1000)
+    )
+    clip, _ = delta_after_one_step(
+        parallel.make_optimizer(lr=3e-4, grad_clip=1e-4)
+    )
+    assert warm < base * 0.1, (warm, base)   # lr ≈ lr/100 at step 1
+    assert clip < base, (clip, base)         # tiny clip bounds the update
+    # Chained optimizer state still carries the fsdp shardings.
+    mu_wq = jax.tree.leaves(
+        jax.tree.map(lambda x: x, state["opt"],
+                     is_leaf=lambda x: hasattr(x, "sharding"))
+    )
+    assert any(
+        getattr(leaf, "sharding", None) is not None
+        and leaf.sharding.spec == parallel.PARAM_RULES["layers.wq"]
+        and leaf.shape == state["params"]["layers"]["wq"].shape
+        for leaf in jax.tree.leaves(state["opt"])
+        if hasattr(leaf, "sharding")
+    )
+
+
 def test_gradient_accumulation_matches_full_batch():
     """accum_steps=2 over [8, S] must produce the same loss and updated
     params as the full-batch step on identical tokens (dense config:

@@ -55,6 +55,35 @@ from ..models.transformer import (
 )
 
 
+# Serving-stat gauges, created lazily ONCE per process: the prometheus
+# client's default registry is global, so per-instance Gauge() calls would
+# raise duplicate-metric errors — instances distinguish themselves by the
+# "server" label instead (see GenerationServer.export_metrics).
+_PROM_GAUGES: Optional[dict] = None
+_PROM_STATS = (
+    ("rounds", "Device rounds dispatched"),
+    ("prefills", "Prompt prefills performed"),
+    ("tokens_emitted", "Tokens emitted (pre-trim, incl. prefill tokens)"),
+    ("tokens_per_round", "Mean decoded tokens per device round"),
+    ("slots_busy", "Arena slots currently serving a request"),
+    ("queued", "Requests waiting for a slot"),
+    ("arena_bytes", "KV arena HBM footprint (addressable shards summed)"),
+    ("draft_acceptance", "Speculative draft acceptance rate"),
+)
+
+
+def _prom_gauges() -> dict:
+    global _PROM_GAUGES
+    if _PROM_GAUGES is None:
+        from prometheus_client import Gauge
+
+        _PROM_GAUGES = {
+            name: Gauge(f"kata_tpu_serving_{name}", desc, ["server"])
+            for name, desc in _PROM_STATS
+        }
+    return _PROM_GAUGES
+
+
 def _hbm_bytes(leaf) -> int:
     """Total device memory a (possibly sharded or replicated) array holds
     across all addressable devices — shard sizes summed, so a replicated
@@ -329,6 +358,28 @@ class GenerationServer:
                 if self._drafts_offered else 0.0
             )
         return out
+
+    _instance_ids = iter(range(1 << 30))
+
+    def export_metrics(self, port: int = 0, label: Optional[str] = None) -> str:
+        """Expose this server's :meth:`stats` as Prometheus gauges
+        (``kata_tpu_serving_*``, scrape-time values — the gauges call
+        ``stats()`` when collected, no polling thread). The guest-side
+        counterpart of the host daemon's ``utils.metrics`` endpoint
+        (SURVEY §5 observability). ``port > 0`` also starts the /metrics
+        HTTP endpoint (one per process); multiple servers in one process
+        distinguish themselves by the ``server`` label. Returns the label.
+        """
+        label = label or f"server{next(GenerationServer._instance_ids)}"
+        for name, gauge in _prom_gauges().items():
+            gauge.labels(server=label).set_function(
+                lambda self=self, n=name: float(self.stats().get(n, 0.0))
+            )
+        if port:
+            from ..utils.metrics import serve
+
+            serve(port)
+        return label
 
     # ----- scheduling ------------------------------------------------------
 

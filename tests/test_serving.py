@@ -506,3 +506,28 @@ def test_speculative_sampling_serving(model):
     with pytest.raises(ValueError, match="top_k/top_p"):
         GenerationServer(params, cfg, temperature=0.9, top_k=5,
                          speculative_k=3)
+
+
+def test_export_metrics_prometheus_gauges(model):
+    """Serving stats exposed as Prometheus gauges (the guest-side
+    counterpart of the daemon's metrics endpoint): values come from
+    stats() at scrape time, and two servers in one process coexist via
+    the server label."""
+    from prometheus_client import REGISTRY, generate_latest
+
+    cfg, params = model
+    srv = GenerationServer(params, cfg, max_batch=2, max_len=32,
+                           speculative_k=2)
+    lbl = srv.export_metrics()
+    srv2 = GenerationServer(params, cfg, max_batch=1, max_len=32)
+    lbl2 = srv2.export_metrics()
+    assert lbl != lbl2
+
+    (p,) = _prompts(cfg, [5], seed=71)
+    srv.submit(p, 6)
+    srv.run()
+    text = generate_latest(REGISTRY).decode()
+    emitted = srv.stats()["tokens_emitted"]
+    assert f'kata_tpu_serving_tokens_emitted{{server="{lbl}"}} {float(emitted)}' in text
+    assert f'kata_tpu_serving_queued{{server="{lbl2}"}} 0.0' in text
+    assert "kata_tpu_serving_draft_acceptance" in text

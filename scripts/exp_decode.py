@@ -1,17 +1,40 @@
 #!/usr/bin/env python3
-"""Decode-step microbenchmark: where does the non-roofline 19% go?
+"""Decode-step microbenchmark suites: where does the non-roofline time go?
 
-Times structural variants of the Gemma-2B decode step on the attached chip:
-  v0  current forward (layer lax.scan, separate wq/wk/wv and gate/up matmuls)
-  v1  fused wqkv [d, q+2kv] and w_gateup [d, 2f] matmuls
-  v2  v1 + layer-scan unroll
-Prints ms/step and implied roofline fraction for each.
+One script, three suites (``--suite``), sharing the model setup and the
+vary-the-inputs timing loop (the axon tunnel caches identical executions,
+see .claude/skills/verify/SKILL.md):
+
+  structural   Structural variants of the Gemma-2B decode step — v0 current
+               forward (layer lax.scan, separate wq/wk/wv and gate/up
+               matmuls), v1 fused wqkv/w_gateup, v2/v3 fused + layer-scan
+               unroll — plus coarse skip-attn / skip-mlp / skip-unembed
+               ablations.  RESULT (v5e, r2): fused ≈ +1%, unroll neutral;
+               weights stream at ~0.83 of spec roofline — the structural
+               ceiling.
+  cache-layout Attention overhead reduction: one combined KV cache
+               ([L,B,T,2*kv_dim], a single dynamic_update_slice per layer)
+               and direct GQA dots without einsum relayouts.  RESULT (v5e,
+               r2): attention's non-weight cost ≈ 0.11 ms/step — too small
+               for a fused decode kernel to win (why ops/decode_attn.py is
+               opt-in).
+  strip        Fine attribution of the remaining ~1.1 ms/step: strip the
+               fused decode step one feature at a time (norms, rope,
+               cache-write, softmax; numerics deliberately wrong — timing
+               only).  RESULT (v5e, r2): spread across many small XLA ops;
+               no single op worth a kernel — only byte reductions (int8
+               weights, int8 KV) move decode.
+
+The recorded conclusions above are the measurement provenance BASELINE.md
+and docs/architecture.md cite; re-run any suite on the attached chip to
+reproduce.  (Consolidates the former exp_decode.py / exp_decode2.py /
+exp_decode3.py siblings.)
 """
 from __future__ import annotations
 
+import argparse
 import sys
 import time
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +46,7 @@ sys.path.insert(0, "/root/repo")
 from kata_xpu_device_plugin_tpu.models import gemma_2b_bench
 from kata_xpu_device_plugin_tpu.models.transformer import (
     forward,
+    fuse_decoder_params,
     init_kv_caches,
     init_params,
     rms_norm,
@@ -32,36 +56,43 @@ from kata_xpu_device_plugin_tpu.models.transformer import (
 cfg = gemma_2b_bench()
 B, PROMPT, STEPS = 8, 128, 128
 MAX_LEN = PROMPT + STEPS
+HBM = 819e9  # v5e spec HBM bandwidth
+ideal_ms = cfg.num_params() * 2 / HBM * 1e3
 
 key = jax.random.PRNGKey(0)
 params = jax.jit(lambda k: init_params(k, cfg, dtype=jnp.bfloat16))(key)
 jax.block_until_ready(params)
-
-param_bytes = cfg.num_params() * 2
-HBM = 819e9
-ideal_ms = param_bytes / HBM * 1e3
-print(f"params {cfg.num_params()/1e9:.3f}G -> ideal {ideal_ms:.3f} ms/step")
-
-
-def fuse(params):
-    l = params["layers"]
-    return {
-        "embed": params["embed"],
-        "final_norm": params["final_norm"],
-        "layers": {
-            "attn_norm": l["attn_norm"],
-            "wqkv": jnp.concatenate([l["wq"], l["wk"], l["wv"]], axis=2),
-            "wo": l["wo"],
-            "mlp_norm": l["mlp_norm"],
-            "w_gateup": jnp.concatenate([l["w_gate"], l["w_up"]], axis=2),
-            "w_down": l["w_down"],
-        },
-    }
-
-
-fparams = jax.jit(fuse)(params)
+fparams = jax.jit(fuse_decoder_params)(params)
 jax.block_until_ready(fparams)
 
+
+def timeit(name, fn, p, caches, pos):
+    """Best-of-3 steady-state timing; inputs vary per rep (tunnel caching)."""
+    tok = jnp.zeros((B,), jnp.int32)
+    np.asarray(fn(p, caches, tok, pos))  # compile
+    best = float("inf")
+    for s in range(3):
+        tok2 = jax.random.randint(jax.random.PRNGKey(s), (B,), 0, cfg.vocab_size)
+        np.asarray(tok2)
+        t0 = time.perf_counter()
+        np.asarray(fn(p, caches, tok2, pos))
+        best = min(best, time.perf_counter() - t0)
+    ms = best / STEPS * 1e3
+    print(f"{name:24s} {ms:7.3f} ms/step  roofline_frac={ideal_ms/ms:.3f}")
+    return ms
+
+
+def steps_scan(step):
+    """Wrap a single-token step fn into the STEPS-long greedy decode scan."""
+
+    def dec(p, caches, tok, pos):
+        (_, _, _), out = lax.scan(step(p), (caches, tok, pos), None, length=STEPS)
+        return out.T
+
+    return jax.jit(dec)
+
+
+# --------------------------------------------------------------- structural
 
 def fused_layer(x, layer, positions, kv_cache, cache_offset):
     Bq, S, _ = x.shape
@@ -88,82 +119,48 @@ def fused_layer(x, layer, positions, kv_cache, cache_offset):
     return x, (ck, cv)
 
 
-def fused_forward(fp, tokens, positions, caches, cache_offset, unroll=1):
-    x = fp["embed"].astype(cfg.dtype)[tokens] * jnp.asarray(
-        jnp.sqrt(cfg.d_model), cfg.dtype
-    )
-
-    def body(x, layer_and_cache):
-        layer, (ck, cv) = layer_and_cache
-        x, new_cache = fused_layer(x, layer, positions, (ck, cv), cache_offset)
-        return x, new_cache
-
-    x, new_caches = lax.scan(body, x, (fp["layers"], caches), unroll=unroll)
-    x = rms_norm(x, fp["final_norm"], cfg.norm_eps)
-    logits = jnp.matmul(
-        x, fp["embed"].T.astype(cfg.dtype), preferred_element_type=jnp.float32
-    )
-    return logits, new_caches
-
-
 def make_decode_v0():
-    @jax.jit
-    def dec(params, caches, tok, pos):
-        def step(carry, _):
+    def step(p):
+        def s(carry, _):
             caches, tok, pos = carry
             positions = pos[:, None] * jnp.ones((B, 1), jnp.int32)
             logits, caches = forward(
-                params, tok[:, None], cfg, positions=positions,
+                p, tok[:, None], cfg, positions=positions,
                 kv_caches=caches, cache_offset=pos[0],
             )
             nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
             return (caches, nxt, pos + 1), nxt
 
-        (_, _, _), out = lax.scan(step, (caches, tok, pos), None, length=STEPS)
-        return out.T
+        return s
 
-    return dec
+    return steps_scan(step)
 
 
 def make_decode_fused(unroll):
-    @jax.jit
-    def dec(fp, caches, tok, pos):
-        def step(carry, _):
+    def step(fp):
+        def s(carry, _):
             caches, tok, pos = carry
             positions = pos[:, None] * jnp.ones((B, 1), jnp.int32)
-            logits, caches = fused_forward(
-                fp, tok[:, None], positions, caches, pos[0], unroll=unroll
+            x = fp["embed"].astype(cfg.dtype)[tok[:, None]] * jnp.asarray(
+                jnp.sqrt(cfg.d_model), cfg.dtype
+            )
+
+            def body(x, layer_and_cache):
+                layer, cc = layer_and_cache
+                return fused_layer(x, layer, positions, cc, pos[0])
+
+            x, caches = lax.scan(body, x, (fp["layers"], caches), unroll=unroll)
+            x = rms_norm(x, fp["final_norm"], cfg.norm_eps)
+            logits = jnp.matmul(
+                x, fp["embed"].T.astype(cfg.dtype),
+                preferred_element_type=jnp.float32,
             )
             nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
             return (caches, nxt, pos + 1), nxt
 
-        (_, _, _), out = lax.scan(step, (caches, tok, pos), None, length=STEPS)
-        return out.T
+        return s
 
-    return dec
-
-
-def timeit(name, fn, p):
-    caches = init_kv_caches(cfg, B, MAX_LEN)
-    tok = jnp.zeros((B,), jnp.int32)
-    pos = jnp.full((B,), PROMPT, jnp.int32)
-    np.asarray(fn(p, caches, tok, pos))  # compile
-    best = float("inf")
-    for s in range(3):
-        tok2 = jax.random.randint(jax.random.PRNGKey(s), (B,), 0, cfg.vocab_size)
-        np.asarray(tok2)
-        t0 = time.perf_counter()
-        np.asarray(fn(p, caches, tok2, pos))
-        best = min(best, time.perf_counter() - t0)
-    ms = best / STEPS * 1e3
-    print(f"{name:24s} {ms:7.3f} ms/step  roofline_frac={ideal_ms/ms:.3f}")
-    return ms
-
-
-timeit("v0 current", make_decode_v0(), params)
-timeit("v1 fused", make_decode_fused(1), fparams)
-timeit("v2 fused+unroll3", make_decode_fused(3), fparams)
-timeit("v3 fused+unroll6", make_decode_fused(6), fparams)
+    return steps_scan(step)
 
 
 def make_decode_ablate(skip_attn=False, skip_mlp=False, skip_unembed=False):
@@ -195,9 +192,8 @@ def make_decode_ablate(skip_attn=False, skip_mlp=False, skip_unembed=False):
             x = x + (gate * gu[..., cfg.d_ff :]) @ layer["w_down"].astype(x.dtype)
         return x, (ck, cv)
 
-    @jax.jit
-    def dec(fp, caches, tok, pos):
-        def step(carry, _):
+    def step(fp):
+        def s(carry, _):
             caches, tok, pos = carry
             positions = pos[:, None] * jnp.ones((B, 1), jnp.int32)
             x = fp["embed"].astype(cfg.dtype)[tok[:, None]] * jnp.asarray(
@@ -220,13 +216,190 @@ def make_decode_ablate(skip_attn=False, skip_mlp=False, skip_unembed=False):
                 nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
             return (caches, nxt, pos + 1), nxt
 
-        (_, _, _), out = lax.scan(step, (caches, tok, pos), None, length=STEPS)
-        return out.T
+        return s
 
-    return dec
+    return steps_scan(step)
 
 
-timeit("ab full", make_decode_ablate(), fparams)
-timeit("ab no-attn", make_decode_ablate(skip_attn=True), fparams)
-timeit("ab no-mlp", make_decode_ablate(skip_mlp=True), fparams)
-timeit("ab no-unembed", make_decode_ablate(skip_unembed=True), fparams)
+def suite_structural():
+    print(f"params {cfg.num_params()/1e9:.3f}G -> ideal {ideal_ms:.3f} ms/step")
+    pos = jnp.full((B,), PROMPT, jnp.int32)
+    split = init_kv_caches(cfg, B, MAX_LEN)
+    timeit("v0 current", make_decode_v0(), params, split, pos)
+    timeit("v1 fused", make_decode_fused(1), fparams, split, pos)
+    timeit("v2 fused+unroll3", make_decode_fused(3), fparams, split, pos)
+    timeit("v3 fused+unroll6", make_decode_fused(6), fparams, split, pos)
+    timeit("ab full", make_decode_ablate(), fparams, split, pos)
+    timeit("ab no-attn", make_decode_ablate(skip_attn=True), fparams, split, pos)
+    timeit("ab no-mlp", make_decode_ablate(skip_mlp=True), fparams, split, pos)
+    timeit("ab no-unembed", make_decode_ablate(skip_unembed=True), fparams, split, pos)
+
+
+# -------------------------------------------------------------- cache-layout
+
+def make_decode_combined():
+    KVD = cfg.kv_dim
+
+    def step(fp):
+        def s(carry, _):
+            caches, tok, pos = carry
+            positions = pos[:, None] * jnp.ones((B, 1), jnp.int32)
+            x = fp["embed"].astype(cfg.dtype)[tok[:, None]] * jnp.asarray(
+                jnp.sqrt(cfg.d_model), cfg.dtype
+            )
+
+            def body(x, layer_and_cache):
+                layer, cache = layer_and_cache
+                h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+                qkv = h @ layer["wqkv"].astype(h.dtype)
+                q = qkv[..., : cfg.q_dim].reshape(B, 1, cfg.n_heads, cfg.head_dim)
+                kv = qkv[..., cfg.q_dim :]  # [B, 1, 2*KVD]
+                q = rope(q, positions, cfg.rope_theta)
+                k = rope(
+                    kv[..., :KVD].reshape(B, 1, cfg.n_kv_heads, cfg.head_dim),
+                    positions, cfg.rope_theta,
+                )
+                kv = jnp.concatenate([k.reshape(B, 1, KVD), kv[..., KVD:]], -1)
+                cache = lax.dynamic_update_slice(
+                    cache, kv.astype(cache.dtype), (0, pos[0], 0)
+                )
+                ck = cache[..., :KVD].reshape(B, MAX_LEN, cfg.n_kv_heads, cfg.head_dim)
+                cv = cache[..., KVD:].reshape(B, MAX_LEN, cfg.n_kv_heads, cfg.head_dim)
+                G = cfg.n_heads // cfg.n_kv_heads
+                qg = q.reshape(B, cfg.n_kv_heads, G, cfg.head_dim)
+                logits = jnp.einsum(
+                    "bhgd,bkhd->bhgk", qg, ck, preferred_element_type=jnp.float32
+                ) * (1.0 / float(cfg.head_dim) ** 0.5)
+                mask = jnp.arange(MAX_LEN)[None, :] <= pos[0]
+                logits = jnp.where(mask[None, None], logits, -1e30)
+                p = jax.nn.softmax(logits, axis=-1)
+                attn = jnp.einsum(
+                    "bhgk,bkhd->bhgd", p.astype(cv.dtype), cv,
+                    preferred_element_type=jnp.float32,
+                ).astype(x.dtype).reshape(B, 1, cfg.q_dim)
+                x = x + attn @ layer["wo"].astype(x.dtype)
+                h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+                gu = h @ layer["w_gateup"].astype(h.dtype)
+                gate = jax.nn.gelu(gu[..., : cfg.d_ff], approximate=True)
+                x = x + (gate * gu[..., cfg.d_ff :]) @ layer["w_down"].astype(x.dtype)
+                return x, cache
+
+            x, caches = lax.scan(body, x, (fp["layers"], caches))
+            x = rms_norm(x, fp["final_norm"], cfg.norm_eps)
+            logits = jnp.matmul(
+                x, fp["embed"].T.astype(cfg.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            return (caches, nxt, pos + 1), nxt
+
+        return s
+
+    return steps_scan(step)
+
+
+def suite_cache_layout():
+    print(f"params {cfg.num_params()/1e9:.3f}G -> ideal {ideal_ms:.3f} ms/step")
+    pos = jnp.full((B,), PROMPT, jnp.int32)
+    combined = jnp.zeros((cfg.n_layers, B, MAX_LEN, 2 * cfg.kv_dim), jnp.bfloat16)
+    timeit("combined-cache", make_decode_combined(), fparams, combined, pos)
+
+
+# --------------------------------------------------------------------- strip
+
+def make_decode_strip(no_norms=False, no_rope=False, no_cachewrite=False,
+                      no_softmax=False, matmuls_only=False):
+    if matmuls_only:
+        no_norms = no_rope = no_cachewrite = no_softmax = True
+
+    def norm(x, scale):
+        return x if no_norms else rms_norm(x, scale, cfg.norm_eps)
+
+    def step(fp):
+        def s(carry, _):
+            caches, tok, pos = carry
+            positions = jnp.full((B, 1), pos, jnp.int32)
+            x = fp["embed"].astype(cfg.dtype)[tok[:, None]] * jnp.asarray(
+                jnp.sqrt(cfg.d_model), cfg.dtype
+            )
+
+            def body(x, layer_and_cache):
+                layer, (ck, cv) = layer_and_cache
+                h = norm(x, layer["attn_norm"])
+                qkv = h @ layer["wqkv"].astype(h.dtype)
+                q = qkv[..., : cfg.q_dim].reshape(B, 1, cfg.n_heads, cfg.head_dim)
+                k = qkv[..., cfg.q_dim : cfg.q_dim + cfg.kv_dim].reshape(
+                    B, 1, cfg.n_kv_heads, cfg.head_dim
+                )
+                v = qkv[..., cfg.q_dim + cfg.kv_dim :].reshape(
+                    B, 1, cfg.n_kv_heads, cfg.head_dim
+                )
+                if not no_rope:
+                    q = rope(q, positions, cfg.rope_theta)
+                    k = rope(k, positions, cfg.rope_theta)
+                if not no_cachewrite:
+                    ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+                    cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+                if matmuls_only:
+                    attn = q.reshape(B, 1, cfg.q_dim)
+                else:
+                    G = cfg.n_heads // cfg.n_kv_heads
+                    qg = q.reshape(B, cfg.n_kv_heads, G, cfg.head_dim)
+                    logits = jnp.einsum(
+                        "bhgd,bkhd->bhgk", qg, ck,
+                        preferred_element_type=jnp.float32,
+                    ) * (1.0 / float(cfg.head_dim) ** 0.5)
+                    mask = jnp.arange(MAX_LEN)[None, :] <= pos
+                    logits = jnp.where(mask[None, None], logits, -1e30)
+                    p = logits if no_softmax else jax.nn.softmax(logits, axis=-1)
+                    attn = jnp.einsum(
+                        "bhgk,bkhd->bhgd", p.astype(cv.dtype), cv,
+                        preferred_element_type=jnp.float32,
+                    ).astype(x.dtype).reshape(B, 1, cfg.q_dim)
+                x = x + attn @ layer["wo"].astype(x.dtype)
+                h = norm(x, layer["mlp_norm"])
+                gu = h @ layer["w_gateup"].astype(h.dtype)
+                gate = jax.nn.gelu(gu[..., : cfg.d_ff], approximate=True)
+                x = x + (gate * gu[..., cfg.d_ff :]) @ layer["w_down"].astype(x.dtype)
+                return x, (ck, cv)
+
+            x, caches = lax.scan(body, x, (fp["layers"], caches))
+            x = norm(x, fp["final_norm"])
+            logits = jnp.matmul(
+                x, fp["embed"].T.astype(cfg.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            return (caches, nxt, pos + 1), nxt
+
+        return s
+
+    return steps_scan(step)
+
+
+def suite_strip():
+    print(f"params {cfg.num_params()/1e9:.3f}G -> ideal {ideal_ms:.3f} ms/step")
+    shape = (cfg.n_layers, B, MAX_LEN, cfg.n_kv_heads, cfg.head_dim)
+    caches = (jnp.zeros(shape, jnp.bfloat16), jnp.zeros(shape, jnp.bfloat16))
+    pos = jnp.int32(PROMPT)
+    timeit("full", make_decode_strip(), fparams, caches, pos)
+    timeit("no-norms", make_decode_strip(no_norms=True), fparams, caches, pos)
+    timeit("no-rope", make_decode_strip(no_rope=True), fparams, caches, pos)
+    timeit("no-cachewrite", make_decode_strip(no_cachewrite=True), fparams, caches, pos)
+    timeit("no-softmax", make_decode_strip(no_softmax=True), fparams, caches, pos)
+    timeit("matmuls-only", make_decode_strip(matmuls_only=True), fparams, caches, pos)
+
+
+SUITES = {
+    "structural": suite_structural,
+    "cache-layout": suite_cache_layout,
+    "strip": suite_strip,
+}
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--suite", choices=sorted(SUITES), default="structural")
+    SUITES[ap.parse_args().suite]()

@@ -3,7 +3,7 @@
 
 Times the real int8 decode step and an int8 matmuls-only variant (weights
 streamed as int8, dequant-scale on the activation, everything else
-stripped) — the int8 analogue of exp_decode3's bf16 floor measurement.
+stripped) — the int8 analogue of exp_decode.py --suite strip's bf16 floor measurement.
 """
 from __future__ import annotations
 

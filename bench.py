@@ -49,12 +49,17 @@ requests through an 8-slot arena, ``serving_tok_per_s`` — plus a
 draft-model speculative variant reporting ``serving_spec_tok_per_s`` and
 the draft acceptance rate; ``KATA_TPU_BENCH_SPEC=0`` skips it), and
 Gemma-2-style softcap prefill on the pallas flash path vs the XLA
-reference (``softcap_prefill_flash_speedup``). All three are crash-guarded
-side sections emitted AFTER the banked headline line, each with its own
-``KATA_TPU_BENCH_{INT8,SERVING,SOFTCAP}=0`` kill switch (the supervisor
-flips all of them off on retries and in the CPU fallback); the optional
-``KATA_TPU_BENCH_W8A8=1`` adds the int8×int8-dot decode variant inside the
-int8 section.
+reference (``softcap_prefill_flash_speedup``), and a train-step MFU
+section — one Llama-3-style ~256M model, one optimizer step on a 1-device
+mesh, pallas-flash vs reference attention, reported against the chip's
+public peak bf16 FLOP/s (``train_mfu``, ``train_flash_speedup``) so the
+training path (flash fwd+bwd kernels, remat, GSPMD step) has chip
+evidence, not just the decode path. All four are crash-guarded side
+sections emitted AFTER the banked headline line, each with its own
+``KATA_TPU_BENCH_{INT8,SERVING,SOFTCAP,TRAIN}=0`` kill switch (the
+supervisor flips all of them off on retries and in the CPU fallback); the
+optional ``KATA_TPU_BENCH_W8A8=1`` adds the int8×int8-dot decode variant
+inside the int8 section.
 
 Flags: --profile-dir DIR dumps a jax.profiler (xplane) trace of the measured
 decode runs. --smoke runs tiny shapes (harness validation, not the metric).
@@ -71,6 +76,9 @@ from typing import Optional
 
 # Per-chip HBM bandwidth (GB/s) by TPU generation — public spec-sheet numbers.
 HBM_GBPS = {"v5e": 819.0, "v5p": 2765.0, "v4": 1228.0, "v6e": 1640.0, "cpu": 50.0}
+# Per-chip peak bf16 matmul throughput (TFLOP/s) by generation — public spec
+# sheets; the denominator of the train section's MFU.
+MXU_TFLOPS = {"v5e": 197.0, "v5p": 459.0, "v4": 275.0, "v6e": 918.0, "cpu": 0.1}
 
 BATCH = 8
 PROMPT_LEN = 128
@@ -230,6 +238,7 @@ def supervise(args: argparse.Namespace) -> int:
             env["KATA_TPU_BENCH_INT8"] = "0"
             env["KATA_TPU_BENCH_SERVING"] = "0"
             env["KATA_TPU_BENCH_SOFTCAP"] = "0"
+            env["KATA_TPU_BENCH_TRAIN"] = "0"
         attempts += 1
         stage_timeout = SMOKE_TIMEOUT_S if args.smoke else ATTEMPT_TIMEOUT_S
         line, hung = run_once(
@@ -266,6 +275,7 @@ def supervise(args: argparse.Namespace) -> int:
         env["KATA_TPU_BENCH_INT8"] = "0"
         env["KATA_TPU_BENCH_SERVING"] = "0"
         env["KATA_TPU_BENCH_SOFTCAP"] = "0"
+        env["KATA_TPU_BENCH_TRAIN"] = "0"
         cmd = list(worker_cmd) + ["--smoke", "--fallback"]
         line, _hung = run_once(cmd, env, SMOKE_TIMEOUT_S, "cpu-fallback")
         if line is not None:
@@ -315,14 +325,25 @@ def _last_json_line(out: str):
 # --------------------------------------------------------------------------
 
 
-def detect_hbm_gbps(dev) -> float:
+def _detect_chip_spec(dev, table: dict) -> float:
+    """Look up a per-generation spec (HBM GB/s, peak TFLOP/s) by device
+    kind substring; unrecognized kinds (the axon relay reports 'TPU v5
+    lite', matching no key) fall back to v5e on TPU, cpu otherwise."""
     kind = str(getattr(dev, "device_kind", "")).lower()
-    for key, bw in HBM_GBPS.items():
+    for key, val in table.items():
         if key in kind:
-            return bw
+            return val
     from kata_xpu_device_plugin_tpu.ops.attention import on_tpu
 
-    return HBM_GBPS["v5e" if on_tpu() else "cpu"]
+    return table["v5e" if on_tpu() else "cpu"]
+
+
+def detect_hbm_gbps(dev) -> float:
+    return _detect_chip_spec(dev, HBM_GBPS)
+
+
+def detect_mxu_tflops(dev) -> float:
+    return _detect_chip_spec(dev, MXU_TFLOPS)
 
 
 def worker(args: argparse.Namespace) -> None:
@@ -645,6 +666,100 @@ def worker(args: argparse.Namespace) -> None:
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"serving_error": f"{type(exc).__name__}: {exc}"[:200]}
 
+    def measure_train() -> dict:
+        # Train-step MFU (r5): the flash bwd kernels, remat, and the GSPMD
+        # train step were inference-unmeasured claims until this section —
+        # the bench series only ever timed decode/prefill. One Llama-3-
+        # style ~256M model, one train step on a 1-device mesh (multi-chip
+        # scaling is the dryrun's job; this measures the per-chip compute
+        # path), pallas-flash attention vs the XLA reference, reported as
+        # model-FLOPs MFU against the chip's public peak. SIDE measurement
+        # with the usual protections: after the banked headline, crash-
+        # guarded, KATA_TPU_BENCH_TRAIN=0 disables.
+        if args.smoke or os.environ.get("KATA_TPU_BENCH_TRAIN", "1") == "0":
+            return {}
+        try:
+            from kata_xpu_device_plugin_tpu import parallel
+            from kata_xpu_device_plugin_tpu.models import llama3_train_bench
+
+            tcfg = llama3_train_bench()
+            # Shape swept on v5e (r5): B=16/S=1024 gives the best MFU
+            # (0.300 vs 0.266 at B=8, 0.285 at B=8/S=2048); remat=False
+            # measured slightly SLOWER than remat=True at B=8 (228 vs
+            # 220 ms) and OOMs at B=16, so remat stays on for both
+            # variants — it is also the long-context recipe.
+            TB, TS = 16, 1024
+            mesh = parallel.build_mesh(devices=devs[:1])
+
+            # Model FLOPs per step (PaLM-appendix MFU convention): fwd+bwd
+            # matmuls = 6 × matmul-params × tokens (embedding gather
+            # excluded, unembedding projection included), plus causal
+            # attention 12·L·B·S²·H·Dh halved for the causal triangle.
+            matmul_params_per_layer = (
+                tcfg.d_model * tcfg.q_dim          # wq
+                + 2 * tcfg.d_model * tcfg.kv_dim   # wk, wv
+                + tcfg.q_dim * tcfg.d_model        # wo
+                + 3 * tcfg.d_model * tcfg.d_ff     # swiglu gate/up/down
+            )
+            matmul_params = (
+                tcfg.n_layers * matmul_params_per_layer
+                + tcfg.d_model * tcfg.vocab_size   # untied unembed
+            )
+            tokens_per_step = TB * TS
+            attn_flops = (
+                6 * tcfg.n_layers * TB * TS * TS * tcfg.n_heads * tcfg.head_dim
+            )
+            flops_per_step = 6 * matmul_params * tokens_per_step + attn_flops
+
+            def run_variant(attn_fn):
+                # remat for both variants: the reference attention's [S,S]
+                # logits only fit by recomputation, and remat is the
+                # long-context recipe the train step ships with anyway.
+                init_state, step = parallel.make_train_step(
+                    tcfg, mesh, attn_fn=attn_fn, remat=True
+                )
+                state = init_state(jax.random.PRNGKey(7))
+
+                def batch(i):
+                    d = jax.random.randint(
+                        jax.random.fold_in(jax.random.PRNGKey(11), i),
+                        (TB, TS), 0, tcfg.vocab_size, dtype=jnp.int32,
+                    )
+                    np.asarray(d)  # materialize outside the timed region
+                    return d
+
+                state, loss = step(state, batch(0))  # compile + warm
+                np.asarray(loss)
+                best = float("inf")
+                for i in range(1, 4):  # varied data: tunnel caches replays
+                    d = batch(i)
+                    t0 = time.perf_counter()
+                    state, loss = step(state, d)
+                    lv = float(np.asarray(loss))
+                    best = min(best, time.perf_counter() - t0)
+                del state
+                return best, lv
+
+            flash_s, flash_loss = run_variant(None)  # None → flash on TPU
+            from kata_xpu_device_plugin_tpu.ops.attention import (
+                reference_attention as _ref,
+            )
+
+            ref_s, _ = run_variant(_ref)
+            peak = detect_mxu_tflops(devs[0]) * 1e12
+            return {
+                "train_config": "llama3_train_bench",
+                "train_tokens_per_step": tokens_per_step,
+                "train_step_s": round(flash_s, 4),
+                "train_tok_per_s": round(tokens_per_step / flash_s, 1),
+                "train_mfu": round(flops_per_step / (flash_s * peak), 4),
+                "train_ref_step_s": round(ref_s, 4),
+                "train_flash_speedup": round(ref_s / flash_s, 3),
+                "train_loss_finite": bool(np.isfinite(flash_loss)),
+            }
+        except Exception as exc:  # noqa: BLE001 — headline must survive
+            return {"train_error": f"{type(exc).__name__}: {exc}"[:200]}
+
     out = {
         "metric": METRIC,
         "value": round(tok_per_s, 1),
@@ -684,12 +799,16 @@ def worker(args: argparse.Namespace) -> None:
     if serving_out:
         out.update(serving_out)
         print(json.dumps(out), flush=True)
-    # Softcap runs LAST: an overrun in the newest, most experimental
-    # section must cost only itself, never the established int8/serving
-    # round-over-round series.
     softcap_out = measure_softcap_prefill()
     if softcap_out:
         out.update(softcap_out)
+        print(json.dumps(out), flush=True)
+    # Train MFU runs LAST: an overrun in the newest, most expensive
+    # section (two fwd+bwd compiles) must cost only itself, never the
+    # established int8/serving/softcap round-over-round series.
+    train_out = measure_train()
+    if train_out:
+        out.update(train_out)
         print(json.dumps(out), flush=True)
 
 

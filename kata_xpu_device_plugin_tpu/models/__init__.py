@@ -8,7 +8,7 @@ from .gemma import (
     gemma_2b_bench,
     gemma_7b,
 )
-from .llama import llama3_8b, llama3_train_test
+from .llama import llama3_8b, llama3_train_bench, llama3_train_test
 from .mistral import mistral_7b, mistral_test_config
 from .mixtral import mixtral_8x7b, mixtral_test_config
 from .speculative import draft_propose, generate_speculative, self_draft
@@ -40,6 +40,7 @@ __all__ = [
     "gemma_2b_bench",
     "gemma_7b",
     "llama3_8b",
+    "llama3_train_bench",
     "llama3_train_test",
     "mistral_7b",
     "mistral_test_config",

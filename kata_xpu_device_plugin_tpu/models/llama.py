@@ -30,6 +30,30 @@ def llama3_8b(**overrides) -> DecoderConfig:
     return replace(cfg, **overrides)
 
 
+def llama3_train_bench(**overrides) -> DecoderConfig:
+    """Llama-3 architecture at single-chip train-bench scale (~256M params,
+    MXU-friendly power-of-two dims): large enough that a train step is
+    matmul-dominated and an MFU number is meaningful, small enough that
+    params + Adam moments + rematerialized activations fit one v5e chip
+    alongside the bench's decode model. Used by bench.py's train side
+    section (``train_mfu`` / ``train_flash_speedup``)."""
+    cfg = DecoderConfig(
+        vocab_size=32768,
+        d_model=1024,
+        n_layers=12,
+        n_heads=16,
+        n_kv_heads=8,
+        head_dim=64,
+        d_ff=4096,
+        rope_theta=500000.0,
+        norm_eps=1e-5,
+        activation="swiglu",
+        scale_embeddings=False,
+        tie_embeddings=False,
+    )
+    return replace(cfg, **overrides)
+
+
 def llama3_train_test(**overrides) -> DecoderConfig:
     """Llama-3 architecture at test scale (same ratios, 8-divisible dims)
     for the multi-chip training dry run."""

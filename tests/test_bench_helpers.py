@@ -1,0 +1,60 @@
+"""Unit tests for bench.py's pure helpers.
+
+The measurement pipeline itself is exercised on hardware (the bench-watch
+watchdog banks real runs; `--smoke` validates the harness end-to-end), but
+the chip-spec lookup that converts a device kind into roofline/MFU
+denominators is pure logic and belongs in the suite: a wrong denominator
+silently corrupts every `vs_baseline`/`train_mfu` the round banks.
+"""
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import bench
+
+
+class _Dev:
+    def __init__(self, kind):
+        self.device_kind = kind
+
+
+@pytest.mark.parametrize(
+    "kind, gbps, tflops",
+    [
+        ("TPU v5p", 2765.0, 459.0),
+        ("TPU v4", 1228.0, 275.0),
+        ("TPU v6e", 1640.0, 918.0),
+        ("tpu v5e-8", 819.0, 197.0),
+    ],
+)
+def test_detect_known_generations(kind, gbps, tflops):
+    assert bench.detect_hbm_gbps(_Dev(kind)) == gbps
+    assert bench.detect_mxu_tflops(_Dev(kind)) == tflops
+
+
+def test_detect_unknown_kind_falls_back_by_backend(monkeypatch):
+    """'TPU v5 lite' (the axon relay's kind string) matches no table key;
+    the fallback keys off on_tpu(). Both tables must take the SAME branch —
+    that is the point of the shared helper."""
+    import kata_xpu_device_plugin_tpu.ops.attention as attention
+
+    monkeypatch.setattr(attention, "on_tpu", lambda: True)
+    assert bench.detect_hbm_gbps(_Dev("TPU v5 lite")) == bench.HBM_GBPS["v5e"]
+    assert bench.detect_mxu_tflops(_Dev("TPU v5 lite")) == bench.MXU_TFLOPS["v5e"]
+
+    # A kind matching no table key ("cpu" included), so the branch under
+    # test is really the on_tpu()==False fallback, not a substring hit.
+    monkeypatch.setattr(attention, "on_tpu", lambda: False)
+    assert bench.detect_hbm_gbps(_Dev("Radeon")) == bench.HBM_GBPS["cpu"]
+    assert bench.detect_mxu_tflops(_Dev("Radeon")) == bench.MXU_TFLOPS["cpu"]
+
+
+def test_spec_tables_cover_same_generations():
+    """A generation added to one table but not the other would make the
+    decode roofline and the train MFU disagree about what chip this is."""
+    assert set(bench.HBM_GBPS) == set(bench.MXU_TFLOPS)

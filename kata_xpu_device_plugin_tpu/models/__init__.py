@@ -8,6 +8,7 @@ from .gemma import (
     gemma_2b_bench,
     gemma_7b,
 )
+from .convert import config_from_hf, from_hf, params_from_hf
 from .llama import llama3_8b, llama3_train_bench, llama3_train_test
 from .mistral import mistral_7b, mistral_test_config
 from .mixtral import mixtral_8x7b, mixtral_test_config
@@ -24,6 +25,9 @@ from .transformer import (
 
 __all__ = [
     "DecoderConfig",
+    "config_from_hf",
+    "from_hf",
+    "params_from_hf",
     "forward",
     "generate",
     "draft_propose",

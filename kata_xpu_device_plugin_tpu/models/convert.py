@@ -1,0 +1,276 @@
+"""Hugging Face checkpoint conversion: ``state_dict`` → this framework's
+parameter pytree + :class:`DecoderConfig`.
+
+The "switch to this framework" piece for weights: users of the supported
+families (Llama/Mistral, Gemma, Gemma-2, Mixtral) hold checkpoints in the
+HF ``transformers`` layout; this module maps them onto the stacked-layer
+tree :func:`transformer.init_params` defines, so `forward`/`generate`/
+`make_train_step` run them unchanged. The reverse of the usual porting
+hazard applies: every convention difference is resolved HERE, once, and
+locked by logit-parity tests against the canonical ``transformers`` CPU
+implementations (`tests/test_hf_convert.py`) — not re-derived per model.
+
+Convention deltas handled (cited to the HF modeling code they mirror):
+
+- **Linear layout**: HF ``nn.Linear.weight`` is ``[out, in]``; this tree
+  is input-major ``[in, out]`` → transpose every projection.
+- **RMSNorm offset**: this tree's :func:`transformer.rms_norm` always
+  computes ``(1 + scale) · x̂`` (the Gemma convention, matching HF
+  ``Gemma*RMSNorm``); Llama-family HF norms compute ``weight · x̂`` → the
+  converted scale is ``weight − 1`` for llama/mistral/mixtral.
+- **Norm placement**: Llama/Gemma-1 ``post_attention_layernorm`` is the
+  PRE-MLP norm (plain pre-norm blocks) → maps to ``mlp_norm``. Gemma-2
+  adds true output norms: ``post_attention_layernorm`` /
+  ``post_feedforward_layernorm`` norm each sublayer's output before the
+  residual add → map to ``post_attn_norm`` / ``post_mlp_norm``, with
+  ``pre_feedforward_layernorm`` as ``mlp_norm`` (cfg.post_norms=True).
+- **Gemma-2 windows**: HF applies ``sliding_window`` on even layer
+  indices (layer 0 local) → ``attn_windows=(sliding_window, 0)``.
+- **Softcaps**: ``attn_logit_softcapping`` / ``final_logit_softcapping``
+  → ``attn_logits_softcap`` / ``logits_softcap``.
+- **Mixtral experts**: per-expert ``w1/w3/w2`` (gate/up/down) stack into
+  ``moe_w_gate/moe_w_in/moe_w_out [L, E, ...]``; the router gate
+  ``[E, d]`` transposes into ``router [d, E]``.
+
+RoPE (half-split rotation, ``theta^{-2i/d}`` frequencies), embedding
+scaling (``sqrt(d_model)``, Gemma only), GQA head grouping, and the
+attention scale (``head_dim^{-1/2}``; Gemma-2 checkpoints use
+``query_pre_attn_scalar == head_dim`` for the supported sizes) already
+agree between the two implementations and need no transformation.
+"""
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import DecoderConfig
+
+# HF model_type → (activation, scale_embeddings, rmsnorm has the +1 baked
+# in, tie_word_embeddings CLASS default). The tie default matters for raw
+# config.json dicts: save_pretrained omits fields equal to the class
+# default, so a tied Gemma checkpoint's dict has no tie_word_embeddings
+# key at all.
+_FAMILIES = {
+    "llama": ("swiglu", False, False, False),
+    "mistral": ("swiglu", False, False, False),
+    "mixtral": ("swiglu", False, False, False),
+    "gemma": ("geglu", True, True, True),
+    "gemma2": ("geglu", True, True, True),
+}
+
+
+def config_from_hf(hf_config: Any) -> DecoderConfig:
+    """Map a ``transformers`` config object (or plain dict) to
+    :class:`DecoderConfig`. Raises on unsupported ``model_type``."""
+    get = (hf_config.get if isinstance(hf_config, Mapping)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    model_type = get("model_type")
+    if model_type not in _FAMILIES:
+        raise ValueError(
+            f"unsupported model_type {model_type!r}; supported: "
+            f"{sorted(_FAMILIES)}"
+        )
+    activation, scale_embeddings, _, tie_default = _FAMILIES[model_type]
+    # Fail closed on conventions this forward does not implement, so a
+    # checkpoint never converts cleanly into wrong logits:
+    scaling = get("rope_scaling")
+    if scaling and (scaling.get("rope_type", scaling.get("type")) or
+                    "default") != "default":
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported: this forward "
+            "applies plain theta**(-2i/d) RoPE (Llama-3.1-style frequency "
+            "rescaling would convert without error but produce wrong "
+            "logits at every position)"
+        )
+    for bias_field in ("attention_bias", "mlp_bias"):
+        if get(bias_field):
+            raise ValueError(
+                f"{bias_field}=True is not supported: projections here "
+                "are bias-free (the released checkpoints of every "
+                "supported family are too) and a silently dropped bias "
+                "would corrupt the logits"
+            )
+    n_heads = get("num_attention_heads")
+    d_model = get("hidden_size")
+    head_dim = get("head_dim") or d_model // n_heads
+    kw = dict(
+        vocab_size=get("vocab_size"),
+        d_model=d_model,
+        n_layers=get("num_hidden_layers"),
+        n_heads=n_heads,
+        n_kv_heads=get("num_key_value_heads") or n_heads,
+        head_dim=head_dim,
+        d_ff=get("intermediate_size"),
+        rope_theta=float(get("rope_theta", 10000.0)),
+        norm_eps=float(get("rms_norm_eps", 1e-6)),
+        activation=activation,
+        scale_embeddings=scale_embeddings,
+        tie_embeddings=bool(get("tie_word_embeddings", tie_default)),
+    )
+    if model_type == "gemma2":
+        kw.update(
+            post_norms=True,
+            # HF Gemma2Attention: even layer indices are sliding-window,
+            # odd are global — layer 0 local matches cycle order.
+            attn_windows=(int(get("sliding_window") or 0), 0),
+            attn_logits_softcap=float(get("attn_logit_softcapping") or 0.0),
+            logits_softcap=float(get("final_logit_softcapping") or 0.0),
+        )
+        scalar = get("query_pre_attn_scalar")
+        if scalar is not None and int(scalar) != head_dim:
+            raise ValueError(
+                f"query_pre_attn_scalar={scalar} != head_dim={head_dim}: "
+                "this forward scales attention by head_dim**-0.5 only "
+                "(true for the released Gemma-2 2B/9B/27B checkpoints)"
+            )
+    elif model_type == "mistral":
+        kw.update(sliding_window=int(get("sliding_window") or 0))
+    elif model_type == "mixtral":
+        kw.update(
+            moe_num_experts=int(get("num_local_experts")),
+            moe_top_k=int(get("num_experts_per_tok")),
+        )
+    return DecoderConfig(**kw)
+
+
+def _t(x) -> np.ndarray:
+    """torch tensor / array-like → float32 numpy (torch only imported if
+    a tensor actually arrives, so the module works without torch)."""
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().float().numpy()
+    return np.asarray(x, dtype=np.float32)
+
+
+def params_from_hf(
+    state_dict: Mapping[str, Any],
+    cfg: DecoderConfig,
+    model_type: str,
+    dtype=jnp.float32,
+) -> Any:
+    """Convert an HF ``state_dict`` to the stacked-layer pytree.
+
+    ``state_dict`` keys may carry the ``model.`` prefix (ForCausalLM) or
+    not (bare base model); both are accepted.
+    """
+    if model_type not in _FAMILIES:
+        raise ValueError(f"unsupported model_type {model_type!r}")
+    norm_has_plus1 = _FAMILIES[model_type][2]
+    # Weights cast to the TARGET dtype per layer before stacking: staging
+    # a whole [L, ...] stack in fp32 first would roughly double peak host
+    # memory on a large bf16 checkpoint (Mixtral-8x7B scale).
+    np_dtype = np.dtype(dtype)
+
+    sd = dict(state_dict)
+    prefix = "model." if any(k.startswith("model.") for k in sd) else ""
+
+    def take(name):
+        key = f"{prefix}{name}"
+        if key not in sd:
+            raise KeyError(
+                f"missing {key!r} in state_dict (family {model_type})"
+            )
+        return _t(sd[key])
+
+    def norm(name):
+        w = take(name)
+        # rms_norm computes (1 + scale)·x̂; HF llama-family computes w·x̂.
+        return w if norm_has_plus1 else w - 1.0
+
+    def stack(fn):
+        return jnp.asarray(
+            np.stack([np.asarray(fn(i), np_dtype)
+                      for i in range(cfg.n_layers)])
+        )
+
+    L = f"layers.{{i}}."
+    layers = {
+        "attn_norm": stack(lambda i: norm(L.format(i=i) + "input_layernorm.weight")),
+        "wq": stack(lambda i: take(L.format(i=i) + "self_attn.q_proj.weight").T),
+        "wk": stack(lambda i: take(L.format(i=i) + "self_attn.k_proj.weight").T),
+        "wv": stack(lambda i: take(L.format(i=i) + "self_attn.v_proj.weight").T),
+        "wo": stack(lambda i: take(L.format(i=i) + "self_attn.o_proj.weight").T),
+    }
+    if model_type == "gemma2":
+        layers["post_attn_norm"] = stack(
+            lambda i: norm(L.format(i=i) + "post_attention_layernorm.weight")
+        )
+        layers["mlp_norm"] = stack(
+            lambda i: norm(L.format(i=i) + "pre_feedforward_layernorm.weight")
+        )
+        layers["post_mlp_norm"] = stack(
+            lambda i: norm(L.format(i=i) + "post_feedforward_layernorm.weight")
+        )
+    else:
+        # Llama/Gemma-1 "post_attention_layernorm" is the pre-MLP norm.
+        layers["mlp_norm"] = stack(
+            lambda i: norm(L.format(i=i) + "post_attention_layernorm.weight")
+        )
+    if model_type == "mixtral":
+        E = cfg.moe_num_experts
+        moe = L + "block_sparse_moe."
+        layers["router"] = stack(
+            lambda i: take(moe.format(i=i) + "gate.weight").T
+        )
+        layers["moe_w_gate"] = stack(lambda i: np.stack(
+            [take(moe.format(i=i) + f"experts.{e}.w1.weight").T for e in range(E)]
+        ))
+        layers["moe_w_in"] = stack(lambda i: np.stack(
+            [take(moe.format(i=i) + f"experts.{e}.w3.weight").T for e in range(E)]
+        ))
+        layers["moe_w_out"] = stack(lambda i: np.stack(
+            [take(moe.format(i=i) + f"experts.{e}.w2.weight").T for e in range(E)]
+        ))
+    else:
+        layers["w_gate"] = stack(
+            lambda i: take(L.format(i=i) + "mlp.gate_proj.weight").T
+        )
+        layers["w_up"] = stack(
+            lambda i: take(L.format(i=i) + "mlp.up_proj.weight").T
+        )
+        layers["w_down"] = stack(
+            lambda i: take(L.format(i=i) + "mlp.down_proj.weight").T
+        )
+
+    params = {
+        "embed": jnp.asarray(take("embed_tokens.weight"), dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(norm("norm.weight"), dtype),
+    }
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" not in sd:
+            raise KeyError(
+                "config says untied embeddings but state_dict has no "
+                "lm_head.weight"
+            )
+        params["unembed"] = jnp.asarray(_t(sd["lm_head.weight"]).T, dtype)
+    return params
+
+
+def from_hf(
+    hf_model_or_state_dict: Any,
+    hf_config: Optional[Any] = None,
+    dtype=jnp.float32,
+) -> tuple[Any, DecoderConfig]:
+    """One-call conversion: ``(params, cfg) = from_hf(hf_model)``.
+
+    Accepts a ``transformers`` ``*ForCausalLM``/base model (config read
+    from it) or a raw ``state_dict`` plus an explicit ``hf_config``.
+    """
+    if hf_config is None:
+        hf_config = getattr(hf_model_or_state_dict, "config", None)
+        if hf_config is None:
+            raise ValueError(
+                "pass hf_config when converting a raw state_dict"
+            )
+    state_dict = (
+        hf_model_or_state_dict.state_dict()
+        if hasattr(hf_model_or_state_dict, "state_dict")
+        else hf_model_or_state_dict
+    )
+    cfg = config_from_hf(hf_config)
+    get = (hf_config.get if isinstance(hf_config, Mapping)
+           else lambda k, d=None: getattr(hf_config, k, d))
+    params = params_from_hf(state_dict, cfg, get("model_type"), dtype)
+    return params, cfg

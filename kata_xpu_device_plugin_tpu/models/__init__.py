@@ -8,7 +8,7 @@ from .gemma import (
     gemma_2b_bench,
     gemma_7b,
 )
-from .convert import config_from_hf, from_hf, params_from_hf
+from .convert import config_from_hf, from_hf, load_hf_checkpoint, params_from_hf
 from .llama import llama3_8b, llama3_train_bench, llama3_train_test
 from .mistral import mistral_7b, mistral_test_config
 from .mixtral import mixtral_8x7b, mixtral_test_config
@@ -27,6 +27,7 @@ __all__ = [
     "DecoderConfig",
     "config_from_hf",
     "from_hf",
+    "load_hf_checkpoint",
     "params_from_hf",
     "forward",
     "generate",

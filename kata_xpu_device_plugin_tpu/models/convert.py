@@ -162,7 +162,9 @@ def params_from_hf(
     # memory on a large bf16 checkpoint (Mixtral-8x7B scale).
     np_dtype = np.dtype(dtype)
 
-    sd = dict(state_dict)
+    # Keep the Mapping as-is (no dict()): a lazy checkpoint view resolves
+    # keys on access so the whole state_dict never materializes at once.
+    sd = state_dict
     prefix = "model." if any(k.startswith("model.") for k in sd) else ""
 
     def take(name):
@@ -246,6 +248,72 @@ def params_from_hf(
             )
         params["unembed"] = jnp.asarray(_t(sd["lm_head.weight"]).T, dtype)
     return params
+
+
+def load_hf_checkpoint(path: str, dtype=jnp.float32) -> tuple[Any, DecoderConfig]:
+    """Load a locally saved HF checkpoint directory (``save_pretrained``
+    layout: ``config.json`` + ``model.safetensors`` or a sharded
+    ``model.safetensors.index.json``) without instantiating a torch model —
+    tensors are read one at a time, on access, through a lazy Mapping
+    (:class:`_LazyCheckpoint`), so peak host memory stays near the output
+    tree plus one stacked weight group, never the whole checkpoint.
+    ``pytorch_model.bin`` checkpoints are rejected (torch pickle
+    loading pulls the whole file into memory and executes pickles; convert
+    them to safetensors first)."""
+    import json
+    import os
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf_config = json.load(f)
+    st_path = os.path.join(path, "model.safetensors")
+    index_path = st_path + ".index.json"
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            shards = sorted(set(json.load(f)["weight_map"].values()))
+    elif os.path.exists(st_path):
+        shards = ["model.safetensors"]
+    else:
+        raise FileNotFoundError(
+            f"no model.safetensors[.index.json] under {path!r} "
+            "(pytorch_model.bin is not supported — convert to safetensors)"
+        )
+    from safetensors import safe_open
+
+    weight_map: dict[str, str] = {}
+    for shard in shards:
+        with safe_open(os.path.join(path, shard), framework="np") as f:
+            for key in f.keys():
+                weight_map[key] = shard
+    return from_hf(_LazyCheckpoint(path, weight_map), hf_config, dtype=dtype)
+
+
+class _LazyCheckpoint(Mapping):
+    """Read-on-access view of a (possibly sharded) safetensors checkpoint:
+    each key lookup mmap-opens its shard and copies out ONE tensor, so
+    conversion peaks near the output tree plus a single stacked group
+    instead of the whole checkpoint (`params_from_hf` must not dict() it —
+    it takes the Mapping as-is)."""
+
+    def __init__(self, path: str, weight_map: Mapping[str, str]):
+        self._path = path
+        self._weight_map = dict(weight_map)
+
+    def __getitem__(self, key: str):
+        import os
+
+        from safetensors import safe_open
+
+        shard = self._weight_map[key]
+        with safe_open(
+            os.path.join(self._path, shard), framework="np"
+        ) as f:
+            return f.get_tensor(key)
+
+    def __iter__(self):
+        return iter(self._weight_map)
+
+    def __len__(self):
+        return len(self._weight_map)
 
 
 def from_hf(

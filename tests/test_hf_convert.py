@@ -21,7 +21,11 @@ import jax.numpy as jnp
 from dataclasses import replace
 
 from kata_xpu_device_plugin_tpu.models import forward
-from kata_xpu_device_plugin_tpu.models.convert import config_from_hf, from_hf
+from kata_xpu_device_plugin_tpu.models.convert import (
+    config_from_hf,
+    from_hf,
+    load_hf_checkpoint,
+)
 
 B, S = 2, 32
 
@@ -132,6 +136,54 @@ def test_mixtral_moe_parity():
     _assert_close(ours, _hf_logits(model, toks))
 
 
+def test_decode_cache_path_matches_hf_forward():
+    """Teacher-forced decode parity: drive OUR prefill→stepwise KV-cache
+    decode on a fixed token stream and compare each step's logits to the
+    HF full-sequence forward at that position. This extends the parity
+    oracle from one forward to the incremental cache machinery (cache
+    writes, q_offset masking, position handling) without the argmax
+    tie-break flakiness greedy-vs-greedy would have on random weights."""
+    from kata_xpu_device_plugin_tpu.models.transformer import init_kv_caches
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, attn_implementation="eager",
+    )
+    torch.manual_seed(6)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    params, cfg = from_hf(model)
+    cfg = replace(cfg, dtype=jnp.float32)
+
+    steps, prompt_len = 8, S - 8
+    toks = _tokens(128, seed=6)  # the full fixed stream, [B, S]
+    hf = _hf_logits(model, toks)  # [B, S, V] — the per-position oracle
+
+    prompt = jnp.asarray(toks[:, :prompt_len])
+    caches = init_kv_caches(cfg, B, S)
+    positions = jnp.arange(prompt_len)[None, :].repeat(B, 0)
+    logits_p, caches = forward(
+        params, prompt, cfg, positions=positions, kv_caches=caches,
+        cache_offset=jnp.int32(0), prefill=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_p, np.float32), hf[:, :prompt_len], rtol=2e-3,
+        atol=2e-3,
+    )
+    for t in range(steps):
+        pos = prompt_len + t
+        tok = jnp.asarray(toks[:, pos:pos + 1])
+        logits_t, caches = forward(
+            params, tok, cfg,
+            positions=jnp.full((B, 1), pos, jnp.int32),
+            kv_caches=caches, cache_offset=jnp.int32(pos),
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_t[:, 0], np.float32), hf[:, pos],
+            rtol=2e-3, atol=2e-3, err_msg=f"step {t} (position {pos})",
+        )
+
+
 def test_unsupported_family_rejected():
     with pytest.raises(ValueError, match="unsupported model_type"):
         config_from_hf({"model_type": "gpt2"})
@@ -167,6 +219,45 @@ def test_dict_config_uses_family_tie_default():
     gemma.pop("head_dim")
     assert config_from_hf(gemma).tie_embeddings is True
     assert config_from_hf(_DICT_BASE).tie_embeddings is False
+
+
+def test_load_hf_checkpoint_dir_sharded(tmp_path):
+    """save_pretrained round trip, forced into MULTIPLE safetensors shards
+    with an index — the on-disk layout real checkpoints ship in. The loaded
+    tree must match the in-memory conversion exactly; a raw torch-pickle
+    checkpoint dir is rejected."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16,
+    )
+    torch.manual_seed(7)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    model.save_pretrained(tmp_path / "ckpt", max_shard_size="100KB")
+    import os
+    assert os.path.exists(tmp_path / "ckpt" / "model.safetensors.index.json")
+
+    params, cfg = load_hf_checkpoint(str(tmp_path / "ckpt"))
+    ref_params, ref_cfg = from_hf(model)
+    assert cfg == ref_cfg
+    import jax
+    flat = {str(k): v for k, v in jax.tree_util.tree_leaves_with_path(params)}
+    ref = {str(k): v for k, v in jax.tree_util.tree_leaves_with_path(ref_params)}
+    assert flat.keys() == ref.keys()
+    for k in flat:
+        np.testing.assert_allclose(
+            np.asarray(flat[k]), np.asarray(ref[k]), err_msg=k
+        )
+
+    # config.json present but no safetensors → the explicit rejection
+    # (covers the pytorch_model.bin-only layout).
+    bare = tmp_path / "bin_only"
+    bare.mkdir()
+    (bare / "config.json").write_text(
+        (tmp_path / "ckpt" / "config.json").read_text()
+    )
+    with pytest.raises(FileNotFoundError, match="safetensors"):
+        load_hf_checkpoint(str(bare))
 
 
 def test_bfloat16_target_dtype():

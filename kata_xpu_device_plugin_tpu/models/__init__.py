@@ -8,7 +8,15 @@ from .gemma import (
     gemma_2b_bench,
     gemma_7b,
 )
-from .convert import config_from_hf, from_hf, load_hf_checkpoint, params_from_hf
+from .convert import (
+    config_from_hf,
+    from_hf,
+    hf_config_dict,
+    load_hf_checkpoint,
+    params_from_hf,
+    save_hf_checkpoint,
+    to_hf_state_dict,
+)
 from .llama import llama3_8b, llama3_train_bench, llama3_train_test
 from .mistral import mistral_7b, mistral_test_config
 from .mixtral import mixtral_8x7b, mixtral_test_config
@@ -27,7 +35,10 @@ __all__ = [
     "DecoderConfig",
     "config_from_hf",
     "from_hf",
+    "hf_config_dict",
     "load_hf_checkpoint",
+    "save_hf_checkpoint",
+    "to_hf_state_dict",
     "params_from_hf",
     "forward",
     "generate",

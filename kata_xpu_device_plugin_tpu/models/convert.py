@@ -342,3 +342,198 @@ def from_hf(
            else lambda k, d=None: getattr(hf_config, k, d))
     params = params_from_hf(state_dict, cfg, get("model_type"), dtype)
     return params, cfg
+
+
+# ----- the reverse direction: export back to the HF ecosystem --------------
+
+
+def hf_config_dict(cfg: DecoderConfig, model_type: str) -> dict:
+    """Inverse of :func:`config_from_hf`: a plain ``config.json``-style
+    dict for ``model_type``. Raises when the config carries features the
+    family cannot express (so an export never silently drops semantics)."""
+    if model_type not in _FAMILIES:
+        raise ValueError(f"unsupported model_type {model_type!r}")
+    activation, scale_embeddings, _, _ = _FAMILIES[model_type]
+    if cfg.activation != activation:
+        raise ValueError(
+            f"cfg.activation={cfg.activation!r} does not match "
+            f"{model_type!r} (expects {activation!r})"
+        )
+    if cfg.scale_embeddings != scale_embeddings:
+        raise ValueError(
+            f"cfg.scale_embeddings={cfg.scale_embeddings} does not match "
+            f"{model_type!r}"
+        )
+    if cfg.moe != (model_type == "mixtral"):
+        raise ValueError(
+            f"MoE={cfg.moe} config cannot export as {model_type!r}"
+        )
+    out = dict(
+        model_type=model_type,
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.d_model,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim,
+        intermediate_size=cfg.d_ff,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.norm_eps,
+        tie_word_embeddings=cfg.tie_embeddings,
+    )
+    if model_type == "gemma2":
+        if not cfg.post_norms:
+            raise ValueError("gemma2 export requires cfg.post_norms=True")
+        cyc = cfg.attn_windows
+        if len(cyc) != 2 or cyc[1] != 0 or cyc[0] <= 0:
+            raise ValueError(
+                f"gemma2 export needs attn_windows=(window, 0), got {cyc!r}"
+            )
+        out.update(
+            sliding_window=cyc[0],
+            query_pre_attn_scalar=cfg.head_dim,
+            attn_logit_softcapping=cfg.attn_logits_softcap or None,
+            final_logit_softcapping=cfg.logits_softcap or None,
+        )
+    else:
+        if cfg.post_norms:
+            raise ValueError(
+                f"{model_type!r} has no post-norm slots; only gemma2 does"
+            )
+        if cfg.attn_logits_softcap or cfg.logits_softcap:
+            raise ValueError(
+                f"{model_type!r} cannot express logit softcaps"
+            )
+        if model_type == "mistral":
+            if cfg.attn_windows:
+                raise ValueError(
+                    "mistral expresses one uniform sliding_window; a "
+                    f"per-layer attn_windows cycle {cfg.attn_windows!r} "
+                    "would export to silently different attention"
+                )
+            out["sliding_window"] = cfg.sliding_window or None
+        elif cfg.sliding_window or cfg.attn_windows:
+            raise ValueError(
+                f"{model_type!r} cannot express sliding windows"
+            )
+    if model_type == "mixtral":
+        out.update(
+            num_local_experts=cfg.moe_num_experts,
+            num_experts_per_tok=cfg.moe_top_k,
+        )
+    return out
+
+
+def to_hf_state_dict(
+    params: Any, cfg: DecoderConfig, model_type: str
+) -> tuple[dict, dict]:
+    """Export the stacked-layer pytree to an HF ``state_dict`` (numpy, the
+    TREE'S dtype preserved — a bf16 tree exports bf16, half the bytes of a
+    forced-fp32 export; norm offsets are computed in fp32 then cast back)
+    + the matching config dict — the inverse of :func:`params_from_hf`,
+    applying the same convention deltas in reverse (transpose back to
+    ``[out, in]``, re-add the llama-family norm +1, unstack layers and
+    Mixtral experts). The full dict is materialized in host memory (one
+    tree-sized copy); there is no lazy path on the export side.
+
+    Fused (``wqkv``), quantized (QTensor tuples) and LoRA trees are
+    refused with the required preparation named: export operates on the
+    plain training layout.
+    """
+    hf_cfg = hf_config_dict(cfg, model_type)
+    norm_has_plus1 = _FAMILIES[model_type][2]
+    layers = params["layers"]
+    if "wqkv" in layers:
+        raise ValueError(
+            "fused inference layout cannot export — convert the separate-"
+            "matrix training layout (before fuse_decoder_params)"
+        )
+    if any(isinstance(v, tuple) for v in layers.values()):
+        raise ValueError(
+            "quantized/LoRA trees cannot export — dequantize or "
+            "merge_lora first"
+        )
+
+    def npf(x) -> np.ndarray:
+        # Native dtype preserved (bf16 trees export bf16 — safetensors'
+        # numpy writer handles ml_dtypes); no forced-fp32 doubling.
+        # ascontiguousarray is load-bearing even without .T: np.asarray on
+        # a jax array can be a zero-copy view with device-layout strides,
+        # and safetensors' numpy writer serializes the raw buffer without
+        # checking contiguity — a non-contiguous view saves scrambled.
+        return np.ascontiguousarray(np.asarray(x))
+
+    def npt(x) -> np.ndarray:
+        # The contiguity copy matters: ``.T`` is an F-ordered VIEW, and
+        # safetensors' numpy writer serializes the raw buffer — saving a
+        # non-contiguous view silently scrambles the element order.
+        return np.ascontiguousarray(npf(x).T)
+
+    def norm_out(w) -> np.ndarray:
+        w = npf(w)
+        if norm_has_plus1:
+            return w
+        # the ±1 offset in fp32, cast back to the tree's dtype
+        return (w.astype(np.float32) + 1.0).astype(w.dtype)
+
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": npf(params["embed"]),
+        "model.norm.weight": norm_out(params["final_norm"]),
+    }
+    if not cfg.tie_embeddings:
+        sd["lm_head.weight"] = npt(params["unembed"])
+
+    for i in range(cfg.n_layers):
+        L = f"model.layers.{i}."
+        sd[L + "input_layernorm.weight"] = norm_out(layers["attn_norm"][i])
+        for ours, theirs in (("wq", "q_proj"), ("wk", "k_proj"),
+                             ("wv", "v_proj"), ("wo", "o_proj")):
+            sd[L + f"self_attn.{theirs}.weight"] = npt(layers[ours][i])
+        if model_type == "gemma2":
+            sd[L + "post_attention_layernorm.weight"] = norm_out(
+                layers["post_attn_norm"][i]
+            )
+            sd[L + "pre_feedforward_layernorm.weight"] = norm_out(
+                layers["mlp_norm"][i]
+            )
+            sd[L + "post_feedforward_layernorm.weight"] = norm_out(
+                layers["post_mlp_norm"][i]
+            )
+        else:
+            sd[L + "post_attention_layernorm.weight"] = norm_out(
+                layers["mlp_norm"][i]
+            )
+        if model_type == "mixtral":
+            moe = L + "block_sparse_moe."
+            sd[moe + "gate.weight"] = npt(layers["router"][i])
+            for e in range(cfg.moe_num_experts):
+                sd[moe + f"experts.{e}.w1.weight"] = npt(
+                    layers["moe_w_gate"][i, e])
+                sd[moe + f"experts.{e}.w3.weight"] = npt(
+                    layers["moe_w_in"][i, e])
+                sd[moe + f"experts.{e}.w2.weight"] = npt(
+                    layers["moe_w_out"][i, e])
+        else:
+            sd[L + "mlp.gate_proj.weight"] = npt(layers["w_gate"][i])
+            sd[L + "mlp.up_proj.weight"] = npt(layers["w_up"][i])
+            sd[L + "mlp.down_proj.weight"] = npt(layers["w_down"][i])
+    return sd, hf_cfg
+
+
+def save_hf_checkpoint(
+    params: Any, cfg: DecoderConfig, model_type: str, path: str
+) -> None:
+    """Write a ``save_pretrained``-layout directory (``config.json`` +
+    ``model.safetensors``) that ``transformers.AutoModelForCausalLM.
+    from_pretrained`` — or :func:`load_hf_checkpoint` — accepts. Torch-free
+    (numpy safetensors)."""
+    import json
+    import os
+
+    from safetensors.numpy import save_file
+
+    sd, hf_cfg = to_hf_state_dict(params, cfg, model_type)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(hf_cfg, f, indent=2)
+    save_file(sd, os.path.join(path, "model.safetensors"))

@@ -24,7 +24,10 @@ from kata_xpu_device_plugin_tpu.models import forward
 from kata_xpu_device_plugin_tpu.models.convert import (
     config_from_hf,
     from_hf,
+    hf_config_dict,
     load_hf_checkpoint,
+    save_hf_checkpoint,
+    to_hf_state_dict,
 )
 
 B, S = 2, 32
@@ -184,6 +187,99 @@ def test_decode_cache_path_matches_hf_forward():
         )
 
 
+def test_export_roundtrip_into_transformers():
+    """The reverse direction: a tree exported with to_hf_state_dict loads
+    into a fresh transformers model (strict=False, but with explicit
+    assertions: nothing unexpected, and the only permitted misses are
+    derived buffers — rotary tables — and the tied lm_head) and produces
+    the same logits our forward does — weights trained here flow back to
+    the HF ecosystem. Exercised on the two families with the most
+    convention deltas (llama: norm offset re-added; gemma2: post-norm
+    fan-out)."""
+    from kata_xpu_device_plugin_tpu.models import init_params
+
+    for model_type, hf_cfg in (
+        ("llama", transformers.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16,
+            attn_implementation="eager")),
+        ("gemma2", transformers.Gemma2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16, query_pre_attn_scalar=16,
+            sliding_window=8, attn_implementation="eager")),
+    ):
+        cfg = replace(config_from_hf(hf_cfg), dtype=jnp.float32)
+        params = init_params(__import__("jax").random.PRNGKey(8), cfg)
+        sd, _ = to_hf_state_dict(params, cfg, model_type)
+        model = (transformers.LlamaForCausalLM if model_type == "llama"
+                 else transformers.Gemma2ForCausalLM)(hf_cfg)
+        missing, unexpected = model.load_state_dict(
+            {k: torch.from_numpy(v) for k, v in sd.items()}, strict=False
+        )
+        # tied lm_head / rotary buffers may be absent from the export;
+        # nothing we exported may be unexpected.
+        assert not unexpected, unexpected
+        assert all("rotary" in m or "lm_head" in m for m in missing), missing
+        toks = _tokens(128, seed=8)
+        ours = np.asarray(
+            forward(params, jnp.asarray(toks), cfg), np.float32
+        )
+        _assert_close(ours, _hf_logits(model, toks))
+
+
+def test_save_hf_checkpoint_roundtrip(tmp_path):
+    """save_hf_checkpoint → load_hf_checkpoint is the identity (config and
+    tree), and the directory is transformers-loadable."""
+    from kata_xpu_device_plugin_tpu.models import init_params
+    import jax
+
+    cfg = replace(
+        config_from_hf({"model_type": "mistral", "vocab_size": 128,
+                        "hidden_size": 64, "intermediate_size": 128,
+                        "num_hidden_layers": 2, "num_attention_heads": 4,
+                        "num_key_value_heads": 2, "head_dim": 16,
+                        "sliding_window": 8}),
+        dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(9), cfg)
+    save_hf_checkpoint(params, cfg, "mistral", str(tmp_path / "out"))
+    params2, cfg2 = load_hf_checkpoint(str(tmp_path / "out"))
+    assert replace(cfg2, dtype=jnp.float32) == cfg
+    flat = {str(k): v for k, v in jax.tree_util.tree_leaves_with_path(params)}
+    back = {str(k): v for k, v in jax.tree_util.tree_leaves_with_path(params2)}
+    assert flat.keys() == back.keys()
+    for k in flat:
+        np.testing.assert_allclose(
+            np.asarray(flat[k]), np.asarray(back[k]), atol=1e-7, err_msg=k
+        )
+    model = transformers.AutoModelForCausalLM.from_pretrained(
+        str(tmp_path / "out"), attn_implementation="eager"
+    )
+    toks = _tokens(128, seed=9)
+    ours = np.asarray(forward(params, jnp.asarray(toks), cfg), np.float32)
+    _assert_close(ours, _hf_logits(model, toks))
+
+
+def test_export_refuses_unexpressible_configs():
+    """hf_config_dict fails closed rather than dropping semantics."""
+    from kata_xpu_device_plugin_tpu.models import gemma2_test_config, llama3_train_test
+
+    with pytest.raises(ValueError, match="activation|softcap|post"):
+        hf_config_dict(gemma2_test_config(), "llama")
+    with pytest.raises(ValueError, match="activation|attn_windows|post_norms"):
+        hf_config_dict(llama3_train_test(), "gemma2")
+    with pytest.raises(ValueError, match="activation|scale_embeddings"):
+        hf_config_dict(llama3_train_test(), "gemma")
+    # mistral expresses ONE uniform window — a per-layer cycle must not
+    # export to silently different attention
+    with pytest.raises(ValueError, match="attn_windows"):
+        hf_config_dict(
+            replace(llama3_train_test(), attn_windows=(8, 0)), "mistral"
+        )
+
+
 def test_unsupported_family_rejected():
     with pytest.raises(ValueError, match="unsupported model_type"):
         config_from_hf({"model_type": "gpt2"})
@@ -279,3 +375,7 @@ def test_bfloat16_target_dtype():
     hf = _hf_logits(model, toks)
     agree = (ours.argmax(-1) == hf.argmax(-1)).mean()
     assert agree > 0.9, agree
+    # and the export side preserves the tree's dtype (no fp32 doubling)
+    sd, _ = to_hf_state_dict(params, cfg, "llama")
+    assert sd["model.layers.0.self_attn.q_proj.weight"].dtype == jnp.bfloat16
+    assert sd["model.layers.0.input_layernorm.weight"].dtype == jnp.bfloat16

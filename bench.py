@@ -190,14 +190,17 @@ def supervise(args: argparse.Namespace) -> int:
             hung = True
             # A kill at a budget-clipped timeout is NOT evidence of a wedge —
             # label it distinctly so the post-mortem can't misread it. The
-            # 90% tolerance matters: the configured stage timeout can sit
-            # just above the budget's maximum grantable window (1080 vs
-            # ~1040 after probe+reserve), and a worker killed with ~96% of
-            # its requested window WAS given a fair run — that is a hang,
-            # not a clip (the clip label is for late-round attempts whose
-            # window was genuinely cut short by time already spent).
+            # slack is ABSOLUTE (180 s), not fractional: the configured
+            # stage timeout (1080) sits above the budget's maximum
+            # grantable window (1380 − reserve 330 − probe ≤ 90 ≈
+            # 960–1050 s), so any first attempt killed with ≥ 900 s of
+            # window had a fair run — that is a hang (the r3 post-mortem
+            # distinction). A fractional threshold (0.9×configured = 972)
+            # would sit ABOVE the slow-probe window of 960 s and mislabel
+            # a genuine first-attempt wedge; the clip label is for
+            # late-round attempts whose window was truly cut short.
             kind = (
-                "hung" if timeout >= 0.9 * configured
+                "hung" if timeout >= configured - 180
                 else "budget clip, not a hang"
             )
             errors.append(f"{label}: killed after {timeout:.0f}s ({kind})")

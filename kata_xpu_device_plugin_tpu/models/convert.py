@@ -92,6 +92,21 @@ def config_from_hf(hf_config: Any) -> DecoderConfig:
                 "supported family are too) and a silently dropped bias "
                 "would corrupt the logits"
             )
+    # The MLP gate nonlinearity is hardcoded per family (swiglu=silu,
+    # geglu=tanh-approx gelu); a checkpoint trained with a different
+    # hidden_act must not convert into silently different logits.
+    allowed_acts = (
+        {"silu"} if activation == "swiglu" else {"gelu_pytorch_tanh"}
+    )
+    for act_field in ("hidden_act", "hidden_activation"):
+        act = get(act_field)
+        if act is not None and act not in allowed_acts:
+            raise ValueError(
+                f"{act_field}={act!r} is not supported for "
+                f"{model_type!r}: this forward applies "
+                f"{sorted(allowed_acts)[0]!r} (exact-erf 'gelu' included "
+                "— the tanh approximation here would drift from it)"
+            )
     n_heads = get("num_attention_heads")
     d_model = get("hidden_size")
     head_dim = get("head_dim") or d_model // n_heads
@@ -129,6 +144,10 @@ def config_from_hf(hf_config: Any) -> DecoderConfig:
         kw.update(sliding_window=int(get("sliding_window") or 0))
     elif model_type == "mixtral":
         kw.update(
+            # Mixtral carries mistral's sliding_window field (None in the
+            # released 8x7B config, set by community fine-tunes) — dropping
+            # it would un-mask attention past the window.
+            sliding_window=int(get("sliding_window") or 0),
             moe_num_experts=int(get("num_local_experts")),
             moe_top_k=int(get("num_experts_per_tok")),
         )
@@ -268,22 +287,20 @@ def load_hf_checkpoint(path: str, dtype=jnp.float32) -> tuple[Any, DecoderConfig
     st_path = os.path.join(path, "model.safetensors")
     index_path = st_path + ".index.json"
     if os.path.exists(index_path):
+        # The index's weight_map IS the key→shard mapping — no need to
+        # open and list every shard just to rebuild it.
         with open(index_path) as f:
-            shards = sorted(set(json.load(f)["weight_map"].values()))
+            weight_map = dict(json.load(f)["weight_map"])
     elif os.path.exists(st_path):
-        shards = ["model.safetensors"]
+        from safetensors import safe_open
+
+        with safe_open(st_path, framework="np") as f:
+            weight_map = {key: "model.safetensors" for key in f.keys()}
     else:
         raise FileNotFoundError(
             f"no model.safetensors[.index.json] under {path!r} "
             "(pytorch_model.bin is not supported — convert to safetensors)"
         )
-    from safetensors import safe_open
-
-    weight_map: dict[str, str] = {}
-    for shard in shards:
-        with safe_open(os.path.join(path, shard), framework="np") as f:
-            for key in f.keys():
-                weight_map[key] = shard
     return from_hf(_LazyCheckpoint(path, weight_map), hf_config, dtype=dtype)
 
 
@@ -404,11 +421,11 @@ def hf_config_dict(cfg: DecoderConfig, model_type: str) -> dict:
             raise ValueError(
                 f"{model_type!r} cannot express logit softcaps"
             )
-        if model_type == "mistral":
+        if model_type in ("mistral", "mixtral"):
             if cfg.attn_windows:
                 raise ValueError(
-                    "mistral expresses one uniform sliding_window; a "
-                    f"per-layer attn_windows cycle {cfg.attn_windows!r} "
+                    f"{model_type} expresses one uniform sliding_window; "
+                    f"a per-layer attn_windows cycle {cfg.attn_windows!r} "
                     "would export to silently different attention"
                 )
             out["sliding_window"] = cfg.sliding_window or None

@@ -59,11 +59,21 @@ MAX_LEN = PROMPT + STEPS
 HBM = 819e9  # v5e spec HBM bandwidth
 ideal_ms = cfg.num_params() * 2 / HBM * 1e3
 
-key = jax.random.PRNGKey(0)
-params = jax.jit(lambda k: init_params(k, cfg, dtype=jnp.bfloat16))(key)
-jax.block_until_ready(params)
-fparams = jax.jit(fuse_decoder_params)(params)
-jax.block_until_ready(fparams)
+# Initialized by _init() AFTER argparse: --help / a mistyped --suite must
+# not pay a 2.5G-param device initialization over the tunnel first.
+params = None
+fparams = None
+
+
+def _init() -> None:
+    global params, fparams
+    if params is not None:
+        return
+    key = jax.random.PRNGKey(0)
+    params = jax.jit(lambda k: init_params(k, cfg, dtype=jnp.bfloat16))(key)
+    jax.block_until_ready(params)
+    fparams = jax.jit(fuse_decoder_params)(params)
+    jax.block_until_ready(fparams)
 
 
 def timeit(name, fn, p, caches, pos):
@@ -402,4 +412,6 @@ if __name__ == "__main__":
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     ap.add_argument("--suite", choices=sorted(SUITES), default="structural")
-    SUITES[ap.parse_args().suite]()
+    suite = SUITES[ap.parse_args().suite]
+    _init()
+    suite()

@@ -122,6 +122,19 @@ def test_mistral_sliding_window_parity():
     _assert_close(ours, _hf_logits(model, toks))
 
 
+def test_mixtral_sliding_window_mapped():
+    """Mixtral carries mistral's sliding_window; it must convert, not drop
+    (a window-bearing fine-tune attends differently past the window)."""
+    cfg = config_from_hf({
+        "model_type": "mixtral", "vocab_size": 128, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+        "num_local_experts": 4, "num_experts_per_tok": 2,
+        "sliding_window": 4096,
+    })
+    assert cfg.sliding_window == 4096 and cfg.moe_num_experts == 4
+
+
 def test_mixtral_moe_parity():
     hf_cfg = transformers.MixtralConfig(
         vocab_size=128, hidden_size=64, intermediate_size=128,
@@ -305,6 +318,12 @@ def test_unsupported_conventions_fail_closed():
         config_from_hf({**_DICT_BASE, "attention_bias": True})
     with pytest.raises(ValueError, match="mlp_bias"):
         config_from_hf({**_DICT_BASE, "mlp_bias": True})
+    # a non-default MLP activation must not silently become silu/gelu-tanh
+    with pytest.raises(ValueError, match="hidden_act"):
+        config_from_hf({**_DICT_BASE, "hidden_act": "gelu"})
+    with pytest.raises(ValueError, match="hidden_activation"):
+        config_from_hf({**_DICT_BASE, "model_type": "gemma",
+                        "hidden_activation": "gelu"})
 
 
 def test_dict_config_uses_family_tie_default():

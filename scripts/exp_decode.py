@@ -11,7 +11,9 @@ see .claude/skills/verify/SKILL.md):
                unroll — plus coarse skip-attn / skip-mlp / skip-unembed
                ablations.  RESULT (v5e, r2): fused ≈ +1%, unroll neutral;
                weights stream at ~0.83 of spec roofline — the structural
-               ceiling.
+               ceiling.  (r5 re-run: unroll now measures ~3× SLOWER,
+               18.7 vs 6.1 ms/step, on the current jax/libtpu — the
+               shipped default of no unroll stands doubly confirmed.)
   cache-layout Attention overhead reduction: one combined KV cache
                ([L,B,T,2*kv_dim], a single dynamic_update_slice per layer)
                and direct GQA dots without einsum relayouts.  RESULT (v5e,

@@ -332,6 +332,39 @@ def test_converted_checkpoint_through_the_serving_stack():
     assert len(qout) == steps  # int8 path runs end-to-end on converted tree
 
 
+def test_converted_draft_model_speculative_decoding():
+    """Two independently converted HF checkpoints compose as speculative
+    target + draft (shared vocab, different depths/widths allowed) and the
+    output is token-identical to plain greedy on the target — the draft
+    moves only the acceptance rate, never the tokens."""
+    from kata_xpu_device_plugin_tpu.models import generate
+    from kata_xpu_device_plugin_tpu.models.speculative import (
+        generate_speculative,
+    )
+
+    def mk(layers, hidden, seed):
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=hidden, intermediate_size=2 * hidden,
+            num_hidden_layers=layers, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16, attn_implementation="eager",
+        )
+        torch.manual_seed(seed)
+        p, c = from_hf(transformers.LlamaForCausalLM(hf_cfg))
+        return p, replace(c, dtype=jnp.float32)
+
+    target_p, target_c = mk(3, 64, 11)
+    draft_p, draft_c = mk(1, 64, 12)  # shallower independent draft
+
+    prompt = jnp.asarray(_tokens(128, seed=11)[:1, :12])
+    steps = 8
+    ref = np.asarray(generate(target_p, prompt, target_c, steps=steps))
+    out = generate_speculative(
+        target_p, prompt, target_c, steps=steps, k=3,
+        draft=(draft_p, draft_c),
+    )
+    np.testing.assert_array_equal(np.asarray(out)[:, :steps], ref)
+
+
 def test_unsupported_family_rejected():
     with pytest.raises(ValueError, match="unsupported model_type"):
         config_from_hf({"model_type": "gpt2"})

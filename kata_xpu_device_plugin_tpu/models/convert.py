@@ -76,14 +76,30 @@ def config_from_hf(hf_config: Any) -> DecoderConfig:
     # Fail closed on conventions this forward does not implement, so a
     # checkpoint never converts cleanly into wrong logits:
     scaling = get("rope_scaling")
-    if scaling and (scaling.get("rope_type", scaling.get("type")) or
-                    "default") != "default":
-        raise ValueError(
-            f"rope_scaling={scaling!r} is not supported: this forward "
-            "applies plain theta**(-2i/d) RoPE (Llama-3.1-style frequency "
-            "rescaling would convert without error but produce wrong "
-            "logits at every position)"
-        )
+    rope_llama3_scaling: tuple = ()
+    if scaling:
+        rope_type = scaling.get("rope_type", scaling.get("type")) or "default"
+        if rope_type == "llama3":
+            try:
+                rope_llama3_scaling = (
+                    float(scaling["factor"]),
+                    float(scaling["low_freq_factor"]),
+                    float(scaling["high_freq_factor"]),
+                    float(scaling["original_max_position_embeddings"]),
+                )
+            except (KeyError, TypeError, ValueError) as bad:
+                raise ValueError(
+                    "rope_scaling rope_type='llama3' needs numeric "
+                    "factor, low_freq_factor, high_freq_factor and "
+                    f"original_max_position_embeddings fields: {bad!r}"
+                ) from None
+        elif rope_type != "default":
+            raise ValueError(
+                f"rope_scaling={scaling!r} is not supported: this forward "
+                "implements plain RoPE and the llama3 per-band rescale "
+                "only (yarn/linear/dynamic would convert without error "
+                "but produce wrong logits at every position)"
+            )
     for bias_field in ("attention_bias", "mlp_bias"):
         if get(bias_field):
             raise ValueError(
@@ -119,6 +135,7 @@ def config_from_hf(hf_config: Any) -> DecoderConfig:
         head_dim=head_dim,
         d_ff=get("intermediate_size"),
         rope_theta=float(get("rope_theta", 10000.0)),
+        rope_llama3_scaling=rope_llama3_scaling,
         norm_eps=float(get("rms_norm_eps", 1e-6)),
         activation=activation,
         scale_embeddings=scale_embeddings,
@@ -398,6 +415,20 @@ def hf_config_dict(cfg: DecoderConfig, model_type: str) -> dict:
         rms_norm_eps=cfg.norm_eps,
         tie_word_embeddings=cfg.tie_embeddings,
     )
+    if cfg.rope_llama3_scaling:
+        if model_type != "llama":
+            raise ValueError(
+                f"{model_type!r} cannot express the llama3 rope rescale "
+                "(only LlamaConfig takes rope_scaling rope_type='llama3')"
+            )
+        factor, low_f, high_f, old_len = cfg.rope_llama3_scaling
+        out["rope_scaling"] = {
+            "rope_type": "llama3",
+            "factor": factor,
+            "low_freq_factor": low_f,
+            "high_freq_factor": high_f,
+            "original_max_position_embeddings": int(old_len),
+        }
     if model_type == "gemma2":
         if not cfg.post_norms:
             raise ValueError("gemma2 export requires cfg.post_norms=True")

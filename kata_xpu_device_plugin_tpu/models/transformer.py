@@ -48,6 +48,13 @@ class DecoderConfig:
     head_dim: int
     d_ff: int
     rope_theta: float = 10000.0
+    # Llama-3.1-style RoPE frequency rescaling for long-context checkpoints:
+    # () disables; (factor, low_freq_factor, high_freq_factor,
+    # original_max_position_embeddings) applies the per-band inv_freq
+    # transform (long wavelengths divided by ``factor``, short ones kept,
+    # the middle band smoothly interpolated). Static tuple — resolved at
+    # trace time, no runtime cost.
+    rope_llama3_scaling: tuple = ()
     norm_eps: float = 1e-6
     # "geglu" (Gemma) or "swiglu" (Llama); both are gated MLPs, differing in
     # the gate nonlinearity.
@@ -238,11 +245,30 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     return (normed * (1.0 + scale.astype(jnp.float32))).astype(x.dtype)
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary position embedding. x: [B, S, H, D], positions: [B, S]."""
+def rope(x: jax.Array, positions: jax.Array, theta: float,
+         llama3_scaling: tuple = ()) -> jax.Array:
+    """Rotary position embedding. x: [B, S, H, D], positions: [B, S].
+
+    ``llama3_scaling`` = (factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings) applies the Llama-3.1 per-band
+    frequency rescale (matches HF ``_compute_llama3_parameters``):
+    wavelengths longer than ``old/low`` are slowed by ``factor``, shorter
+    than ``old/high`` kept, the band between linearly interpolated in
+    ``old/wavelen`` space. Everything is static, so the transform folds
+    into the compiled constant table."""
     d = x.shape[-1]
     freq_exponents = jnp.arange(0, d // 2, dtype=jnp.float32) * (2.0 / d)
     inv_freq = theta ** -freq_exponents  # [D/2]
+    if llama3_scaling:
+        factor, low_f, high_f, old_len = (float(v) for v in llama3_scaling)
+        wavelen = 2.0 * jnp.pi / inv_freq
+        smooth = (old_len / wavelen - low_f) / (high_f - low_f)
+        smoothed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen > old_len / low_f,           # long-wavelength band
+            inv_freq / factor,
+            jnp.where(wavelen < old_len / high_f, inv_freq, smoothed),
+        )
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
     angles = angles[:, :, None, :]  # [B, S, 1, D/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
@@ -392,8 +418,8 @@ def _layer(
     q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
     k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-    q = rope(q, positions, cfg.rope_theta)
-    k = rope(k, positions, cfg.rope_theta)
+    q = rope(q, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
+    k = rope(k, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
 
     if kv_cache is not None and prefill:
         # Prefill: the cache is empty, so attention over the FRESH k/v is

@@ -108,6 +108,39 @@ def test_gemma2_parity():
     _assert_close(ours, _hf_logits(model, toks))
 
 
+def test_llama31_rope_scaling_parity():
+    """Llama-3.1-style rope_scaling (the long-context checkpoints' config)
+    against HF's _compute_llama3_parameters. rope_theta=100 and
+    original_max_position_embeddings=16 put this head_dim's wavelengths in
+    ALL THREE bands (kept / smoothed / divided-by-factor), so a band-logic
+    error cannot hide; S=32 > old_len so scaled positions are exercised."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=100.0, max_position_embeddings=64,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 2.0, "original_max_position_embeddings": 16,
+        },
+        attn_implementation="eager",
+    )
+    torch.manual_seed(13)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    toks = _tokens(128, seed=13)
+    ours, cfg = _ours_logits(model, toks)
+    assert cfg.rope_llama3_scaling == (8.0, 1.0, 2.0, 16.0)
+    _assert_close(ours, _hf_logits(model, toks))
+    # non-llama3 scaling types still fail closed
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf({**_DICT_BASE, "rope_scaling": {
+            "rope_type": "yarn", "factor": 4.0}})
+    # and the export direction round-trips the scaling dict
+    p, c = from_hf(model)
+    _, hf_dict = to_hf_state_dict(p, c, "llama")
+    assert hf_dict["rope_scaling"]["rope_type"] == "llama3"
+    assert hf_dict["rope_scaling"]["factor"] == 8.0
+
+
 def test_mistral_sliding_window_parity():
     hf_cfg = transformers.MistralConfig(
         vocab_size=128, hidden_size=64, intermediate_size=128,
@@ -381,9 +414,16 @@ def test_unsupported_conventions_fail_closed():
     """A checkpoint must never convert cleanly into wrong logits: scaled
     RoPE (Llama-3.1 style) and projection biases are rejected, not
     silently dropped."""
-    with pytest.raises(ValueError, match="rope_scaling"):
+    # llama3 scaling is SUPPORTED, but a malformed dict must raise a clear
+    # ValueError, not a KeyError deep in the field access
+    with pytest.raises(ValueError, match="needs numeric"):
         config_from_hf({**_DICT_BASE, "rope_scaling": {
             "rope_type": "llama3", "factor": 8.0}})
+    with pytest.raises(ValueError, match="needs numeric"):
+        config_from_hf({**_DICT_BASE, "rope_scaling": {
+            "rope_type": "llama3", "factor": None, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192}})
     # the no-op "default" rope_type (serialized by some configs) is fine
     config_from_hf({**_DICT_BASE, "rope_scaling": {"rope_type": "default"}})
     with pytest.raises(ValueError, match="attention_bias"):

@@ -19,6 +19,7 @@ from .convert import (
 )
 from .llama import llama3_8b, llama3_train_bench, llama3_train_test
 from .mistral import mistral_7b, mistral_test_config
+from .qwen2 import qwen2_7b, qwen2_test_config
 from .mixtral import mixtral_8x7b, mixtral_test_config
 from .speculative import draft_propose, generate_speculative, self_draft
 from .transformer import (
@@ -62,4 +63,6 @@ __all__ = [
     "mistral_test_config",
     "mixtral_8x7b",
     "mixtral_test_config",
+    "qwen2_7b",
+    "qwen2_test_config",
 ]

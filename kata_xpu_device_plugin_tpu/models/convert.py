@@ -56,6 +56,7 @@ _FAMILIES = {
     "llama": ("swiglu", False, False, False),
     "mistral": ("swiglu", False, False, False),
     "mixtral": ("swiglu", False, False, False),
+    "qwen2": ("swiglu", False, False, False),
     "gemma": ("geglu", True, True, True),
     "gemma2": ("geglu", True, True, True),
 }
@@ -103,10 +104,11 @@ def config_from_hf(hf_config: Any) -> DecoderConfig:
     for bias_field in ("attention_bias", "mlp_bias"):
         if get(bias_field):
             raise ValueError(
-                f"{bias_field}=True is not supported: projections here "
-                "are bias-free (the released checkpoints of every "
-                "supported family are too) and a silently dropped bias "
-                "would corrupt the logits"
+                f"{bias_field}=True is not supported for "
+                f"{model_type!r}: this family's projections are "
+                "bias-free here (qwen2 is the one family whose q/k/v "
+                "biases are modeled) and a silently dropped bias would "
+                "corrupt the logits"
             )
     # The MLP gate nonlinearity is hardcoded per family (swiglu=silu,
     # geglu=tanh-approx gelu); a checkpoint trained with a different
@@ -168,6 +170,18 @@ def config_from_hf(hf_config: Any) -> DecoderConfig:
             moe_num_experts=int(get("num_local_experts")),
             moe_top_k=int(get("num_experts_per_tok")),
         )
+    elif model_type == "qwen2":
+        # Qwen2's q/k/v projections carry additive biases (wo/MLP do not).
+        kw.update(qkv_bias=True)
+        if get("use_sliding_window"):
+            # Qwen2 gates its window per layer index (max_window_layers) —
+            # different semantics from the uniform window here; the
+            # released Qwen2/2.5 checkpoints ship use_sliding_window=False.
+            raise ValueError(
+                "use_sliding_window=True is not supported: Qwen2's "
+                "layer-gated window (max_window_layers) has no equivalent "
+                "here and a uniform window would attend differently"
+            )
     return DecoderConfig(**kw)
 
 
@@ -230,6 +244,14 @@ def params_from_hf(
         "wv": stack(lambda i: take(L.format(i=i) + "self_attn.v_proj.weight").T),
         "wo": stack(lambda i: take(L.format(i=i) + "self_attn.o_proj.weight").T),
     }
+    if cfg.qkv_bias:  # Qwen2: additive q/k/v projection biases
+        for ours, theirs in (("bq", "q_proj"), ("bk", "k_proj"),
+                             ("bv", "v_proj")):
+            layers[ours] = stack(
+                lambda i, t=theirs: take(
+                    L.format(i=i) + f"self_attn.{t}.bias"
+                )
+            )
     if model_type == "gemma2":
         layers["post_attn_norm"] = stack(
             lambda i: norm(L.format(i=i) + "post_attention_layernorm.weight")
@@ -402,6 +424,19 @@ def hf_config_dict(cfg: DecoderConfig, model_type: str) -> dict:
         raise ValueError(
             f"MoE={cfg.moe} config cannot export as {model_type!r}"
         )
+    if cfg.qkv_bias != (model_type == "qwen2"):
+        raise ValueError(
+            f"qkv_bias={cfg.qkv_bias} cannot export as {model_type!r}: "
+            "only qwen2 carries q/k/v projection biases (a mismatch "
+            "would leave the HF model's biases random-initialized or "
+            "drop trained ones)"
+        )
+    if model_type == "qwen2" and cfg.head_dim * cfg.n_heads != cfg.d_model:
+        raise ValueError(
+            "qwen2 derives head_dim as hidden_size // num_heads; "
+            f"head_dim={cfg.head_dim} × n_heads={cfg.n_heads} != "
+            f"d_model={cfg.d_model} cannot round-trip"
+        )
     out = dict(
         model_type=model_type,
         vocab_size=cfg.vocab_size,
@@ -537,6 +572,10 @@ def to_hf_state_dict(
         for ours, theirs in (("wq", "q_proj"), ("wk", "k_proj"),
                              ("wv", "v_proj"), ("wo", "o_proj")):
             sd[L + f"self_attn.{theirs}.weight"] = npt(layers[ours][i])
+        if cfg.qkv_bias:
+            for ours, theirs in (("bq", "q_proj"), ("bk", "k_proj"),
+                                 ("bv", "v_proj")):
+                sd[L + f"self_attn.{theirs}.bias"] = npf(layers[ours][i])
         if model_type == "gemma2":
             sd[L + "post_attention_layernorm.weight"] = norm_out(
                 layers["post_attn_norm"][i]

@@ -1,0 +1,55 @@
+"""Qwen2/2.5 family configs: Llama-style pre-norm decoder (GQA, SwiGLU,
+RMSNorm w·x̂, untied unembedding at 7B scale) whose one architectural
+delta is additive biases on the q/k/v projections (``qkv_bias=True`` —
+wo and the MLP stay bias-free). Checkpoints convert both ways via
+``models.convert`` (family ``qwen2``), parity-locked against the HF
+implementation in ``tests/test_hf_convert.py``.
+
+Architecture facts from the public Qwen2 report / HF configs: 7B is
+28 layers, d_model 3584, 28 q heads / 4 kv heads (head_dim 128),
+d_ff 18944, rope theta 1e6, vocab 152064.
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+
+from .transformer import DecoderConfig
+
+
+def qwen2_7b(**overrides) -> DecoderConfig:
+    cfg = DecoderConfig(
+        vocab_size=152064,
+        d_model=3584,
+        n_layers=28,
+        n_heads=28,
+        n_kv_heads=4,
+        head_dim=128,
+        d_ff=18944,
+        rope_theta=1e6,
+        norm_eps=1e-6,
+        activation="swiglu",
+        scale_embeddings=False,
+        tie_embeddings=False,
+        qkv_bias=True,
+    )
+    return replace(cfg, **overrides)
+
+
+def qwen2_test_config(**overrides) -> DecoderConfig:
+    """Qwen2 architecture at test scale (same ratios, 8-divisible dims)."""
+    cfg = DecoderConfig(
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=8,
+        n_kv_heads=4,
+        head_dim=16,
+        d_ff=256,
+        rope_theta=1e6,
+        norm_eps=1e-6,
+        activation="swiglu",
+        scale_embeddings=False,
+        tie_embeddings=False,
+        qkv_bias=True,
+    )
+    return replace(cfg, **overrides)

@@ -74,6 +74,11 @@ class DecoderConfig:
     # Gemma-2 block shape: RMSNorm applied to each sublayer's OUTPUT as
     # well as its input (post_attn_norm / post_mlp_norm params).
     post_norms: bool = False
+    # Qwen2-style additive biases on the q/k/v projections only (wo and
+    # the MLP stay bias-free). Params ``bq/bk/bv`` (fused: ``bqkv``)
+    # appear in the tree iff True — the same key-presence pattern as
+    # post_norms, so every layout/parallelism path is tree-driven.
+    qkv_bias: bool = False
     # Soft cap on ATTENTION logits (Gemma-2 uses 50.0); 0 disables. Capped
     # attention runs the XLA reference path (the flash kernels' blockwise
     # backward does not model the tanh).
@@ -125,6 +130,8 @@ class DecoderConfig:
     def num_params(self) -> int:
         embed = self.vocab_size * self.d_model
         attn = self.d_model * (self.q_dim + 2 * self.kv_dim) + self.q_dim * self.d_model
+        if self.qkv_bias:
+            attn += self.q_dim + 2 * self.kv_dim
         if self.moe:
             mlp = self.d_model * self.moe_num_experts  # router
             mlp += self.moe_num_experts * 3 * self.d_model * self.d_ff
@@ -179,6 +186,10 @@ def init_params(key: jax.Array, cfg: DecoderConfig, dtype=jnp.float32) -> Params
     if cfg.post_norms:
         layers["post_attn_norm"] = jnp.ones((L, cfg.d_model), dtype)
         layers["post_mlp_norm"] = jnp.ones((L, cfg.d_model), dtype)
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, cfg.q_dim), dtype)
+        layers["bk"] = jnp.zeros((L, cfg.kv_dim), dtype)
+        layers["bv"] = jnp.zeros((L, cfg.kv_dim), dtype)
     if cfg.moe:
         E, F = cfg.moe_num_experts, cfg.d_ff
         layers.update({
@@ -223,12 +234,16 @@ def fuse_decoder_params(params: Params) -> Params:
         )
     fused = {
         k: v for k, v in layers.items()
-        if k not in ("wq", "wk", "wv", "w_gate", "w_up")
+        if k not in ("wq", "wk", "wv", "w_gate", "w_up", "bq", "bk", "bv")
     }
     fused["wqkv"] = jnp.concatenate(
         [layers["wq"], layers["wk"], layers["wv"]], axis=2
     )
     fused["w_gateup"] = jnp.concatenate([layers["w_gate"], layers["w_up"]], axis=2)
+    if "bq" in layers:  # Qwen2 qkv biases fuse along the same boundary
+        fused["bqkv"] = jnp.concatenate(
+            [layers["bq"], layers["bk"], layers["bv"]], axis=1
+        )
     out = dict(params)
     out["layers"] = fused
     return out
@@ -408,6 +423,8 @@ def _layer(
         # bandwidth-bound decode step. weight_matmul also accepts int8
         # QTensors (ops.quant), which halve that stream again.
         qkv = weight_matmul(h, layer["wqkv"])
+        if "bqkv" in layer:  # Qwen2: fused q/k/v bias, one add
+            qkv = qkv + layer["bqkv"].astype(qkv.dtype)
         q = qkv[..., : cfg.q_dim]
         k = qkv[..., cfg.q_dim : cfg.q_dim + cfg.kv_dim]
         v = qkv[..., cfg.q_dim + cfg.kv_dim :]
@@ -415,6 +432,10 @@ def _layer(
         q = weight_matmul(h, layer["wq"])
         k = weight_matmul(h, layer["wk"])
         v = weight_matmul(h, layer["wv"])
+        if "bq" in layer:  # Qwen2: q/k/v projection biases
+            q = q + layer["bq"].astype(q.dtype)
+            k = k + layer["bk"].astype(k.dtype)
+            v = v + layer["bv"].astype(v.dtype)
     q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
     k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)

@@ -36,6 +36,13 @@ PARAM_RULES: dict[str, P] = {
     "layers.wq": P(None, AXIS_FSDP, AXIS_MODEL),
     "layers.wk": P(None, AXIS_FSDP, AXIS_MODEL),
     "layers.wv": P(None, AXIS_FSDP, AXIS_MODEL),
+    # Qwen2 q/k/v biases: shard the out axis exactly like their matrices
+    # so the post-matmul add needs no resharding (GSPMD splits the
+    # concatenated fused-bias axis at arbitrary boundaries, like wqkv).
+    "layers.bq": P(None, AXIS_MODEL),
+    "layers.bk": P(None, AXIS_MODEL),
+    "layers.bv": P(None, AXIS_MODEL),
+    "layers.bqkv": P(None, AXIS_MODEL),
     "layers.wo": P(None, AXIS_MODEL, AXIS_FSDP),
     "layers.w_gate": P(None, AXIS_FSDP, AXIS_MODEL),
     "layers.w_up": P(None, AXIS_FSDP, AXIS_MODEL),

@@ -155,6 +155,92 @@ def test_mistral_sliding_window_parity():
     _assert_close(ours, _hf_logits(model, toks))
 
 
+def test_qwen2_parity():
+    """Qwen2: llama-style blocks plus additive q/k/v projection biases —
+    torch random-inits the biases nonzero, so the bias path is genuinely
+    exercised, GQA included."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(14)
+    model = transformers.Qwen2ForCausalLM(hf_cfg)
+    toks = _tokens(128, seed=14)
+    ours, cfg = _ours_logits(model, toks)
+    assert cfg.qkv_bias and cfg.activation == "swiglu"
+    _assert_close(ours, _hf_logits(model, toks))
+    # layer-gated windows fail closed rather than attending differently
+    with pytest.raises(ValueError, match="use_sliding_window"):
+        config_from_hf({**_DICT_BASE, "model_type": "qwen2",
+                        "use_sliding_window": True, "sliding_window": 8})
+
+
+def test_qwen2_export_roundtrip(tmp_path):
+    """Export with biases loads back into transformers with the same
+    logits; bias-bearing trees refuse to export as bias-free families."""
+    from kata_xpu_device_plugin_tpu.models import init_params
+    import jax
+
+    cfg = replace(
+        config_from_hf({"model_type": "qwen2", "vocab_size": 128,
+                        "hidden_size": 64, "intermediate_size": 128,
+                        "num_hidden_layers": 2, "num_attention_heads": 4,
+                        "num_key_value_heads": 2}),
+        dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(15), cfg)
+    # init biases are zeros — randomize so the export carries real values
+    layers = dict(params["layers"])
+    for i, b in enumerate(("bq", "bk", "bv")):
+        layers[b] = jax.random.normal(
+            jax.random.PRNGKey(100 + i), layers[b].shape
+        ) * 0.1
+    params = {**params, "layers": layers}
+    save_hf_checkpoint(params, cfg, "qwen2", str(tmp_path / "out"))
+    model = transformers.AutoModelForCausalLM.from_pretrained(
+        str(tmp_path / "out"), attn_implementation="eager"
+    )
+    toks = _tokens(128, seed=15)
+    ours = np.asarray(forward(params, jnp.asarray(toks), cfg), np.float32)
+    _assert_close(ours, _hf_logits(model, toks))
+    with pytest.raises(ValueError, match="qkv_bias"):
+        to_hf_state_dict(params, cfg, "llama")
+
+
+def test_qwen2_fused_quantized_serving():
+    """The capstone journey for the bias-carrying family: converted Qwen2
+    through fuse (bq/bk/bv → one bqkv) → bf16 serving token-identical to
+    generate() → int8 serving runs (biases pass through quantization)."""
+    from kata_xpu_device_plugin_tpu.guest.serving import serve_batch
+    from kata_xpu_device_plugin_tpu.models import generate
+    from kata_xpu_device_plugin_tpu.models.transformer import (
+        fuse_decoder_params,
+    )
+    from kata_xpu_device_plugin_tpu.ops.quant import quantize_decoder_params
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(16)
+    params, cfg = from_hf(transformers.Qwen2ForCausalLM(hf_cfg))
+    cfg = replace(cfg, dtype=jnp.float32)
+    prompt = np.asarray(_tokens(128, seed=16)[0, :12])
+    steps = 8
+    ref = np.asarray(
+        generate(params, jnp.asarray(prompt)[None], cfg, steps=steps)
+    )[0]
+    fused = fuse_decoder_params(params)
+    assert "bqkv" in fused["layers"] and "bq" not in fused["layers"]
+    out = serve_batch(fused, cfg, [prompt], steps, max_batch=2, max_len=32)[0]
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    q = quantize_decoder_params(fused)
+    qout = serve_batch(q, cfg, [prompt], steps, max_batch=2, max_len=32)[0]
+    assert len(qout) == steps
+
+
 def test_mixtral_sliding_window_mapped():
     """Mixtral carries mistral's sliding_window; it must convert, not drop
     (a window-bearing fine-tune attends differently past the window)."""

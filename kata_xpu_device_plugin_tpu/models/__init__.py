@@ -2,6 +2,8 @@
 inference workload) and Llama-3 (BASELINE training workload) configs."""
 from .gemma import (
     gemma2_2b,
+    gemma3_4b,
+    gemma3_test_config,
     gemma2_9b,
     gemma2_test_config,
     gemma_2b,
@@ -53,6 +55,8 @@ __all__ = [
     "gemma2_2b",
     "gemma2_9b",
     "gemma2_test_config",
+    "gemma3_4b",
+    "gemma3_test_config",
     "gemma_2b",
     "gemma_2b_bench",
     "gemma_7b",

@@ -59,6 +59,7 @@ _FAMILIES = {
     "qwen2": ("swiglu", False, False, False),
     "gemma": ("geglu", True, True, True),
     "gemma2": ("geglu", True, True, True),
+    "gemma3_text": ("geglu", True, True, True),
 }
 
 
@@ -78,9 +79,23 @@ def config_from_hf(hf_config: Any) -> DecoderConfig:
     # checkpoint never converts cleanly into wrong logits:
     scaling = get("rope_scaling")
     rope_llama3_scaling: tuple = ()
+    gemma3_linear_factor = 1.0
     if scaling:
         rope_type = scaling.get("rope_type", scaling.get("type")) or "default"
-        if rope_type == "llama3":
+        if model_type == "gemma3_text":
+            # HF applies rope_scaling to the GLOBAL rotary only (local
+            # layers force rope_type=default at rope_local_base_freq), so
+            # only the linear rescale maps — anything else (llama3/yarn)
+            # would be applied per-layer differently than here.
+            if rope_type == "linear":
+                gemma3_linear_factor = float(scaling["factor"])
+            elif rope_type != "default":
+                raise ValueError(
+                    f"rope_scaling rope_type={rope_type!r} is not "
+                    "supported for gemma3_text (only the released "
+                    "checkpoints' 'linear' global-layer rescale is)"
+                )
+        elif rope_type == "llama3":
             try:
                 rope_llama3_scaling = (
                     float(scaling["factor"]),
@@ -170,6 +185,64 @@ def config_from_hf(hf_config: Any) -> DecoderConfig:
             moe_num_experts=int(get("num_local_experts")),
             moe_top_k=int(get("num_experts_per_tok")),
         )
+    elif model_type == "gemma3_text":
+        layer_types = list(get("layer_types") or [])
+        if not layer_types:
+            raise ValueError("gemma3_text config has no layer_types list")
+        # Compress the per-layer attention types to their minimal period
+        # (the released checkpoints repeat 5 sliding : 1 full) — the scan
+        # unrolls one period, so compile cost scales with it.
+        known = {"sliding_attention", "full_attention"}
+        unknown = sorted(set(layer_types) - known)
+        if unknown:
+            raise ValueError(
+                f"unknown gemma3 layer_types {unknown}: only "
+                f"{sorted(known)} are modeled — an unrecognized type "
+                "must not silently become full attention"
+            )
+        sw = int(get("sliding_window") or 0)
+        if "sliding_attention" in layer_types and sw <= 0:
+            raise ValueError(
+                "gemma3_text config declares sliding_attention layers "
+                f"but sliding_window={get('sliding_window')!r} — "
+                "converting them to full attention would un-mask them"
+            )
+        n = len(layer_types)
+        period = next(
+            p for p in range(1, n + 1)
+            if n % p == 0 and layer_types == layer_types[:p] * (n // p)
+        )
+        windows = tuple(
+            sw if t == "sliding_attention" else 0
+            for t in layer_types[:period]
+        )
+        theta_local = float(get("rope_local_base_freq", 10000.0))
+        theta_global = kw["rope_theta"]
+        kw.update(
+            post_norms=True,
+            qk_norm=True,
+            attn_windows=windows,
+            # local (windowed) layers rope at the local base frequency;
+            # global layers at rope_theta, linearly rescaled on 4B+.
+            rope_theta_cycle=tuple(
+                theta_local if w > 0 else theta_global for w in windows
+            ),
+            rope_linear_cycle=(
+                tuple(
+                    1.0 if w > 0 else gemma3_linear_factor for w in windows
+                )
+                if gemma3_linear_factor != 1.0 else ()
+            ),
+        )
+        scalar = get("query_pre_attn_scalar")
+        if scalar is not None and int(scalar) != head_dim:
+            raise ValueError(
+                f"query_pre_attn_scalar={scalar} != head_dim={head_dim}: "
+                "this forward scales attention by head_dim**-0.5 only "
+                "(true for the released Gemma-3 1B/4B/12B text "
+                "checkpoints; 27B scales by hidden/heads and is not "
+                "supported)"
+            )
     elif model_type == "qwen2":
         # Qwen2's q/k/v projections carry additive biases (wo/MLP do not).
         kw.update(qkv_bias=True)
@@ -252,7 +325,14 @@ def params_from_hf(
                     L.format(i=i) + f"self_attn.{t}.bias"
                 )
             )
-    if model_type == "gemma2":
+    if cfg.qk_norm:  # Gemma-3: per-head QK-norms ((1+w) convention)
+        layers["q_norm"] = stack(
+            lambda i: norm(L.format(i=i) + "self_attn.q_norm.weight")
+        )
+        layers["k_norm"] = stack(
+            lambda i: norm(L.format(i=i) + "self_attn.k_norm.weight")
+        )
+    if model_type in ("gemma2", "gemma3_text"):
         layers["post_attn_norm"] = stack(
             lambda i: norm(L.format(i=i) + "post_attention_layernorm.weight")
         )
@@ -409,6 +489,16 @@ def hf_config_dict(cfg: DecoderConfig, model_type: str) -> dict:
     family cannot express (so an export never silently drops semantics)."""
     if model_type not in _FAMILIES:
         raise ValueError(f"unsupported model_type {model_type!r}")
+    if model_type == "gemma3_text":
+        raise ValueError(
+            "gemma3_text is an import-only family: export would need the "
+            "per-layer layer_types / dual-rope reconstruction"
+        )
+    if cfg.qk_norm or cfg.rope_theta_cycle or cfg.rope_linear_cycle:
+        raise ValueError(
+            "QK-norm / per-layer rope cycles (Gemma-3) have no exportable "
+            f"representation in {model_type!r}"
+        )
     activation, scale_embeddings, _, _ = _FAMILIES[model_type]
     if cfg.activation != activation:
         raise ValueError(

@@ -116,3 +116,61 @@ def gemma2_test_config(**overrides) -> DecoderConfig:
         post_norms=True,
     )
     return replace(base, **overrides)
+
+
+def gemma3_4b(**overrides) -> DecoderConfig:
+    """Gemma-3 4B text (public Gemma-3 report / HF config): 5:1
+    local/global attention pattern (1024-token window; every 6th layer
+    global, the 34-layer tail truncating the last period exactly as the
+    released checkpoint's layer_types does), per-head QK-norms, dual rope
+    (local layers at base 10k, global at 1M with linear factor 8),
+    pre+post norms, NO logit softcaps (Gemma-3 dropped them). The
+    truncated pattern has no shorter period, so the scan unrolls the full
+    depth — compile cost matches an unrolled model, numerics unaffected."""
+    n_layers = 34
+    windows = tuple(1024 if (i + 1) % 6 else 0 for i in range(n_layers))
+    cfg = DecoderConfig(
+        vocab_size=262208,
+        d_model=2560,
+        n_layers=n_layers,
+        n_heads=8,
+        n_kv_heads=4,
+        head_dim=256,
+        d_ff=10240,
+        rope_theta=1_000_000.0,
+        activation="geglu",
+        scale_embeddings=True,
+        tie_embeddings=True,
+        post_norms=True,
+        qk_norm=True,
+        attn_windows=windows,
+        rope_theta_cycle=tuple(
+            10000.0 if w else 1_000_000.0 for w in windows
+        ),
+        rope_linear_cycle=tuple(1.0 if w else 8.0 for w in windows),
+    )
+    return replace(cfg, **overrides)
+
+
+def gemma3_test_config(**overrides) -> DecoderConfig:
+    """Gemma-3 architecture at test scale: QK-norms, a 2:1 local/global
+    cycle with dual rope and a linear factor on the global position."""
+    cfg = DecoderConfig(
+        vocab_size=512,
+        d_model=128,
+        n_layers=6,
+        n_heads=8,
+        n_kv_heads=4,
+        head_dim=16,
+        d_ff=256,
+        rope_theta=100_000.0,
+        activation="geglu",
+        scale_embeddings=True,
+        tie_embeddings=True,
+        post_norms=True,
+        qk_norm=True,
+        attn_windows=(8, 8, 0),
+        rope_theta_cycle=(10000.0, 10000.0, 100_000.0),
+        rope_linear_cycle=(1.0, 1.0, 8.0),
+    )
+    return replace(cfg, **overrides)

@@ -79,6 +79,19 @@ class DecoderConfig:
     # appear in the tree iff True — the same key-presence pattern as
     # post_norms, so every layout/parallelism path is tree-driven.
     qkv_bias: bool = False
+    # Gemma-3-style per-head QK-norm: RMSNorm over head_dim applied to q
+    # and k after the projection reshape, BEFORE rope. Params
+    # ``q_norm``/``k_norm`` [L, head_dim] appear iff True.
+    qk_norm: bool = False
+    # Gemma-3-style per-layer rope parameters, aligned with the
+    # attn_windows cycle (local layers use a different base frequency,
+    # and 4B+ checkpoints linearly rescale positions on global layers):
+    # rope_theta_cycle[i] overrides rope_theta for cycle position i;
+    # rope_linear_cycle[i] divides the angular frequencies (HF
+    # rope_type="linear" factor). () = uniform. When set, each must have
+    # exactly len(window_cycle) entries.
+    rope_theta_cycle: tuple = ()
+    rope_linear_cycle: tuple = ()
     # Soft cap on ATTENTION logits (Gemma-2 uses 50.0); 0 disables. Capped
     # attention runs the XLA reference path (the flash kernels' blockwise
     # backward does not model the tanh).
@@ -132,6 +145,8 @@ class DecoderConfig:
         attn = self.d_model * (self.q_dim + 2 * self.kv_dim) + self.q_dim * self.d_model
         if self.qkv_bias:
             attn += self.q_dim + 2 * self.kv_dim
+        if self.qk_norm:
+            attn += 2 * self.head_dim
         if self.moe:
             mlp = self.d_model * self.moe_num_experts  # router
             mlp += self.moe_num_experts * 3 * self.d_model * self.d_ff
@@ -190,6 +205,9 @@ def init_params(key: jax.Array, cfg: DecoderConfig, dtype=jnp.float32) -> Params
         layers["bq"] = jnp.zeros((L, cfg.q_dim), dtype)
         layers["bk"] = jnp.zeros((L, cfg.kv_dim), dtype)
         layers["bv"] = jnp.zeros((L, cfg.kv_dim), dtype)
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, cfg.head_dim), dtype)
+        layers["k_norm"] = jnp.ones((L, cfg.head_dim), dtype)
     if cfg.moe:
         E, F = cfg.moe_num_experts, cfg.d_ff
         layers.update({
@@ -261,7 +279,7 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float,
-         llama3_scaling: tuple = ()) -> jax.Array:
+         llama3_scaling: tuple = (), linear_factor: float = 1.0) -> jax.Array:
     """Rotary position embedding. x: [B, S, H, D], positions: [B, S].
 
     ``llama3_scaling`` = (factor, low_freq_factor, high_freq_factor,
@@ -269,11 +287,15 @@ def rope(x: jax.Array, positions: jax.Array, theta: float,
     frequency rescale (matches HF ``_compute_llama3_parameters``):
     wavelengths longer than ``old/low`` are slowed by ``factor``, shorter
     than ``old/high`` kept, the band between linearly interpolated in
-    ``old/wavelen`` space. Everything is static, so the transform folds
-    into the compiled constant table."""
+    ``old/wavelen`` space. ``linear_factor`` > 1 divides ALL angular
+    frequencies (HF ``rope_type="linear"`` — Gemma-3's global layers).
+    Everything is static, so the transforms fold into the compiled
+    constant table."""
     d = x.shape[-1]
     freq_exponents = jnp.arange(0, d // 2, dtype=jnp.float32) * (2.0 / d)
     inv_freq = theta ** -freq_exponents  # [D/2]
+    if linear_factor != 1.0:
+        inv_freq = inv_freq / linear_factor
     if llama3_scaling:
         factor, low_f, high_f, old_len = (float(v) for v in llama3_scaling)
         wavelen = 2.0 * jnp.pi / inv_freq
@@ -377,6 +399,8 @@ def _layer(
     moe_mesh=None,
     ring: bool = False,
     window: Optional[int] = None,
+    rope_theta: Optional[float] = None,
+    rope_linear: float = 1.0,
 ):
     """One decoder block. x: [B, S, D]. Returns (x, new_kv, aux) where aux
     is the layer's MoE load-balancing loss (0.0 for dense layers).
@@ -439,8 +463,12 @@ def _layer(
     q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
     k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-    q = rope(q, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
-    k = rope(k, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
+    if "q_norm" in layer:  # Gemma-3: per-head QK-norm before rope
+        q = rms_norm(q, layer["q_norm"], cfg.norm_eps)
+        k = rms_norm(k, layer["k_norm"], cfg.norm_eps)
+    theta = cfg.rope_theta if rope_theta is None else rope_theta
+    q = rope(q, positions, theta, cfg.rope_llama3_scaling, rope_linear)
+    k = rope(k, positions, theta, cfg.rope_llama3_scaling, rope_linear)
 
     if kv_cache is not None and prefill:
         # Prefill: the cache is empty, so attention over the FRESH k/v is
@@ -629,6 +657,17 @@ def forward(
             f"n_layers={cfg.n_layers} not divisible by the attn_windows "
             f"cycle {cfg.attn_windows}"
         )
+    # Per-cycle-position rope parameters (Gemma-3: local layers use a
+    # different base frequency; global layers may linearly rescale).
+    for name, c in (("rope_theta_cycle", cfg.rope_theta_cycle),
+                    ("rope_linear_cycle", cfg.rope_linear_cycle)):
+        if c and len(c) != P:
+            raise ValueError(
+                f"{name} {c!r} must have one entry per attn_windows "
+                f"cycle position ({P})"
+            )
+    theta_cycle = cfg.rope_theta_cycle or (None,) * P
+    linear_cycle = cfg.rope_linear_cycle or (1.0,) * P
 
     # ring + a window cycle ⇒ the CYCLE ARENA cache layout: kv_caches is a
     # tuple over cycle positions, each a [L/P, ...]-stacked cache pair of
@@ -637,11 +676,11 @@ def forward(
     # in one stacked array, so the scan consumes the tuple directly.
     cycle_arena = ring and P > 1
 
-    def one_layer(x, layer, cache, w):
+    def one_layer(x, layer, cache, w, theta=None, linear=1.0):
         return _layer(
             cfg, attn_fn, x, layer, positions, cache, cache_offset,
             prefill=prefill, moe_mesh=moe_mesh, ring=ring and w > 0,
-            window=w,
+            window=w, rope_theta=theta, rope_linear=linear,
         )
 
     def body(carry, group_and_cache):
@@ -650,7 +689,10 @@ def forward(
             group_and_cache if kv_caches is not None else (group_and_cache, None)
         )
         if P == 1:
-            x, new_cache, aux = one_layer(x, group, cache_group, cycle[0])
+            x, new_cache, aux = one_layer(
+                x, group, cache_group, cycle[0],
+                theta_cycle[0], linear_cycle[0],
+            )
             if kv_caches is not None:
                 return x, (new_cache, aux)
             return x, aux
@@ -663,7 +705,10 @@ def forward(
                 sub_cache = cache_group[i]  # scan already sliced [B, len_i, ...]
             else:
                 sub_cache = jax.tree.map(lambda a: a[i], cache_group)
-            x, nc, a = one_layer(x, sub_layer, sub_cache, cycle[i])
+            x, nc, a = one_layer(
+                x, sub_layer, sub_cache, cycle[i],
+                theta_cycle[i], linear_cycle[i],
+            )
             new_caches.append(nc)
             auxes.append(a)
         aux = jnp.mean(jnp.stack(auxes))

@@ -179,10 +179,11 @@ def make_transformer_pipeline(
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by {n_stages} stages"
         )
-    if cfg.attn_windows:
+    if cfg.attn_windows or cfg.rope_theta_cycle or cfg.rope_linear_cycle:
         raise ValueError(
-            "pipeline stages apply one uniform attention window; per-layer "
-            "attn_windows cycles (Gemma-2 style) are not supported here"
+            "pipeline stages apply one uniform attention window and rope; "
+            "per-layer attn_windows / rope cycles (Gemma-2/3 style) are "
+            "not supported here"
         )
     layers_per_stage = cfg.n_layers // n_stages
 

@@ -33,6 +33,8 @@ PARAM_RULES: dict[str, P] = {
     "layers.mlp_norm": P(None, None),
     "layers.post_attn_norm": P(None, None),  # Gemma-2 post-sublayer norms
     "layers.post_mlp_norm": P(None, None),
+    "layers.q_norm": P(None, None),  # Gemma-3 per-head QK-norms (tiny)
+    "layers.k_norm": P(None, None),
     "layers.wq": P(None, AXIS_FSDP, AXIS_MODEL),
     "layers.wk": P(None, AXIS_FSDP, AXIS_MODEL),
     "layers.wv": P(None, AXIS_FSDP, AXIS_MODEL),

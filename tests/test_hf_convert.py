@@ -155,6 +155,39 @@ def test_mistral_sliding_window_parity():
     _assert_close(ours, _hf_logits(model, toks))
 
 
+def test_gemma3_parity():
+    """Gemma-3 text: per-head QK-norms, a truncated 5:1 local/global
+    layer pattern, DUAL rope (local base freq on windowed layers, global
+    theta with a linear rescale on full layers), post-norms, no softcaps.
+    n_layers=7 with pattern period 3 forces the truncated-tail path
+    (minimal period = full depth) and a window small enough to bite."""
+    hf_cfg = transformers.Gemma3TextConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=7, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, query_pre_attn_scalar=16, sliding_window=8,
+        sliding_window_pattern=3, rope_theta=100_000.0,
+        rope_local_base_freq=1000.0,
+        rope_scaling={"rope_type": "linear", "factor": 4.0},
+        attn_implementation="eager",
+    )
+    torch.manual_seed(17)
+    model = transformers.Gemma3ForCausalLM(hf_cfg)
+    toks = _tokens(128, seed=17)
+    ours, cfg = _ours_logits(model, toks)
+    assert cfg.qk_norm and cfg.post_norms
+    assert len(cfg.attn_windows) == len(cfg.rope_theta_cycle)
+    assert 0 in cfg.attn_windows and 8 in cfg.attn_windows
+    assert 1000.0 in cfg.rope_theta_cycle and 100_000.0 in cfg.rope_theta_cycle
+    assert 4.0 in cfg.rope_linear_cycle
+    _assert_close(ours, _hf_logits(model, toks))
+    # import-only: export fails closed rather than dropping the dual rope
+    params, _ = from_hf(model)
+    with pytest.raises(ValueError, match="import-only"):
+        to_hf_state_dict(params, cfg, "gemma3_text")
+    with pytest.raises(ValueError, match="QK-norm|rope cycles"):
+        to_hf_state_dict(params, cfg, "gemma2")
+
+
 def test_qwen2_parity():
     """Qwen2: llama-style blocks plus additive q/k/v projection biases —
     torch random-inits the biases nonzero, so the bias path is genuinely

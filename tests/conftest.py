@@ -26,3 +26,23 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import gc  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_process_accumulation():
+    """Clear jax's compiled-executable caches (and collect garbage) after
+    each test MODULE. The suite runs ~370 tests in one interpreter that
+    also hosts torch (the HF parity oracles); with every compiled
+    executable of every module retained, full-suite runs intermittently
+    died with a SIGSEGV inside XLA's LLVM compilation late in the run
+    (observed twice at ~85%, never reproducible on the same tests in a
+    shorter process). Bounding the accumulation costs a few re-compiles
+    of shared tiny shapes and removes the corrupting condition."""
+    yield
+    jax.clear_caches()
+    gc.collect()

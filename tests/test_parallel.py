@@ -749,3 +749,29 @@ def test_qkv_bias_train_step_matches_unsharded():
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-3)
     # the optimizer really updated the biases (grads are nonzero)
     assert float(jnp.abs(state["params"]["layers"]["bq"]).max()) > 0.0
+
+
+def test_gemma3_dual_rope_seq_composed_train_step():
+    """Gemma-3's full block — QK-norms, window cycle, DUAL per-layer rope
+    (local base freq + linearly rescaled global) — through the seq×fsdp×tp
+    composed GSPMD train step. The rope cycles are applied in _layer
+    before the ring attention override, so they must survive the seq
+    sharding unchanged: first-step loss matches the unsharded reference."""
+    from dataclasses import replace as _replace
+
+    from kata_xpu_device_plugin_tpu.models import gemma3_test_config
+    from kata_xpu_device_plugin_tpu.models.transformer import (
+        init_params,
+        next_token_loss,
+    )
+
+    cfg = _replace(gemma3_test_config(), dtype=jnp.float32)
+    assert cfg.rope_theta_cycle and cfg.qk_norm
+    mesh = parallel.build_mesh({"data": 1, "fsdp": 2, "model": 2, "seq": 2})
+    init_state, step = parallel.make_train_step(cfg, mesh)
+    state = init_state(jax.random.PRNGKey(6))
+    toks = jax.random.randint(jax.random.PRNGKey(7), (4, 32), 0, cfg.vocab_size)
+    state, loss = step(state, parallel.shard_batch(toks, mesh))
+
+    ref_loss = next_token_loss(init_params(jax.random.PRNGKey(6), cfg), toks, cfg)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-3)

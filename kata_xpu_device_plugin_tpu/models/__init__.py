@@ -19,7 +19,7 @@ from .convert import (
     save_hf_checkpoint,
     to_hf_state_dict,
 )
-from .llama import llama3_8b, llama3_train_bench, llama3_train_test
+from .llama import llama31_8b, llama3_8b, llama3_train_bench, llama3_train_test
 from .mistral import mistral_7b, mistral_test_config
 from .qwen2 import qwen2_7b, qwen2_test_config
 from .mixtral import mixtral_8x7b, mixtral_test_config
@@ -60,6 +60,7 @@ __all__ = [
     "gemma_2b",
     "gemma_2b_bench",
     "gemma_7b",
+    "llama31_8b",
     "llama3_8b",
     "llama3_train_bench",
     "llama3_train_test",

@@ -30,6 +30,16 @@ def llama3_8b(**overrides) -> DecoderConfig:
     return replace(cfg, **overrides)
 
 
+def llama31_8b(**overrides) -> DecoderConfig:
+    """Llama-3.1 8B: the 3.0 architecture plus the llama3 per-band rope
+    rescale that buys the 128k context (factor 8 over an 8192-token
+    original context — the released checkpoint's rope_scaling, applied
+    in :func:`transformer.rope`)."""
+    return llama3_8b(
+        rope_llama3_scaling=(8.0, 1.0, 4.0, 8192.0), **overrides
+    )
+
+
 def llama3_train_bench(**overrides) -> DecoderConfig:
     """Llama-3 architecture at single-chip train-bench scale (~256M params,
     MXU-friendly power-of-two dims): large enough that a train step is

@@ -117,6 +117,11 @@ def config_from_hf(hf_config: Any) -> DecoderConfig:
                 "but produce wrong logits at every position)"
             )
     for bias_field in ("attention_bias", "mlp_bias"):
+        # qwen2's q/k/v biases ARE modeled (its branch sets qkv_bias) —
+        # an attention_bias:true annotation there is accurate, not an
+        # unsupported convention.
+        if bias_field == "attention_bias" and model_type == "qwen2":
+            continue
         if get(bias_field):
             raise ValueError(
                 f"{bias_field}=True is not supported for "
@@ -188,7 +193,15 @@ def config_from_hf(hf_config: Any) -> DecoderConfig:
     elif model_type == "gemma3_text":
         layer_types = list(get("layer_types") or [])
         if not layer_types:
-            raise ValueError("gemma3_text config has no layer_types list")
+            # Raw config.json dicts saved before transformers introduced
+            # layer_types carry sliding_window_pattern instead; HF's
+            # Gemma3TextConfig derives the list the same way (every
+            # pattern-th layer is global).
+            pattern = int(get("sliding_window_pattern") or 6)
+            layer_types = [
+                "sliding_attention" if (i + 1) % pattern else "full_attention"
+                for i in range(int(get("num_hidden_layers")))
+            ]
         # Compress the per-layer attention types to their minimal period
         # (the released checkpoints repeat 5 sliding : 1 full) — the scan
         # unrolls one period, so compile cost scales with it.
@@ -200,7 +213,9 @@ def config_from_hf(hf_config: Any) -> DecoderConfig:
                 f"{sorted(known)} are modeled — an unrecognized type "
                 "must not silently become full attention"
             )
-        sw = int(get("sliding_window") or 0)
+        # 4096 is Gemma3TextConfig's class default — absent from raw
+        # dicts saved with use_diff (save_pretrained omits defaults).
+        sw = int(get("sliding_window") or 4096)
         if "sliding_attention" in layer_types and sw <= 0:
             raise ValueError(
                 "gemma3_text config declares sliding_attention layers "
@@ -554,6 +569,13 @@ def hf_config_dict(cfg: DecoderConfig, model_type: str) -> dict:
             "high_freq_factor": high_f,
             "original_max_position_embeddings": int(old_len),
         }
+        # Without this, LlamaConfig's 2048 default would claim a context
+        # BELOW the pre-scaling one and downstream consumers (serving
+        # stacks read it as the context limit) would cap the long-context
+        # model the rescale exists to enable. factor×old is the span the
+        # rescale guarantees; trained-further checkpoints (3.1 ships
+        # 131072) can override in config.json.
+        out["max_position_embeddings"] = int(factor * old_len)
     if model_type == "gemma2":
         if not cfg.post_norms:
             raise ValueError("gemma2 export requires cfg.post_norms=True")

@@ -35,9 +35,8 @@ def llama31_8b(**overrides) -> DecoderConfig:
     rescale that buys the 128k context (factor 8 over an 8192-token
     original context — the released checkpoint's rope_scaling, applied
     in :func:`transformer.rope`)."""
-    return llama3_8b(
-        rope_llama3_scaling=(8.0, 1.0, 4.0, 8192.0), **overrides
-    )
+    cfg = llama3_8b(rope_llama3_scaling=(8.0, 1.0, 4.0, 8192.0))
+    return replace(cfg, **overrides)
 
 
 def llama3_train_bench(**overrides) -> DecoderConfig:

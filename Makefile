@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto test test-fast bench bench-watch eval demo dryrun image clean deploy
+.PHONY: all build proto lint test test-fast bench bench-watch eval demo dryrun image clean deploy
 
 all: build
 
@@ -21,6 +21,22 @@ ifneq ($(PROTOS),)
 	protoc -Ikata_xpu_device_plugin_tpu/plugin/api \
 	  --python_out=kata_xpu_device_plugin_tpu/plugin/api $(PROTOS)
 endif
+
+# Static analysis: the repo's own AST rules (JAX drift, hermeticity —
+# always available), then ruff + mypy when installed. The repo rules are
+# the gate that catches the class of bug that shipped the seed broken
+# (drifted JAX imports crashing pytest collection); ruff/mypy deepen it
+# where the toolchain has them. Strict scope (compat/, tools/lint) is
+# configured in pyproject.toml.
+lint:
+	$(PY) -m tools.lint
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check kata_xpu_device_plugin_tpu/compat tools/lint && \
+	  ruff check --exit-zero kata_xpu_device_plugin_tpu tests scripts bench.py; \
+	else echo "lint: ruff not installed — skipped (pip install ruff)"; fi
+	@if command -v mypy >/dev/null 2>&1; then \
+	  mypy; \
+	else echo "lint: mypy not installed — skipped (pip install mypy)"; fi
 
 test:
 	$(PY) -m pytest tests/ -x -q

@@ -1,0 +1,9 @@
+"""Version-compat shims for fast-moving dependency surfaces.
+
+:mod:`.jaxapi` is the single place the repo touches JAX symbols that have
+moved (or will move) between release lines. Everything else imports them
+from here; ``tools.lint`` rule JX001 enforces that.
+"""
+from . import jaxapi
+
+__all__ = ["jaxapi"]

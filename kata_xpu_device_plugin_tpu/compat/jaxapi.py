@@ -1,0 +1,442 @@
+"""One version-gated shim for every JAX symbol that has drifted between
+release lines.
+
+The seed of this repo could not even *import*: nine modules used APIs from
+a newer JAX (``from jax import shard_map``, ``jax.sharding.AxisType``) that
+do not exist in the installed 0.4.x, so 19 of ~30 test files died at pytest
+collection. The accelerator runtime moves faster than the framework — the
+fix is to resolve each moved symbol HERE, once, against whatever JAX is
+installed, and let ``tools.lint`` (rule JX001) make any direct import of a
+drifted symbol outside this package a lint error at PR time instead of a
+collection crash at run time.
+
+Supported range: jax >= 0.4.26 (``jax.tree``, ``jax.experimental.shard_map``
+with partial-auto) through the current stable line (``jax.shard_map``,
+typed mesh axes). Export table — see ``docs/compat_and_lint.md``:
+
+==================  ============================  ===========================
+symbol              0.4.x resolution              newer resolution
+==================  ============================  ===========================
+``shard_map``       ``jax.experimental.shard_map``  ``jax.shard_map``
+                    (``check_vma``→``check_rep``,
+                    ``axis_names``→``auto``)
+``AxisType``        fallback enum (Auto only       ``jax.sharding.AxisType``
+                    honorable)
+``make_mesh``       drops ``axis_types``           passes ``axis_types``
+``Mesh`` etc.       ``jax.sharding``               ``jax.sharding``
+``pvary``           no-op                          ``lax.pvary``/``pcast``
+``tree_map`` etc.   ``jax.tree`` / ``jax.tree_util``  same
+==================  ============================  ===========================
+
+Every resolver takes the ``jax`` module as a parameter so the unit tests
+can drive both sides of each gate with a fake old/new module surface
+(``tests/test_compat_jaxapi.py``) regardless of the JAX actually installed.
+"""
+from __future__ import annotations
+
+import enum
+import importlib
+import inspect
+import re
+from typing import Any, Callable, Optional, Sequence
+
+
+class JaxCompatError(ImportError):
+    """A JAX symbol this repo depends on is unavailable in the installed
+    version. Names the symbol, what was searched, and the minimum version
+    that provides it."""
+
+    def __init__(self, symbol: str, detail: str, min_version: str):
+        self.symbol = symbol
+        self.min_version = min_version
+        super().__init__(
+            f"jax compat: cannot resolve {symbol!r} ({detail}); "
+            f"this repo needs jax >= {min_version} — "
+            f"see kata_xpu_device_plugin_tpu/compat/jaxapi.py"
+        )
+
+
+def parse_version(version: str) -> tuple[int, int, int]:
+    """``"0.4.37"`` / ``"0.5.0.dev20250101"`` → ``(0, 4, 37)`` (non-numeric
+    tails dropped; missing fields are 0)."""
+    nums = []
+    for part in version.split(".")[:3]:
+        m = re.match(r"\d+", part)
+        nums.append(int(m.group()) if m else 0)
+    while len(nums) < 3:
+        nums.append(0)
+    return tuple(nums)  # type: ignore[return-value]
+
+
+# ----- shard_map ------------------------------------------------------------
+
+
+def resolve_shard_map(jax_mod: Any) -> tuple[Callable, str]:
+    """Find the raw shard_map: ``jax.shard_map`` on the stable line,
+    ``jax.experimental.shard_map.shard_map`` on 0.4.x. Returns
+    ``(fn, style)`` with style ``"stable"`` or ``"experimental"``."""
+    fn = getattr(jax_mod, "shard_map", None)
+    if callable(fn):
+        return fn, "stable"
+    # Fakes/tests expose the submodule as an attribute; the real package
+    # needs an import to materialize it.
+    exp = getattr(jax_mod, "experimental", None)
+    sub = getattr(exp, "shard_map", None) if exp is not None else None
+    if sub is None:
+        try:
+            sub = importlib.import_module(
+                f"{jax_mod.__name__}.experimental.shard_map"
+            )
+        except ImportError:
+            sub = None
+    fn = getattr(sub, "shard_map", None) if sub is not None else None
+    if callable(fn):
+        return fn, "experimental"
+    raise JaxCompatError(
+        "shard_map",
+        "neither jax.shard_map nor jax.experimental.shard_map.shard_map "
+        f"exists in jax {getattr(jax_mod, '__version__', '?')}",
+        min_version="0.4.26",
+    )
+
+
+def build_shard_map(raw: Callable, style: str) -> Callable:
+    """Wrap the raw shard_map behind ONE calling convention — the stable
+    line's: ``shard_map(f, mesh=, in_specs=, out_specs=, check_vma=,
+    axis_names=)``, where ``None`` for either optional means "the
+    version's own default" on BOTH lines (the stable jax.shard_map would
+    otherwise receive a literal None where its default is True). On the
+    experimental line, ``check_vma`` maps to its older spelling
+    ``check_rep`` and ``axis_names`` (the set of MANUAL axes) maps to its
+    complement ``auto`` (the set of axes GSPMD keeps)."""
+
+    def shard_map(
+        f: Callable,
+        *,
+        mesh: Any,
+        in_specs: Any,
+        out_specs: Any,
+        check_vma: Optional[bool] = None,
+        axis_names: Optional[Any] = None,
+        **kw: Any,
+    ) -> Callable:
+        if style == "stable":
+            if check_vma is not None:
+                kw.setdefault("check_vma", check_vma)
+            if axis_names is not None:
+                kw.setdefault("axis_names", set(axis_names))
+        else:
+            if check_vma is not None:
+                kw.setdefault("check_rep", check_vma)
+            if axis_names is not None:
+                manual = frozenset(axis_names)
+                kw.setdefault("auto", frozenset(mesh.axis_names) - manual)
+        return raw(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+    return shard_map
+
+
+# ----- mesh axis types ------------------------------------------------------
+
+
+class _FallbackAxisType(enum.Enum):
+    """Stand-in for ``jax.sharding.AxisType`` on lines that predate typed
+    mesh axes. Only ``Auto`` is honorable there: untyped meshes ARE
+    all-auto (GSPMD partitions every axis unless a shard_map takes it
+    manual), so requesting ``Auto`` is a no-op and anything else raises at
+    :func:`make_mesh` time."""
+
+    Auto = "auto"
+    Explicit = "explicit"
+    Manual = "manual"
+
+
+def resolve_axis_type(jax_mod: Any) -> Any:
+    sharding = getattr(jax_mod, "sharding", None)
+    native = getattr(sharding, "AxisType", None) if sharding is not None else None
+    return native if native is not None else _FallbackAxisType
+
+
+def resolve_sharding_types(jax_mod: Any) -> tuple[Any, Any, Any]:
+    """``(Mesh, NamedSharding, PartitionSpec)`` — stable across the
+    supported range, re-exported so call sites have one import home."""
+    sharding = getattr(jax_mod, "sharding", None)
+    out = []
+    for name in ("Mesh", "NamedSharding", "PartitionSpec"):
+        sym = getattr(sharding, name, None) if sharding is not None else None
+        if sym is None:
+            raise JaxCompatError(
+                name, f"jax.sharding.{name} missing", min_version="0.4.26"
+            )
+        out.append(sym)
+    return tuple(out)  # type: ignore[return-value]
+
+
+def build_make_mesh(jax_mod: Any, axis_type: Any) -> Callable:
+    """``make_mesh(axis_shapes, axis_names, *, axis_types=None, devices=None)``.
+
+    Newer JAX forwards ``axis_types`` natively. 0.4.x has no axis type
+    system: ``AxisType.Auto`` is dropped (untyped == all-auto there) and
+    any other requested type raises — silently ignoring ``Explicit`` would
+    change sharding semantics, not just syntax."""
+    native = getattr(jax_mod, "make_mesh", None)
+    native_takes_types = False
+    if native is not None:
+        try:
+            native_takes_types = "axis_types" in inspect.signature(
+                native
+            ).parameters
+        except (TypeError, ValueError):  # pragma: no cover - C impls
+            native_takes_types = False
+
+    def make_mesh(
+        axis_shapes: Sequence[int],
+        axis_names: Sequence[str],
+        *,
+        axis_types: Optional[Sequence[Any]] = None,
+        devices: Optional[Sequence[Any]] = None,
+    ) -> Any:
+        if native is not None and native_takes_types:
+            kw: dict = {"devices": devices}
+            if axis_types is not None:
+                kw["axis_types"] = tuple(axis_types)
+            return native(tuple(axis_shapes), tuple(axis_names), **kw)
+        auto = getattr(axis_type, "Auto", None)
+        if axis_types is not None and any(t is not auto for t in axis_types):
+            raise JaxCompatError(
+                "make_mesh(axis_types=...)",
+                f"installed jax {getattr(jax_mod, '__version__', '?')} has "
+                "untyped mesh axes; only AxisType.Auto can be honored",
+                min_version="0.6.0",
+            )
+        if native is not None:
+            return native(tuple(axis_shapes), tuple(axis_names), devices=devices)
+        # Pre-make_mesh fallback: row-major device grid.
+        import numpy as np
+
+        devs = list(devices if devices is not None else jax_mod.devices())
+        mesh_cls = jax_mod.sharding.Mesh
+        return mesh_cls(
+            np.asarray(devs).reshape(tuple(axis_shapes)), tuple(axis_names)
+        )
+
+    return make_mesh
+
+
+# ----- device-variance marking ---------------------------------------------
+
+
+def resolve_pvary(jax_mod: Any) -> Callable:
+    """``pvary(x, axes)``: mark ``x`` device-varying over ``axes`` under
+    shard_map's varying-axis type system. No-op on lines without one (the
+    experimental shard_map's ``check_rep`` analysis needs no marking)."""
+    lax = getattr(jax_mod, "lax", None)
+    pcast = getattr(lax, "pcast", None) if lax is not None else None
+    if pcast is not None:
+        return lambda x, axes: pcast(x, tuple(axes), to="varying")
+    pv = getattr(lax, "pvary", None) if lax is not None else None
+    if pv is not None:
+        return lambda x, axes: pv(x, tuple(axes))
+    return lambda x, axes: x
+
+
+# ----- axis introspection ---------------------------------------------------
+
+
+def resolve_axis_size(jax_mod: Any) -> Callable:
+    """``axis_size(name)`` inside a shard_map/pmap body. Newer JAX exposes
+    ``lax.axis_size``; on 0.4.x the idiom is ``lax.psum(1, name)``, which
+    evaluates to a concrete Python int at trace time (the operand is a
+    non-tracer constant), so callers can build static permutation lists."""
+    lax = getattr(jax_mod, "lax", None)
+    native = getattr(lax, "axis_size", None) if lax is not None else None
+    if native is not None:
+        return native
+    psum = getattr(lax, "psum", None) if lax is not None else None
+    if psum is None:
+        raise JaxCompatError(
+            "axis_size", "jax.lax.{axis_size,psum} both missing",
+            min_version="0.4.26",
+        )
+    return lambda name: psum(1, name)
+
+
+# ----- pallas TPU compiler params -------------------------------------------
+
+
+def resolve_pallas_compiler_params(pltpu_mod: Any) -> Any:
+    """The pallas-TPU compiler params class: newer pallas renamed
+    ``TPUCompilerParams`` → ``CompilerParams``."""
+    cls = getattr(pltpu_mod, "CompilerParams", None)
+    if cls is None:
+        cls = getattr(pltpu_mod, "TPUCompilerParams", None)
+    if cls is None:
+        raise JaxCompatError(
+            "pallas tpu CompilerParams",
+            "neither CompilerParams nor TPUCompilerParams exists on "
+            "jax.experimental.pallas.tpu",
+            min_version="0.4.26",
+        )
+    return cls
+
+
+def pallas_tpu_compiler_params(**kwargs: Any) -> Any:
+    """Build pallas-TPU compiler params under either name (lazy import:
+    pallas is heavy and only kernel modules need it)."""
+    from jax.experimental import pallas as _pl  # noqa: F401 - registers submodule
+    from jax.experimental.pallas import tpu as _pltpu
+
+    return resolve_pallas_compiler_params(_pltpu)(**kwargs)
+
+
+# ----- RNG partitioning semantics -------------------------------------------
+
+
+def normalize_rng_config(jax_mod: Any) -> bool:
+    """Make sharded-jit RNG match the stable line's semantics.
+
+    0.4.x defaults ``jax_threefry_partitionable=False``, under which
+    ``jax.random.normal`` inside a jit with sharded ``out_shardings``
+    produces DIFFERENT values than the same call run eagerly — so
+    ``init_sharded_params`` would silently initialize a different model
+    than ``init_params``. Newer JAX defaults the flag to True (and later
+    removes it), where sharded == unsharded. Flip it when present-and-off;
+    returns whether a change was made.
+
+    Runs at package import ON PURPOSE (unlike
+    :func:`enable_cpu_multiprocess_collectives`, which is call-site
+    scoped): on 0.4.x the flag also changes the threefry STREAM, so the
+    only safe flip point is before any random draw in the process —
+    flipping lazily at the first sharded init would desync values drawn
+    earlier in the same program. Consequence: every process of a
+    multi-process run must import this package before drawing data
+    (tests/test_distributed_init.py shows the pattern)."""
+    config = getattr(jax_mod, "config", None)
+    if config is None or not hasattr(config, "jax_threefry_partitionable"):
+        return False
+    if config.jax_threefry_partitionable:
+        return False
+    config.update("jax_threefry_partitionable", True)
+    return True
+
+
+# ----- CPU cross-process collectives ----------------------------------------
+
+
+def enable_cpu_multiprocess_collectives(jax_mod: Any) -> bool:
+    """Let multi-process CPU meshes actually communicate.
+
+    Newer JAX ships CPU cross-process collectives on by default; 0.4.x
+    defaults ``jax_cpu_collectives_implementation`` to ``"none"``, so any
+    computation spanning processes dies with "Multiprocess computations
+    aren't implemented on the CPU backend". Flip it to gloo when the option
+    exists and is still unset. Must run BEFORE the CPU client is created —
+    call it on the distributed-init path, not at import. Returns whether a
+    change was made."""
+    config = getattr(jax_mod, "config", None)
+    if config is None:
+        return False
+    # On 0.4.x the option is a flag: update() accepts it but it is NOT
+    # readable as a config attribute, so probe by updating, not hasattr.
+    current = getattr(config, "jax_cpu_collectives_implementation", None)
+    if current not in (None, "none"):
+        return False  # newer line: already defaulted on
+    try:
+        config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - option or gloo build absent
+        return False
+    return True
+
+
+# ----- tree utilities -------------------------------------------------------
+
+
+def resolve_tree_utils(jax_mod: Any) -> dict[str, Callable]:
+    """``jax.tree.map`` and friends (0.4.26+) with a ``jax.tree_util``
+    fallback; ``tree_map_with_path`` lives in ``jax.tree_util`` on every
+    supported line."""
+    tree = getattr(jax_mod, "tree", None)
+    tu = getattr(jax_mod, "tree_util", None)
+    out: dict[str, Callable] = {}
+    for short, tu_name in (
+        ("map", "tree_map"),
+        ("leaves", "tree_leaves"),
+        ("flatten", "tree_flatten"),
+        ("unflatten", "tree_unflatten"),
+    ):
+        fn = getattr(tree, short, None) if tree is not None else None
+        if fn is None:
+            fn = getattr(tu, tu_name, None) if tu is not None else None
+        if fn is None:
+            raise JaxCompatError(
+                f"tree_{short}",
+                f"neither jax.tree.{short} nor jax.tree_util.{tu_name} exists",
+                min_version="0.4.26",
+            )
+        out[f"tree_{short}"] = fn
+    with_path = getattr(tu, "tree_map_with_path", None) if tu is not None else None
+    if with_path is None:
+        raise JaxCompatError(
+            "tree_map_with_path",
+            "jax.tree_util.tree_map_with_path missing",
+            min_version="0.4.26",
+        )
+    out["tree_map_with_path"] = with_path
+    return out
+
+
+# ----- module-level exports (resolved once against the installed jax) -------
+
+import jax as _jax  # noqa: E402
+
+JAX_VERSION: tuple[int, int, int] = parse_version(_jax.__version__)
+
+_raw_shard_map, SHARD_MAP_STYLE = resolve_shard_map(_jax)
+shard_map = build_shard_map(_raw_shard_map, SHARD_MAP_STYLE)
+AxisType = resolve_axis_type(_jax)
+Mesh, NamedSharding, PartitionSpec = resolve_sharding_types(_jax)
+P = PartitionSpec
+make_mesh = build_make_mesh(_jax, AxisType)
+pvary = resolve_pvary(_jax)
+axis_size = resolve_axis_size(_jax)
+normalize_rng_config(_jax)
+
+_tree = resolve_tree_utils(_jax)
+tree_map = _tree["tree_map"]
+tree_leaves = _tree["tree_leaves"]
+tree_flatten = _tree["tree_flatten"]
+tree_unflatten = _tree["tree_unflatten"]
+tree_map_with_path = _tree["tree_map_with_path"]
+
+__all__ = [
+    "JAX_VERSION",
+    "SHARD_MAP_STYLE",
+    "AxisType",
+    "JaxCompatError",
+    "Mesh",
+    "NamedSharding",
+    "P",
+    "PartitionSpec",
+    "axis_size",
+    "build_make_mesh",
+    "build_shard_map",
+    "enable_cpu_multiprocess_collectives",
+    "make_mesh",
+    "normalize_rng_config",
+    "pallas_tpu_compiler_params",
+    "parse_version",
+    "pvary",
+    "resolve_axis_size",
+    "resolve_axis_type",
+    "resolve_pallas_compiler_params",
+    "resolve_pvary",
+    "resolve_shard_map",
+    "resolve_sharding_types",
+    "resolve_tree_utils",
+    "shard_map",
+    "tree_flatten",
+    "tree_leaves",
+    "tree_map",
+    "tree_map_with_path",
+    "tree_unflatten",
+]

@@ -98,6 +98,13 @@ def initialize_from_env(env: Optional[dict] = None,
         return summary
     import jax
 
+    from ..compat.jaxapi import enable_cpu_multiprocess_collectives
+
+    # 0.4.x CPU backends cannot run cross-process computations until the
+    # gloo collectives are selected (newer JAX defaults them on). Must
+    # happen before the backend is instantiated, i.e. right here.
+    enable_cpu_multiprocess_collectives(jax)
+
     jax.distributed.initialize(
         coordinator_address=cfg.coordinator_address,
         num_processes=cfg.num_processes,

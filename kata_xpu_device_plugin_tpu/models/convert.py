@@ -147,7 +147,14 @@ def config_from_hf(hf_config: Any) -> DecoderConfig:
             )
     n_heads = get("num_attention_heads")
     d_model = get("hidden_size")
-    head_dim = get("head_dim") or d_model // n_heads
+    head_dim = get("head_dim")
+    if head_dim is None:
+        # save_pretrained omits fields equal to the class default, and every
+        # Gemma config class defaults head_dim=256 — which does NOT equal
+        # d_model // n_heads for gemma-7b (3072/16=192), gemma2-9b (224) or
+        # gemma3-4b (320). The quotient fallback is only correct for the
+        # llama-family classes, which derive head_dim that way.
+        head_dim = 256 if model_type.startswith("gemma") else d_model // n_heads
     kw = dict(
         vocab_size=get("vocab_size"),
         d_model=d_model,
@@ -325,6 +332,29 @@ def params_from_hf(
         )
 
     L = f"layers.{{i}}."
+    # Validate the projection shapes BEFORE converting anything: a config
+    # whose head_dim was mis-derived (e.g. a gemma config.json re-saved
+    # without its head_dim field) must fail here with the actual-vs-expected
+    # shapes, not as a reshape crash deep inside the first forward. Shapes
+    # are read off the raw tensors (torch or numpy both carry .shape) —
+    # no _t() fp32 copy of a large projection just to look at its shape.
+    for proj, expected, derivation in (
+        ("q_proj", (cfg.q_dim, cfg.d_model),
+         f"n_heads={cfg.n_heads} × head_dim={cfg.head_dim}"),
+        ("k_proj", (cfg.kv_dim, cfg.d_model),
+         f"n_kv_heads={cfg.n_kv_heads} × head_dim={cfg.head_dim}"),
+    ):
+        key = f"{prefix}{L.format(i=0)}self_attn.{proj}.weight"
+        if key not in sd:
+            raise KeyError(f"missing {key!r} in state_dict (family {model_type})")
+        got = tuple(sd[key].shape)  # HF linear layout [out, in]
+        if got != expected:
+            raise ValueError(
+                f"{proj} weight is {got} but the config derives {expected} "
+                f"({derivation}, d_model={cfg.d_model}): the checkpoint and "
+                "config disagree — most often a re-saved config.json "
+                "missing its head_dim field"
+            )
     layers = {
         "attn_norm": stack(lambda i: norm(L.format(i=i) + "input_layernorm.weight")),
         "wq": stack(lambda i: take(L.format(i=i) + "self_attn.q_proj.weight").T),
@@ -498,10 +528,21 @@ def from_hf(
 # ----- the reverse direction: export back to the HF ecosystem --------------
 
 
-def hf_config_dict(cfg: DecoderConfig, model_type: str) -> dict:
+def hf_config_dict(
+    cfg: DecoderConfig,
+    model_type: str,
+    max_position_embeddings: Optional[int] = None,
+) -> dict:
     """Inverse of :func:`config_from_hf`: a plain ``config.json``-style
     dict for ``model_type``. Raises when the config carries features the
-    family cannot express (so an export never silently drops semantics)."""
+    family cannot express (so an export never silently drops semantics).
+
+    ``max_position_embeddings``: trained context length to stamp into the
+    exported config. Without it, unscaled llama/mistral/qwen2 exports
+    inherit the HF CLASS default (LlamaConfig: 2048) and serving stacks
+    that read it as the context limit cap an 8k+ model at 2k. For
+    llama3-rope-scaled exports it overrides the ``factor × original`` span
+    derived below (3.1 checkpoints train further and ship 131072)."""
     if model_type not in _FAMILIES:
         raise ValueError(f"unsupported model_type {model_type!r}")
     if model_type == "gemma3_text":
@@ -574,8 +615,10 @@ def hf_config_dict(cfg: DecoderConfig, model_type: str) -> dict:
         # stacks read it as the context limit) would cap the long-context
         # model the rescale exists to enable. factor×old is the span the
         # rescale guarantees; trained-further checkpoints (3.1 ships
-        # 131072) can override in config.json.
+        # 131072) override via the explicit parameter.
         out["max_position_embeddings"] = int(factor * old_len)
+    if max_position_embeddings is not None:
+        out["max_position_embeddings"] = int(max_position_embeddings)
     if model_type == "gemma2":
         if not cfg.post_norms:
             raise ValueError("gemma2 export requires cfg.post_norms=True")
@@ -620,7 +663,10 @@ def hf_config_dict(cfg: DecoderConfig, model_type: str) -> dict:
 
 
 def to_hf_state_dict(
-    params: Any, cfg: DecoderConfig, model_type: str
+    params: Any,
+    cfg: DecoderConfig,
+    model_type: str,
+    max_position_embeddings: Optional[int] = None,
 ) -> tuple[dict, dict]:
     """Export the stacked-layer pytree to an HF ``state_dict`` (numpy, the
     TREE'S dtype preserved — a bf16 tree exports bf16, half the bytes of a
@@ -635,7 +681,7 @@ def to_hf_state_dict(
     refused with the required preparation named: export operates on the
     plain training layout.
     """
-    hf_cfg = hf_config_dict(cfg, model_type)
+    hf_cfg = hf_config_dict(cfg, model_type, max_position_embeddings)
     norm_has_plus1 = _FAMILIES[model_type][2]
     layers = params["layers"]
     if "wqkv" in layers:
@@ -720,7 +766,11 @@ def to_hf_state_dict(
 
 
 def save_hf_checkpoint(
-    params: Any, cfg: DecoderConfig, model_type: str, path: str
+    params: Any,
+    cfg: DecoderConfig,
+    model_type: str,
+    path: str,
+    max_position_embeddings: Optional[int] = None,
 ) -> None:
     """Write a ``save_pretrained``-layout directory (``config.json`` +
     ``model.safetensors``) that ``transformers.AutoModelForCausalLM.
@@ -731,7 +781,7 @@ def save_hf_checkpoint(
 
     from safetensors.numpy import save_file
 
-    sd, hf_cfg = to_hf_state_dict(params, cfg, model_type)
+    sd, hf_cfg = to_hf_state_dict(params, cfg, model_type, max_position_embeddings)
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(hf_cfg, f, indent=2)

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..compat.jaxapi import tree_map
 from ..ops.quant import (
     QTensor,
     dequantize_kv,
@@ -309,8 +310,21 @@ def rope(x: jax.Array, positions: jax.Array, theta: float,
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
     angles = angles[:, :, None, :]  # [B, S, 1, D/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    # Half-split rotation reassembled with two pads + add, NOT with
+    # split+concat or reshape/stack on the rotated dim: when x comes out of
+    # a tensor-sharded projection, the 0.4.x SPMD partitioner silently
+    # compiles both of those spellings to WRONG values (observed max-abs
+    # errors of ~7-30 on a [B,S,2,16] GQA k — standalone for split+concat,
+    # and once a KV-cache write joins the consumer set for reshape/stack).
+    # Padding each rotated half to full width and adding is numerically
+    # identical (disjoint supports) and partitions correctly on every
+    # supported line in both patterns.
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+    lo = x1 * cos - x2 * sin  # occupies [0, D/2)
+    hi = x2 * cos + x1 * sin  # occupies [D/2, D)
+    widths = [(0, 0)] * (x.ndim - 1)
+    out = jnp.pad(lo, widths + [(0, d // 2)]) + jnp.pad(hi, widths + [(d // 2, 0)])
     return out.astype(x.dtype)
 
 
@@ -698,13 +712,13 @@ def forward(
             return x, aux
         new_caches, auxes = [], []
         for i in range(P):
-            sub_layer = jax.tree.map(lambda a: a[i], group)
+            sub_layer = tree_map(lambda a: a[i], group)
             if cache_group is None:
                 sub_cache = None
             elif cycle_arena:
                 sub_cache = cache_group[i]  # scan already sliced [B, len_i, ...]
             else:
-                sub_cache = jax.tree.map(lambda a: a[i], cache_group)
+                sub_cache = tree_map(lambda a: a[i], cache_group)
             x, nc, a = one_layer(
                 x, sub_layer, sub_cache, cycle[i],
                 theta_cycle[i], linear_cycle[i],
@@ -715,7 +729,7 @@ def forward(
         if kv_caches is not None:
             if cycle_arena:  # per-position lengths differ: keep the tuple
                 return x, (tuple(new_caches), aux)
-            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *new_caches)
+            stacked = tree_map(lambda *xs: jnp.stack(xs), *new_caches)
             return x, (stacked, aux)
         return x, aux
 
@@ -723,12 +737,12 @@ def forward(
         body = jax.checkpoint(body)
 
     def group_leaves(tree):  # [L, ...] → [L//P, P, ...] for the cycle scan
-        return jax.tree.map(
+        return tree_map(
             lambda a: a.reshape((a.shape[0] // P, P) + a.shape[1:]), tree
         )
 
     def ungroup_leaves(tree):
-        return jax.tree.map(
+        return tree_map(
             lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), tree
         )
 
@@ -921,7 +935,7 @@ def ring_caches_from_prefill(caches, pos: jax.Array, window: int):
         mask = valid.reshape((1, 1, window) + (1,) * (g.ndim - 3))
         return jnp.where(mask, g, jnp.zeros_like(g))
 
-    return jax.tree.map(fold, caches)
+    return tree_map(fold, caches)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_len", "margin"))
@@ -939,7 +953,7 @@ def cycle_ring_caches_from_prefill(caches, pos: jax.Array,
     P = len(cycle)
     arena = []
     for i, w in enumerate(cycle):
-        sub = jax.tree.map(lambda a: a[i::P], caches)  # [L/P, B, S, ...]
+        sub = tree_map(lambda a: a[i::P], caches)  # [L/P, B, S, ...]
         if w > 0:
             arena.append(ring_caches_from_prefill(sub, pos, w + margin))
         else:
@@ -949,7 +963,7 @@ def cycle_ring_caches_from_prefill(caches, pos: jax.Array,
                     full, c, (0,) * full.ndim
                 )
 
-            arena.append(jax.tree.map(pad, sub))
+            arena.append(tree_map(pad, sub))
     return tuple(arena)
 
 

@@ -13,9 +13,8 @@ from functools import partial
 
 import jax
 from jax import lax
-from jax import shard_map
-from jax.sharding import Mesh
-from jax.sharding import PartitionSpec as P
+
+from ..compat.jaxapi import Mesh, P, shard_map
 
 
 def pmap_all_reduce(x_per_device: jax.Array) -> jax.Array:

@@ -32,8 +32,13 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+# Pallas has no stable import home yet; these two stay experimental on
+# every supported JAX line (see docs/compat_and_lint.md).
+from jax.experimental import pallas as pl  # lint: allow(JX002) pallas-only API
+from jax.experimental.pallas import tpu as pltpu  # lint: allow(JX002) pallas-only API
+
+from ..compat.jaxapi import pallas_tpu_compiler_params
 
 NEG_INF = -1e30
 
@@ -158,7 +163,7 @@ def pallas_decode_attention(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, 1, H, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -23,8 +23,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+# Pallas has no stable import home yet; these two stay experimental on
+# every supported JAX line (see docs/compat_and_lint.md).
+from jax.experimental import pallas as pl  # lint: allow(JX002) pallas-only API
+from jax.experimental.pallas import tpu as pltpu  # lint: allow(JX002) pallas-only API
+
+from ..compat.jaxapi import pallas_tpu_compiler_params
 
 NEG_INF = -1e30
 # Lane width for per-row side outputs (logsumexp, delta): only column 0 is
@@ -199,7 +204,7 @@ def _fwd_call(q_t, k_t, v_t, causal, block_q, block_k, group, interpret, scale,
             ],
         ),
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -384,7 +389,7 @@ def _bwd_call(q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k,
             scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct(q_t.shape, q_t.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -423,7 +428,7 @@ def _bwd_call(q_t, k_t, v_t, out_t, lse, do_t, causal, block_q, block_k,
             jax.ShapeDtypeStruct((B, H, Sk, D), jnp.float32),
             jax.ShapeDtypeStruct((B, H, Sk, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

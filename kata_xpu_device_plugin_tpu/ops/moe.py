@@ -27,8 +27,8 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding
-from jax.sharding import PartitionSpec as P
+
+from ..compat.jaxapi import Mesh, NamedSharding, P, shard_map
 
 AXIS_EXPERT = "expert"
 
@@ -316,11 +316,6 @@ def moe_ffn_sharded(
     the GSPMD :func:`moe_ffn`). Returns ``(y, aux_loss)`` with the aux term
     computed from GLOBAL routing fractions (psum over the whole mesh).
     """
-    try:  # jax.shard_map is the stable home (v0.8+)
-        from jax import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
-
     expert_axis = expert_axis or expert_axis_for(mesh)
     token_axes = tuple(a for a in mesh.axis_names if a != expert_axis)
     all_axes = token_axes + (expert_axis,)

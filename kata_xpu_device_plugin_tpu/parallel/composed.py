@@ -32,13 +32,17 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
-try:  # jax.shard_map is the stable home (v0.8+); experimental before that
-    from jax import shard_map  # type: ignore[attr-defined]
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
-from jax.sharding import AxisType, Mesh, NamedSharding
-from jax.sharding import PartitionSpec as P
-
+from ..compat.jaxapi import (
+    SHARD_MAP_STYLE,
+    AxisType,
+    Mesh,
+    NamedSharding,
+    P,
+    make_mesh,
+    shard_map,
+    tree_map,
+    tree_map_with_path,
+)
 from ..models import transformer as tfm
 from .mesh import AXIS_FSDP, AXIS_MODEL
 from .pipeline import AXIS_PIPE, _pvary, transformer_stage_fn
@@ -57,7 +61,7 @@ def composed_mesh(
     n = pipe * fsdp * model
     if len(devices) < n:
         raise ValueError(f"need {n} devices, have {len(devices)}")
-    return jax.make_mesh(
+    return make_mesh(
         (pipe, fsdp, model),
         (AXIS_PIPE, AXIS_FSDP, AXIS_MODEL),
         axis_types=(AxisType.Auto,) * 3,
@@ -79,7 +83,7 @@ def to_pp_params(params: Any, n_stages: int) -> Any:
     """[L, ...]-stacked layers → [P, L/P, ...] stage-major (a pure reshape:
     stage s holds contiguous layers [s*L/P, (s+1)*L/P))."""
     out = dict(params)
-    out["layers"] = jax.tree.map(
+    out["layers"] = tree_map(
         lambda a: a.reshape((n_stages, a.shape[0] // n_stages) + a.shape[1:]),
         params["layers"],
     )
@@ -93,7 +97,7 @@ def pp_param_shardings(params_pp: Any, mesh: Mesh) -> Any:
         # paths are on the pp tree; the rule table is keyed by the flat tree.
         return NamedSharding(mesh, pp_param_spec(path))
 
-    return jax.tree.map(spec, _tree_paths(params_pp))
+    return tree_map(spec, _tree_paths(params_pp))
 
 
 def init_pp_params(
@@ -142,12 +146,38 @@ def make_pp_loss(
     total_ticks = num_microbatches + n_stages - 1
     stage_fn = transformer_stage_fn(cfg, attn_fn)
 
-    def per_stage(layers_blk: Any, flat_params: Any, tokens_blk: jax.Array):
+    # Partial-auto (pipe manual, fsdp/model left to GSPMD) is the production
+    # shape, but the 0.4.x SPMD partitioner cannot compile this body's
+    # manual-subgroup program (CHECK failure on IsManualSubgroup). Fallback
+    # there: fully-manual over ALL axes — each (fsdp, model) group member
+    # replicates its stage's compute — with the final psum taken over every
+    # axis and divided by the replica count. Forward value is identical;
+    # gradients stay exact because the P()-input transpose psums cotangents
+    # over all axes, cancelling the 1/replicas normalization.
+    partial_auto = SHARD_MAP_STYLE == "stable"
+    if partial_auto:
+        manual_axes, reduce_axes, replicas = {AXIS_PIPE}, AXIS_PIPE, 1
+    else:
+        reduce_axes = tuple(mesh.axis_names)
+        replicas = 1
+        for a in mesh.axis_names:
+            if a != AXIS_PIPE:
+                replicas *= mesh.shape[a]
+        manual_axes = None
+
+    def per_stage(
+        stage_ids: jax.Array, layers_blk: Any, flat_params: Any,
+        tokens_blk: jax.Array,
+    ):
         # layers_blk [1, L/P, ...] manual over pipe; flat_params (embed,
         # norms, optional unembed) auto-sharded over fsdp/model; tokens_blk
-        # [M/P, mb, S] this stage's microbatch block.
-        stage = lax.axis_index(AXIS_PIPE)
-        own_layers = jax.tree.map(lambda p: p[0], layers_blk)
+        # [M/P, mb, S] this stage's microbatch block. stage_ids is a
+        # pipe-sharded iota: stage_ids[0] == this stage's index. Using it
+        # instead of lax.axis_index keeps the partial-auto body free of the
+        # PartitionId op, which 0.4.x GSPMD cannot re-partition (newer JAX
+        # handles either spelling).
+        stage = stage_ids[0]
+        own_layers = tree_map(lambda p: p[0], layers_blk)
         mb, S = tokens_blk.shape[1], tokens_blk.shape[2]
         d = cfg.d_model
 
@@ -199,7 +229,7 @@ def make_pp_loss(
             state = lax.ppermute(y, AXIS_PIPE, ring)
             return state, outputs
 
-        init = jax.tree.map(
+        init = tree_map(
             lambda z: _pvary(z, AXIS_PIPE),
             (
                 jnp.zeros((mb, S - 1, d), cfg.dtype),
@@ -210,19 +240,22 @@ def make_pp_loss(
 
         # Owner-local unembed + loss over this stage's microbatch block.
         logits = tfm.unembed(flat_params, outputs, cfg)  # [M/P, mb, S-1, V]
-        return lax.psum(tfm.token_nll_sum(logits, tokens_blk[:, :, 1:]), AXIS_PIPE)
+        nll = tfm.token_nll_sum(logits, tokens_blk[:, :, 1:])
+        return lax.psum(nll, reduce_axes) / replicas
 
     mapped = shard_map(
         per_stage,
         mesh=mesh,
-        in_specs=(P(AXIS_PIPE), P(), MICROBATCH_SPEC),
+        in_specs=(P(AXIS_PIPE), P(AXIS_PIPE), P(), MICROBATCH_SPEC),
         out_specs=P(),
-        axis_names={AXIS_PIPE},
+        axis_names=manual_axes,
+        check_vma=None if partial_auto else False,
     )
 
     def loss_fn(params_pp: Any, tokens: jax.Array) -> jax.Array:
         flat = {k: v for k, v in params_pp.items() if k != "layers"}
-        total = mapped(params_pp["layers"], flat, tokens)
+        stage_ids = jnp.arange(n_stages, dtype=jnp.int32)
+        total = mapped(stage_ids, params_pp["layers"], flat, tokens)
         M, mb, S = tokens.shape
         return total / (M * mb * (S - 1))
 
@@ -276,7 +309,7 @@ def _pp_opt_shardings(optimizer, params_pp, mesh):
                 return NamedSharding(mesh, pp_param_spec(cand))
         return replicated
 
-    return jax.tree_util.tree_map_with_path(
+    return tree_map_with_path(
         leaf_sharding, jax.eval_shape(optimizer.init, params_pp)
     )
 

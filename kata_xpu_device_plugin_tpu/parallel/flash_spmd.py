@@ -25,10 +25,8 @@ from functools import lru_cache, partial
 from typing import Optional
 
 import jax
-from jax import shard_map
-from jax.sharding import Mesh
-from jax.sharding import PartitionSpec as P
 
+from ..compat.jaxapi import Mesh, P, shard_map
 from .mesh import AXIS_DATA, AXIS_FSDP
 
 

@@ -65,7 +65,7 @@ def build_mesh(
     if int(np.prod(dims)) != len(devices):
         raise ValueError(f"mesh shape {shape} does not cover {len(devices)} devices")
     try:
-        from jax.experimental import mesh_utils
+        from jax.experimental import mesh_utils  # lint: allow(JX002) no stable home on any supported line
 
         dev_array = mesh_utils.create_device_mesh(dims, devices=devices)
     except Exception:

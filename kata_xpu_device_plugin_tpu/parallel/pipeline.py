@@ -21,13 +21,8 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh
-from jax.sharding import PartitionSpec as P
 
-try:  # jax.shard_map is the stable home (v0.8+); experimental before that
-    from jax import shard_map  # type: ignore[attr-defined]
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+from ..compat.jaxapi import Mesh, P, pvary, shard_map, tree_map
 
 AXIS_PIPE = "pipe"
 
@@ -35,11 +30,7 @@ AXIS_PIPE = "pipe"
 def _pvary(x: jax.Array, axis: str) -> jax.Array:
     """Mark ``x`` as device-varying over ``axis`` (no-op on JAX versions
     whose shard_map has no varying-axis type system)."""
-    pcast = getattr(lax, "pcast", None)
-    if pcast is not None:
-        return pcast(x, (axis,), to="varying")
-    pvary = getattr(lax, "pvary", None)
-    return pvary(x, (axis,)) if pvary is not None else x
+    return pvary(x, (axis,))
 
 
 def pipe_mesh(n_stages: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -52,7 +43,7 @@ def pipe_mesh(n_stages: int, devices: Optional[Sequence[jax.Device]] = None) -> 
 def stack_stage_params(stage_params: Sequence[Any]) -> Any:
     """Stack per-stage parameter pytrees along a new leading axis — the axis
     the pipeline shards over ``pipe``."""
-    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params)
+    return tree_map(lambda *leaves: jnp.stack(leaves), *stage_params)
 
 
 def make_pipeline(
@@ -83,7 +74,7 @@ def make_pipeline(
 
     def per_stage(params_blk: Any, mbs: jax.Array) -> jax.Array:
         stage_idx = lax.axis_index(axis)
-        own_params = jax.tree.map(lambda p: p[0], params_blk)
+        own_params = tree_map(lambda p: p[0], params_blk)
         num_mb = mbs.shape[0]
 
         def tick(t, carry):
@@ -110,7 +101,7 @@ def make_pipeline(
         # The loop carry is device-varying (each stage holds different
         # activations); the zero init must be marked varying over the pipe
         # axis or the carry types disagree under shard_map's type system.
-        init = jax.tree.map(
+        init = tree_map(
             lambda z: _pvary(z, axis), (jnp.zeros_like(mbs[0]), jnp.zeros_like(mbs))
         )
         _, outputs = lax.fori_loop(0, num_mb + num_stages - 1, tick, init)
@@ -193,7 +184,7 @@ def make_transformer_pipeline(
         x = tfm.embed(params, tokens_mb, cfg)  # [M, mb, S, D]
         # Stacked layers [L, ...] → [n_stages, L/n_stages, ...]: leading axis
         # shards over ``pipe``, the second is each stage's local scan.
-        stage_layers = jax.tree.map(
+        stage_layers = tree_map(
             lambda a: a.reshape((n_stages, layers_per_stage) + a.shape[1:]),
             params["layers"],
         )

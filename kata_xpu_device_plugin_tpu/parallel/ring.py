@@ -25,10 +25,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
-from jax.sharding import Mesh
-from jax.sharding import PartitionSpec as P
 
+from ..compat.jaxapi import Mesh, P, axis_size, shard_map
 from ..ops.attention import _expand_kv
 from .mesh import AXIS_SEQ
 
@@ -63,7 +61,7 @@ def _local_ring_attention(
     ``min(n−1, (S + window − 2)//S)`` hops — both the kernel launches and
     the ppermute ICI traffic beyond the band are never emitted.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, S, H, D = q.shape
     if not use_flash:

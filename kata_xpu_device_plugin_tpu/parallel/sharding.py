@@ -14,9 +14,14 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding
-from jax.sharding import PartitionSpec as P
 
+from ..compat.jaxapi import (
+    Mesh,
+    NamedSharding,
+    P,
+    tree_map,
+    tree_map_with_path,
+)
 from ..models import transformer as tfm
 from .mesh import AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_SEQ
 
@@ -139,7 +144,7 @@ def param_specs(params: Any) -> Any:
 
 
 def param_shardings(params: Any, mesh: Mesh) -> Any:
-    return jax.tree.map(
+    return tree_map(
         lambda spec: NamedSharding(mesh, spec), param_specs(params)
     )
 
@@ -304,13 +309,13 @@ def make_train_step(
             def micro(carry, mb):
                 g_sum, l_sum = carry
                 l, g = jax.value_and_grad(loss_fn)(state["params"], mb)
-                return (jax.tree.map(jnp.add, g_sum, g), l_sum + l), None
+                return (tree_map(jnp.add, g_sum, g), l_sum + l), None
 
-            zeros = jax.tree.map(jnp.zeros_like, state["params"])
+            zeros = tree_map(jnp.zeros_like, state["params"])
             (g_sum, l_sum), _ = jax.lax.scan(
                 micro, (zeros, jnp.float32(0.0)), micros
             )
-            grads = jax.tree.map(lambda g: g / accum_steps, g_sum)
+            grads = tree_map(lambda g: g / accum_steps, g_sum)
             loss = l_sum / accum_steps
         updates, new_opt = optimizer.update(grads, state["opt"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
@@ -336,7 +341,7 @@ def _opt_shardings(optimizer, params, mesh):
                 return NamedSharding(mesh, PARAM_RULES[cand])
         return replicated
 
-    return jax.tree_util.tree_map_with_path(
+    return tree_map_with_path(
         leaf_sharding, jax.eval_shape(optimizer.init, params)
     )
 

@@ -30,13 +30,7 @@ from typing import Callable, Optional
 import jax
 from jax import lax
 
-try:  # jax.shard_map is the stable home (v0.8+)
-    from jax import shard_map  # type: ignore[attr-defined]
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
-from jax.sharding import Mesh
-from jax.sharding import PartitionSpec as P
-
+from ..compat.jaxapi import Mesh, P, shard_map
 from .mesh import AXIS_SEQ
 
 

@@ -1,0 +1,329 @@
+"""Unit tests for the JAX-version compat shim.
+
+The resolvers in ``compat.jaxapi`` each take the ``jax`` module as a
+parameter, so both sides of every version gate are driven here with FAKE
+module surfaces — an "old" 0.4.x-shaped jax (``experimental.shard_map``,
+no ``AxisType``, ``check_rep``/``auto`` spellings) and a "new" stable-line
+jax (``jax.shard_map``, typed mesh axes) — regardless of which JAX is
+actually installed. The installed-jax integration (the module-level
+exports) is covered at the end.
+"""
+from types import SimpleNamespace
+
+import pytest
+
+from kata_xpu_device_plugin_tpu.compat import jaxapi
+
+
+# ----- fake surfaces ---------------------------------------------------------
+
+
+def _record(**defaults):
+    """A callable that records how it was called and returns its kwargs."""
+    calls = []
+
+    def fn(*args, **kwargs):
+        calls.append((args, kwargs))
+        return SimpleNamespace(args=args, kwargs={**defaults, **kwargs})
+
+    fn.calls = calls
+    return fn
+
+
+class _FakeMesh:
+    axis_names = ("pipe", "fsdp", "model")
+
+
+def make_old_jax():
+    """0.4.x shape: shard_map lives in jax.experimental.shard_map with
+    check_rep/auto; jax.sharding has no AxisType; make_mesh takes no
+    axis_types; lax has neither pvary nor axis_size (psum idiom)."""
+    raw_shard_map = _record()
+    make_mesh = _record()
+    # mirror the real 0.4.x signature (no axis_types parameter)
+    make_mesh.__signature__ = None
+
+    def old_make_mesh(axis_shapes, axis_names, *, devices=None):
+        return SimpleNamespace(
+            axis_shapes=axis_shapes, axis_names=axis_names, devices=devices
+        )
+
+    psum_calls = []
+
+    def psum(x, name):
+        psum_calls.append((x, name))
+        return 8  # concrete trace-time value, as on the real 0.4.x line
+
+    return SimpleNamespace(
+        __version__="0.4.37",
+        __name__="fake_old_jax",
+        experimental=SimpleNamespace(
+            shard_map=SimpleNamespace(shard_map=raw_shard_map)
+        ),
+        sharding=SimpleNamespace(
+            Mesh=_FakeMesh, NamedSharding=object, PartitionSpec=tuple
+        ),
+        make_mesh=old_make_mesh,
+        lax=SimpleNamespace(psum=psum),
+        tree=SimpleNamespace(map=min, leaves=max, flatten=sum, unflatten=any),
+        tree_util=SimpleNamespace(tree_map_with_path=all),
+        config=SimpleNamespace(jax_threefry_partitionable=False,
+                               update=_record()),
+        _raw_shard_map=raw_shard_map,
+        _psum_calls=psum_calls,
+    )
+
+
+def make_new_jax():
+    """Stable-line shape: jax.shard_map with check_vma/axis_names; typed
+    mesh axes; lax.pvary; make_mesh takes axis_types."""
+    stable_shard_map = _record()
+
+    class AxisType:
+        Auto = "auto"
+        Explicit = "explicit"
+
+    def new_make_mesh(axis_shapes, axis_names, *, axis_types=None, devices=None):
+        return SimpleNamespace(
+            axis_shapes=axis_shapes, axis_names=axis_names,
+            axis_types=axis_types, devices=devices,
+        )
+
+    pvary_calls = []
+
+    def pvary(x, axes):
+        pvary_calls.append((x, axes))
+        return x
+
+    return SimpleNamespace(
+        __version__="0.8.0",
+        __name__="fake_new_jax",
+        shard_map=stable_shard_map,
+        sharding=SimpleNamespace(
+            Mesh=_FakeMesh, NamedSharding=object, PartitionSpec=tuple,
+            AxisType=AxisType,
+        ),
+        make_mesh=new_make_mesh,
+        lax=SimpleNamespace(pvary=pvary, axis_size=lambda name: 4),
+        tree=SimpleNamespace(map=min, leaves=max, flatten=sum, unflatten=any),
+        tree_util=SimpleNamespace(tree_map_with_path=all),
+        _pvary_calls=pvary_calls,
+    )
+
+
+# ----- shard_map -------------------------------------------------------------
+
+
+def test_shard_map_resolves_stable_on_new():
+    new = make_new_jax()
+    fn, style = jaxapi.resolve_shard_map(new)
+    assert style == "stable" and fn is new.shard_map
+
+
+def test_shard_map_resolves_experimental_on_old():
+    old = make_old_jax()
+    fn, style = jaxapi.resolve_shard_map(old)
+    assert style == "experimental" and fn is old._raw_shard_map
+
+
+def test_shard_map_missing_raises_with_version_hint():
+    bare = SimpleNamespace(__version__="0.4.1", __name__="fake_bare",
+                           experimental=SimpleNamespace())
+    with pytest.raises(jaxapi.JaxCompatError) as err:
+        jaxapi.resolve_shard_map(bare)
+    assert "shard_map" in str(err.value)
+    assert "0.4.26" in str(err.value)  # names the minimum version
+
+
+def test_shard_map_wrapper_translates_kwargs_on_old():
+    """check_vma → check_rep, axis_names (manual set) → auto (complement)."""
+    old = make_old_jax()
+    raw, style = jaxapi.resolve_shard_map(old)
+    sm = jaxapi.build_shard_map(raw, style)
+    mesh = _FakeMesh()
+    body = lambda x: x  # noqa: E731
+
+    sm(body, mesh=mesh, in_specs=(), out_specs=(), check_vma=False)
+    _, kwargs = raw.calls[-1]
+    assert kwargs["check_rep"] is False and "check_vma" not in kwargs
+
+    sm(body, mesh=mesh, in_specs=(), out_specs=(), axis_names={"pipe"})
+    _, kwargs = raw.calls[-1]
+    assert kwargs["auto"] == frozenset({"fsdp", "model"})
+    assert "axis_names" not in kwargs
+
+
+def test_shard_map_wrapper_native_kwargs_on_new():
+    """Stable line: kwargs forward under their native names, and None means
+    'use the version default' — the raw fn must NOT receive check_vma=None
+    (its own default is True; a literal None would silently disable it)."""
+    new = make_new_jax()
+    raw, style = jaxapi.resolve_shard_map(new)
+    sm = jaxapi.build_shard_map(raw, style)
+    body = lambda x: x  # noqa: E731
+
+    sm(body, mesh=_FakeMesh(), in_specs=(), out_specs=())
+    _, kwargs = raw.calls[-1]
+    assert "check_vma" not in kwargs and "axis_names" not in kwargs
+
+    sm(body, mesh=_FakeMesh(), in_specs=(), out_specs=(),
+       check_vma=False, axis_names={"pipe"})
+    _, kwargs = raw.calls[-1]
+    assert kwargs["check_vma"] is False
+    assert kwargs["axis_names"] == {"pipe"}
+    assert "check_rep" not in kwargs and "auto" not in kwargs
+
+
+# ----- AxisType / make_mesh --------------------------------------------------
+
+
+def test_axis_type_native_on_new_fallback_on_old():
+    new, old = make_new_jax(), make_old_jax()
+    assert jaxapi.resolve_axis_type(new) is new.sharding.AxisType
+    fallback = jaxapi.resolve_axis_type(old)
+    assert fallback is jaxapi._FallbackAxisType
+    assert {t.name for t in fallback} >= {"Auto", "Explicit", "Manual"}
+
+
+def test_make_mesh_forwards_axis_types_on_new():
+    new = make_new_jax()
+    at = jaxapi.resolve_axis_type(new)
+    mm = jaxapi.build_make_mesh(new, at)
+    mesh = mm((2, 2), ("a", "b"), axis_types=(at.Auto, at.Auto), devices=[1, 2, 3, 4])
+    assert mesh.axis_types == (at.Auto, at.Auto)
+
+
+def test_make_mesh_drops_auto_rejects_explicit_on_old():
+    old = make_old_jax()
+    at = jaxapi.resolve_axis_type(old)
+    mm = jaxapi.build_make_mesh(old, at)
+    # Auto is the 0.4.x default semantics — silently dropped.
+    mesh = mm((2, 2), ("a", "b"), axis_types=(at.Auto, at.Auto), devices=[1, 2, 3, 4])
+    assert mesh.axis_names == ("a", "b")
+    # Anything else cannot be honored on untyped meshes — loud failure.
+    with pytest.raises(jaxapi.JaxCompatError, match="AxisType.Auto"):
+        mm((2, 2), ("a", "b"), axis_types=(at.Explicit, at.Auto))
+
+
+# ----- pvary / axis_size -----------------------------------------------------
+
+
+def test_pvary_native_on_new_noop_on_old():
+    new, old = make_new_jax(), make_old_jax()
+    pv_new = jaxapi.resolve_pvary(new)
+    sentinel = object()
+    assert pv_new(sentinel, ("pipe",)) is sentinel
+    assert new._pvary_calls == [(sentinel, ("pipe",))]
+    pv_old = jaxapi.resolve_pvary(old)
+    assert pv_old(sentinel, ("pipe",)) is sentinel  # no-op, no error
+
+
+def test_axis_size_native_on_new_psum_idiom_on_old():
+    new, old = make_new_jax(), make_old_jax()
+    assert jaxapi.resolve_axis_size(new)("i") == 4
+    assert jaxapi.resolve_axis_size(old)("i") == 8
+    assert old._psum_calls == [(1, "i")]
+
+
+# ----- sharding types / tree utils ------------------------------------------
+
+
+def test_sharding_types_resolve_and_missing_raises():
+    old = make_old_jax()
+    mesh_cls, named, pspec = jaxapi.resolve_sharding_types(old)
+    assert mesh_cls is _FakeMesh and pspec is tuple
+    with pytest.raises(jaxapi.JaxCompatError, match="Mesh"):
+        jaxapi.resolve_sharding_types(
+            SimpleNamespace(sharding=SimpleNamespace())
+        )
+
+
+def test_tree_utils_prefer_jax_tree_then_tree_util():
+    old = make_old_jax()
+    utils = jaxapi.resolve_tree_utils(old)
+    assert utils["tree_map"] is min and utils["tree_map_with_path"] is all
+    # jax.tree absent → the tree_util spellings back it up
+    tu_only = SimpleNamespace(
+        tree_util=SimpleNamespace(
+            tree_map=min, tree_leaves=max, tree_flatten=sum,
+            tree_unflatten=any, tree_map_with_path=all,
+        )
+    )
+    utils = jaxapi.resolve_tree_utils(tu_only)
+    assert utils["tree_flatten"] is sum
+    with pytest.raises(jaxapi.JaxCompatError, match="tree_map"):
+        jaxapi.resolve_tree_utils(SimpleNamespace())
+
+
+# ----- config normalizers ----------------------------------------------------
+
+
+def test_normalize_rng_config_flips_only_when_off():
+    old = make_old_jax()
+    assert jaxapi.normalize_rng_config(old) is True
+    assert old.config.update.calls[-1][0] == ("jax_threefry_partitionable", True)
+    on = SimpleNamespace(
+        config=SimpleNamespace(jax_threefry_partitionable=True, update=_record())
+    )
+    assert jaxapi.normalize_rng_config(on) is False
+    assert on.config.update.calls == []
+    # newer lines that removed the flag entirely: nothing to do
+    assert jaxapi.normalize_rng_config(SimpleNamespace(config=SimpleNamespace())) is False
+
+
+def test_parse_version():
+    assert jaxapi.parse_version("0.4.37") == (0, 4, 37)
+    assert jaxapi.parse_version("0.5.0.dev20250101") == (0, 5, 0)
+    assert jaxapi.parse_version("0.8") == (0, 8, 0)
+
+
+# ----- pallas compiler params ------------------------------------------------
+
+
+def test_pallas_compiler_params_prefers_new_name():
+    new_mod = SimpleNamespace(CompilerParams=dict, TPUCompilerParams=list)
+    assert jaxapi.resolve_pallas_compiler_params(new_mod) is dict
+    old_mod = SimpleNamespace(TPUCompilerParams=list)
+    assert jaxapi.resolve_pallas_compiler_params(old_mod) is list
+    with pytest.raises(jaxapi.JaxCompatError, match="CompilerParams"):
+        jaxapi.resolve_pallas_compiler_params(SimpleNamespace())
+
+
+# ----- installed-jax integration --------------------------------------------
+
+
+def test_module_exports_resolve_against_installed_jax():
+    """Whatever JAX the image ships, every export must have resolved."""
+    import jax
+
+    assert jaxapi.JAX_VERSION == jaxapi.parse_version(jax.__version__)
+    assert jaxapi.SHARD_MAP_STYLE in ("stable", "experimental")
+    assert callable(jaxapi.shard_map)
+    assert callable(jaxapi.make_mesh)
+    assert callable(jaxapi.tree_map)
+    assert jaxapi.Mesh is jax.sharding.Mesh
+    assert jaxapi.P is jax.sharding.PartitionSpec
+    # the RNG normalization must have left sharded-init == eager-init
+    assert jax.config.jax_threefry_partitionable is True
+
+
+def test_installed_shard_map_runs_a_psum():
+    """End-to-end: the wrapped shard_map actually executes on the installed
+    line, including the check_vma spelling."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices()
+    mesh = jaxapi.Mesh(np.array(devs[: min(4, len(devs))]), ("i",))
+    n = len(mesh.devices)
+
+    out = jaxapi.shard_map(
+        lambda x: jax.lax.psum(x, "i"),
+        mesh=mesh,
+        in_specs=jaxapi.P("i"),
+        out_specs=jaxapi.P(),
+        check_vma=False,
+    )(jnp.arange(float(n)))
+    # each device contributes its single-element shard; psum replicates [sum]
+    assert float(out[0]) == sum(range(n))

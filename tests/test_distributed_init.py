@@ -17,6 +17,13 @@ import sys
 
 import pytest
 
+# The spawned workers import the package, whose compat layer normalizes
+# jax's RNG-partitioning config (jax_threefry_partitionable) — which on
+# 0.4.x CHANGES the threefry stream. Import it here too so the parent's
+# closed-form references are computed from the same stream the workers
+# drew their data from.
+from kata_xpu_device_plugin_tpu.compat import jaxapi as _jaxapi  # noqa: F401
+
 _CHILD = """
 import json, os
 import jax

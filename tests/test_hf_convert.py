@@ -557,6 +557,74 @@ def test_unsupported_conventions_fail_closed():
                         "hidden_activation": "gelu"})
 
 
+def test_gemma_head_dim_defaults_to_class_default():
+    """save_pretrained omits head_dim when it equals the Gemma class
+    default 256 — and d_model // n_heads is NOT 256 for the released
+    gemma-7b/gemma2-9b/gemma3-4b geometries, so the quotient fallback
+    mis-derives every projection shape. Absent head_dim on a gemma family
+    must mean 256; the llama families keep the quotient derivation."""
+    gemma7b_ish = dict(
+        model_type="gemma", vocab_size=256, hidden_size=3072,
+        intermediate_size=512, num_hidden_layers=2,
+        num_attention_heads=16, num_key_value_heads=16,
+    )
+    assert config_from_hf(gemma7b_ish).head_dim == 256  # not 3072//16=192
+    llama = dict(_DICT_BASE)
+    llama.pop("head_dim")
+    assert config_from_hf(llama).head_dim == 64 // 4
+
+
+def test_mismatched_q_proj_shape_fails_at_convert_time():
+    """A config whose head_dim disagrees with the checkpoint weights must
+    raise a descriptive convert-time error, not a reshape crash at first
+    forward."""
+    from kata_xpu_device_plugin_tpu.models.convert import params_from_hf
+
+    cfg = config_from_hf(_DICT_BASE)
+    # state_dict built for head_dim=8 (q_dim 32) vs the config's 16 (64).
+    wrong = {}
+    for i in range(cfg.n_layers):
+        L = f"model.layers.{i}."
+        wrong[L + "self_attn.q_proj.weight"] = np.zeros((32, 64), np.float32)
+        wrong[L + "self_attn.k_proj.weight"] = np.zeros((16, 64), np.float32)
+    wrong["model.embed_tokens.weight"] = np.zeros((128, 64), np.float32)
+    with pytest.raises(ValueError, match="q_proj weight is .* head_dim"):
+        params_from_hf(wrong, cfg, "llama")
+
+
+def test_export_stamps_max_position_embeddings():
+    """Unscaled llama/mistral/qwen2 exports accept an explicit trained
+    context length; without it the key is absent (HF class default 2048
+    would cap serving) and the llama3-scaled derivation still applies."""
+    from kata_xpu_device_plugin_tpu.models.transformer import (
+        init_params,
+        tiny_test_config,
+    )
+
+    cfg = tiny_test_config(
+        activation="swiglu", scale_embeddings=False, tie_embeddings=False
+    )
+    out = hf_config_dict(cfg, "llama", max_position_embeddings=8192)
+    assert out["max_position_embeddings"] == 8192
+    assert "max_position_embeddings" not in hf_config_dict(cfg, "llama")
+
+    # threads through the state-dict export entry point too
+    import jax
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    _, hf_cfg = to_hf_state_dict(
+        params, cfg, "llama", max_position_embeddings=4096
+    )
+    assert hf_cfg["max_position_embeddings"] == 4096
+
+    # explicit value overrides the llama3-scaled factor×original derivation
+    scaled = replace(cfg, rope_llama3_scaling=(8.0, 1.0, 4.0, 8192.0))
+    derived = hf_config_dict(scaled, "llama")
+    assert derived["max_position_embeddings"] == 8 * 8192
+    overridden = hf_config_dict(scaled, "llama", max_position_embeddings=131072)
+    assert overridden["max_position_embeddings"] == 131072
+
+
 def test_dict_config_uses_family_tie_default():
     """save_pretrained omits fields equal to the class default, so a raw
     gemma config.json usually has NO tie_word_embeddings key — the family

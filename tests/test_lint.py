@@ -1,0 +1,244 @@
+"""Unit tests for ``tools.lint``: each rule has a positive fixture (must
+fire) and a negative fixture (must stay quiet), plus pragma/scoping/CLI
+behavior. Fixtures are linted via ``check_source`` under the repo-relative
+path that puts them in the rule's scope."""
+import subprocess
+import sys
+
+import pytest
+
+from tools.lint import check_source
+from tools.lint.cli import run
+
+OPS_PATH = "kata_xpu_device_plugin_tpu/ops/example.py"
+COMPAT_PATH = "kata_xpu_device_plugin_tpu/compat/jaxapi.py"
+TEST_PATH = "tests/test_example.py"
+BENCH_PATH = "bench.py"
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ----- JX001: drifted symbol import -----------------------------------------
+
+
+def test_jx001_fires_on_drifted_import():
+    findings = check_source("from jax import shard_map\n", OPS_PATH)
+    assert rules_of(findings) == ["JX001"]
+
+
+def test_jx001_fires_on_drifted_sharding_import():
+    findings = check_source(
+        "from jax.sharding import AxisType\n", "kata_xpu_device_plugin_tpu/parallel/x.py"
+    )
+    assert rules_of(findings) == ["JX001"]
+
+
+def test_jx001_fires_on_attribute_use():
+    findings = check_source(
+        "import jax\nn = jax.lax.axis_size('i')\n", OPS_PATH
+    )
+    assert "JX001" in rules_of(findings)
+
+
+def test_jx001_quiet_on_compat_import():
+    src = "from ..compat.jaxapi import shard_map\nfrom jax.sharding import Mesh\n"
+    assert check_source(src, OPS_PATH) == []
+
+
+def test_jx001_quiet_inside_compat():
+    # compat/ is the one place allowed to touch the drifted surface.
+    src = "from jax.experimental.shard_map import shard_map\n"
+    assert check_source(src, COMPAT_PATH) == []
+
+
+# ----- JX002: jax.experimental outside compat -------------------------------
+
+
+def test_jx002_fires_on_experimental_import():
+    findings = check_source(
+        "from jax.experimental import mesh_utils\n", OPS_PATH
+    )
+    assert rules_of(findings) == ["JX002"]
+
+
+def test_jx002_respects_pragma():
+    src = (
+        "from jax.experimental import pallas as pl"
+        "  # lint: allow(JX002) pallas-only API\n"
+    )
+    assert check_source(src, OPS_PATH) == []
+
+
+# ----- JX003: float64 in TPU-path code --------------------------------------
+
+
+def test_jx003_fires_on_float64_dtype():
+    findings = check_source(
+        "import jax.numpy as jnp\nx = jnp.zeros((4,), jnp.float64)\n", OPS_PATH
+    )
+    assert rules_of(findings) == ["JX003"]
+
+
+def test_jx003_fires_on_float64_string():
+    findings = check_source(
+        "def f(a):\n    return a.astype('float64')\n", OPS_PATH
+    )
+    assert rules_of(findings) == ["JX003"]
+
+
+def test_jx003_quiet_on_float32_and_out_of_scope():
+    ok = "import jax.numpy as jnp\nx = jnp.zeros((4,), jnp.float32)\n"
+    assert check_source(ok, OPS_PATH) == []
+    # float64 in host-side plugin code is not TPU-path — out of scope.
+    host = "import numpy as np\nx = np.float64(3)\n"
+    assert check_source(host, "kata_xpu_device_plugin_tpu/plugin/manager.py") == []
+
+
+# ----- JX004: unfenced timing loops -----------------------------------------
+
+_TIMED_UNFENCED = """
+import time
+
+def run(f, x):
+    t0 = time.perf_counter()
+    y = f(x)
+    return time.perf_counter() - t0, y
+"""
+
+_TIMED_FENCED = """
+import time
+import jax
+
+def run(f, x):
+    t0 = time.perf_counter()
+    y = jax.block_until_ready(f(x))
+    return time.perf_counter() - t0, y
+"""
+
+_TIMED_TRANSFER_FENCED = """
+import time
+import numpy as np
+
+def run(f, x):
+    t0 = time.perf_counter()
+    y = np.asarray(f(x))
+    return time.perf_counter() - t0, y
+"""
+
+
+def test_jx004_fires_on_unfenced_timing():
+    findings = check_source(_TIMED_UNFENCED, BENCH_PATH)
+    assert rules_of(findings) == ["JX004"]
+
+
+def test_jx004_quiet_when_fenced():
+    assert check_source(_TIMED_FENCED, BENCH_PATH) == []
+    # A device→host transfer of the result is an equally hard fence.
+    assert check_source(_TIMED_TRANSFER_FENCED, BENCH_PATH) == []
+
+
+_TIMED_NESTED = """
+import time
+import jax
+
+def outer(f, x):
+    # two unfenced timers HERE; the fence lives only in a nested callback
+    # that may never run inline — it must not excuse the outer loop.
+    def cb(y):
+        return jax.block_until_ready(y)
+    t0 = time.perf_counter()
+    y = f(x, cb)
+    return time.perf_counter() - t0, y
+
+def helper(f, x):
+    # no timers of its own: only the nested def times, and it fences.
+    def timed(z):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(f(z))
+        return time.perf_counter() - t0, out
+    return timed(x)
+"""
+
+
+def test_jx004_nested_defs_scored_separately():
+    findings = check_source(_TIMED_NESTED, BENCH_PATH)
+    # 'outer' fires (its fence is inside a nested callback); 'cb', 'timed'
+    # and 'helper' are each clean on their own.
+    assert [(f.rule, f.line) for f in findings] == [("JX004", 5)]
+
+
+def test_jx004_out_of_scope_outside_bench():
+    # Timing in ordinary library code is not the bench rule's business.
+    assert check_source(_TIMED_UNFENCED, "kata_xpu_device_plugin_tpu/utils/log.py") == []
+
+
+# ----- TS001: non-hermetic tests --------------------------------------------
+
+
+def test_ts001_fires_on_dev_probe():
+    findings = check_source(
+        "import os\nok = os.path.exists('/dev/accel0')\n", TEST_PATH
+    )
+    assert rules_of(findings) == ["TS001"]
+
+
+def test_ts001_fires_on_network_call():
+    findings = check_source(
+        "import urllib.request\nurllib.request.urlopen('http://x')\n", TEST_PATH
+    )
+    assert rules_of(findings) == ["TS001"]
+
+
+def test_ts001_quiet_on_fake_roots_and_literals():
+    # Asserting on a /dev/... *string* (e.g. a CDI spec's declared path) is
+    # fine — only filesystem probes against the real tree are flagged.
+    src = (
+        "def test_x(tmp_path):\n"
+        "    p = tmp_path / 'accel0'\n"
+        "    assert str(p).endswith('accel0')\n"
+        "    expected = '/dev/accel0'\n"
+        "    assert expected == '/dev/accel0'\n"
+    )
+    assert check_source(src, TEST_PATH) == []
+
+
+# ----- plumbing --------------------------------------------------------------
+
+
+def test_syntax_error_reported_not_raised():
+    findings = check_source("def broken(:\n", OPS_PATH)
+    assert rules_of(findings) == ["E999"]
+
+
+def test_rule_filter():
+    src = "from jax import shard_map\nfrom jax.experimental import pallas\n"
+    only_jx002 = check_source(src, OPS_PATH, rules=["JX002"])
+    assert rules_of(only_jx002) == ["JX002"]
+
+
+def test_repo_is_lint_clean():
+    """The acceptance bar: the linter exits clean on this repo."""
+    assert run(root=None) == []
+
+
+def test_cli_red_on_seed_bug(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax import shard_map\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", str(bad), "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert proc.returncode == 1
+    assert "JX001" in proc.stdout
+
+
+def test_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--list-rules"],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert proc.returncode == 0
+    for rule in ("JX001", "JX002", "JX003", "JX004", "TS001"):
+        assert rule in proc.stdout

@@ -1,0 +1,1 @@
+"""Repo tooling (not shipped in the wheel): static analysis, CI helpers."""

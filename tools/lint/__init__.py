@@ -1,0 +1,24 @@
+"""Repo-specific static analysis: the JAX-drift and test-hermeticity rules
+that turn this repo's known failure classes into PR-time lint errors.
+
+Run as ``python -m tools.lint [paths...]`` (default: the whole repo).
+Rule catalogue and rationale: ``docs/compat_and_lint.md``.
+"""
+from .rules import (
+    ALL_RULES,
+    DRIFTED_JAX_SYMBOLS,
+    Finding,
+    check_file,
+    check_source,
+)
+from .cli import main, run
+
+__all__ = [
+    "ALL_RULES",
+    "DRIFTED_JAX_SYMBOLS",
+    "Finding",
+    "check_file",
+    "check_source",
+    "main",
+    "run",
+]

@@ -1,0 +1,111 @@
+"""``python -m tools.lint`` — run the repo's static-analysis rules.
+
+Exit status: 0 clean, 1 findings, 2 usage error. Findings print as
+``path:line: RULE message`` (editor/CI friendly).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Iterable, Optional
+
+from .rules import ALL_RULES, Finding, check_file
+
+# Directories never worth linting (generated protobufs change names on
+# regeneration; caches are not source).
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}
+_SKIP_SUFFIXES = ("_pb2.py", "_pb2_grpc.py")
+
+# Default lint surface, repo-root relative.
+DEFAULT_TARGETS = (
+    "kata_xpu_device_plugin_tpu",
+    "tools",
+    "tests",
+    "scripts",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+
+def _iter_py_files(target: str) -> Iterable[str]:
+    if os.path.isfile(target):
+        if target.endswith(".py"):
+            yield target
+        return
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+        for name in sorted(filenames):
+            if name.endswith(".py") and not name.endswith(_SKIP_SUFFIXES):
+                yield os.path.join(dirpath, name)
+
+
+def run(
+    targets: Optional[Iterable[str]] = None,
+    root: Optional[str] = None,
+    rules: Optional[Iterable[str]] = None,
+) -> list[Finding]:
+    """Lint ``targets`` (files or directories, resolved under ``root``)."""
+    root = root or os.getcwd()
+    chosen = list(targets) if targets else [
+        t for t in DEFAULT_TARGETS if os.path.exists(os.path.join(root, t))
+    ]
+    findings: list[Finding] = []
+    for target in chosen:
+        abs_target = target if os.path.isabs(target) else os.path.join(root, target)
+        if not os.path.exists(abs_target):
+            raise FileNotFoundError(f"lint target {target!r} does not exist")
+        for path in _iter_py_files(abs_target):
+            rel = os.path.relpath(path, root)
+            findings.extend(check_file(path, rel, rules))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="Repo static analysis: JAX drift + hermeticity rules.",
+    )
+    parser.add_argument(
+        "targets", nargs="*",
+        help="files/directories to lint (default: the repo surface)",
+    )
+    parser.add_argument(
+        "--rule", action="append", dest="rules", metavar="ID",
+        help="restrict to one or more rule ids (repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue"
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root paths are reported relative to (default: cwd)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, summary in sorted(ALL_RULES.items()):
+            print(f"{rule}  {summary}")
+        return 0
+
+    if args.rules:
+        unknown = set(args.rules) - set(ALL_RULES)
+        if unknown:
+            print(f"unknown rule ids: {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    try:
+        findings = run(args.targets or None, args.root, args.rules)
+    except FileNotFoundError as err:
+        print(str(err), file=sys.stderr)
+        return 2
+
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(
+            f"\n{len(findings)} finding(s). Rule docs: docs/compat_and_lint.md",
+            file=sys.stderr,
+        )
+        return 1
+    return 0

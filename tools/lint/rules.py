@@ -1,0 +1,326 @@
+"""AST rules for the repo linter.
+
+Each rule encodes a failure class this repo has actually hit (or is one
+change away from hitting):
+
+- **JX001** — direct import/use of a *drifted* JAX symbol outside
+  ``compat/``. ``from jax import shard_map`` is exactly the seed bug that
+  left 19 test files uncollectable on jax 0.4.x; the symbols in
+  :data:`DRIFTED_JAX_SYMBOLS` must come from
+  ``kata_xpu_device_plugin_tpu.compat.jaxapi``.
+- **JX002** — ``jax.experimental.*`` import outside ``compat/``.
+  Experimental APIs move between releases; each use needs either a shim in
+  compat or an explicit ``# lint: allow(JX002)`` pragma naming why there is
+  no stable home (pallas, mesh_utils).
+- **JX003** — float64 literals/dtypes in TPU-path code
+  (``ops/``/``models/``/``parallel/``). TPUs demote f64 to f32 silently;
+  a double-precision constant is a numerics bug waiting for hardware.
+- **JX004** — a timing loop (two+ ``perf_counter``/``time.time`` calls in
+  one function) with no dispatch fence (``block_until_ready``,
+  ``device_get``, or an ``np.asarray`` host transfer). Async dispatch means
+  such a loop measures Python dispatch, not compute.
+- **TS001** — non-hermetic test patterns in ``tests/``: probing hardcoded
+  ``/dev/...`` device nodes (tests must target fake sysfs roots) or
+  calling out to the network.
+
+A finding on a line carrying ``# lint: allow(RULE)`` is suppressed; the
+pragma should name its reason inline.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+# Symbols whose import location (or existence) differs across supported JAX
+# versions — resolved once in compat/jaxapi.py, nowhere else.
+DRIFTED_JAX_SYMBOLS = frozenset({
+    "shard_map",
+    "AxisType",
+    "axis_size",
+    "pvary",
+    "pcast",
+    "make_mesh",
+})
+
+# Dotted call targets a test may not reach for (network egress).
+_NETWORK_CALLS = frozenset({
+    "urllib.request.urlopen",
+    "requests.get",
+    "requests.post",
+    "requests.put",
+    "requests.delete",
+    "requests.request",
+    "http.client.HTTPConnection",
+    "http.client.HTTPSConnection",
+    "socket.create_connection",
+})
+
+# Filesystem probes that must not target literal /dev paths in tests.
+_FS_PROBE_CALLS = frozenset({
+    "open",
+    "os.path.exists",
+    "os.path.isfile",
+    "os.path.isdir",
+    "os.listdir",
+    "os.stat",
+    "os.scandir",
+    "os.open",
+    "Path",
+    "pathlib.Path",
+})
+
+# Calls that fence JAX's async dispatch before a timer is read.
+_TIMING_FENCES = frozenset({"block_until_ready", "device_get", "asarray", "array"})
+_TIMER_CALLS = frozenset({"perf_counter", "monotonic", "time"})
+
+_PRAGMA_RE = re.compile(r"#\s*lint:\s*allow\(([A-Z0-9, ]+)\)")
+
+ALL_RULES = {
+    "JX001": "direct import of a version-drifted JAX symbol outside compat/",
+    "JX002": "jax.experimental import outside compat/ without a pragma",
+    "JX003": "float64 literal/dtype in TPU-path code (silently demoted on TPU)",
+    "JX004": "timing loop without a dispatch fence (measures dispatch, not compute)",
+    "TS001": "non-hermetic test pattern (hardcoded /dev/* probe or network call)",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` attribute/name chain → ``"a.b.c"`` (None if not a chain)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_own_body(fn: ast.AST):
+    """Yield ``fn``'s nodes EXCLUDING nested function/lambda bodies —
+    ``ast.walk`` cannot be pruned, and for the timing rule a fence inside a
+    nested callback must not excuse the enclosing function's unfenced
+    timers (nested defs are checked on their own visit)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _allowed_lines(src: str) -> dict[int, frozenset[str]]:
+    """line number → rules allowed by an inline ``# lint: allow(...)``."""
+    out: dict[int, frozenset[str]] = {}
+    for i, text in enumerate(src.splitlines(), start=1):
+        m = _PRAGMA_RE.search(text)
+        if m:
+            out[i] = frozenset(r.strip() for r in m.group(1).split(","))
+    return out
+
+
+def _scopes(path: str) -> dict[str, bool]:
+    p = path.replace("\\", "/")
+    in_compat = "/compat/" in p or p.startswith("compat/")
+    base = p.rsplit("/", 1)[-1]
+    return {
+        "jx001": not in_compat and not p.startswith("tools/"),
+        "jx002": (
+            "kata_xpu_device_plugin_tpu/" in p or p.startswith(
+                "kata_xpu_device_plugin_tpu"
+            )
+        ) and not in_compat,
+        "jx003": any(
+            f"kata_xpu_device_plugin_tpu/{d}/" in p
+            for d in ("ops", "models", "parallel")
+        ),
+        "jx004": base.startswith("bench") or (
+            "scripts/" in p and "bench" in base
+        ) or ("eval" in base and "scripts/" in p),
+        "ts001": "tests/" in p or p.startswith("tests"),
+    }
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, path: str, scopes: dict[str, bool]):
+        self.path = path
+        self.scopes = scopes
+        self.findings: list[Finding] = []
+
+    def _add(self, node: ast.AST, rule: str, message: str) -> None:
+        self.findings.append(
+            Finding(self.path, getattr(node, "lineno", 1), rule, message)
+        )
+
+    # -- imports (JX001 / JX002) --------------------------------------------
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        if self.scopes["jx001"] and (mod == "jax" or mod.startswith("jax.")):
+            for alias in node.names:
+                if alias.name in DRIFTED_JAX_SYMBOLS:
+                    self._add(
+                        node, "JX001",
+                        f"'from {mod} import {alias.name}' drifts across JAX "
+                        "releases; import it from "
+                        "kata_xpu_device_plugin_tpu.compat.jaxapi",
+                    )
+        if self.scopes["jx002"] and (
+            mod.startswith("jax.experimental")
+            or (mod == "jax" and any(a.name == "experimental" for a in node.names))
+        ):
+            self._add(
+                node, "JX002",
+                f"'from {mod} import ...' reaches into jax.experimental; "
+                "shim it in compat/jaxapi.py or annotate "
+                "'# lint: allow(JX002) <reason>'",
+            )
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        if self.scopes["jx002"]:
+            for alias in node.names:
+                if alias.name.startswith("jax.experimental"):
+                    self._add(
+                        node, "JX002",
+                        f"'import {alias.name}' reaches into jax.experimental; "
+                        "shim it in compat/jaxapi.py or annotate "
+                        "'# lint: allow(JX002) <reason>'",
+                    )
+        self.generic_visit(node)
+
+    # -- attribute use of drifted symbols (JX001) ---------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self.scopes["jx001"] and node.attr in DRIFTED_JAX_SYMBOLS:
+            dotted = _dotted(node)
+            if dotted and (
+                dotted.startswith("jax.") or dotted.startswith("lax.")
+            ):
+                self._add(
+                    node, "JX001",
+                    f"'{dotted}' drifts across JAX releases; use the "
+                    "kata_xpu_device_plugin_tpu.compat.jaxapi export",
+                )
+        if self.scopes["jx003"] and node.attr == "float64":
+            self._add(
+                node, "JX003",
+                f"'{_dotted(node) or node.attr}' in TPU-path code: TPUs "
+                "demote f64 to f32 silently — use float32/bfloat16",
+            )
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if self.scopes["jx003"] and node.value == "float64":
+            self._add(
+                node, "JX003",
+                "dtype string 'float64' in TPU-path code: TPUs demote f64 "
+                "to f32 silently — use 'float32'/'bfloat16'",
+            )
+        self.generic_visit(node)
+
+    # -- bench timing fences (JX004) ----------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_timing(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_timing(node)
+        self.generic_visit(node)
+
+    def _check_timing(self, fn: ast.AST) -> None:
+        if not self.scopes["jx004"]:
+            return
+        timers = fences = 0
+        for sub in _walk_own_body(fn):
+            if isinstance(sub, ast.Call):
+                dotted = _dotted(sub.func) or ""
+                leaf = dotted.rsplit(".", 1)[-1]
+                if leaf in _TIMER_CALLS and (
+                    dotted.startswith("time.")
+                    or leaf in ("perf_counter", "monotonic")
+                ):
+                    # qualified time.* calls, plus the unambiguous bare
+                    # spellings (`from time import perf_counter`); a bare
+                    # `time()` stays unflagged — too generic a name.
+                    timers += 1
+                elif leaf in _TIMING_FENCES:
+                    fences += 1
+        if timers >= 2 and fences == 0:
+            self._add(
+                fn, "JX004",
+                f"function '{getattr(fn, 'name', '?')}' times a region but "
+                "never fences dispatch (jax.block_until_ready / "
+                "jax.device_get / np.asarray of the result) — it measures "
+                "dispatch, not compute",
+            )
+
+    # -- test hermeticity (TS001) -------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.scopes["ts001"]:
+            dotted = _dotted(node.func) or ""
+            if dotted in _NETWORK_CALLS:
+                self._add(
+                    node, "TS001",
+                    f"'{dotted}' in a test: tests must not reach the "
+                    "network (fake the endpoint or mark/skip explicitly)",
+                )
+            if dotted in _FS_PROBE_CALLS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str
+                    ) and arg.value.startswith("/dev/"):
+                        self._add(
+                            node, "TS001",
+                            f"'{dotted}({arg.value!r})' probes a real device "
+                            "node: tests must target a fake root (tmp_path)",
+                        )
+        self.generic_visit(node)
+
+
+def check_source(
+    src: str, path: str = "<string>", rules: Optional[Iterable[str]] = None
+) -> list[Finding]:
+    """Lint ``src`` as repo-relative ``path``. ``rules`` restricts to a
+    subset of rule ids (default: all)."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as err:
+        return [
+            Finding(path, err.lineno or 1, "E999", f"syntax error: {err.msg}")
+        ]
+    checker = _Checker(path, _scopes(path))
+    checker.visit(tree)
+    allowed = _allowed_lines(src)
+    selected = set(rules) if rules is not None else None
+    out = []
+    for f in checker.findings:
+        if selected is not None and f.rule not in selected:
+            continue
+        if f.rule in allowed.get(f.line, frozenset()):
+            continue
+        out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def check_file(
+    path: str, rel: Optional[str] = None, rules: Optional[Iterable[str]] = None
+) -> list[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        return check_source(fh.read(), rel or path, rules)

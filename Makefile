@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint test test-fast bench bench-watch eval demo dryrun image clean deploy
+.PHONY: all build proto lint test test-fast bench bench-watch eval demo dryrun image clean deploy obs-check
 
 all: build
 
@@ -37,6 +37,16 @@ lint:
 	@if command -v mypy >/dev/null 2>&1; then \
 	  mypy; \
 	else echo "lint: mypy not installed — skipped (pip install mypy)"; fi
+
+# Telemetry gate (ISSUE 2): the JX005 rule (raw perf_counter timing in
+# library code must go through obs.span/obs.timer) plus the obs unit
+# tests (spans, registry, sinks, profiler hook, lint fixtures). The obs
+# test run itself streams into an event file — the tier-1 timing
+# artifact CI uploads.
+obs-check:
+	$(PY) -m tools.lint --rule JX005
+	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=obs_check_events.jsonl \
+	  $(PY) -m pytest tests/test_obs.py tests/test_lint.py -q
 
 test:
 	$(PY) -m pytest tests/ -x -q

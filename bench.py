@@ -414,6 +414,26 @@ def worker(args: argparse.Namespace) -> None:
         cfg = gemma_2b_bench()
     max_len = PROMPT_LEN + DECODE_STEPS
 
+    # ISSUE 2: the worker streams its measurement spans (compile / prefill
+    # / decode) into the obs JSONL sink and parses them back into the
+    # per-phase breakdown the result line reports — one pipeline for bench
+    # evidence and production telemetry. KATATPU_OBS_FILE pins the path;
+    # default is a fresh temp file per attempt.
+    import tempfile
+
+    from kata_xpu_device_plugin_tpu import obs
+
+    events_path = os.environ.get("KATATPU_OBS_FILE") or os.path.join(
+        tempfile.mkdtemp(prefix="bench_obs_"), "events.jsonl"
+    )
+    # A pinned path may already hold earlier runs' events (the sink
+    # appends); remember where this run starts so the phase aggregation
+    # below cannot mix runs.
+    events_offset = (
+        os.path.getsize(events_path) if os.path.exists(events_path) else 0
+    )
+    obs.set_default_sink(obs.EventSink(events_path))
+
     key = jax.random.PRNGKey(0)
     # Fused inference layout: wqkv / w_gateup stream each weight group in one
     # matmul on the bandwidth-bound decode step.
@@ -422,7 +442,7 @@ def worker(args: argparse.Namespace) -> None:
     )(key)
     jax.block_until_ready(params)
 
-    def run(p, seed: int):
+    def run(p, seed: int, tag: str = "bench"):
         # Fresh prompt every iteration and a full device→host transfer of
         # the result: the remote-device tunnel can serve repeated identical
         # executions from cache and does not reliably block on
@@ -431,22 +451,32 @@ def worker(args: argparse.Namespace) -> None:
         # tiny `last`-token transfer fences prefill completion so the decode
         # window contains only the decode scan (prefill is compute-bound;
         # folding it in understated decode tok/s by a few percent in r02).
+        # ``tag`` namespaces the emitted spans (int8/w8a8 reruns must not
+        # pollute the bf16 ``bench.*`` phase aggregates); tag=None silences
+        # them (warm-up runs measure compile, not prefill/decode).
         prompt = jax.random.randint(
             jax.random.PRNGKey(seed), (BATCH, PROMPT_LEN), 0,
             cfg.vocab_size, dtype=jnp.int32,
         )
         np.asarray(prompt)
         t0 = time.perf_counter()
-        caches, last, _pos = prefill(p, prompt, cfg, max_len)
-        np.asarray(last)
+        with obs.span(f"{tag}.prefill", tokens=BATCH * PROMPT_LEN) if tag \
+                else _null_span():
+            caches, last, _pos = prefill(p, prompt, cfg, max_len)
+            np.asarray(last)
         t_pre = time.perf_counter() - t0
         t1 = time.perf_counter()
         # pos as the static python int: decode's bound check must not cost a
         # device->host fetch inside the timed window.
-        out = np.asarray(decode(p, caches, last, PROMPT_LEN, cfg, DECODE_STEPS))
+        with obs.span(f"{tag}.decode", tokens=BATCH * DECODE_STEPS) if tag \
+                else _null_span():
+            out = np.asarray(decode(p, caches, last, PROMPT_LEN, cfg, DECODE_STEPS))
         return t_pre, time.perf_counter() - t1, out
 
-    run(params, 0)  # warm-up: compiles prefill + decode scan
+    from contextlib import nullcontext as _null_span
+
+    with obs.span("bench.compile"):
+        run(params, 0, tag=None)  # warm-up: compiles prefill + decode scan
 
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
@@ -520,9 +550,12 @@ def worker(args: argparse.Namespace) -> None:
 
             qparams = jax.jit(quantize_decoder_params)(params)
             jax.block_until_ready(qparams)
-            run(qparams, 0)  # warm-up: int8 layouts recompile prefill+decode
+            # warm-up: int8 layouts recompile prefill+decode
+            run(qparams, 0, tag=None)
             q_dt = min(
-                t for _, t in [run(qparams, seed)[:2] for seed in range(4, 7)]
+                t for _, t in [
+                    run(qparams, seed, tag="int8")[:2] for seed in range(4, 7)
+                ]
             )
             int8_bytes = params_hbm_bytes(qparams) + kv_bytes_per_step
             int8_roofline_tok_s = hbm_gbps * 1e9 / int8_bytes * BATCH
@@ -548,9 +581,11 @@ def worker(args: argparse.Namespace) -> None:
                 set_w8a8(True)
                 try:
                     jax.clear_caches()
-                    run(qparams, 10)  # warm-up under the W8A8 trace
+                    run(qparams, 10, tag=None)  # warm-up under the W8A8 trace
                     w_dt = min(
-                        t for _, t in [run(qparams, s)[:2] for s in (11, 12, 13)]
+                        t for _, t in [
+                            run(qparams, s, tag="w8a8")[:2] for s in (11, 12, 13)
+                        ]
                     )
                     out["w8a8_tok_per_s"] = round(total_tokens / w_dt, 1)
                     out["w8a8_vs_baseline"] = round(
@@ -783,11 +818,25 @@ def worker(args: argparse.Namespace) -> None:
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"train_error": f"{type(exc).__name__}: {exc}"[:200]}
 
+    # Per-phase breakdown, parsed back from the JSONL event stream the
+    # spans above emitted (ISSUE 2 acceptance: BENCH output carries
+    # compile/prefill/decode instead of one opaque number). Crash-guarded:
+    # a telemetry parse failure must never cost the headline.
+    try:
+        phases = obs.summarize_phases(
+            obs.read_events(events_path, offset=events_offset),
+            prefix="bench.",
+        )
+    except Exception as exc:  # noqa: BLE001 — headline must survive
+        phases = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+
     out = {
         "metric": METRIC,
         "value": round(tok_per_s, 1),
         "unit": "tok/s",
         "vs_baseline": round(tok_per_s / roofline_tok_s, 4),
+        "phases": phases,
+        "obs_events_file": events_path,
         "platform": devs[0].platform,
         "device_kind": str(getattr(devs[0], "device_kind", "")),
         "config": "smoke-tiny" if args.smoke else "gemma2b",

@@ -21,6 +21,9 @@ Subpackage map (host side, no JAX imports):
   plugin/     kubelet gRPC server + health + manager     (ref L2: generic_device_plugin.go)
   multihost/  TPU_WORKER_ID/HOSTNAMES coordination       (new)
   utils/      logging, metrics, inotify, pod-resources   (ref L0: utils/)
+  obs/        unified telemetry: spans, metric factory,  (new; the "no
+              JSONL events, profiler hooks               metrics" fix at
+                                                         stack scale)
 
 Guest side (JAX; imported lazily so the host daemon never loads jax):
   guest/      device probe + collective smoke ladder

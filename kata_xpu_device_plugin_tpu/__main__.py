@@ -56,6 +56,14 @@ def _run(args: argparse.Namespace) -> int:
     cfg = from_args(args)
     logger = log.setup(cfg.log_level, cfg.log_format)
     metrics.serve(cfg.metrics_port)
+    if cfg.obs_events_file:
+        # Daemon-side JSONL event stream (ISSUE 2): spans from the gRPC
+        # handlers land in the same pipeline the guest stack writes to.
+        from . import obs
+
+        obs.set_default_sink(obs.EventSink(cfg.obs_events_file))
+    if cfg.obs_profile_dir:
+        os.environ.setdefault("KATATPU_OBS_PROFILE_DIR", cfg.obs_profile_dir)
     mgr = PluginManager(cfg)
 
     # Self-pipe shutdown: the handler runs ON the main thread, which may be

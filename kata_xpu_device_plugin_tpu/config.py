@@ -74,10 +74,13 @@ class Config:
     rescan_interval_s: float = 30.0
     health_poll_interval_s: float = 5.0
 
-    # Observability.
+    # Observability (ISSUE 2: the unified telemetry layer's daemon knobs;
+    # the guest stack reads the KATATPU_OBS* env contract directly).
     metrics_port: int = 9400  # 0 disables
     log_level: str = "info"
     log_format: str = "text"
+    obs_events_file: str = ""  # JSONL event stream path ("" disables)
+    obs_profile_dir: str = ""  # jax.profiler dump dir ("" disables)
 
     def __post_init__(self) -> None:
         if not self.kubelet_socket:

@@ -34,6 +34,7 @@ expected prompt.
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Optional
@@ -42,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..models.transformer import (
     DecoderConfig,
     _decode_scan,
@@ -55,11 +57,10 @@ from ..models.transformer import (
 )
 
 
-# Serving-stat gauges, created lazily ONCE per process: the prometheus
-# client's default registry is global, so per-instance Gauge() calls would
-# raise duplicate-metric errors — instances distinguish themselves by the
-# "server" label instead (see GenerationServer.export_metrics).
-_PROM_GAUGES: Optional[dict] = None
+# Serving-stat gauges, created through obs.metrics' idempotent factory
+# (a reload or second import path returns the SAME collectors instead of
+# raising Duplicated timeseries); instances distinguish themselves by the
+# "server" label (see GenerationServer.export_metrics).
 _PROM_STATS = (
     ("rounds", "Device rounds dispatched"),
     ("prefills", "Prompt prefills performed"),
@@ -67,21 +68,36 @@ _PROM_STATS = (
     ("tokens_per_round", "Mean decoded tokens per device round"),
     ("slots_busy", "Arena slots currently serving a request"),
     ("queued", "Requests waiting for a slot"),
+    ("batch_occupancy", "Busy fraction of the arena's slots"),
+    ("kv_slot_utilization", "Mean busy-slot cache fill (pos / arena len)"),
     ("arena_bytes", "KV arena HBM footprint (addressable shards summed)"),
     ("draft_acceptance", "Speculative draft acceptance rate"),
 )
 
 
 def _prom_gauges() -> dict:
-    global _PROM_GAUGES
-    if _PROM_GAUGES is None:
-        from prometheus_client import Gauge
+    return {
+        name: obs.gauge(f"kata_tpu_serving_{name}", desc, ["server"])
+        for name, desc in _PROM_STATS
+    }
 
-        _PROM_GAUGES = {
-            name: Gauge(f"kata_tpu_serving_{name}", desc, ["server"])
-            for name, desc in _PROM_STATS
-        }
-    return _PROM_GAUGES
+
+# Latency histograms (ISSUE 2): TTFT (submit → first token, includes
+# queueing) and per-token decode latency (chunk wall time / chunk steps).
+def _hist_ttft():
+    return obs.histogram(
+        "kata_tpu_serving_ttft_seconds",
+        "Time to first token: submit → prefill token sampled",
+        ["server"],
+    )
+
+
+def _hist_decode_token():
+    return obs.histogram(
+        "kata_tpu_serving_decode_token_seconds",
+        "Per-token decode latency (fenced chunk time / steps)",
+        ["server"],
+    )
 
 
 def _hbm_bytes(leaf) -> int:
@@ -101,6 +117,7 @@ class _Request:
     rid: int
     prompt: np.ndarray  # [S] int32
     max_new_tokens: int
+    t_submit: float = 0.0  # monotonic clock at submit() — TTFT anchor
     out: list = field(default_factory=list)
     done: bool = False
 
@@ -256,12 +273,20 @@ class GenerationServer:
         self._results: dict[int, np.ndarray] = {}
         self._next_rid = 0
         # Counters for stats(): device rounds dispatched, tokens emitted
-        # (pre-trim), speculative drafts offered/accepted.
+        # (pre-trim), speculative drafts offered/accepted. CUMULATIVE over
+        # the server's lifetime — run() drains results but never resets
+        # these (snapshot semantics, documented on stats()).
         self._rounds = 0
         self._emitted = 0
         self._prefills = 0
         self._drafts_offered = 0
         self._drafts_accepted = 0
+        # Latency summaries (ISSUE 2): host-side Rolling for stats()
+        # quantiles, mirrored into the prometheus histograms at record
+        # time under this server's label.
+        self._label = f"server{next(GenerationServer._instance_ids)}"
+        self._ttft = obs.Rolling()
+        self._tok_lat = obs.Rolling()
 
     def _shard_over(self, mesh) -> None:
         """Tensor-parallel serving: place params by their layout-aware
@@ -316,7 +341,9 @@ class GenerationServer:
             )
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, prompt, max_new_tokens))
+        self._queue.append(
+            _Request(rid, prompt, max_new_tokens, t_submit=time.monotonic())
+        )
         return rid
 
     def run(self) -> dict[int, np.ndarray]:
@@ -328,9 +355,20 @@ class GenerationServer:
 
     def stats(self) -> dict:
         """Serving counters: device rounds, tokens emitted (pre-trim),
-        mean tokens per round, and — under ``speculative_k`` — the draft
-        acceptance rate (the number the k parameter should be tuned by)."""
+        mean tokens per round, occupancy/utilization gauges, latency
+        summaries, and — under ``speculative_k`` — the draft acceptance
+        rate (the number the k parameter should be tuned by).
+
+        SNAPSHOT semantics (ISSUE 2): every counter is cumulative over the
+        server's lifetime and stats() NEVER resets anything — two
+        back-to-back calls with no traffic in between return equal dicts,
+        and counters only grow across successive ``run()`` batches
+        (``run()`` drains *results*, not telemetry). The latency summaries
+        (``ttft_s``, ``decode_token_s``) are count/mean/min/max/p50/p95
+        dicts from a bounded reservoir — cumulative counts, recent-window
+        quantiles."""
         decoded = self._emitted - self._prefills
+        busy = sum(r is not None for r in self._slot_req)
         out = {
             "rounds": self._rounds,
             "prefills": self._prefills,
@@ -338,8 +376,14 @@ class GenerationServer:
             "tokens_per_round": (
                 round(decoded / self._rounds, 3) if self._rounds else 0.0
             ),
-            "slots_busy": sum(r is not None for r in self._slot_req),
+            "slots_busy": busy,
             "queued": len(self._queue),
+            "batch_occupancy": round(busy / self.max_batch, 4),
+            # Mean cache fill of the busy slots: positions written over the
+            # per-slot arena length (ring arenas wrap, so cap at 1.0).
+            "kv_slot_utilization": self._kv_slot_utilization(),
+            "ttft_s": self._ttft.summary(),
+            "decode_token_s": self._tok_lat.summary(),
             # KV arena footprint — the number ring/cycle arenas and int8
             # caches exist to shrink (sum over leaves: int8 payloads and
             # quant scales both counted). Summed over ADDRESSABLE SHARDS,
@@ -359,27 +403,44 @@ class GenerationServer:
             )
         return out
 
+    def _kv_slot_utilization(self) -> float:
+        busy = [b for b in range(self.max_batch) if self._slot_req[b] is not None]
+        if not busy:
+            return 0.0
+        if self.ring_kv:
+            arena_len = self.cfg.window_cycle[0] + self._ring_margin
+        else:
+            arena_len = self.max_len
+        return round(
+            float(np.mean([min(1.0, self._pos[b] / arena_len) for b in busy])),
+            4,
+        )
+
     _instance_ids = iter(range(1 << 30))
 
     def export_metrics(self, port: int = 0, label: Optional[str] = None) -> str:
         """Expose this server's :meth:`stats` as Prometheus gauges
         (``kata_tpu_serving_*``, scrape-time values — the gauges call
-        ``stats()`` when collected, no polling thread). The guest-side
-        counterpart of the host daemon's ``utils.metrics`` endpoint
-        (SURVEY §5 observability). ``port > 0`` also starts the /metrics
-        HTTP endpoint (one per process); multiple servers in one process
-        distinguish themselves by the ``server`` label. Returns the label.
-        """
-        label = label or f"server{next(GenerationServer._instance_ids)}"
+        ``stats()`` when collected, no polling thread) alongside the TTFT
+        and per-token-latency HISTOGRAMS the server records as it runs.
+        The guest-side counterpart of the host daemon's ``utils.metrics``
+        endpoint (SURVEY §5 observability). ``port > 0`` also starts the
+        /metrics HTTP endpoint (one per process); multiple servers in one
+        process distinguish themselves by the ``server`` label. ``label``
+        renames this server (default ``server<N>``) — call before traffic
+        so histogram samples land under the final label. Returns the
+        label."""
+        if label:
+            self._label = label
         for name, gauge in _prom_gauges().items():
-            gauge.labels(server=label).set_function(
+            gauge.labels(server=self._label).set_function(
                 lambda self=self, n=name: float(self.stats().get(n, 0.0))
             )
         if port:
             from ..utils.metrics import serve
 
             serve(port)
-        return label
+        return self._label
 
     # ----- scheduling ------------------------------------------------------
 
@@ -403,24 +464,43 @@ class GenerationServer:
         # the live window into the slot's ring (slot s ← the latest
         # position ≡ s mod W) — the arena itself never grows past W.
         cache_len = len(prompt) if self.ring_kv else self.max_len
-        caches, last_logits, pos = prefill(
-            self.params, jnp.asarray(prompt)[None, :], self.cfg,
-            cache_len, return_logits=True, kv_quantized=self.kv_quant,
-            true_len=jnp.int32(true_len) if bucket is not None else None,
-        )
-        if self._cycle:
-            caches = cycle_ring_caches_from_prefill(
-                caches, pos, self.cfg, self.max_len,
-                margin=self._ring_margin,
+        # Span fence: _sample_first's int() transfers the sampled token,
+        # which depends on the whole prefill forward.
+        with obs.span(
+            "serving.prefill",
+            server=self._label, rid=req.rid, slot=b,
+            prompt_len=true_len, padded_len=len(prompt), tokens=true_len,
+        ):
+            caches, last_logits, pos = prefill(
+                self.params, jnp.asarray(prompt)[None, :], self.cfg,
+                cache_len, return_logits=True, kv_quantized=self.kv_quant,
+                true_len=jnp.int32(true_len) if bucket is not None else None,
             )
-        elif self.ring_kv:
-            caches = ring_caches_from_prefill(
-                caches, pos, self.cfg.window_cycle[0] + self._ring_margin
-            )
-        first = self._sample_first(last_logits)
+            if self._cycle:
+                caches = cycle_ring_caches_from_prefill(
+                    caches, pos, self.cfg, self.max_len,
+                    margin=self._ring_margin,
+                )
+            elif self.ring_kv:
+                caches = ring_caches_from_prefill(
+                    caches, pos, self.cfg.window_cycle[0] + self._ring_margin
+                )
+            first = self._sample_first(last_logits)
         req.out.append(first)
         self._prefills += 1
         self._emitted += 1  # the prefill forward emits each request's first token
+        # TTFT: submit → first token. _sample_first's int() is a host
+        # transfer of the prefill logits, so the device work is fenced —
+        # the measurement includes queue wait by design (that is what the
+        # client experiences).
+        ttft = time.monotonic() - req.t_submit
+        self._ttft.observe(ttft)
+        _hist_ttft().labels(server=self._label).observe(ttft)
+        obs.emit(
+            "serving", "ttft",
+            server=self._label, rid=req.rid, ttft_s=round(ttft, 6),
+            prompt_len=int(true_len), queued=len(self._queue),
+        )
         self.arena = _write_slot(self.arena, caches, b)
         if self.draft is not None:
             # The draft prefills the same prompt into its own arena slot
@@ -466,7 +546,28 @@ class GenerationServer:
             return bool(self._queue)
 
         if self.speculative_k:
-            return self._step_speculative(active)
+            # The round's verify transfer (np.asarray inside) is the
+            # span's fence; accepted-token accounting lands in a follow-up
+            # event because it is only known after the host-side accept.
+            before = self._emitted
+            with obs.span(
+                "serving.verify_round",
+                server=self._label, slots_busy=len(active),
+                queued=len(self._queue),
+            ) as sp:
+                alive = self._step_speculative(active)
+            accepted = self._emitted - before
+            if accepted:
+                tok_lat = sp.duration_s / (accepted / len(active))
+                self._tok_lat.observe(tok_lat)
+                _hist_decode_token().labels(server=self._label).observe(tok_lat)
+                obs.emit(
+                    "serving", "spec_round",
+                    server=self._label, accepted=accepted,
+                    offered=self.speculative_k * len(active),
+                    dur_s=round(sp.duration_s, 6),
+                )
+            return alive
 
         # Always decode exactly ``chunk`` steps: ``steps`` is a static arg,
         # so a data-dependent chunk would compile a fresh full-model decode
@@ -476,13 +577,26 @@ class GenerationServer:
         # slot that is finished (and refill overwrites the whole slot), and
         # _maybe_finish trims tokens past eos/budget.
         self._key, sub = jax.random.split(self._key)
-        toks, caches, last, pos = _serve_decode(
-            self.params, self.arena, jnp.asarray(self._last),
-            jnp.asarray(self._pos), self.cfg, self.chunk, self._do_sample,
-            self.top_k, jnp.float32(self.temperature), sub, top_p=self.top_p,
-            ring=self.ring_kv,
-        )
-        toks = np.asarray(toks)  # [max_batch, chunk]
+        # The chunk span's duration is honest by construction: np.asarray
+        # on the chunk's tokens is a device→host transfer, i.e. the fence.
+        with obs.span(
+            "serving.decode_chunk",
+            server=self._label, tokens=len(active) * self.chunk,
+            slots_busy=len(active), queued=len(self._queue),
+            batch_occupancy=round(len(active) / self.max_batch, 4),
+        ) as sp:
+            toks, caches, last, pos = _serve_decode(
+                self.params, self.arena, jnp.asarray(self._last),
+                jnp.asarray(self._pos), self.cfg, self.chunk, self._do_sample,
+                self.top_k, jnp.float32(self.temperature), sub,
+                top_p=self.top_p, ring=self.ring_kv,
+            )
+            toks = np.asarray(toks)  # [max_batch, chunk]
+        # Per-token decode latency as a client sees it: chunk wall time
+        # over the chunk's steps (each step yields one token per slot).
+        tok_lat = sp.duration_s / self.chunk
+        self._tok_lat.observe(tok_lat)
+        _hist_decode_token().labels(server=self._label).observe(tok_lat)
         self.arena = caches
         # np.array (not asarray): device arrays convert read-only, and
         # _fill_slot writes these rows in place on refill.

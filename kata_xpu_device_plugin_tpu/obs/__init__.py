@@ -1,0 +1,84 @@
+"""Unified telemetry layer (ISSUE 2): spans, metrics, events, profiler.
+
+The daemon side fixed the reference's "no metrics endpoint" sliver
+(SURVEY §5); this package gives the whole stack — trainer, serving,
+plugin gRPC — one pipeline for the signals production traffic needs:
+
+- :mod:`.trace`    — span-based tracer with JAX-aware timing: spans FENCE
+  via ``jax.block_until_ready`` on exit, so device async dispatch cannot
+  fake sub-ms steps. Context-manager (:func:`span`, :func:`timer`) and
+  decorator (:func:`traced`) APIs; trace/span ids ride into
+  ``utils/log.py`` records automatically.
+- :mod:`.metrics`  — counters/gauges/histograms created through a factory
+  against an injectable ``CollectorRegistry`` (idempotent: re-import and
+  double-registration cannot raise ``Duplicated timeseries``), exported
+  over the same Prometheus endpoint as ``utils.metrics``.
+- :mod:`.events`   — a JSONL event sink (``KATATPU_OBS=1`` +
+  ``KATATPU_OBS_FILE``) every span and metric event streams into;
+  ``bench.py`` parses it back into per-phase breakdowns.
+- :mod:`.profiler` — optional ``jax.profiler`` start/stop around N
+  configurable steps.
+
+Import discipline: NOTHING here imports jax at module level — the host
+daemon (plugin/, utils/) imports this package and must stay jax-free;
+jax is reached lazily, only when a span actually fences device values or
+the profiler starts.
+"""
+from __future__ import annotations
+
+from .events import (
+    EventSink,
+    configure_from_env,
+    default_sink,
+    emit,
+    enabled,
+    read_events,
+    set_default_sink,
+    summarize_phases,
+)
+from .metrics import (
+    DEFAULT_REGISTRY,
+    MetricsRegistry,
+    Rolling,
+    counter,
+    gauge,
+    histogram,
+    serve,
+)
+from .profiler import ProfilerHook, profiler_from_env
+from .trace import (
+    Span,
+    current_span_id,
+    current_trace_id,
+    new_trace,
+    span,
+    timer,
+    traced,
+)
+
+__all__ = [
+    "EventSink",
+    "configure_from_env",
+    "default_sink",
+    "emit",
+    "enabled",
+    "read_events",
+    "set_default_sink",
+    "summarize_phases",
+    "DEFAULT_REGISTRY",
+    "MetricsRegistry",
+    "Rolling",
+    "counter",
+    "gauge",
+    "histogram",
+    "serve",
+    "ProfilerHook",
+    "profiler_from_env",
+    "Span",
+    "current_span_id",
+    "current_trace_id",
+    "new_trace",
+    "span",
+    "timer",
+    "traced",
+]

@@ -1,0 +1,203 @@
+"""Metric factory over an injectable Prometheus ``CollectorRegistry``.
+
+``utils/metrics.py``'s module-global ``Counter(...)``/``Gauge(...)`` calls
+break the moment the module is imported twice (``importlib.reload``, a
+second sys.path alias, plugin tests after serving tests) — prometheus's
+process-global default registry raises ``Duplicated timeseries``. This
+factory fixes the class of bug:
+
+- collectors are created through :class:`MetricsRegistry`, which caches by
+  (name, type, labelnames) and ADOPTS a collector the underlying registry
+  already holds instead of re-registering it — creation is idempotent;
+- the registry itself is injectable, so tests run against a fresh
+  ``CollectorRegistry()`` instead of fighting global state;
+- the default instance exports over the same ``/metrics`` endpoint the
+  daemon already serves (:func:`serve`).
+
+Also here: :class:`Rolling`, a tiny host-side summary (count/sum/min/max +
+bounded reservoir for quantiles) for the ``stats()``-style dict snapshots
+that prometheus histograms cannot answer client-side.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from prometheus_client import (
+    REGISTRY,
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    start_http_server,
+)
+
+# One namespace for every metric this repo exports (host daemon and guest
+# stack share the pipeline — the PAPERS.md Network-Driver-Model argument).
+NS = "kata_tpu"
+
+# Latency buckets tuned for this stack's two regimes: sub-ms device steps
+# (decode tokens, gRPC handlers) through multi-second compiles.
+LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class MetricsRegistry:
+    """Idempotent counter/gauge/histogram factory over one
+    ``CollectorRegistry`` (default: prometheus's process-global one).
+
+    >>> reg = MetricsRegistry(CollectorRegistry())
+    >>> c = reg.counter("requests_total", "Requests", ["outcome"])
+    >>> c is reg.counter("requests_total", "Requests", ["outcome"])
+    True
+    """
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry if registry is not None else REGISTRY
+        self._lock = threading.Lock()
+        self._collectors: dict[str, object] = {}
+
+    def counter(self, name: str, doc: str, labels: Sequence[str] = ()):
+        return self._get(Counter, name, doc, labels)
+
+    def gauge(self, name: str, doc: str, labels: Sequence[str] = ()):
+        return self._get(Gauge, name, doc, labels)
+
+    def histogram(
+        self,
+        name: str,
+        doc: str,
+        labels: Sequence[str] = (),
+        buckets: Sequence[float] = LATENCY_BUCKETS,
+    ):
+        return self._get(Histogram, name, doc, labels, buckets=buckets)
+
+    def _get(self, cls, name: str, doc: str, labels, **kwargs):
+        with self._lock:
+            cached = self._collectors.get(name)
+            if cached is None:
+                # A fresh MetricsRegistry over a registry that already holds
+                # the collector (module reloaded, two import paths): adopt
+                # it — re-registering is exactly the Duplicated-timeseries
+                # crash this factory exists to remove.
+                cached = self._adopt(name)
+            if cached is not None:
+                if not isinstance(cached, cls) or tuple(
+                    getattr(cached, "_labelnames", ())
+                ) != tuple(labels):
+                    raise ValueError(
+                        f"metric {name!r} already exists as "
+                        f"{type(cached).__name__} with labels "
+                        f"{tuple(getattr(cached, '_labelnames', ()))}, "
+                        f"requested {cls.__name__} with {tuple(labels)}"
+                    )
+                self._collectors[name] = cached
+                return cached
+            collector = cls(
+                name, doc, labelnames=tuple(labels),
+                registry=self.registry, **kwargs,
+            )
+            self._collectors[name] = collector
+            return collector
+
+    def _adopt(self, name: str):
+        # _names_to_collectors is private but stable (0.x..0.23); absence
+        # just means no adoption — first registration still works.
+        table = getattr(self.registry, "_names_to_collectors", None)
+        if not table:
+            return None
+        # Counters register under name+"_total"; look up both spellings.
+        return table.get(name) or table.get(f"{name}_total")
+
+
+# Process-default registry: backs utils.metrics' aliases and every
+# instrumented path that does not inject its own.
+DEFAULT_REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, doc: str, labels: Sequence[str] = ()):
+    return DEFAULT_REGISTRY.counter(name, doc, labels)
+
+
+def gauge(name: str, doc: str, labels: Sequence[str] = ()):
+    return DEFAULT_REGISTRY.gauge(name, doc, labels)
+
+
+def histogram(
+    name: str, doc: str, labels: Sequence[str] = (),
+    buckets: Sequence[float] = LATENCY_BUCKETS,
+):
+    return DEFAULT_REGISTRY.histogram(name, doc, labels, buckets)
+
+
+_served_port: Optional[int] = None
+
+
+def serve(
+    port: int, registry: Optional[CollectorRegistry] = None
+) -> Optional[int]:
+    """Start the /metrics HTTP endpoint; 0 disables; idempotent per
+    process (a second call for the same port is a no-op — the daemon and a
+    guest server can both ask). Returns the bound port."""
+    global _served_port
+    if not port:
+        return None
+    if _served_port == port:
+        return port
+    start_http_server(
+        port, registry=registry if registry is not None else REGISTRY
+    )
+    _served_port = port
+    return port
+
+
+class Rolling:
+    """Host-side summary: count/sum/min/max plus a bounded reservoir of the
+    most recent values for p50/p95 — the dict-snapshot complement of a
+    prometheus histogram (whose quantiles only exist server-side).
+
+    Thread-safe; ``summary()`` returns a plain-floats dict ready for
+    ``stats()`` / JSON.
+    """
+
+    def __init__(self, keep: int = 512):
+        self._lock = threading.Lock()
+        self._keep = keep
+        self._recent: list[float] = []
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self.count += 1
+            self.total += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+            self._recent.append(value)
+            if len(self._recent) > self._keep:
+                del self._recent[: len(self._recent) - self._keep]
+
+    def _quantile(self, q: float) -> float:
+        vals = sorted(self._recent)
+        if not vals:
+            return 0.0
+        idx = min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))
+        return vals[idx]
+
+    def summary(self) -> dict:
+        with self._lock:
+            if not self.count:
+                return {"count": 0}
+            return {
+                "count": self.count,
+                "mean": round(self.total / self.count, 6),
+                "min": round(self.min or 0.0, 6),
+                "max": round(self.max or 0.0, 6),
+                "p50": round(self._quantile(0.50), 6),
+                "p95": round(self._quantile(0.95), 6),
+            }

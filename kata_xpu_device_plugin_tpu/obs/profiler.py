@@ -1,0 +1,123 @@
+"""Optional ``jax.profiler`` hook: trace a configurable window of steps.
+
+The bench already knows ``--profile-dir``; this gives the TRAINING loop
+(and any other stepped workload) the same capability without hand-editing
+the loop: construct a :class:`ProfilerHook` (or let
+:func:`profiler_from_env` build one from ``KATATPU_OBS_PROFILE_DIR`` /
+``KATATPU_OBS_PROFILE_START`` / ``KATATPU_OBS_PROFILE_STEPS``) and call
+``on_step(step)`` once per step — the hook starts ``jax.profiler`` at
+``start_step``, stops it ``num_steps`` later, and dumps the xplane trace
+into the directory. ``stop()`` is idempotent and also runs on ``close``,
+so an exception mid-window cannot leave the profiler running.
+
+jax is imported lazily at start time; a host-side process that never
+crosses the start step never loads it.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils import log
+from . import events
+
+LOG = log.get("obs.profiler")
+
+_ENV_DIR = ("KATATPU_OBS_PROFILE_DIR", "KATA_TPU_OBS_PROFILE_DIR")
+_ENV_START = ("KATATPU_OBS_PROFILE_START", "KATA_TPU_OBS_PROFILE_START")
+_ENV_STEPS = ("KATATPU_OBS_PROFILE_STEPS", "KATA_TPU_OBS_PROFILE_STEPS")
+
+
+def _env(names: tuple, default: str = "") -> str:
+    for n in names:
+        v = os.environ.get(n, "")
+        if v:
+            return v
+    return default
+
+
+class ProfilerHook:
+    """Start/stop ``jax.profiler`` around steps
+    ``[start_step, start_step + num_steps)`` (1-indexed, matching the
+    trainer's step numbering)."""
+
+    def __init__(self, profile_dir: str, start_step: int = 2,
+                 num_steps: int = 3):
+        if start_step < 1:
+            raise ValueError(f"start_step must be >= 1, got {start_step}")
+        if num_steps < 1:
+            raise ValueError(f"num_steps must be >= 1, got {num_steps}")
+        self.profile_dir = profile_dir
+        self.start_step = start_step
+        self.stop_after = start_step + num_steps - 1
+        self._active = False
+        self._done = False
+
+    def on_step(self, step: int) -> None:
+        """Call AFTER step ``step`` completes (the trainer's on_step
+        convention; the trainer also primes the hook with the step it
+        RESUMES from, so ``start_step=1`` — and a resume landing inside
+        the window — both work): the window opens once ``start_step - 1``
+        has completed and covers through ``stop_after``, i.e. by default
+        starting at step 2, past the compile+execute first step that
+        would drown the steady state. A resume already past the window
+        never starts it (a partial trace would masquerade as the
+        configured window)."""
+        if (
+            not self._done
+            and not self._active
+            and self.start_step - 1 <= step < self.stop_after
+        ):
+            self._start()
+        elif self._active and step >= self.stop_after:
+            self.stop()
+
+    def _start(self) -> None:
+        import jax
+
+        os.makedirs(self.profile_dir, exist_ok=True)
+        jax.profiler.start_trace(self.profile_dir)
+        self._active = True
+        LOG.info(
+            "profiler trace started",
+            extra=log.kv(dir=self.profile_dir, start=self.start_step,
+                         stop=self.stop_after),
+        )
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        import jax
+
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+        events.emit(
+            "profile", "jax_trace",
+            dir=self.profile_dir,
+            start_step=self.start_step,
+            stop_step=self.stop_after,
+        )
+        LOG.info("profiler trace stopped", extra=log.kv(dir=self.profile_dir))
+
+    def close(self) -> None:
+        self.stop()
+
+    def __enter__(self) -> "ProfilerHook":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def profiler_from_env() -> Optional[ProfilerHook]:
+    """Build a hook from ``KATATPU_OBS_PROFILE_DIR`` (+ optional
+    ``_START``/``_STEPS``); None when unset."""
+    profile_dir = _env(_ENV_DIR)
+    if not profile_dir:
+        return None
+    return ProfilerHook(
+        profile_dir,
+        start_step=int(_env(_ENV_START, "2")),
+        num_steps=int(_env(_ENV_STEPS, "3")),
+    )

@@ -1,0 +1,250 @@
+"""Span-based tracer with JAX-aware timing.
+
+The one thing a naive ``perf_counter`` pair around a jitted call measures
+is Python dispatch: JAX returns futures, and the device may still be
+running when the second timestamp is read (the JX004/JX005 lint rules
+exist because this bug keeps recurring). Spans close that hole
+structurally — a span FENCES on exit: any value registered via
+``Span.fence(x)`` (or the ``fence=`` argument) is passed through
+``jax.block_until_ready`` BEFORE the end timestamp is taken, so the
+recorded duration covers device compute, not dispatch.
+
+APIs:
+
+- :func:`span` — context manager; nesting builds a parent/child tree under
+  one trace id (contextvar-propagated, so it follows async tasks and
+  survives thread-pool hand-off when contexts are copied)::
+
+      with obs.span("train.step", step=i) as sp:
+          state, loss = step_fn(state, batch)
+          sp.fence(loss)          # block_until_ready before the end stamp
+          sp.set(loss=float(loss))
+
+- :func:`traced` — decorator; fences the wrapped function's return value
+  by default.
+- :func:`timer` — a plain timing context manager (same fencing) that can
+  also feed a prometheus histogram child or a :class:`..obs.metrics.Rolling`.
+
+Every closed span emits one JSONL event (kind ``"span"``) to the default
+event sink; with the sink disabled the cost is two ``perf_counter`` calls
+and a dict. Trace/span ids of the innermost open span ride into
+``utils/log.py`` records automatically (the formatters ask
+:func:`current_trace_id`/:func:`current_span_id`).
+
+jax is imported lazily, inside the fence — host-side code (plugin/,
+utils/) spans freely without pulling jax into the daemon.
+"""
+from __future__ import annotations
+
+import contextvars
+import functools
+import os
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Optional
+
+from . import events
+
+# Innermost open span for this context (None at top level). A contextvar,
+# not a thread-local: gRPC handlers and asyncio tasks each get their own
+# copied context, so concurrent requests cannot cross-link spans.
+_current: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "katatpu_current_span", default=None
+)
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def current_span() -> Optional["Span"]:
+    return _current.get()
+
+
+def current_trace_id() -> Optional[str]:
+    sp = _current.get()
+    return sp.trace_id if sp is not None else None
+
+
+def current_span_id() -> Optional[str]:
+    sp = _current.get()
+    return sp.span_id if sp is not None else None
+
+
+def new_trace() -> str:
+    """A fresh trace id for callers that thread one across process
+    boundaries (e.g. the plugin logs it per Allocate so pod-resources
+    queries can join device ids back to the handler that granted them)."""
+    return _new_id(8)
+
+
+def _block_until_ready(value: Any) -> None:
+    """Fence: block until every device buffer in ``value`` is computed.
+    Lazy jax import; a jax-free process (host daemon) no-ops — nothing
+    host-side dispatches asynchronously."""
+    try:
+        import jax
+    except Exception:
+        return
+    jax.block_until_ready(value)
+
+
+class Span:
+    """One timed region. Mutable while open: ``set()`` attaches attributes,
+    ``fence()`` registers values to block on at exit. Closed spans carry
+    ``duration_s`` and have been emitted to the event sink."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "attrs",
+        "duration_s", "_fence", "_t0", "_token",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        parent: Optional["Span"] = None,
+        trace_id: Optional[str] = None,
+        **attrs,
+    ):
+        self.name = name
+        self.trace_id = (
+            trace_id
+            or (parent.trace_id if parent is not None else None)
+            or _new_id(8)
+        )
+        self.span_id = _new_id(4)
+        self.parent_id = parent.span_id if parent is not None else None
+        self.attrs: dict = dict(attrs)
+        self.duration_s: Optional[float] = None
+        self._fence: list = []
+        self._t0: Optional[float] = None
+        self._token = None
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def fence(self, value: Any) -> Any:
+        """Register ``value`` (any pytree) to ``block_until_ready`` at span
+        exit; returns it unchanged so it drops into expressions."""
+        self._fence.append(value)
+        return value
+
+    def _open(self) -> "Span":
+        self._token = _current.set(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def _close(
+        self, error: Optional[BaseException]
+    ) -> Optional[BaseException]:
+        # Fence BEFORE the end stamp — this ordering is the tracer's whole
+        # reason to exist (async dispatch fakes sub-ms steps otherwise).
+        # block_until_ready surfaces deferred device errors: when the body
+        # succeeded, such an error must propagate (after bookkeeping); when
+        # the body already raised, it must not mask the original.
+        fence_error: Optional[BaseException] = None
+        for value in self._fence:
+            try:
+                _block_until_ready(value)
+            except BaseException as e:
+                fence_error = fence_error or e
+        self.duration_s = time.perf_counter() - (self._t0 or 0.0)
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        payload = dict(self.attrs)
+        error = error or fence_error
+        if error is not None:
+            payload["error"] = f"{type(error).__name__}: {error}"[:200]
+        # Derived throughput: a span that knows its token count reports
+        # tokens/sec itself, so consumers never divide by an unfenced time.
+        tokens = payload.get("tokens")
+        if isinstance(tokens, (int, float)) and self.duration_s > 0:
+            payload["tokens_per_s"] = round(tokens / self.duration_s, 2)
+        events.emit(
+            "span",
+            self.name,
+            trace=self.trace_id,
+            span=self.span_id,
+            parent=self.parent_id,
+            dur_s=round(self.duration_s, 6),
+            **payload,
+        )
+        return fence_error
+
+
+@contextmanager
+def span(
+    name: str,
+    fence: Any = None,
+    trace_id: Optional[str] = None,
+    **attrs,
+):
+    """Open a span named ``name``; see the module docstring for the
+    contract. ``fence`` registers an up-front value (or zero-arg callable
+    resolved at exit) to block on; ``Span.fence()`` registers more from
+    inside the block."""
+    sp = Span(name, parent=_current.get(), trace_id=trace_id, **attrs)
+    sp._open()
+    error: Optional[BaseException] = None
+    try:
+        yield sp
+    except BaseException as e:
+        error = e
+        raise
+    finally:
+        # The up-front fence resolves only on the success path: after a
+        # body exception its value is likely invalid, and an exception
+        # from the resolver would mask the original. A raising resolver
+        # must still not skip _close — the span has to unwind the context
+        # stack and emit, or every later span inherits a dead parent.
+        resolver_error: Optional[BaseException] = None
+        if fence is not None and error is None:
+            try:
+                sp._fence.append(fence() if callable(fence) else fence)
+            except BaseException as e:
+                resolver_error = e
+        fence_error = sp._close(error or resolver_error)
+        if error is None:
+            if resolver_error is not None:
+                raise resolver_error
+            if fence_error is not None:
+                raise fence_error  # deferred device error surfaced by the fence
+
+
+@contextmanager
+def timer(name: str, metric: Any = None, fence: Any = None, **attrs):
+    """Like :func:`span` but also feeds ``metric`` — a prometheus
+    histogram/gauge child (``.observe``/``.set``) or a
+    :class:`..obs.metrics.Rolling` — with the fenced duration."""
+    with span(name, fence=fence, **attrs) as sp:
+        yield sp
+    if metric is not None:
+        observe = getattr(metric, "observe", None) or getattr(
+            metric, "set", None
+        )
+        if observe is not None:
+            observe(sp.duration_s)
+
+
+def traced(
+    name: Optional[str] = None, fence_result: bool = True
+) -> Callable:
+    """Decorator form: the whole call is one span; the return value is
+    fenced before the end stamp unless ``fence_result=False``."""
+
+    def deco(fn: Callable) -> Callable:
+        span_name = name or f"{fn.__module__.rsplit('.', 1)[-1]}.{fn.__qualname__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with span(span_name) as sp:
+                result = fn(*args, **kwargs)
+                if fence_result:
+                    sp.fence(result)
+                return result
+
+        return wrapper
+
+    return deco

@@ -211,9 +211,15 @@ def make_train_step(
     attn_fn: Optional[Callable] = None,
     remat: bool = False,
     accum_steps: int = 1,
+    aux_metrics: bool = False,
 ):
     """Returns (init_state, step). ``step(state, tokens) -> (state, loss)``,
-    jitted over the mesh with donated state.
+    jitted over the mesh with donated state. ``aux_metrics=True`` changes
+    the step contract to ``(state, loss, aux)`` with
+    ``aux = {"grad_norm": global_grad_norm}`` — the shape
+    :func:`.trainer.fit`'s telemetry consumes (ISSUE 2); the norm is one
+    extra fused reduction inside the same executable, negligible next to
+    the backward pass.
 
     ``attn_fn`` defaults by mesh: on a mesh with a ``seq`` axis, ring
     attention over that axis (shard_map composes with the surrounding GSPMD
@@ -319,7 +325,12 @@ def make_train_step(
             loss = l_sum / accum_steps
         updates, new_opt = optimizer.update(grads, state["opt"], state["params"])
         new_params = optax.apply_updates(state["params"], updates)
-        return {"params": new_params, "opt": new_opt, "step": state["step"] + 1}, loss
+        new_state = {
+            "params": new_params, "opt": new_opt, "step": state["step"] + 1
+        }
+        if aux_metrics:
+            return new_state, loss, {"grad_norm": optax.global_norm(grads)}
+        return new_state, loss
 
     return init_state, step
 

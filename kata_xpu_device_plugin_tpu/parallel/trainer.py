@@ -19,11 +19,25 @@ from typing import Any, Callable, Optional
 import jax
 import numpy as np
 
+from .. import obs
 from ..utils import log
 from .checkpoint import TrainCheckpointer
 from .loader import TokenBatchLoader
 
 LOG = log.get("trainer")
+
+# Trainer metrics (ISSUE 2) — created through the idempotent factory, so
+# two fit() calls (or a module reload) share one set of collectors.
+_step_seconds = obs.histogram(
+    "kata_tpu_train_step_seconds", "Optimizer-step wall time (fenced)"
+)
+_loss_gauge = obs.gauge("kata_tpu_train_loss", "Last training loss")
+_tokens_per_s = obs.gauge(
+    "kata_tpu_train_tokens_per_s", "Training throughput, last step"
+)
+_grad_norm_gauge = obs.gauge(
+    "kata_tpu_train_grad_norm", "Global gradient norm, last step"
+)
 
 
 def _loader_state_path(directory: str, step: int) -> str:
@@ -40,11 +54,15 @@ def fit(
     ckpt_every: int = 0,
     log_every: int = 0,
     on_step: Optional[Callable] = None,
+    profiler: Optional[obs.ProfilerHook] = None,
 ) -> tuple[Any, list]:
     """Train for ``steps`` optimizer steps; returns ``(state, losses)``.
 
     ``init_state``/``step_fn`` are :func:`.sharding.make_train_step`'s pair
-    (or any pair of the same shape). With ``ckpt_dir``:
+    (or any pair of the same shape — a ``step_fn`` may also return
+    ``(state, loss, aux)`` with an aux metrics dict, e.g.
+    ``make_train_step(..., aux_metrics=True)``'s grad-norm). With
+    ``ckpt_dir``:
 
     - every ``ckpt_every`` steps the train state is checkpointed (orbax,
       atomic) and the loader cursor written next to it;
@@ -54,9 +72,23 @@ def fit(
 
     ``on_step(step, loss)`` is a host callback (metrics, early stop via
     raising); ``log_every`` emits structured log lines.
+
+    Telemetry (ISSUE 2): with the obs event stream enabled
+    (``KATATPU_OBS=1``), every step runs inside an ``obs.span`` that
+    FENCES on the loss — per-step wall time, loss, tokens/sec and (when
+    the step reports it) grad-norm stream to the JSONL sink and the
+    ``kata_tpu_train_*`` Prometheus metrics, and a compile-vs-execute
+    split is derived from the first step (which pays compilation) vs the
+    steady state. The instrumented path syncs on every step by design —
+    honest step times cost the async pipeline; with obs disabled the loop
+    is byte-for-byte the old async one. ``profiler`` (default: from
+    ``KATATPU_OBS_PROFILE_DIR``) dumps a ``jax.profiler`` trace around
+    the configured step window.
     """
     if ckpt_every and not ckpt_dir:
         raise ValueError("ckpt_every needs ckpt_dir")
+    if profiler is None:
+        profiler = obs.profiler_from_env()
     state = init_state(key if key is not None else jax.random.PRNGKey(0))
 
     ckpt: Optional[TrainCheckpointer] = None
@@ -88,10 +120,23 @@ def fit(
                 "resumed", extra=log.kv(step=latest, dir=ckpt_dir)
             )
 
+    instrument = obs.default_sink() is not None
+    step_durs: list[float] = []
     losses: list = []
     try:
+        if profiler is not None:
+            # Prime with the step we resume from ("step start_step has
+            # completed"): a start_step=1 window starts before the first
+            # executed step, and a resume landing mid-window still opens it.
+            profiler.on_step(start_step)
         for s in range(start_step, steps):
-            state, loss = step_fn(state, next(loader))
+            batch = next(loader)
+            if instrument:
+                state, loss = _instrumented_step(
+                    step_fn, state, batch, s + 1, s == start_step, step_durs
+                )
+            else:
+                state, loss, _aux = _unpack_step(step_fn(state, batch))
             if log_every and (s + 1) % log_every == 0:
                 LOG.info(
                     "step", extra=log.kv(step=s + 1, loss=float(loss))
@@ -99,6 +144,8 @@ def fit(
             if on_step is not None:
                 on_step(s + 1, loss)
             losses.append(loss)
+            if profiler is not None:
+                profiler.on_step(s + 1)
             if ckpt is not None and ckpt_every and (s + 1) % ckpt_every == 0:
                 # Loader cursor FIRST (tiny json), then the state; a kill
                 # between the two leaves the previous step as orbax-latest
@@ -108,15 +155,70 @@ def fit(
                 ckpt.save(s + 1, state)
                 _prune_cursors(ckpt_dir, ckpt.steps())
     finally:
+        if profiler is not None:
+            profiler.stop()
         # on_step may raise to stop early (documented): in-flight async
         # orbax writes must still be finalized or the 'saved' checkpoint
         # is discarded by atomicity and resume falls back further.
         if ckpt is not None:
             ckpt.wait()
             ckpt.close()
+    if instrument and len(step_durs) >= 2:
+        # Compile-vs-execute split: the run's first step pays tracing +
+        # XLA compilation on top of one execution; the steady-state
+        # minimum is the execute-only cost, so the difference estimates
+        # the compile. Derived, not directly measured — labeled as such.
+        steady = min(step_durs[1:])
+        obs.emit(
+            "derived", "train.compile_estimate",
+            dur_s=round(max(0.0, step_durs[0] - steady), 6),
+            first_step_s=round(step_durs[0], 6),
+            steady_step_s=round(steady, 6),
+        )
     # Device scalars → host floats once, at the end (per-step .item() would
     # serialize the async dispatch pipeline).
     return state, [float(np.asarray(l)) for l in losses]
+
+
+def _unpack_step(out) -> tuple[Any, Any, dict]:
+    """Both step contracts: ``(state, loss)`` and ``(state, loss, aux)``."""
+    if len(out) == 3:
+        state, loss, aux = out
+        return state, loss, dict(aux)
+    state, loss = out
+    return state, loss, {}
+
+
+def _instrumented_step(
+    step_fn, state, batch, step_num: int, first: bool, step_durs: list
+):
+    """One step under an ``obs.span`` that fences on the loss (one output
+    of the jitted step executable is ready only when the whole step is —
+    the host transfer IS the fence). Feeds the span, the JSONL sink, and
+    the ``kata_tpu_train_*`` Prometheus collectors."""
+    shape = getattr(batch, "shape", None)
+    tokens = int(np.prod(shape)) if shape else None
+    attrs = {"step": step_num}
+    if tokens:
+        attrs["tokens"] = tokens
+    if first:
+        attrs["includes_compile"] = True
+    with obs.span("train.step", **attrs) as sp:
+        state, loss, aux = _unpack_step(step_fn(state, batch))
+        loss_val = float(np.asarray(loss))  # host transfer == fence
+        sp.set(loss=round(loss_val, 6))
+        grad_norm = aux.get("grad_norm")
+        if grad_norm is not None:
+            grad_norm = float(np.asarray(grad_norm))
+            sp.set(grad_norm=round(grad_norm, 6))
+    step_durs.append(sp.duration_s)
+    _step_seconds.observe(sp.duration_s)
+    _loss_gauge.set(loss_val)
+    if tokens and sp.duration_s > 0:
+        _tokens_per_s.set(tokens / sp.duration_s)
+    if grad_norm is not None:
+        _grad_norm_gauge.set(grad_norm)
+    return state, loss
 
 
 def _prune_cursors(directory: str, live_steps) -> None:

@@ -21,6 +21,7 @@ from typing import Callable, Optional, Protocol, Sequence
 
 import grpc
 
+from .. import obs
 from ..utils import log, metrics
 from .api import deviceplugin_pb2 as pb
 from .api import glue
@@ -319,74 +320,111 @@ class DevicePluginServer(glue.DevicePluginServicer):
             self.state.unsubscribe(q)
 
     def _list_response(self) -> pb.ListAndWatchResponse:
-        devices = self.state.snapshot()
-        resp = pb.ListAndWatchResponse(devices=[d.to_pb() for d in devices])
-        for health in (glue.HEALTHY, glue.UNHEALTHY):
-            metrics.devices_total.labels(resource=self.resource_name, health=health).set(
-                sum(1 for d in devices if d.health == health)
-            )
+        # Snapshot build + gauge refresh latency per stream update — the
+        # device-layer half of the shared telemetry pipeline (ISSUE 2).
+        with obs.timer(
+            "plugin.ListAndWatch_update",
+            metric=metrics.grpc_handler_seconds.labels(
+                method="ListAndWatch_update", resource=self.resource_name
+            ),
+            resource=self.resource_name,
+        ) as sp:
+            devices = self.state.snapshot()
+            resp = pb.ListAndWatchResponse(devices=[d.to_pb() for d in devices])
+            for health in (glue.HEALTHY, glue.UNHEALTHY):
+                metrics.devices_total.labels(resource=self.resource_name, health=health).set(
+                    sum(1 for d in devices if d.health == health)
+                )
+            sp.set(devices=len(devices))
         return resp
 
     def GetPreferredAllocation(self, request, context) -> pb.PreferredAllocationResponse:
         resp = pb.PreferredAllocationResponse()
-        for creq in request.container_requests:
-            try:
-                chosen = self.allocator.preferred(
-                    list(creq.available_device_ids),
-                    list(creq.must_include_device_ids),
-                    creq.allocation_size,
-                )
-            except Exception as e:  # advisory API: degrade, don't fail admission
-                LOG.warning(
-                    "preferred allocation failed",
-                    extra=log.kv(resource=self.resource_name, err=str(e)),
-                )
-                chosen = list(creq.available_device_ids)[: creq.allocation_size]
-            resp.container_responses.add(device_ids=chosen)
+        with obs.timer(
+            "plugin.GetPreferredAllocation",
+            metric=metrics.grpc_handler_seconds.labels(
+                method="GetPreferredAllocation", resource=self.resource_name
+            ),
+            resource=self.resource_name,
+        ):
+            for creq in request.container_requests:
+                try:
+                    chosen = self.allocator.preferred(
+                        list(creq.available_device_ids),
+                        list(creq.must_include_device_ids),
+                        creq.allocation_size,
+                    )
+                except Exception as e:  # advisory API: degrade, don't fail admission
+                    LOG.warning(
+                        "preferred allocation failed",
+                        extra=log.kv(resource=self.resource_name, err=str(e)),
+                    )
+                    chosen = list(creq.available_device_ids)[: creq.allocation_size]
+                resp.container_responses.add(device_ids=chosen)
         return resp
 
     def Allocate(self, request, context) -> pb.AllocateResponse:
         """Validate against live state and answer with CDI references
-        (ref generic_device_plugin.go:320-355)."""
+        (ref generic_device_plugin.go:320-355).
+
+        Telemetry: the whole call runs inside one span whose trace id is
+        carried by every log line it emits (the formatters attach it), so
+        the "allocated" line — and through it the device ids — can be
+        joined to the pod UID the kubelet's pod-resources API later
+        reports for those ids. The AllocateRequest itself carries no pod
+        identity (v1beta1 limitation); the trace id is the join key."""
         resp = pb.AllocateResponse()
-        for creq in request.container_requests:
-            ids = list(creq.device_ids)
-            for dev_id in ids:
-                dev = self.state.get(dev_id)
-                if dev is None:
+        granted: list[str] = []
+        with obs.span(
+            "plugin.Allocate",
+            resource=self.resource_name,
+            containers=len(request.container_requests),
+        ) as sp:
+            for creq in request.container_requests:
+                ids = list(creq.device_ids)
+                for dev_id in ids:
+                    dev = self.state.get(dev_id)
+                    if dev is None:
+                        metrics.allocations_total.labels(
+                            resource=self.resource_name, outcome="unknown_device"
+                        ).inc()
+                        context.abort(
+                            grpc.StatusCode.INVALID_ARGUMENT,
+                            f"unknown device id {dev_id!r} for {self.resource_name}",
+                        )
+                    if dev.health != glue.HEALTHY:
+                        metrics.allocations_total.labels(
+                            resource=self.resource_name, outcome="unhealthy"
+                        ).inc()
+                        context.abort(
+                            grpc.StatusCode.UNAVAILABLE,
+                            f"device {dev_id} of {self.resource_name} is unhealthy",
+                        )
+                try:
+                    cresp = self.allocator.allocate(ids)
+                except AllocationError as e:
                     metrics.allocations_total.labels(
-                        resource=self.resource_name, outcome="unknown_device"
+                        resource=self.resource_name, outcome="rejected"
                     ).inc()
-                    context.abort(
-                        grpc.StatusCode.INVALID_ARGUMENT,
-                        f"unknown device id {dev_id!r} for {self.resource_name}",
-                    )
-                if dev.health != glue.HEALTHY:
-                    metrics.allocations_total.labels(
-                        resource=self.resource_name, outcome="unhealthy"
-                    ).inc()
-                    context.abort(
-                        grpc.StatusCode.UNAVAILABLE,
-                        f"device {dev_id} of {self.resource_name} is unhealthy",
-                    )
-            try:
-                cresp = self.allocator.allocate(ids)
-            except AllocationError as e:
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                resp.container_responses.append(cresp)
                 metrics.allocations_total.labels(
-                    resource=self.resource_name, outcome="rejected"
+                    resource=self.resource_name, outcome="ok"
                 ).inc()
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            resp.container_responses.append(cresp)
-            metrics.allocations_total.labels(
-                resource=self.resource_name, outcome="ok"
-            ).inc()
-            metrics.allocation_chips_total.labels(resource=self.resource_name).inc(len(ids))
-            if self.on_allocate:
-                self.on_allocate(ids)
-            LOG.info(
-                "allocated",
-                extra=log.kv(resource=self.resource_name, devices=",".join(ids)),
-            )
+                metrics.allocation_chips_total.labels(resource=self.resource_name).inc(len(ids))
+                if self.on_allocate:
+                    self.on_allocate(ids)
+                LOG.info(
+                    "allocated",
+                    extra=log.kv(resource=self.resource_name, devices=",".join(ids)),
+                )
+                # Accumulate across containers: the span event is the
+                # device-ids↔pod join record for the WHOLE request.
+                granted.extend(ids)
+                sp.set(devices=",".join(granted))
+        metrics.grpc_handler_seconds.labels(
+            method="Allocate", resource=self.resource_name
+        ).observe(sp.duration_s)
         return resp
 
     def PreStartContainer(self, request, context) -> pb.PreStartContainerResponse:

@@ -2,6 +2,11 @@
 
 The reference mixes stdlib log, klog and bare Println (SURVEY §5); here one
 configured logger tree with either key=value text or JSON lines.
+
+Log lines emitted inside an open ``obs.span`` automatically carry its
+``trace``/``span`` ids (ISSUE 2), so an Allocate handler's "allocated"
+line joins the span event for the same request without the call sites
+threading ids by hand.
 """
 from __future__ import annotations
 
@@ -11,6 +16,20 @@ import sys
 import time
 
 ROOT = "katatpu"
+
+
+def _trace_context() -> dict:
+    """trace/span ids of the innermost open obs span (empty at top level).
+    Imported lazily per record: log must stay importable before (and
+    without) the obs package, and obs.trace itself logs nothing."""
+    try:
+        from ..obs import trace
+    except Exception:
+        return {}
+    tid = trace.current_trace_id()
+    if tid is None:
+        return {}
+    return {"trace": tid, "span": trace.current_span_id()}
 
 
 class _JsonFormatter(logging.Formatter):
@@ -23,6 +42,7 @@ class _JsonFormatter(logging.Formatter):
         }
         if record.exc_info:
             entry["exc"] = self.formatException(record.exc_info)
+        entry.update(_trace_context())
         extra = getattr(record, "kv", None)
         if extra:
             entry.update(extra)
@@ -35,7 +55,8 @@ class _TextFormatter(logging.Formatter):
             f"{self.formatTime(record, '%Y-%m-%dT%H:%M:%S')} "
             f"{record.levelname[0]} {record.name} {record.getMessage()}"
         )
-        extra = getattr(record, "kv", None)
+        extra = dict(_trace_context())
+        extra.update(getattr(record, "kv", None) or {})
         if extra:
             base += " " + " ".join(f"{k}={v}" for k, v in extra.items())
         if record.exc_info:

@@ -1,41 +1,63 @@
-"""Prometheus metrics.
+"""Prometheus metrics for the host daemon.
 
-The reference has Prometheus only as an unused indirect dependency (SURVEY §5
-"no metrics endpoint"); here the daemon exports real counters/gauges on a
-configurable port.
+The reference has Prometheus only as an unused indirect dependency (SURVEY
+§5 "no metrics endpoint"); here the daemon exports real counters/gauges on
+a configurable port.
+
+Since ISSUE 2 these are thin aliases over :mod:`..obs.metrics`'s factory:
+the old module-global ``Counter(...)`` constructors registered directly
+against prometheus's process-global registry, so importing this module
+twice (``importlib.reload``, a second sys.path alias, the plugin tests
+after the serving tests) raised ``Duplicated timeseries in
+CollectorRegistry``. The factory is idempotent — it caches by name and
+adopts collectors the registry already holds — so re-import is safe and
+tests can inject a fresh ``CollectorRegistry`` instead of fighting global
+state. Callers keep the old names (``metrics.allocations_total`` etc.)
+unchanged.
 """
 from __future__ import annotations
 
 from typing import Optional
 
-from prometheus_client import Counter, Gauge, start_http_server
+from ..obs import metrics as obs_metrics
 
 NS = "kata_tpu_device_plugin"
 
-devices_total = Gauge(f"{NS}_devices", "Devices advertised", ["resource", "health"])
-allocations_total = Counter(
+devices_total = obs_metrics.gauge(
+    f"{NS}_devices", "Devices advertised", ["resource", "health"]
+)
+allocations_total = obs_metrics.counter(
     f"{NS}_allocations_total", "Allocate calls served", ["resource", "outcome"]
 )
-allocation_chips_total = Counter(
+allocation_chips_total = obs_metrics.counter(
     f"{NS}_allocation_chips_total", "Chips handed out", ["resource"]
 )
-noncontiguous_allocations_total = Counter(
+noncontiguous_allocations_total = obs_metrics.counter(
     f"{NS}_noncontiguous_preferred_total",
     "Preferred-allocation answers that could not be made ICI-contiguous",
     ["resource"],
 )
-registrations_total = Counter(
+registrations_total = obs_metrics.counter(
     f"{NS}_registrations_total", "Kubelet registrations performed", ["resource"]
 )
-health_transitions_total = Counter(
+health_transitions_total = obs_metrics.counter(
     f"{NS}_health_transitions_total", "Device health transitions", ["resource", "to"]
 )
-rescans_total = Counter(f"{NS}_rescans_total", "Discovery rescans", ["changed"])
+rescans_total = obs_metrics.counter(
+    f"{NS}_rescans_total", "Discovery rescans", ["changed"]
+)
+
+# gRPC handler latency (ISSUE 2): one histogram, labeled by method —
+# Allocate / GetPreferredAllocation / ListAndWatch_update share it.
+grpc_handler_seconds = obs_metrics.histogram(
+    f"{NS}_grpc_handler_seconds",
+    "Device-plugin gRPC handler latency",
+    ["method", "resource"],
+)
 
 
 def serve(port: int) -> Optional[int]:
-    """Start the /metrics HTTP endpoint; 0 disables. Returns the bound port."""
-    if not port:
-        return None
-    start_http_server(port)
-    return port
+    """Start the /metrics HTTP endpoint; 0 disables. Returns the bound
+    port. Idempotent per process (delegates to obs.metrics.serve), so the
+    daemon and a guest GenerationServer can both ask for the endpoint."""
+    return obs_metrics.serve(port)

@@ -85,4 +85,19 @@ outs = serve_batch(served_params, cfg, prompts, max_new_tokens=16,
                    max_batch=2, max_len=64, speculative_k=3, kv_quant=True)
 print(f"served {len(outs)} requests through 2 slots; "
       f"first output: {outs[0].tolist()}")
+
+# Telemetry (docs/observability.md): run with KATATPU_OBS=1 and the whole
+# journey above — train steps, prefills, TTFTs, speculative rounds —
+# lands in one JSONL event stream.
+from kata_xpu_device_plugin_tpu import obs
+
+sink = obs.default_sink()
+if sink is not None:
+    from kata_xpu_device_plugin_tpu.obs import read_events, summarize_phases
+
+    evs = read_events(sink.path)
+    print(f"obs: {sink.emitted} events -> {sink.path}")
+    print(f"obs: train phases {summarize_phases(evs, prefix='train.')}")
+    ttfts = [e["ttft_s"] for e in evs if e["name"] == "ttft"]
+    print(f"obs: {len(ttfts)} TTFTs, max {max(ttfts):.3f}s" if ttfts else "")
 print("demo complete")

@@ -170,8 +170,69 @@ def test_jx004_nested_defs_scored_separately():
 
 
 def test_jx004_out_of_scope_outside_bench():
-    # Timing in ordinary library code is not the bench rule's business.
-    assert check_source(_TIMED_UNFENCED, "kata_xpu_device_plugin_tpu/utils/log.py") == []
+    # Timing in ordinary library code is not the bench rule's business —
+    # since ISSUE 2 it is JX005's (use obs.span/obs.timer), not JX004's.
+    findings = check_source(
+        _TIMED_UNFENCED, "kata_xpu_device_plugin_tpu/utils/log.py"
+    )
+    assert rules_of(findings) == ["JX005"]
+    assert check_source(
+        _TIMED_UNFENCED, "kata_xpu_device_plugin_tpu/utils/log.py",
+        rules=["JX004"],
+    ) == []
+
+
+# ----- JX005: raw timing in library code ------------------------------------
+
+_LIB_PATH = "kata_xpu_device_plugin_tpu/guest/serving.py"
+_OBS_PATH = "kata_xpu_device_plugin_tpu/obs/trace.py"
+
+
+def test_jx005_fires_on_library_timing_window():
+    findings = check_source(_TIMED_UNFENCED, _LIB_PATH)
+    assert rules_of(findings) == ["JX005"]
+
+
+def test_jx005_fires_even_when_fenced():
+    # JX004's escape hatch (a fence) does not apply: library code must use
+    # obs.span/obs.timer so the measurement lands in the pipeline, not a
+    # local variable.
+    assert rules_of(check_source(_TIMED_FENCED, _LIB_PATH)) == ["JX005"]
+    assert rules_of(check_source(_TIMED_TRANSFER_FENCED, _LIB_PATH)) == [
+        "JX005"
+    ]
+
+
+def test_jx005_quiet_on_single_timestamp():
+    # One timer call is a timestamp (e.g. stamping a request's submit
+    # time), not a timing window.
+    src = (
+        "import time\n"
+        "def submit(q, req):\n"
+        "    req.t_submit = time.monotonic()\n"
+        "    q.append(req)\n"
+    )
+    assert check_source(src, _LIB_PATH) == []
+
+
+def test_jx005_out_of_scope_in_obs_and_bench():
+    # obs/ implements the timer — it is the one library place allowed raw
+    # perf_counter pairs; bench files stay under JX004's fence rule.
+    assert check_source(_TIMED_UNFENCED, _OBS_PATH) == []
+    assert rules_of(check_source(_TIMED_UNFENCED, BENCH_PATH)) == ["JX004"]
+    assert check_source(_TIMED_FENCED, BENCH_PATH) == []
+    # ...and plain tools/tests code is neither scope.
+    assert check_source(_TIMED_UNFENCED, "tools/lint/cli.py") == []
+
+
+def test_jx005_respects_pragma():
+    src = _TIMED_UNFENCED.replace(
+        "def run(f, x):",
+        "def run(f, x):  # lint: allow(JX005) wall-clock only, no device work",
+    )
+    # The pragma sits on the function's own line, where the finding anchors.
+    findings = check_source(src, _LIB_PATH)
+    assert findings == []
 
 
 # ----- TS001: non-hermetic tests --------------------------------------------
@@ -240,5 +301,5 @@ def test_cli_list_rules():
         capture_output=True, text=True, cwd="/root/repo",
     )
     assert proc.returncode == 0
-    for rule in ("JX001", "JX002", "JX003", "JX004", "TS001"):
+    for rule in ("JX001", "JX002", "JX003", "JX004", "JX005", "TS001"):
         assert rule in proc.stdout

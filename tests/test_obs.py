@@ -1,0 +1,610 @@
+"""Unit tests for the unified telemetry layer (ISSUE 2): span
+nesting/fencing, registry injection + re-import safety, trainer-step and
+serving emission on tiny CPU models, profiler-hook windowing, and the
+JSONL sink round-trip.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from prometheus_client import REGISTRY, CollectorRegistry, generate_latest
+
+from kata_xpu_device_plugin_tpu import obs
+from kata_xpu_device_plugin_tpu.obs import events as obs_events
+from kata_xpu_device_plugin_tpu.obs import trace as obs_trace
+
+
+@pytest.fixture
+def sink(tmp_path):
+    """A fresh default sink writing under tmp_path; restores the previous
+    default afterwards (the default is process state)."""
+    path = str(tmp_path / "events.jsonl")
+    s = obs.EventSink(path)
+    prev = obs.set_default_sink(s)
+    yield s, path
+    s.close()
+    obs.set_default_sink(prev)
+
+
+def read(path):
+    return obs.read_events(path)
+
+
+# ----- spans: nesting, fencing, decorator -----------------------------------
+
+
+def test_span_nesting_ids_and_parents(sink):
+    s, path = sink
+    with obs.span("outer") as o:
+        assert obs_trace.current_span_id() == o.span_id
+        with obs.span("inner") as i:
+            assert i.trace_id == o.trace_id  # one trace
+            assert i.parent_id == o.span_id
+            assert obs_trace.current_span_id() == i.span_id
+        assert obs_trace.current_span_id() == o.span_id
+    assert obs_trace.current_span_id() is None
+    evs = {e["name"]: e for e in read(path)}
+    # inner closes first; both carry the shared trace and the link.
+    assert evs["inner"]["parent"] == evs["outer"]["span"]
+    assert evs["inner"]["trace"] == evs["outer"]["trace"]
+    assert evs["outer"]["parent"] is None
+    assert evs["outer"]["dur_s"] >= evs["inner"]["dur_s"] >= 0
+
+
+def test_span_fences_registered_values(sink, monkeypatch):
+    fenced = []
+    monkeypatch.setattr(obs_trace, "_block_until_ready", fenced.append)
+    x = jnp.ones((4,))
+    with obs.span("work") as sp:
+        assert sp.fence(x) is x  # pass-through for expression use
+    with obs.span("arg-form", fence=lambda: "late"):
+        pass
+    assert fenced == [x, "late"]
+
+
+def test_span_fence_real_jax_value(sink):
+    # End to end with the real fence: a jitted result registered via
+    # fence() must not error, and the duration is recorded after the wait.
+    with obs.span("jit") as sp:
+        y = jax.jit(lambda a: a * 2)(jnp.arange(8))
+        sp.fence(y)
+    assert sp.duration_s > 0
+
+
+def test_span_fence_error_surfaces_without_masking(sink, monkeypatch):
+    s, path = sink
+
+    def explode(_value):
+        raise RuntimeError("deferred device error")
+
+    monkeypatch.setattr(obs_trace, "_block_until_ready", explode)
+    # Success-path body: the fence's deferred error must propagate (after
+    # the span's bookkeeping — the event is still emitted and the stack
+    # unwound).
+    with pytest.raises(RuntimeError, match="deferred device error"):
+        with obs.span("fenced") as sp:
+            sp.fence(jnp.ones(2))
+    assert obs_trace.current_span_id() is None
+    # Failing body: the body's exception wins; the fence error must not
+    # mask it (and the up-front fence callable is not even resolved).
+    with pytest.raises(ValueError, match="body wins"):
+        with obs.span("both", fence=lambda: 1 / 0):
+            raise ValueError("body wins")
+    evs = {e["name"]: e for e in read(path)}
+    assert evs["fenced"]["error"].startswith("RuntimeError")
+    assert evs["both"]["error"].startswith("ValueError")
+
+
+def test_span_fence_resolver_error_still_closes_span(sink):
+    s, path = sink
+    # A raising up-front fence RESOLVER must surface its error AND still
+    # close the span (context unwound, event emitted) — a dead span left
+    # installed would corrupt every later span's parent/trace.
+    with pytest.raises(ZeroDivisionError):
+        with obs.span("resolver-fails", fence=lambda: 1 / 0):
+            pass
+    assert obs_trace.current_span_id() is None
+    (ev,) = read(path)
+    assert ev["name"] == "resolver-fails"
+    assert ev["error"].startswith("ZeroDivisionError")
+    # ...and a clean nested span afterwards starts a fresh trace.
+    with obs.span("after") as sp:
+        assert sp.parent_id is None
+
+
+def test_span_error_recorded_and_reraised(sink):
+    s, path = sink
+    with pytest.raises(ValueError, match="boom"):
+        with obs.span("fails"):
+            raise ValueError("boom")
+    (ev,) = read(path)
+    assert ev["error"].startswith("ValueError: boom")
+    assert obs_trace.current_span_id() is None  # stack unwound
+
+
+def test_span_tokens_per_s_derived(sink):
+    import time as _time
+
+    s, path = sink
+    with obs.span("step", tokens=1000):
+        _time.sleep(0.02)  # dwarf the 1µs dur_s rounding granularity
+    (ev,) = read(path)
+    assert ev["tokens"] == 1000
+    assert ev["tokens_per_s"] == pytest.approx(1000 / ev["dur_s"], rel=0.05)
+
+
+def test_traced_decorator(sink):
+    s, path = sink
+
+    @obs.traced()
+    def double(a):
+        return a * 2
+
+    out = double(jnp.arange(4))
+    np.testing.assert_array_equal(np.asarray(out), [0, 2, 4, 6])
+    (ev,) = read(path)
+    assert ev["name"].endswith("double")
+
+
+def test_timer_feeds_metric(sink):
+    rolling = obs.Rolling()
+    with obs.timer("t", metric=rolling):
+        pass
+    with obs.timer("t", metric=rolling):
+        pass
+    summ = rolling.summary()
+    assert summ["count"] == 2
+    assert summ["min"] <= summ["p50"] <= summ["max"]
+
+
+def test_disabled_sink_is_noop(tmp_path):
+    prev = obs.set_default_sink(None)
+    try:
+        with obs.span("quiet") as sp:
+            pass
+        assert sp.duration_s is not None  # still timed, just not emitted
+        assert obs.emit("x", "y") is None
+    finally:
+        obs.set_default_sink(prev)
+
+
+# ----- metrics registry ------------------------------------------------------
+
+
+def test_registry_injection_and_idempotence():
+    reg = obs.MetricsRegistry(CollectorRegistry())
+    c1 = reg.counter("things_total", "Things", ["kind"])
+    c2 = reg.counter("things_total", "Things", ["kind"])
+    assert c1 is c2
+    c1.labels(kind="a").inc(3)
+    text = generate_latest(reg.registry).decode()
+    assert 'things_total{kind="a"} 3.0' in text
+
+
+def test_registry_adopts_after_cache_loss():
+    # A NEW MetricsRegistry over the same CollectorRegistry (the reload
+    # scenario: module cache gone, prometheus registry persists) must
+    # adopt, not re-register.
+    prom = CollectorRegistry()
+    a = obs.MetricsRegistry(prom).counter("x_total", "d")
+    b = obs.MetricsRegistry(prom).counter("x_total", "d")
+    assert a is b
+    g1 = obs.MetricsRegistry(prom).gauge("g", "d", ["l"])
+    g2 = obs.MetricsRegistry(prom).gauge("g", "d", ["l"])
+    assert g1 is g2
+
+
+def test_registry_type_and_label_mismatch_raises():
+    reg = obs.MetricsRegistry(CollectorRegistry())
+    reg.counter("m_total", "d", ["a"])
+    with pytest.raises(ValueError, match="already exists"):
+        reg.gauge("m_total", "d", ["a"])
+    with pytest.raises(ValueError, match="already exists"):
+        reg.counter("m_total", "d", ["b"])
+
+
+def test_utils_metrics_reimport_safe():
+    """The satellite bug: importing utils.metrics twice (or after any other
+    module registered the same names) used to raise Duplicated timeseries."""
+    from kata_xpu_device_plugin_tpu.utils import metrics as um
+
+    before = um.allocations_total
+    um2 = importlib.reload(um)
+    assert um2.allocations_total is before  # adopted, not re-registered
+    importlib.reload(um2)  # and again, for good measure
+
+
+def test_rolling_summary_quantiles():
+    r = obs.Rolling(keep=100)
+    for v in range(1, 101):
+        r.observe(v / 100)
+    s = r.summary()
+    assert s["count"] == 100
+    assert s["min"] == 0.01 and s["max"] == 1.0
+    assert 0.45 <= s["p50"] <= 0.55
+    assert 0.90 <= s["p95"] <= 1.0
+    assert obs.Rolling().summary() == {"count": 0}
+
+
+# ----- trainer emission ------------------------------------------------------
+
+
+def test_trainer_step_metrics_on_tiny_model(sink):
+    s, path = sink
+    from kata_xpu_device_plugin_tpu.models import llama3_train_test
+    from kata_xpu_device_plugin_tpu.parallel import (
+        build_mesh,
+        fit,
+        make_loader,
+        make_train_step,
+    )
+
+    cfg = llama3_train_test()
+    mesh = build_mesh({"data": 2, "fsdp": 2, "model": 2})
+    init_state, step = make_train_step(cfg, mesh, aux_metrics=True)
+    loader = make_loader(
+        np.arange(4096, dtype=np.int32) % cfg.vocab_size,
+        batch=8, seq_len=31, mesh=mesh, seed=5,
+    )
+    state, losses = fit(init_state, step, loader, steps=3,
+                        key=jax.random.PRNGKey(0))
+    assert len(losses) == 3
+
+    evs = read(path)
+    steps = [e for e in evs if e["name"] == "train.step"]
+    assert len(steps) == 3
+    assert steps[0]["includes_compile"] is True
+    assert "includes_compile" not in steps[1]
+    for i, ev in enumerate(steps):
+        assert ev["step"] == i + 1
+        assert ev["tokens"] == 8 * 32  # batch × (seq_len + 1) token window
+        assert ev["tokens_per_s"] > 0
+        assert np.isfinite(ev["loss"])
+        assert ev["grad_norm"] > 0  # aux_metrics contract
+        assert ev["dur_s"] > 0
+    # losses in events must equal fit()'s returned series.
+    np.testing.assert_allclose([e["loss"] for e in steps], losses, rtol=1e-5)
+
+    (est,) = [e for e in evs if e["name"] == "train.compile_estimate"]
+    assert est["first_step_s"] >= est["steady_step_s"] > 0
+    assert est["dur_s"] == pytest.approx(
+        est["first_step_s"] - est["steady_step_s"], abs=1e-5
+    )
+
+    # Prometheus side: the gauges/histogram carry the last step.
+    text = generate_latest(REGISTRY).decode()
+    assert "kata_tpu_train_step_seconds_bucket" in text
+    assert f"kata_tpu_train_loss {losses[-1]}" in text
+
+
+def test_trainer_uninstrumented_path_unchanged():
+    """With no sink, fit() must not emit, sync per step, or alter the
+    (state, loss) contract — including the 3-tuple aux form."""
+    from kata_xpu_device_plugin_tpu.parallel.trainer import _unpack_step
+
+    assert _unpack_step(("s", 1.0)) == ("s", 1.0, {})
+    assert _unpack_step(("s", 1.0, {"grad_norm": 2.0})) == (
+        "s", 1.0, {"grad_norm": 2.0}
+    )
+
+
+# ----- serving emission ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from kata_xpu_device_plugin_tpu.models import tiny_test_config
+    from kata_xpu_device_plugin_tpu.models.transformer import init_params
+
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _submit_prompts(srv, cfg, lengths, budget=8, seed=9):
+    key = jax.random.PRNGKey(seed)
+    return [
+        srv.submit(
+            np.asarray(
+                jax.random.randint(
+                    jax.random.fold_in(key, i), (n,), 0, cfg.vocab_size
+                ),
+                np.int32,
+            ),
+            budget,
+        )
+        for i, n in enumerate(lengths)
+    ]
+
+
+def test_serving_ttft_and_queue_metrics(sink, tiny_model):
+    s, path = sink
+    from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+
+    cfg, params = tiny_model
+    srv = GenerationServer(params, cfg, max_batch=2, max_len=32, chunk=4)
+    rids = _submit_prompts(srv, cfg, [4, 7, 5, 6])  # queue pressure: 4 → 2 slots
+    results = srv.run()
+    assert set(results) == set(rids)
+
+    st = srv.stats()
+    assert st["ttft_s"]["count"] == 4  # one TTFT per request
+    assert st["ttft_s"]["min"] > 0
+    assert st["decode_token_s"]["count"] == st["rounds"]
+    assert st["decode_token_s"]["mean"] > 0
+    assert st["batch_occupancy"] == 0.0 and st["kv_slot_utilization"] == 0.0
+
+    evs = read(path)
+    ttfts = [e for e in evs if e["name"] == "ttft"]
+    assert len(ttfts) == 4
+    # The 3rd/4th requests waited in the queue — their events say so.
+    assert any(e["queued"] > 0 for e in ttfts)
+    chunks = [e for e in evs if e["name"] == "serving.decode_chunk"]
+    assert len(chunks) == st["rounds"]
+    for c in chunks:
+        assert c["slots_busy"] >= 1
+        assert 0 < c["batch_occupancy"] <= 1.0
+        assert c["tokens"] == c["slots_busy"] * 4  # chunk=4
+    prefills = [e for e in evs if e["name"] == "serving.prefill"]
+    assert len(prefills) == 4
+
+
+def test_serving_stats_snapshot_semantics(tiny_model):
+    """stats() is a cumulative SNAPSHOT: calling it never resets anything,
+    and counters keep growing across successive run() batches."""
+    from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+
+    cfg, params = tiny_model
+    srv = GenerationServer(params, cfg, max_batch=2, max_len=32)
+    _submit_prompts(srv, cfg, [4, 6], budget=5)
+    srv.run()
+    st1 = srv.stats()
+    assert srv.stats() == st1  # idle snapshot is stable
+    _submit_prompts(srv, cfg, [5], budget=5, seed=10)
+    srv.run()
+    st2 = srv.stats()
+    assert st2["prefills"] == st1["prefills"] + 1
+    assert st2["tokens_emitted"] > st1["tokens_emitted"]
+    assert st2["ttft_s"]["count"] == st1["ttft_s"]["count"] + 1
+
+
+def test_serving_speculative_round_events(sink, tiny_model):
+    s, path = sink
+    from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+
+    cfg, params = tiny_model
+    rep = np.tile(np.array([5, 17], np.int32), 6)
+    srv = GenerationServer(params, cfg, max_batch=1, max_len=40,
+                           speculative_k=3)
+    srv.submit(rep, max_new_tokens=10)
+    srv.run()
+    rounds = [e for e in read(path) if e["name"] == "spec_round"]
+    assert rounds and all(r["accepted"] >= 1 for r in rounds)
+    assert all(r["offered"] == 3 for r in rounds)
+    assert srv.stats()["decode_token_s"]["count"] == len(rounds)
+
+
+def test_serving_histograms_exported(tiny_model):
+    from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+
+    cfg, params = tiny_model
+    srv = GenerationServer(params, cfg, max_batch=1, max_len=32)
+    lbl = srv.export_metrics()
+    _submit_prompts(srv, cfg, [5], budget=6, seed=12)
+    srv.run()
+    text = generate_latest(REGISTRY).decode()
+    assert f'kata_tpu_serving_ttft_seconds_count{{server="{lbl}"}} 1.0' in text
+    assert "kata_tpu_serving_decode_token_seconds_bucket" in text
+    # New occupancy gauges ride the same scrape.
+    assert f'kata_tpu_serving_batch_occupancy{{server="{lbl}"}}' in text
+    assert f'kata_tpu_serving_kv_slot_utilization{{server="{lbl}"}}' in text
+
+
+# ----- JSONL sink ------------------------------------------------------------
+
+
+def test_event_sink_round_trip(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    with obs.EventSink(path, clock=lambda: 123.0) as s:
+        s.emit("span", "a", dur_s=0.5, n=1)
+        s.emit("serving", "ttft", ttft_s=0.01, arr=np.int32(7))
+    evs = read(path)
+    assert evs == [
+        {"ts": 123.0, "kind": "span", "name": "a", "dur_s": 0.5, "n": 1},
+        {"ts": 123.0, "kind": "serving", "name": "ttft", "ttft_s": 0.01,
+         "arr": 7},  # numpy scalars serialize as plain numbers
+    ]
+    assert s.emitted == 2
+
+
+def test_event_sink_appends_and_tolerates_torn_line(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    with obs.EventSink(path) as s:
+        s.emit("span", "a")
+    with open(path, "a") as fh:
+        fh.write('{"torn": ')  # killed writer mid-line
+    with obs.EventSink(path) as s2:  # append, not truncate
+        s2.emit("span", "b")
+    names = [e["name"] for e in read(path)]
+    assert names == ["a", "b"]
+
+
+def test_read_events_offset_isolates_a_run(tmp_path):
+    """The bench worker records the pre-run file size and reads from that
+    offset — a pinned KATATPU_OBS_FILE carrying earlier runs' spans must
+    not pollute the new run's phase aggregation."""
+    import os
+
+    path = str(tmp_path / "shared.jsonl")
+    with obs.EventSink(path) as s:
+        s.emit("span", "bench.decode", dur_s=1.0)  # previous run
+    offset = os.path.getsize(path)
+    with obs.EventSink(path) as s2:
+        s2.emit("span", "bench.decode", dur_s=2.0)  # this run
+    assert [e["dur_s"] for e in read(path)] == [1.0, 2.0]
+    this_run = obs.read_events(path, offset=offset)
+    assert [e["dur_s"] for e in this_run] == [2.0]
+    assert obs.summarize_phases(this_run, prefix="bench.")["decode"]["count"] == 1
+
+
+def test_summarize_phases():
+    evs = [
+        {"kind": "span", "name": "bench.decode", "dur_s": 0.2},
+        {"kind": "span", "name": "bench.decode", "dur_s": 0.4},
+        {"kind": "span", "name": "bench.compile", "dur_s": 2.0},
+        {"kind": "span", "name": "serving.prefill", "dur_s": 9.0},  # filtered
+        {"kind": "serving", "name": "bench.decode"},  # not a span
+    ]
+    out = obs.summarize_phases(evs, prefix="bench.")
+    assert set(out) == {"decode", "compile"}
+    assert out["decode"] == {
+        "count": 2, "total_s": 0.6, "min_s": 0.2, "max_s": 0.4, "mean_s": 0.3,
+    }
+    assert out["compile"]["count"] == 1
+
+
+def test_configure_from_env(tmp_path, monkeypatch):
+    path = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv("KATATPU_OBS", "1")
+    monkeypatch.setenv("KATATPU_OBS_FILE", path)
+    assert obs.enabled()
+    prev_sink = obs_events._default if obs_events._configured else None
+    try:
+        s = obs.configure_from_env(force=True)
+        assert s is not None and s.path == path
+        obs.emit("span", "via-env", dur_s=0.1)
+        assert [e["name"] for e in read(path)] == ["via-env"]
+    finally:
+        obs.set_default_sink(prev_sink)
+    monkeypatch.delenv("KATATPU_OBS")
+    assert obs.configure_from_env(force=True) is None
+    obs.set_default_sink(prev_sink)
+
+
+def test_log_records_carry_trace_ids(sink, capsys):
+    import logging
+
+    from kata_xpu_device_plugin_tpu.utils import log
+
+    logger = logging.getLogger(log.ROOT)
+    saved = (logger.level, logger.propagate, list(logger.handlers))
+    log.setup("info", "json")
+    try:
+        with obs.span("handler") as sp:
+            logger.info("inside", extra=log.kv(k="v"))
+        logger.info("outside")
+        err = capsys.readouterr().err.strip().splitlines()
+        inside, outside = (json.loads(line) for line in err[-2:])
+        assert inside["trace"] == sp.trace_id
+        assert inside["span"] == sp.span_id
+        assert inside["k"] == "v"
+        assert "trace" not in outside
+    finally:
+        # setup() reconfigures the process-global "katatpu" logger tree
+        # (propagate=False, stderr handler); restore it or later tests'
+        # caplog (which relies on propagation to root) goes blind.
+        logger.handlers.clear()
+        logger.handlers.extend(saved[2])
+        logger.setLevel(saved[0])
+        logger.propagate = saved[1]
+
+
+# ----- profiler hook ---------------------------------------------------------
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, "start_trace", lambda d: calls.append(("start", d))
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: calls.append(("stop",))
+    )
+    return calls
+
+
+def test_profiler_hook_window(tmp_path, fake_profiler, sink):
+    s, path = sink
+    d = str(tmp_path / "prof")
+    hook = obs.ProfilerHook(d, start_step=2, num_steps=3)
+    for step in range(1, 7):
+        hook.on_step(step)
+    assert fake_profiler == [("start", d), ("stop",)]
+    hook.on_step(1)  # window done: never restarts
+    assert len(fake_profiler) == 2
+    (ev,) = [e for e in read(path) if e["kind"] == "profile"]
+    assert ev["start_step"] == 2 and ev["stop_step"] == 4
+
+
+def test_profiler_hook_start_step_one_and_resume(tmp_path, fake_profiler):
+    # start_step=1: the trainer primes with on_step(resume_step) before the
+    # loop, so the window opens before the first executed step.
+    hook = obs.ProfilerHook(str(tmp_path / "a"), start_step=1, num_steps=2)
+    for step in (0, 1, 2, 3):  # fit() primes with 0, then steps 1..3
+        hook.on_step(step)
+    assert fake_profiler == [("start", str(tmp_path / "a")), ("stop",)]
+    fake_profiler.clear()
+    # Resume landing INSIDE the window [3, 5] still opens it...
+    hook = obs.ProfilerHook(str(tmp_path / "b"), start_step=3, num_steps=3)
+    for step in (4, 5, 6):
+        hook.on_step(step)
+    assert fake_profiler == [("start", str(tmp_path / "b")), ("stop",)]
+    fake_profiler.clear()
+    # ...but a resume already PAST it never starts a partial trace.
+    hook = obs.ProfilerHook(str(tmp_path / "c"), start_step=3, num_steps=3)
+    for step in (7, 8, 9):
+        hook.on_step(step)
+    assert fake_profiler == []
+
+
+def test_profiler_hook_stop_idempotent_and_guarding(tmp_path, fake_profiler):
+    hook = obs.ProfilerHook(str(tmp_path), start_step=1, num_steps=1)
+    hook.stop()  # never started: no-op
+    assert fake_profiler == []
+    with obs.ProfilerHook(str(tmp_path), start_step=1, num_steps=5) as h:
+        h.on_step(0)  # opens at start_step - 1
+        assert fake_profiler[-1][0] == "start"
+    # context exit force-stops a still-open window
+    assert fake_profiler[-1] == ("stop",)
+    with pytest.raises(ValueError):
+        obs.ProfilerHook(str(tmp_path), start_step=0)
+    with pytest.raises(ValueError):
+        obs.ProfilerHook(str(tmp_path), num_steps=0)
+
+
+def test_profiler_from_env(tmp_path, monkeypatch):
+    assert obs.profiler_from_env() is None
+    monkeypatch.setenv("KATATPU_OBS_PROFILE_DIR", str(tmp_path))
+    monkeypatch.setenv("KATATPU_OBS_PROFILE_START", "3")
+    monkeypatch.setenv("KATATPU_OBS_PROFILE_STEPS", "2")
+    hook = obs.profiler_from_env()
+    assert hook.profile_dir == str(tmp_path)
+    assert hook.start_step == 3 and hook.stop_after == 4
+
+
+def test_fit_drives_profiler(tmp_path, fake_profiler, sink):
+    from kata_xpu_device_plugin_tpu.models import llama3_train_test
+    from kata_xpu_device_plugin_tpu.parallel import (
+        build_mesh,
+        fit,
+        make_loader,
+        make_train_step,
+    )
+
+    cfg = llama3_train_test()
+    mesh = build_mesh({"data": 2, "fsdp": 2, "model": 2})
+    init_state, step = make_train_step(cfg, mesh)
+    loader = make_loader(
+        np.arange(4096, dtype=np.int32) % cfg.vocab_size,
+        batch=8, seq_len=31, mesh=mesh, seed=3,
+    )
+    hook = obs.ProfilerHook(str(tmp_path / "p"), start_step=2, num_steps=1)
+    fit(init_state, step, loader, steps=3, key=jax.random.PRNGKey(1),
+        profiler=hook)
+    assert fake_profiler == [("start", str(tmp_path / "p")), ("stop",)]

@@ -130,6 +130,53 @@ def test_allocate_cdi_cri(manager, kubelet):
         assert cresp.envs[C.ENV_TPU_VISIBLE_CHIPS] == "0,1,2,3"
 
 
+def test_allocate_telemetry_span_and_latency(manager, kubelet, tmp_path):
+    """ISSUE 2: an Allocate call emits one span event (trace id, device
+    ids) into the JSONL sink and a sample into the gRPC latency histogram;
+    a ListAndWatch update records under its own method label."""
+    from prometheus_client import REGISTRY, generate_latest
+
+    from kata_xpu_device_plugin_tpu import obs
+
+    sink = obs.EventSink(str(tmp_path / "plugin.jsonl"))
+    prev = obs.set_default_sink(sink)
+    try:
+        ch, stub = kubelet.plugin_stub(kubelet.registrations[0].endpoint)
+        with ch:
+            stream = stub.ListAndWatch(pb.Empty())
+            next(stream)
+            stub.Allocate(
+                pb.AllocateRequest(
+                    container_requests=[
+                        pb.ContainerAllocateRequest(device_ids=["0", "1"]),
+                        pb.ContainerAllocateRequest(device_ids=["2", "3"]),
+                    ]
+                )
+            )
+            stream.cancel()
+    finally:
+        sink.close()
+        obs.set_default_sink(prev)
+
+    evs = obs.read_events(str(tmp_path / "plugin.jsonl"))
+    (alloc,) = [e for e in evs if e["name"] == "plugin.Allocate"]
+    # ALL containers' ids — the span is the join record for the whole call.
+    assert alloc["devices"] == "0,1,2,3"
+    assert alloc["containers"] == 2
+    assert alloc["resource"] == "google.com/tpu"
+    assert alloc["trace"] and alloc["span"]  # the log join key
+    assert alloc["dur_s"] > 0
+    updates = [e for e in evs if e["name"] == "plugin.ListAndWatch_update"]
+    assert updates and all(u["devices"] == 8 for u in updates)
+
+    text = generate_latest(REGISTRY).decode()
+    assert (
+        'kata_tpu_device_plugin_grpc_handler_seconds_count'
+        '{method="Allocate",resource="google.com/tpu"}'
+    ) in text
+    assert 'method="ListAndWatch_update"' in text
+
+
 def test_allocate_unknown_and_unhealthy(manager, kubelet, v5e8):
     ch, stub = kubelet.plugin_stub(kubelet.registrations[0].endpoint)
     with ch:

@@ -19,6 +19,13 @@ change away from hitting):
   one function) with no dispatch fence (``block_until_ready``,
   ``device_get``, or an ``np.asarray`` host transfer). Async dispatch means
   such a loop measures Python dispatch, not compute.
+- **JX005** — a raw timing window (two+ timer calls in one function) in
+  LIBRARY code (``kata_xpu_device_plugin_tpu/`` outside ``obs/``). Bench
+  scripts may fence by hand (JX004 checks they do); library code must use
+  ``obs.span``/``obs.timer``, which fence on exit AND emit the measurement
+  into the telemetry pipeline — a fenced-but-unexported timer is a number
+  nobody sees, and an unfenced one is wrong. A single timer call (e.g.
+  stamping a request's submit time) is fine.
 - **TS001** — non-hermetic test patterns in ``tests/``: probing hardcoded
   ``/dev/...`` device nodes (tests must target fake sysfs roots) or
   calling out to the network.
@@ -82,6 +89,7 @@ ALL_RULES = {
     "JX002": "jax.experimental import outside compat/ without a pragma",
     "JX003": "float64 literal/dtype in TPU-path code (silently demoted on TPU)",
     "JX004": "timing loop without a dispatch fence (measures dispatch, not compute)",
+    "JX005": "raw perf_counter timing in library code (use obs.span/obs.timer)",
     "TS001": "non-hermetic test pattern (hardcoded /dev/* probe or network call)",
 }
 
@@ -151,6 +159,10 @@ def _scopes(path: str) -> dict[str, bool]:
         "jx004": base.startswith("bench") or (
             "scripts/" in p and "bench" in base
         ) or ("eval" in base and "scripts/" in p),
+        "jx005": (
+            "kata_xpu_device_plugin_tpu/" in p
+            or p.startswith("kata_xpu_device_plugin_tpu")
+        ) and "/obs/" not in p and not p.startswith("obs/"),
         "ts001": "tests/" in p or p.startswith("tests"),
     }
 
@@ -244,7 +256,7 @@ class _Checker(ast.NodeVisitor):
         self.generic_visit(node)
 
     def _check_timing(self, fn: ast.AST) -> None:
-        if not self.scopes["jx004"]:
+        if not (self.scopes["jx004"] or self.scopes["jx005"]):
             return
         timers = fences = 0
         for sub in _walk_own_body(fn):
@@ -261,7 +273,17 @@ class _Checker(ast.NodeVisitor):
                     timers += 1
                 elif leaf in _TIMING_FENCES:
                     fences += 1
-        if timers >= 2 and fences == 0:
+        if self.scopes["jx005"] and timers >= 2:
+            # Library scope: a hand-rolled timing window is flagged even
+            # when fenced — the measurement belongs in the telemetry
+            # pipeline (obs.span/obs.timer fence AND emit).
+            self._add(
+                fn, "JX005",
+                f"function '{getattr(fn, 'name', '?')}' hand-rolls a "
+                "timing window in library code — use obs.span/obs.timer "
+                "(they fence device dispatch and emit the measurement)",
+            )
+        elif self.scopes["jx004"] and timers >= 2 and fences == 0:
             self._add(
                 fn, "JX004",
                 f"function '{getattr(fn, 'name', '?')}' times a region but "

@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint test test-fast bench bench-watch eval demo dryrun image clean deploy obs-check
+.PHONY: all build proto lint test test-fast bench bench-smoke bench-watch eval demo dryrun image clean deploy obs-check
 
 all: build
 
@@ -56,6 +56,17 @@ test-fast:
 
 bench:
 	$(PY) bench.py
+
+# Harness validation in seconds (ISSUE 3): smoke-tiny shapes on CPU with
+# the persistent compilation cache on and the obs JSONL stream captured —
+# the serving section's overlap-vs-lockstep A/B and the compile/prefill/
+# decode phase breakdown both land in the emitted line; CI uploads
+# bench_smoke_events.jsonl next to the tier-1 timing artifact. The number
+# printed is NOT the headline metric.
+bench-smoke:
+	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=bench_smoke_events.jsonl \
+	KATA_TPU_COMPILE_CACHE_DIR=$${KATA_TPU_COMPILE_CACHE_DIR:-.cache/xla-compile} \
+	  $(PY) bench.py --smoke
 
 # Opportunistic TPU bench: probe the tunnel every few minutes and run the
 # full bench on the first healthy probe, banking a dated committed JSON

@@ -98,7 +98,15 @@ MAX_ATTEMPTS = int(os.environ.get("KATA_TPU_BENCH_ATTEMPTS", "3"))
 # train runs LAST, so a mid-train kill still lands everything else.
 ATTEMPT_TIMEOUT_S = int(os.environ.get("KATA_TPU_BENCH_ATTEMPT_TIMEOUT_S", "1080"))
 SMOKE_TIMEOUT_S = int(os.environ.get("KATA_TPU_BENCH_SMOKE_TIMEOUT_S", "300"))
-PROBE_TIMEOUT_S = int(os.environ.get("KATA_TPU_BENCH_PROBE_TIMEOUT_S", "90"))
+# Probe timeout: KATATPU_BENCH_PROBE_TIMEOUT is the documented knob (the
+# obs-env spelling); the legacy KATA_TPU_BENCH_PROBE_TIMEOUT_S name keeps
+# working. The last 10 BENCH_TPU runs all died on "probe: hung" at the
+# default — operators need to shorten it (fail fast to the CPU fallback)
+# without editing the bench.
+PROBE_TIMEOUT_S = int(
+    os.environ.get("KATATPU_BENCH_PROBE_TIMEOUT")
+    or os.environ.get("KATA_TPU_BENCH_PROBE_TIMEOUT_S", "90")
+)
 # Hard ceiling on EVERYTHING the supervisor does (probe + attempts +
 # fallback). 23 min keeps the worst case inside the driver's budget with
 # margin. Cost model (r5, measured): headline ~3 min cold; +int8/serving/
@@ -150,8 +158,17 @@ def probe_tunnel(deadline: float,
         out, _ = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         proc.kill()
-        proc.communicate()
-        return False, True, f"probe: hung (killed after {timeout:.0f}s)"
+        # The probe's stderr is merged into stdout — whatever the killed
+        # interpreter managed to print (a PJRT handshake line, a tunnel
+        # error) is the only post-mortem evidence of WHERE it wedged, so
+        # the tail rides into the result line's error field instead of
+        # being dropped on the floor.
+        out, _ = proc.communicate()
+        tail = _tail(out)
+        return False, True, (
+            f"probe: hung (killed after {timeout:.0f}s)"
+            + (f", tail={tail}" if tail else "")
+        )
     if proc.returncode == 0 and "probe-ok tpu" in (out or ""):
         return True, False, ""
     if proc.returncode == 0 and "probe-ok" in (out or ""):
@@ -167,6 +184,8 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
         worker_cmd += ["--profile-dir", args.profile_dir]
     if args.smoke:
         worker_cmd += ["--smoke"]
+    if args.no_overlap:
+        worker_cmd += ["--no-overlap"]
 
     errors: list[str] = []
 
@@ -379,6 +398,17 @@ def worker(args: argparse.Namespace) -> None:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
+
+    # Persistent compilation cache (ISSUE 3): the per-executable compile
+    # cost the phase breakdown keeps showing is paid once per MACHINE —
+    # the second worker process (a retry, the next round's run) loads the
+    # compiled binaries instead of rebuilding them. Best-effort: an
+    # unwritable cache dir degrades to the old always-compile behavior.
+    from kata_xpu_device_plugin_tpu.compat.jaxapi import (
+        enable_compilation_cache,
+    )
+
+    compile_cache_dir = enable_compilation_cache()
 
     devs = jax.devices()
     if not devs:
@@ -637,27 +667,40 @@ def worker(args: argparse.Namespace) -> None:
 
     def measure_serving() -> dict:  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
         # Continuous-batching throughput (guest/serving.py): 16 mixed-length
-        # requests through an 8-slot arena. A SIDE measurement with the same
-        # protections as int8: runs after the banked headline line, crashes
-        # report as serving_error, KATA_TPU_BENCH_SERVING=0 disables.
-        if args.smoke or os.environ.get("KATA_TPU_BENCH_SERVING", "1") == "0":
+        # requests through an 8-slot arena, measured OVERLAPPED (the
+        # pipelined default) and LOCK-STEP (--no-overlap's config) so the
+        # decode tok/s and TTFT delta of the pipeline lands in the result
+        # line (ISSUE 3 acceptance). Runs in smoke mode too — tiny shapes
+        # are exactly where the host-side scheduling gap the overlap hides
+        # is widest. A SIDE measurement with the same protections as int8:
+        # after the banked headline line, crashes report as serving_error,
+        # KATA_TPU_BENCH_SERVING=0 disables.
+        if os.environ.get("KATA_TPU_BENCH_SERVING", "1") == "0":
             return {}
         try:
             from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
 
-            def make_server():
+            # Smoke keeps the full 64-token budgets but halves the chunk:
+            # a pipeline only has rounds to overlap when each request
+            # spans several chunks (budget == chunk degenerates to
+            # lock-step by the dispatch gate's design).
+            srv_chunk = 8 if args.smoke else 16
+
+            def make_server(overlap):
                 return GenerationServer(
                     params, cfg, max_batch=BATCH, max_len=PROMPT_LEN + 72,
-                    chunk=16, prefill_buckets=(PROMPT_LEN,),
+                    chunk=srv_chunk, prefill_buckets=(PROMPT_LEN,),
+                    overlap=overlap,
                 )
 
             rng = jax.random.PRNGKey(42)
             new_per_req = 64
+            len_step = max(1, PROMPT_LEN // 8)  # smoke-safe mixed lengths
 
             def reqs(srv, count, salt=0):
                 out = []
                 for i in range(count):
-                    n = PROMPT_LEN - (i % 4) * 16  # mixed lengths, one bucket
+                    n = PROMPT_LEN - (i % 4) * len_step  # mixed, one bucket
                     p = jax.random.randint(
                         jax.random.fold_in(rng, salt + i), (n,), 0,
                         cfg.vocab_size, dtype=jnp.int32,
@@ -665,27 +708,64 @@ def worker(args: argparse.Namespace) -> None:
                     out.append(srv.submit(np.asarray(p), new_per_req))
                 return out
 
-            # Warm-up server: same shapes → the timed run reuses the
+            # Warm-up server: same shapes → the timed runs reuse the
             # compiled prefill/decode/_write_slot executables (every other
             # measurement here excludes compiles; this one must too). The
             # warm-up PROMPT differs (salt) so the remote tunnel's
             # identical-execution cache cannot serve the timed request.
-            warm = make_server()
-            reqs(warm, 1, salt=1000)
+            # Full queue-pressure warm-up (2×BATCH requests through the
+            # overlapped server): one pass compiles the whole executable
+            # family — the [N, bucket] batched-admission prefill, the
+            # single-row refill prefill, _write_slot(s), the decode chunk,
+            # and the overlap path's row merge — so neither A/B side pays
+            # a compile inside its timed window.
+            warm = make_server(overlap=True)
+            reqs(warm, 2 * BATCH, salt=1000)
             warm.run()
 
-            srv = make_server()
-            rids = reqs(srv, 2 * BATCH)
-            t0 = time.perf_counter()
-            results = srv.run()
-            dt_s = time.perf_counter() - t0
-            total = sum(len(results[r]) for r in rids)
+            def timed_run(overlap, salt):  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
+                # Best-of-3 like the headline: one serving run is ~tens of
+                # ms at smoke shapes, well inside scheduler-noise range,
+                # and the A/B delta is the whole point of the section.
+                best = None
+                for trial in range(3):
+                    srv = make_server(overlap)
+                    rids = reqs(srv, 2 * BATCH, salt=salt + trial)
+                    t0 = time.perf_counter()
+                    results = srv.run()
+                    dt_s = time.perf_counter() - t0
+                    total = sum(len(results[r]) for r in rids)
+                    ttft = (srv.stats()["ttft_s"] or {}).get("mean", 0.0)
+                    if best is None or dt_s < best[1]:
+                        best = (total, dt_s, ttft, len(rids))
+                return best
+
+            overlap_on = not args.no_overlap
+            total, dt_s, ttft_mean, n_req = timed_run(overlap_on, salt=0)
             out = {
                 "serving_tok_per_s": round(total / dt_s, 1),
-                "serving_requests": len(rids),
+                "serving_requests": n_req,
                 "serving_s": round(dt_s, 3),
+                "serving_ttft_mean_s": round(ttft_mean, 4),
+                "serving_overlap": overlap_on,
             }
-            if os.environ.get("KATA_TPU_BENCH_SPEC", "1") == "1":
+            if overlap_on:
+                # A/B inside one worker: the same traffic through the
+                # lock-step loop — the tok/s and TTFT deltas the pipeline
+                # is worth on this platform. (--no-overlap instead makes
+                # lock-step the PRIMARY config, for two-run A/Bs.)
+                nv_total, nv_dt, nv_ttft, _ = timed_run(False, salt=5000)
+                out.update({
+                    "serving_noverlap_tok_per_s": round(nv_total / nv_dt, 1),
+                    "serving_noverlap_s": round(nv_dt, 3),
+                    "serving_noverlap_ttft_mean_s": round(nv_ttft, 4),
+                    "serving_overlap_speedup": round(
+                        (total / dt_s) / (nv_total / nv_dt), 3
+                    ),
+                })
+            # Speculative sub-section: skipped in smoke (the A/B above is
+            # the smoke payload; spec warms a second executable family).
+            if not args.smoke and os.environ.get("KATA_TPU_BENCH_SPEC", "1") == "1":
                 # Draft-model speculative serving: a depth-truncated
                 # self-draft (zero extra weights to load) through the same
                 # arena; reports throughput AND the acceptance rate — the
@@ -837,6 +917,7 @@ def worker(args: argparse.Namespace) -> None:
         "vs_baseline": round(tok_per_s / roofline_tok_s, 4),
         "phases": phases,
         "obs_events_file": events_path,
+        "compile_cache_dir": compile_cache_dir,
         "platform": devs[0].platform,
         "device_kind": str(getattr(devs[0], "device_kind", "")),
         "config": "smoke-tiny" if args.smoke else "gemma2b",
@@ -892,6 +973,14 @@ def main() -> int:
         action="store_true",
         help="tiny config/shapes: validates the harness end-to-end in seconds "
         "(the number it prints is NOT the headline metric)",
+    )
+    ap.add_argument(
+        "--no-overlap",
+        action="store_true",
+        help="serving section A/B baseline: run the GenerationServer "
+        "lock-step (overlap=False) as the primary serving config instead "
+        "of the pipelined default (a default run already reports both "
+        "sides as serving_* vs serving_noverlap_*)",
     )
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--fallback", action="store_true", help=argparse.SUPPRESS)

@@ -54,6 +54,11 @@ ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
 ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
 ENV_TPU_SKIP_MDS_QUERY = "TPU_SKIP_MDS_QUERY"
 
+# Persistent XLA compilation cache directory handed to the guest (ISSUE 3):
+# compat.jaxapi.enable_compilation_cache reads this env in-guest, so the
+# daemon's --compile-cache-dir knob reaches every allocated workload.
+ENV_COMPILE_CACHE_DIR = "KATA_TPU_COMPILE_CACHE_DIR"
+
 # Default location where containerd/CRI-O pick up CDI spec files
 # (ref pkg/device_plugin/device_plugin.go:20).
 DEFAULT_CDI_DIR = "/var/run/cdi"

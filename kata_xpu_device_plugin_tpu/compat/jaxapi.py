@@ -37,6 +37,7 @@ from __future__ import annotations
 import enum
 import importlib
 import inspect
+import os
 import re
 from typing import Any, Callable, Optional, Sequence
 
@@ -348,6 +349,75 @@ def enable_cpu_multiprocess_collectives(jax_mod: Any) -> bool:
     return True
 
 
+# ----- persistent compilation cache ----------------------------------------
+
+
+def enable_compilation_cache(
+    cache_dir: str = "",
+    jax_mod: Any = None,
+    min_compile_time_s: float = 1.0,
+) -> str:
+    """Switch on JAX's persistent (on-disk) compilation cache, best-effort.
+
+    The multi-second per-executable XLA compile cost (visible in bench.py's
+    compile-phase breakdown) is paid once per MACHINE instead of once per
+    process: compiled executables are keyed by (HLO, compile options,
+    backend) and written under ``cache_dir``, so a fresh interpreter tracing
+    the same program loads the binary instead of recompiling.
+
+    Resolution ladder: explicit ``cache_dir`` argument > env
+    ``KATA_TPU_COMPILE_CACHE_DIR`` > ``~/.cache/kata-tpu/xla-cache``.
+    ``KATA_TPU_COMPILE_CACHE=0`` disables entirely (kill switch for cache
+    corruption or read-only filesystems). Returns the directory in use, or
+    ``""`` when disabled/unsupported — callers never need to branch.
+
+    ``min_compile_time_s`` maps to ``jax_persistent_cache_min_compile_time_secs``
+    (skip caching executables cheaper to rebuild than to read); tests pass 0
+    so tiny CPU executables round-trip. Each config option is applied
+    independently under try/except — on a JAX line missing one knob the
+    others still apply, and a line missing the cache entirely returns ``""``
+    rather than raising (the option set drifted across 0.4.x)."""
+    if os.environ.get("KATA_TPU_COMPILE_CACHE", "").lower() in ("0", "false", "no"):
+        return ""
+    jax_mod = jax_mod if jax_mod is not None else _jax
+    cache_dir = (
+        cache_dir
+        or os.environ.get("KATA_TPU_COMPILE_CACHE_DIR", "")
+        or os.path.join(
+            os.path.expanduser("~"), ".cache", "kata-tpu", "xla-cache"
+        )
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax_mod.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:  # pragma: no cover - unwritable dir / ancient jax
+        return ""
+    for option, value in (
+        ("jax_persistent_cache_min_compile_time_secs", min_compile_time_s),
+        # Cache every size of executable: the default floor exists to bound
+        # metadata churn on shared filesystems; a per-machine local dir has
+        # no such concern and small serving executables add up.
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax_mod.config.update(option, value)
+        except Exception:  # pragma: no cover - knob absent on this line
+            pass
+    # The cache singleton initializes lazily on the FIRST compile and then
+    # memoizes — a process that already compiled anything (a test suite, a
+    # server enabling the cache late) would silently keep running
+    # cache-less. Reset so the new dir takes effect from the next compile.
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - layout drifted on this line
+        pass
+    return cache_dir
+
+
 # ----- tree utilities -------------------------------------------------------
 
 
@@ -420,6 +490,7 @@ __all__ = [
     "axis_size",
     "build_make_mesh",
     "build_shard_map",
+    "enable_compilation_cache",
     "enable_cpu_multiprocess_collectives",
     "make_mesh",
     "normalize_rng_config",

@@ -82,6 +82,18 @@ class Config:
     obs_events_file: str = ""  # JSONL event stream path ("" disables)
     obs_profile_dir: str = ""  # jax.profiler dump dir ("" disables)
 
+    # Persistent XLA compilation cache (ISSUE 3): when set, the daemon
+    # injects KATA_TPU_COMPILE_CACHE_DIR into every TPU AllocateResponse
+    # (plugin/allocators.py), so granted guest workloads point jax's
+    # on-disk executable cache at one per-node directory and the
+    # multi-second per-executable compile is paid once per machine, not
+    # once per process. Guest side, compat.jaxapi.enable_compilation_cache
+    # reads that env directly (bench.py and scripts/ call it on startup;
+    # "" there falls back to ~/.cache/kata-tpu/xla-cache).
+    # KATA_TPU_COMPILE_CACHE=0 is the in-guest kill switch (cache
+    # corruption, read-only fs).
+    compile_cache_dir: str = ""
+
     def __post_init__(self) -> None:
         if not self.kubelet_socket:
             self.kubelet_socket = os.path.join(self.kubelet_socket_dir, "kubelet.sock")

@@ -31,10 +31,26 @@ count is bounded by ``len(buckets)`` only for prompts that fit a bucket;
 longer prompts fall back to exact-length prefill (one executable per
 distinct length), so the largest bucket should be sized to the longest
 expected prompt.
+
+PIPELINED rounds (``overlap=True``, the default): the round loop keeps one
+decode chunk in flight — chunk N+1 is dispatched from chunk N's on-device
+``last``/``pos`` outputs BEFORE chunk N's tokens are inspected, and chunk
+N's token transfer rides an async ``DeviceFence`` copy started at
+dispatch. Host-side scheduling (finish detection, queue refill, telemetry)
+then runs concurrently with device compute instead of serializing with it.
+Greedy output is token-identical to the lock-step loop (tested): each
+request's tokens depend only on its own prefill state and per-slot
+positions, and an admission decided after chunk N simply starts decoding at
+chunk N+2 — a one-round scheduling lag, never a numerics change. Admission
+itself batches: queued requests padding to the same prefill bucket run one
+``[N, bucket]`` forward (``transformer.prefill_batch``) and scatter into
+their slots in one vectorized write, instead of N sequential weight
+streams — the dominant TTFT cost under burst arrival.
 """
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Optional
@@ -53,6 +69,7 @@ from ..models.transformer import (
     init_cycle_kv_caches,
     init_kv_caches,
     prefill,
+    prefill_batch,
     ring_caches_from_prefill,
 )
 
@@ -72,6 +89,7 @@ _PROM_STATS = (
     ("kv_slot_utilization", "Mean busy-slot cache fill (pos / arena len)"),
     ("arena_bytes", "KV arena HBM footprint (addressable shards summed)"),
     ("draft_acceptance", "Speculative draft acceptance rate"),
+    ("prefill_batches", "Multi-request admission prefill forwards"),
 )
 
 
@@ -122,6 +140,23 @@ class _Request:
     done: bool = False
 
 
+@dataclass
+class _Inflight:
+    """One dispatched-but-unretired decode chunk (the pipeline's depth-1
+    slot). ``last``/``pos`` are the chunk's ON-DEVICE outputs — the next
+    chunk dispatches from them directly, no host round-trip; ``fence`` is
+    the async D2H copy of the tokens (and last/pos) started at dispatch.
+    ``slots`` pins (slot, request) pairs at dispatch time: a slot refilled
+    while the chunk was in flight fails the identity check at retire and
+    its stale tokens are discarded."""
+    fence: obs.DeviceFence
+    last: Any  # [B] device int32 — next chunk's tok input
+    pos: Any  # [B] device int32
+    slots: list  # [(slot_index, _Request)] host-known-busy at dispatch
+    span: obs.Span  # detached; ends (fences + emits) at retire
+    t_dispatch: float  # perf_counter at dispatch — round-cadence anchor
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def _write_slot(arena, slot_caches, slot: jax.Array):
     """Copy a freshly prefilled single-sequence cache pair into arena slot
@@ -135,6 +170,28 @@ def _write_slot(arena, slot_caches, slot: jax.Array):
         return jax.lax.dynamic_update_slice(a, c, at)
 
     return jax.tree.map(write, arena, slot_caches)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _write_slots(arena, batch_caches, slots: jax.Array):
+    """Vectorized :func:`_write_slot`: scatter the N cache rows of one
+    batched admission prefill (``prefill_batch`` — leaves ``[L, N, len,
+    ...]``) into arena slots ``slots`` ([N] int32, traced) in ONE
+    executable, instead of N sequential whole-arena update_slices. Same
+    tree-map shape tolerance (bf16 and int8 QTensor q/scale leaves)."""
+    def write(a, c):
+        return a.at[:, slots].set(c)
+
+    return jax.tree.map(write, arena, batch_caches)
+
+
+@jax.jit
+def _merge_rows(dev_vals, host_vals, fresh):
+    """Overlapped dispatch input: the in-flight chunk's on-device
+    ``last``/``pos`` rows, with rows the host refilled since the last
+    dispatch (``fresh`` mask) overridden by their prefill values — the
+    one-round scheduling lag's merge point."""
+    return jnp.where(fresh, host_vals, dev_vals)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "do_sample", "top_k",
@@ -171,6 +228,16 @@ class GenerationServer:
     its own prefill executable — pair ring_kv with a bucket ladder (e.g.
     ``prefill_buckets=(256, 1024, 4096)``) to keep the
     one-executable-per-bucket property the module header promises.
+
+    ``overlap=True`` (default) pipelines the round loop: one decode chunk
+    stays in flight, the next chunk dispatches from its on-device state,
+    and token transfers ride async copies — host scheduling overlaps
+    device compute (see the module header for the token-identity
+    argument). ``overlap=False`` restores the lock-step loop (the A/B
+    baseline ``bench.py --no-overlap`` measures). Speculative serving
+    (``speculative_k``) always runs lock-step: a verify round's inputs are
+    the host-side accept decision of the previous round, so there is no
+    schedule slack to hide transfers in.
     """
 
     def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
@@ -179,7 +246,7 @@ class GenerationServer:
                  top_p: float = 0.0, seed: int = 0, mesh: Any = None,
                  kv_quant: bool = False, prefill_buckets: tuple = (),
                  speculative_k: int = 0, ring_kv: bool = False,
-                 draft: Optional[tuple] = None):
+                 draft: Optional[tuple] = None, overlap: bool = True):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
@@ -269,9 +336,24 @@ class GenerationServer:
         self._slot_req: list[Optional[_Request]] = [None] * max_batch
         self._pos = np.zeros(max_batch, np.int32)
         self._last = np.zeros(max_batch, np.int32)
-        self._queue: list[_Request] = []
+        # deque: admission pops the head every refill — list.pop(0) is O(n)
+        # per admission (O(n²) to drain a burst); popleft keeps FIFO order
+        # at O(1).
+        self._queue: deque[_Request] = deque()
         self._results: dict[int, np.ndarray] = {}
         self._next_rid = 0
+        # Pipelined rounds (overlap=True): the one in-flight chunk, and the
+        # slot rows admission refilled since the last dispatch — their host
+        # prefill values override the in-flight chunk's device rows at the
+        # next dispatch (the one-round scheduling lag's merge point).
+        self.overlap = overlap
+        self._inflight: Optional[_Inflight] = None
+        self._fresh_rows: set[int] = set()
+        self._t_last_retire = 0.0  # round-cadence anchor (perf_counter)
+        # Batched admission runs one [N, bucket] prefill per same-bucket
+        # group — the plain arena only: ring/cycle folds and draft-arena
+        # mirroring are per-request transforms keyed to a scalar position.
+        self._can_batch_prefill = not ring_kv and draft is None
         # Counters for stats(): device rounds dispatched, tokens emitted
         # (pre-trim), speculative drafts offered/accepted. CUMULATIVE over
         # the server's lifetime — run() drains results but never resets
@@ -279,6 +361,7 @@ class GenerationServer:
         self._rounds = 0
         self._emitted = 0
         self._prefills = 0
+        self._batch_prefills = 0
         self._drafts_offered = 0
         self._drafts_accepted = 0
         # Latency summaries (ISSUE 2): host-side Rolling for stats()
@@ -287,6 +370,14 @@ class GenerationServer:
         self._label = f"server{next(GenerationServer._instance_ids)}"
         self._ttft = obs.Rolling()
         self._tok_lat = obs.Rolling()
+        # Labeled histogram children resolved ONCE: registry lookup +
+        # .labels() on every prefill/chunk is pure hot-path overhead —
+        # export_metrics(label=...) re-resolves on rename.
+        self._bind_histograms()
+
+    def _bind_histograms(self) -> None:
+        self._h_ttft = _hist_ttft().labels(server=self._label)
+        self._h_tok_lat = _hist_decode_token().labels(server=self._label)
 
     def _shard_over(self, mesh) -> None:
         """Tensor-parallel serving: place params by their layout-aware
@@ -372,6 +463,7 @@ class GenerationServer:
         out = {
             "rounds": self._rounds,
             "prefills": self._prefills,
+            "prefill_batches": self._batch_prefills,
             "tokens_emitted": self._emitted,  # incl. one prefill token/request
             "tokens_per_round": (
                 round(decoded / self._rounds, 3) if self._rounds else 0.0
@@ -432,6 +524,7 @@ class GenerationServer:
         label."""
         if label:
             self._label = label
+            self._bind_histograms()  # future samples land under the new label
         for name, gauge in _prom_gauges().items():
             gauge.labels(server=self._label).set_function(
                 lambda self=self, n=name: float(self.stats().get(n, 0.0))
@@ -450,14 +543,16 @@ class GenerationServer:
                                jnp.float32(self.temperature), self.top_k,
                                self.top_p)[0])
 
-    def _fill_slot(self, b: int, req: _Request) -> None:
-        """Prefill ``req``'s prompt into arena slot ``b``. With
-        ``prefill_buckets``, the prompt is right-padded up to the smallest
-        bucket that fits — one prefill executable per bucket rather than
-        one per distinct prompt length (exact: see ``transformer.prefill``'s
-        ``true_len``)."""
+    def _fill_slot(self, b: int, req: _Request,
+                   bucket: Optional[int]) -> None:
+        """Prefill ``req``'s prompt into arena slot ``b``. ``bucket`` is
+        the admission pass's already-resolved prefill bucket (None = exact
+        length) — resolved ONCE in :meth:`_admit` so the grouping policy
+        and the executable shape compiled here cannot drift apart. A
+        bucketed prompt is right-padded to it — one prefill executable per
+        bucket rather than one per distinct prompt length (exact: see
+        ``transformer.prefill``'s ``true_len``)."""
         prompt, true_len = req.prompt, len(req.prompt)
-        bucket = next((k for k in self.prefill_buckets if k >= true_len), None)
         if bucket is not None and bucket > true_len:
             prompt = np.pad(prompt, (0, bucket - true_len))
         # ring_kv: prefill into a transient prompt-length cache, then fold
@@ -495,7 +590,7 @@ class GenerationServer:
         # client experiences).
         ttft = time.monotonic() - req.t_submit
         self._ttft.observe(ttft)
-        _hist_ttft().labels(server=self._label).observe(ttft)
+        self._h_ttft.observe(ttft)
         obs.emit(
             "serving", "ttft",
             server=self._label, rid=req.rid, ttft_s=round(ttft, 6),
@@ -516,7 +611,108 @@ class GenerationServer:
         self._slot_req[b] = req
         self._pos[b] = int(pos)
         self._last[b] = first
+        self._fresh_rows.add(b)  # overlap: override the in-flight row
         self._maybe_finish(b, [first])
+
+    def _fill_slots_batched(self, slots: list[int], reqs: list,
+                            pad_len: int) -> None:
+        """Admit N same-bucket requests in ONE ``[N, pad_len]`` prefill
+        forward (``transformer.prefill_batch``) and one vectorized arena
+        scatter (:func:`_write_slots`) — N weight streams collapse to one,
+        the dominant TTFT cost under burst arrival. Exactness is per-row
+        ``true_len`` masking, same as the sequential bucket path."""
+        n = len(reqs)
+        prompts = np.zeros((n, pad_len), np.int32)
+        true_lens = np.array([len(r.prompt) for r in reqs], np.int32)
+        for i, req in enumerate(reqs):
+            prompts[i, : len(req.prompt)] = req.prompt
+        # Span fence: the firsts transfer below depends on every row's
+        # full prefill forward.
+        with obs.span(
+            "serving.prefill_batch",
+            server=self._label, n=n, padded_len=pad_len,
+            tokens=int(true_lens.sum()),
+            rids=[r.rid for r in reqs], slots=list(slots),
+        ):
+            caches, last_logits, pos = prefill_batch(
+                self.params, jnp.asarray(prompts), self.cfg, self.max_len,
+                jnp.asarray(true_lens), kv_quantized=self.kv_quant,
+            )
+            if self._do_sample:
+                self._key, sub = jax.random.split(self._key)
+                firsts = np.asarray(_next_token(
+                    last_logits, sub, True, jnp.float32(self.temperature),
+                    self.top_k, self.top_p,
+                ))
+            else:
+                firsts = np.asarray(jnp.argmax(last_logits, axis=-1))
+        self.arena = _write_slots(
+            self.arena, caches, jnp.asarray(np.asarray(slots, np.int32))
+        )
+        self._batch_prefills += 1
+        now = time.monotonic()  # after the firsts transfer fenced the forward
+        for i, (b, req) in enumerate(zip(slots, reqs)):
+            first = int(firsts[i])
+            req.out.append(first)
+            self._prefills += 1
+            self._emitted += 1
+            ttft = now - req.t_submit
+            self._ttft.observe(ttft)
+            self._h_ttft.observe(ttft)
+            obs.emit(
+                "serving", "ttft",
+                server=self._label, rid=req.rid, ttft_s=round(ttft, 6),
+                prompt_len=int(true_lens[i]), queued=len(self._queue),
+                batched=n,
+            )
+            self._slot_req[b] = req
+            self._pos[b] = int(true_lens[i])
+            self._last[b] = first
+            self._fresh_rows.add(b)
+            self._maybe_finish(b, [first])
+
+    def _admit(self) -> None:
+        """Refill every free slot from the queue (FIFO). The admitted set
+        each pass is the FIFO prefix that fits the free slots — batching
+        only regroups requests WITHIN that prefix by padded length, so
+        fairness is unchanged. Loops because a request can finish during
+        its own prefill (eos / 1-token budget) and the freed slot should be
+        re-offered immediately rather than idling for a whole chunk."""
+        while self._queue:
+            free = [
+                b for b in range(self.max_batch) if self._slot_req[b] is None
+            ]
+            if not free:
+                return
+            take = [
+                self._queue.popleft()
+                for _ in range(min(len(free), len(self._queue)))
+            ]
+            # Group by PADDED length (bucket when one fits, exact length
+            # otherwise): rows of one prefill executable must share a
+            # shape. dict preserves insertion order, so groups stay FIFO.
+            groups: dict[int, list] = {}
+            for req in take:
+                true_len = len(req.prompt)
+                bucket = next(
+                    (k for k in self.prefill_buckets if k >= true_len), None
+                )
+                groups.setdefault(bucket or true_len, []).append(req)
+            it = iter(free)
+            for pad_len, reqs in groups.items():
+                if len(reqs) >= 2 and self._can_batch_prefill:
+                    self._fill_slots_batched(
+                        [next(it) for _ in reqs], reqs, pad_len
+                    )
+                else:
+                    # Recover the group's bucket-vs-exact decision from its
+                    # key: exact-length groups exist only when no bucket
+                    # fit, so a key matching a bucket IS that bucket.
+                    bucket = (
+                        pad_len if pad_len in self.prefill_buckets else None
+                    )
+                    for req in reqs:
+                        self._fill_slot(next(it), req, bucket)
 
     def _maybe_finish(self, b: int, new_tokens: list) -> None:
         req = self._slot_req[b]
@@ -532,15 +728,19 @@ class GenerationServer:
             self._slot_req[b] = None
 
     def step(self) -> bool:
-        """One scheduler round: refill free slots, then one decode chunk.
-        Returns False when queue and slots are both empty."""
-        for b in range(self.max_batch):
-            # Loop, don't just check once: a request can finish during its
-            # own prefill (eos or a 1-token budget on the first token), and
-            # the freed slot should be re-offered to the queue immediately
-            # rather than idling for a whole decode chunk.
-            while self._slot_req[b] is None and self._queue:
-                self._fill_slot(b, self._queue.pop(0))
+        """One scheduler round. Lock-step (``overlap=False`` or
+        speculative): refill free slots, then one fenced decode chunk.
+        Pipelined (default): dispatch the next chunk from the in-flight
+        chunk's device state, THEN retire the in-flight chunk's tokens
+        while the device runs — see :meth:`_step_overlapped`. Returns
+        False when queue, slots, and pipeline are all empty."""
+        if self.overlap and not self.speculative_k:
+            return self._step_overlapped()
+        return self._step_lockstep()
+
+    def _step_lockstep(self) -> bool:
+        self._admit()
+        self._fresh_rows.clear()  # lock-step dispatch reads host rows
         active = [b for b in range(self.max_batch) if self._slot_req[b] is not None]
         if not active:
             return bool(self._queue)
@@ -560,7 +760,7 @@ class GenerationServer:
             if accepted:
                 tok_lat = sp.duration_s / (accepted / len(active))
                 self._tok_lat.observe(tok_lat)
-                _hist_decode_token().labels(server=self._label).observe(tok_lat)
+                self._h_tok_lat.observe(tok_lat)
                 obs.emit(
                     "serving", "spec_round",
                     server=self._label, accepted=accepted,
@@ -596,7 +796,7 @@ class GenerationServer:
         # over the chunk's steps (each step yields one token per slot).
         tok_lat = sp.duration_s / self.chunk
         self._tok_lat.observe(tok_lat)
-        _hist_decode_token().labels(server=self._label).observe(tok_lat)
+        self._h_tok_lat.observe(tok_lat)
         self.arena = caches
         # np.array (not asarray): device arrays convert read-only, and
         # _fill_slot writes these rows in place on refill.
@@ -609,6 +809,144 @@ class GenerationServer:
             self._emitted += len(new)
             self._maybe_finish(b, new)
         return True
+
+    # ----- pipelined rounds (overlap=True) ---------------------------------
+
+    def _step_overlapped(self) -> bool:
+        """One pipelined round. Ordering is the whole point: the NEXT chunk
+        dispatches first — fed by the in-flight chunk's on-device
+        ``last``/``pos`` (no host round-trip), with rows admission refilled
+        since the last dispatch merged in — and only then is the in-flight
+        chunk retired, so finish detection, refill prefills, and telemetry
+        run while the device computes. A chunk dispatched before its
+        predecessor's tokens were inspected may decode garbage rows for
+        requests that turn out to have finished; retire discards those via
+        the slot-identity check, and refill overwrites the whole slot —
+        wasted FLOPs on a dead row, never wrong tokens (the module
+        header's one-round scheduling lag)."""
+        prev, self._inflight = self._inflight, None
+        if prev is None:
+            self._admit()  # pipeline empty: admission feeds this dispatch
+        busy = any(r is not None for r in self._slot_req)
+        if busy and (prev is None or self._any_survives(prev)):
+            if prev is None:
+                last, pos = jnp.asarray(self._last), jnp.asarray(self._pos)
+            elif self._fresh_rows:
+                mask = np.zeros(self.max_batch, np.bool_)
+                mask[list(self._fresh_rows)] = True
+                fresh = jnp.asarray(mask)
+                last = _merge_rows(prev.last, jnp.asarray(self._last), fresh)
+                pos = _merge_rows(prev.pos, jnp.asarray(self._pos), fresh)
+            else:
+                last, pos = prev.last, prev.pos
+            self._fresh_rows.clear()
+            self._dispatch_chunk(last, pos)
+        if prev is not None:
+            self._retire(prev)  # host work overlaps the dispatched chunk
+        return (
+            self._inflight is not None
+            or bool(self._queue)
+            or any(r is not None for r in self._slot_req)
+        )
+
+    def _any_survives(self, prev: _Inflight) -> bool:
+        """Speculative-dispatch gate: is ANY slot certain to still be
+        decoding after the in-flight chunk lands? Budget arithmetic the
+        host already holds answers this exactly in one direction — a slot
+        at ``len(out) + chunk >= max_new_tokens`` is CERTAIN to finish
+        (eos only ever finishes it earlier), so when no slot can survive,
+        dispatching the next chunk would burn a whole provably-dead chunk
+        (the worst case: budgets aligned to chunk boundaries waste 50% of
+        device compute). Skipping costs nothing: the pipeline just drains
+        and the next round dispatches lock-step from host state. The
+        remaining speculation is eos-shaped only — a slot predicted alive
+        may still eos out mid-chunk, wasting its row, never the chunk."""
+        prev_req = dict(prev.slots)
+        for b in range(self.max_batch):
+            req = self._slot_req[b]
+            if req is None:
+                continue
+            if prev_req.get(b) is not req:
+                return True  # refilled since dispatch: untouched budget
+            if len(req.out) + self.chunk < req.max_new_tokens:
+                return True
+        return False
+
+    def _dispatch_chunk(self, last, pos) -> None:
+        """Dispatch one decode chunk without fencing: the arena is donated
+        forward, tokens/last/pos come back as futures, and a DeviceFence
+        starts their async D2H copy so arrival overlaps the next chunk's
+        compute. The detached span ends at retire — ``dispatch_s`` records
+        the host-side dispatch cost, ``dur_s`` the honest dispatch→arrival
+        round time (no forced sync at dispatch)."""
+        active = [(b, self._slot_req[b]) for b in range(self.max_batch)
+                  if self._slot_req[b] is not None]
+        self._key, sub = jax.random.split(self._key)
+        # chunk_tokens, NOT tokens: at steady state this span's dur_s is
+        # the PIPELINE window (≈ two chunk periods — it opens while the
+        # previous chunk still computes), so the tracer's auto-derived
+        # tokens/s over dur_s would understate throughput ~2×. Retire
+        # attaches round_s (retire→retire cadence) and derives the honest
+        # rate from that instead.
+        sp = obs.start_span(
+            "serving.decode_chunk",
+            server=self._label, chunk_tokens=len(active) * self.chunk,
+            slots_busy=len(active), queued=len(self._queue),
+            batch_occupancy=round(len(active) / self.max_batch, 4),
+            overlapped=True,
+        )
+        toks, caches, new_last, new_pos = _serve_decode(
+            self.params, self.arena, last, pos, self.cfg, self.chunk,
+            self._do_sample, self.top_k, jnp.float32(self.temperature), sub,
+            top_p=self.top_p, ring=self.ring_kv,
+        )
+        sp.mark("dispatch")
+        self.arena = caches
+        self._inflight = _Inflight(
+            fence=obs.DeviceFence(toks=toks, last=new_last, pos=new_pos),
+            last=new_last, pos=new_pos, slots=active, span=sp,
+            t_dispatch=time.perf_counter(),
+        )
+
+    def _retire(self, fl: _Inflight) -> None:
+        """Land one in-flight chunk: wait on the async token copy (the
+        honest fence), apply tokens to the requests that still own their
+        slots, then refill freed slots — those prefills affect the chunk
+        after next, and their ``_write_slot`` updates chain behind the
+        already-dispatched chunk's donated arena."""
+        host = fl.fence.wait()
+        # Honest per-token latency under pipelining is the round CADENCE —
+        # retire→retire (one chunk period at steady state), falling back to
+        # this chunk's own dispatch anchor when the pipeline was empty (an
+        # idle gap must not ride into the latency). The span's dur_s stays
+        # the dispatch→arrival pipeline window (≈ two chunk periods when
+        # full): useful as in-flight latency, WRONG as a rate denominator —
+        # which is why the rate metrics divide round_s, and the span
+        # derives tokens_per_s from round_s explicitly.
+        now = time.perf_counter()
+        round_s = now - max(fl.t_dispatch, self._t_last_retire)
+        self._t_last_retire = now
+        n_tokens = len(fl.slots) * self.chunk
+        fl.span.set(
+            round_s=round(round_s, 6),
+            tokens_per_s=round(n_tokens / round_s, 2) if round_s > 0 else 0.0,
+        )
+        fl.span.end()
+        toks, last, pos = host["toks"], host["last"], host["pos"]
+        tok_lat = round_s / self.chunk
+        self._tok_lat.observe(tok_lat)
+        self._h_tok_lat.observe(tok_lat)
+        self._rounds += 1
+        for b, req in fl.slots:
+            if self._slot_req[b] is not req:
+                continue  # finished earlier and refilled: stale garbage row
+            self._last[b] = last[b]
+            self._pos[b] = pos[b]
+            new = toks[b].tolist()
+            req.out.extend(new)
+            self._emitted += len(new)
+            self._maybe_finish(b, new)
+        self._admit()  # freed slots refill; rows land in _fresh_rows
 
     def _step_speculative(self, active: list) -> bool:
         """One speculative round over the whole arena: drafts per active

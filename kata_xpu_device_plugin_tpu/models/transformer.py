@@ -1011,6 +1011,51 @@ def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
     return caches, last, pos
 
 
+@partial(jax.jit, static_argnames=("cfg", "max_len", "attn_fn",
+                                   "return_logits", "kv_quantized"))
+def prefill_batch(params: Params, prompts: jax.Array, cfg: DecoderConfig,
+                  max_len: int, true_lens: jax.Array,
+                  attn_fn: Optional[AttnFn] = None,
+                  return_logits: bool = True, kv_quantized: bool = False):
+    """Batched admission prefill: N right-padded prompts ``[N, S]`` with a
+    ``[N]`` vector of true lengths run ONE forward, returning
+    ``(caches, last_logits [N, vocab], pos [N])`` — the caches hold each
+    row's prompt at positions ``0..true_lens[n]-1``.
+
+    The batched sibling of :func:`prefill` (scalar ``true_len``), for
+    continuous-batching servers admitting several queued requests at once:
+    N sequential single-row prefills are N weight streams over the same
+    bytes, while one ``[N, S]`` forward streams them once — the dominant
+    TTFT cost under burst arrival. Exactness is the same ``true_len``
+    argument as the scalar path: causal masking hides pad positions from
+    every real token, logits are gathered per row at ``true_lens[n]-1``,
+    and pad cache entries sit at positions decode's index mask never reads
+    before they are overwritten. Each row's cache/logits equal its own
+    single-row prefill (batching rows is independent math in every layer).
+
+    One executable per (N, padded-length) pair — a server pairing this
+    with ``prefill_buckets`` and a bounded arena keeps the compile count
+    at ``len(buckets) × max_batch`` worst case, paid once per machine
+    under the persistent compilation cache."""
+    if attn_fn is None:
+        from ..ops.attention import flash_attention
+
+        attn_fn = flash_attention
+    B, _S = prompts.shape
+    caches = init_kv_caches(cfg, B, max_len, quantized=kv_quantized)
+    logits, caches = forward(
+        params, prompts, cfg, attn_fn=attn_fn, kv_caches=caches,
+        cache_offset=jnp.int32(0), prefill=True,
+    )
+    pos = jnp.asarray(true_lens, jnp.int32)
+    last = jnp.take_along_axis(
+        logits, (pos - 1)[:, None, None], axis=1
+    )[:, 0, :]
+    if not return_logits:
+        last = greedy_token(last)
+    return caches, last, pos
+
+
 @partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn", "do_sample",
                                    "top_k", "top_p", "return_state", "ring"))
 def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,

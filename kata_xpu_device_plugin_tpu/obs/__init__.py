@@ -47,11 +47,13 @@ from .metrics import (
 )
 from .profiler import ProfilerHook, profiler_from_env
 from .trace import (
+    DeviceFence,
     Span,
     current_span_id,
     current_trace_id,
     new_trace,
     span,
+    start_span,
     timer,
     traced,
 )
@@ -74,11 +76,13 @@ __all__ = [
     "serve",
     "ProfilerHook",
     "profiler_from_env",
+    "DeviceFence",
     "Span",
     "current_span_id",
     "current_trace_id",
     "new_trace",
     "span",
+    "start_span",
     "timer",
     "traced",
 ]

@@ -24,6 +24,18 @@ APIs:
   by default.
 - :func:`timer` — a plain timing context manager (same fencing) that can
   also feed a prometheus histogram child or a :class:`..obs.metrics.Rolling`.
+- :func:`start_span` / :meth:`Span.end` — a DETACHED span for overlapped
+  (pipelined) regions whose dispatch and completion happen in different
+  call frames: open at dispatch, :meth:`Span.mark` stamps intermediate
+  offsets (``<label>_s`` attributes, e.g. the dispatch→return split), and
+  ``end()`` fences + emits whenever the in-flight work actually lands.
+  Detached spans never become the ambient parent (the contextvar is
+  untouched), so an overlapped region cannot corrupt the nesting of spans
+  opened while it is in flight.
+- :class:`DeviceFence` — an async device→host transfer handle: starts
+  ``copy_to_host_async`` on every registered array at construction so the
+  copy overlaps subsequent device work, and resolves to host numpy in
+  ``wait()`` — the overlapped-serving round's token transfer.
 
 Every closed span emits one JSONL event (kind ``"span"``) to the default
 event sink; with the sink disabled the cost is two ``perf_counter`` calls
@@ -130,6 +142,27 @@ class Span:
         self._fence.append(value)
         return value
 
+    def mark(self, label: str) -> float:
+        """Stamp an intermediate offset: attaches ``<label>_s`` = seconds
+        since the span opened. For overlapped regions this records the
+        dispatch→return split (``dispatch_s``) separately from the full
+        dispatch→fence duration ``end()`` later reports as ``dur_s`` —
+        honest pipelined timing without forcing a sync at dispatch."""
+        offset = time.perf_counter() - (self._t0 or 0.0)
+        self.attrs[f"{label}_s"] = round(offset, 6)
+        return offset
+
+    def end(self, fence: Any = None) -> "Span":
+        """Close a DETACHED span (see :func:`start_span`): fences, stamps
+        ``dur_s``, emits the event. ``fence`` registers one more value to
+        block on first. Idempotent closes are a bug — call once."""
+        if fence is not None:
+            self._fence.append(fence)
+        err = self._close(None)
+        if err is not None:
+            raise err
+        return self
+
     def _open(self) -> "Span":
         self._token = _current.set(self)
         self._t0 = time.perf_counter()
@@ -226,6 +259,61 @@ def timer(name: str, metric: Any = None, fence: Any = None, **attrs):
         )
         if observe is not None:
             observe(sp.duration_s)
+
+
+def start_span(name: str, **attrs) -> Span:
+    """Open a DETACHED span: timing starts now, but the span is NOT pushed
+    onto the ambient contextvar stack — spans opened while it is in flight
+    do not become its children, and closing it (from any later call frame)
+    cannot unwind someone else's parent. It still records the ambient span
+    at open time as its parent, so the trace tree stays joined.
+
+    This is the API for overlapped regions — work dispatched in one call
+    frame and fenced in another (the pipelined serving round)::
+
+        sp = obs.start_span("serving.decode_chunk", tokens=n)
+        toks = dispatch(...)        # returns futures immediately
+        sp.mark("dispatch")         # dispatch_s: host-side dispatch cost
+        ...                         # host schedules while device computes
+        sp.end(fence=toks)          # dur_s: dispatch → results ready
+    """
+    sp = Span(name, parent=_current.get(), **attrs)
+    sp._t0 = time.perf_counter()  # open without touching the contextvar
+    return sp
+
+
+class DeviceFence:
+    """In-flight device→host transfer handle for overlapped scheduling.
+
+    Construction starts ``copy_to_host_async`` on every registered array —
+    the D2H copy is enqueued behind the producing computation and overlaps
+    whatever the device (and host) do next. :meth:`wait` resolves to host
+    numpy arrays, blocking only until the copies land; by the time an
+    overlapped consumer calls it, the data is typically already resident
+    and the wait is the honest fence for the producing chunk.
+
+    Arrays without ``copy_to_host_async`` (plain numpy, older backends)
+    degrade gracefully: ``wait()`` falls back to a synchronous transfer.
+    """
+
+    __slots__ = ("_arrays",)
+
+    def __init__(self, **arrays: Any):
+        self._arrays = arrays
+        for a in arrays.values():
+            start = getattr(a, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:  # pragma: no cover - backend quirk
+                    pass  # wait() still resolves synchronously
+
+    def wait(self) -> dict:
+        """Block until every array's host copy is ready; returns
+        ``{name: np.ndarray}``."""
+        import numpy as np
+
+        return {k: np.asarray(v) for k, v in self._arrays.items()}
 
 
 def traced(

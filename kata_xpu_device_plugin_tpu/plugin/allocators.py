@@ -42,6 +42,7 @@ class TpuAllocator:
         strategies: Sequence[str] = (C.STRATEGY_CDI_CRI,),
         libtpu_host_path: str = "",
         revalidate: Optional[Callable[[object], bool]] = None,
+        compile_cache_dir: str = "",
     ):
         self._inventory = inventory
         self._vendor = vendor
@@ -49,6 +50,10 @@ class TpuAllocator:
         self._strategies = tuple(strategies)
         self._resource = f"{vendor}/{cls}"
         self._libtpu_host_path = libtpu_host_path
+        # Guest-side persistent XLA compile cache (config.compile_cache_dir):
+        # rides the AllocateResponse env so every granted workload points
+        # jax's on-disk executable cache at the same per-node directory.
+        self._compile_cache_dir = compile_cache_dir
         # Driver-level liveness check supplied by the manager
         # (``manager.tpu_chip_alive``: node_alive over the same
         # dev+driver-state pair health watches); bare existence would hand a
@@ -98,6 +103,8 @@ class TpuAllocator:
                 resp.envs[C.LIBTPU_ENV] = C.LIBTPU_CONTAINER_PATH
         resp.envs[C.ENV_CDI_VENDOR_CLASS] = self._resource
         resp.envs[C.ENV_TPU_VISIBLE_CHIPS] = ",".join(str(c.index) for c in chips)
+        if self._compile_cache_dir:
+            resp.envs[C.ENV_COMPILE_CACHE_DIR] = self._compile_cache_dir
         return resp
 
     def preferred(

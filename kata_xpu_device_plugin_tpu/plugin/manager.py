@@ -397,6 +397,7 @@ class PluginManager:
                 revalidate=lambda chip: tpu_chip_alive(
                     chip, cfg.sysfs_root, cfg.dev_root
                 ),
+                compile_cache_dir=cfg.compile_cache_dir,
             ),
             socket_dir=cfg.kubelet_socket_dir,
             kubelet_socket=cfg.kubelet_socket,

@@ -65,6 +65,14 @@ def main() -> int:
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+
+    from kata_xpu_device_plugin_tpu.compat.jaxapi import (
+        enable_compilation_cache,
+    )
+
+    # Persistent XLA compile cache (ISSUE 3): ladder reruns (per-model
+    # quality gates) skip recompiles; KATA_TPU_COMPILE_CACHE=0 disables.
+    enable_compilation_cache()
     import jax.numpy as jnp
     import numpy as np
 

@@ -17,6 +17,12 @@ import numpy as np
 
 sys.path.insert(0, "/root/repo")
 
+from kata_xpu_device_plugin_tpu.compat.jaxapi import enable_compilation_cache
+
+# Persistent XLA compile cache (ISSUE 3): sweep reruns skip the
+# multi-second recompiles; KATA_TPU_COMPILE_CACHE=0 disables.
+enable_compilation_cache()
+
 from kata_xpu_device_plugin_tpu.models import gemma_2b_bench
 from kata_xpu_device_plugin_tpu.models.transformer import (
     forward,

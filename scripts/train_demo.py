@@ -21,6 +21,12 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+from kata_xpu_device_plugin_tpu.compat.jaxapi import enable_compilation_cache
+
+# Persistent XLA compile cache (ISSUE 3): the demo's second run skips the
+# train/prefill/decode recompiles; KATA_TPU_COMPILE_CACHE=0 disables.
+enable_compilation_cache()
+
 import numpy as np
 import jax.numpy as jnp
 

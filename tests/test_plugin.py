@@ -128,6 +128,25 @@ def test_allocate_cdi_cri(manager, kubelet):
         ]
         assert cresp.envs[C.ENV_CDI_VENDOR_CLASS] == "google.com/tpu"
         assert cresp.envs[C.ENV_TPU_VISIBLE_CHIPS] == "0,1,2,3"
+        # No compile_cache_dir configured → no env injected (the guest
+        # falls back to its own default resolution).
+        assert C.ENV_COMPILE_CACHE_DIR not in cresp.envs
+
+
+def test_tpu_allocator_injects_compile_cache_env(v5e8):
+    # config.compile_cache_dir (ISSUE 3) rides the AllocateResponse env:
+    # every granted workload points jax's persistent compilation cache at
+    # the node's shared directory (compat.jaxapi.enable_compilation_cache
+    # reads KATA_TPU_COMPILE_CACHE_DIR in-guest).
+    from kata_xpu_device_plugin_tpu.discovery import scan_tpus
+    from kata_xpu_device_plugin_tpu.plugin import TpuAllocator
+
+    inv = scan_tpus(v5e8.sysfs, v5e8.dev, env={})
+    wired = TpuAllocator(
+        lambda: inv, "google.com", "tpu",
+        compile_cache_dir="/var/cache/kata-tpu/xla",
+    ).allocate(["0"])
+    assert wired.envs[C.ENV_COMPILE_CACHE_DIR] == "/var/cache/kata-tpu/xla"
 
 
 def test_allocate_telemetry_span_and_latency(manager, kubelet, tmp_path):

@@ -58,15 +58,36 @@ def test_ragged_prompts_match_generate_per_request(model):
         np.testing.assert_array_equal(o, _oracle(params, cfg, p, 10, 32))
 
 
-def test_queue_pressure_slot_reuse(model):
+def test_queue_pressure_slot_reuse(model, tmp_path):
     # 6 requests through 2 slots: finished slots must be refilled and the
     # refilled sequences must not be corrupted by their predecessors' cache.
+    # Admission must stay FIFO (the deque queue): the per-admission ttft
+    # events record rids in the order slots were granted. With <= 2 free
+    # slots per pass, bucket grouping cannot reorder within a pass, so the
+    # event order here must be STRICTLY sorted; the general guarantee —
+    # each pass admits the FIFO prefix, grouping only within it — is
+    # locked by test_serving_pipeline.py's interleaved-bucket test.
+    from kata_xpu_device_plugin_tpu import obs
+
     cfg, params = model
-    prompts = _prompts(cfg, [4, 8, 6, 3, 10, 5], seed=2)
-    out = serve_batch(params, cfg, prompts, max_new_tokens=8,
-                      max_batch=2, max_len=32, chunk=4)
+    sink = obs.EventSink(str(tmp_path / "events.jsonl"))
+    prev = obs.set_default_sink(sink)
+    try:
+        prompts = _prompts(cfg, [4, 8, 6, 3, 10, 5], seed=2)
+        out = serve_batch(params, cfg, prompts, max_new_tokens=8,
+                          max_batch=2, max_len=32, chunk=4)
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
     for p, o in zip(prompts, out):
         np.testing.assert_array_equal(o, _oracle(params, cfg, p, 8, 32))
+    admitted = [
+        ev["rid"] for ev in obs.read_events(str(tmp_path / "events.jsonl"))
+        if ev.get("name") == "ttft"
+    ]
+    assert admitted == sorted(admitted), (
+        f"admission order {admitted} violates FIFO"
+    )
 
 
 def test_differing_budgets_and_chunk_boundary(model):

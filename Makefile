@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint test test-fast bench bench-smoke bench-watch eval demo dryrun image clean deploy obs-check
+.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-watch eval demo dryrun image clean deploy obs-check
 
 all: build
 
@@ -37,6 +37,20 @@ lint:
 	@if command -v mypy >/dev/null 2>&1; then \
 	  mypy; \
 	else echo "lint: mypy not installed — skipped (pip install mypy)"; fi
+
+# jaxguard (ISSUE 4): interprocedural dataflow analysis over the package
+# + bench/scripts — implicit host syncs on hot paths (JG101),
+# use-after-donation (JG102), tracer leaks (JG103), recompile hazards
+# (JG104). The JSON report is the CI artifact; exit 1 on any
+# unsuppressed finding. Pure-stdlib AST analysis: no jax import, runs
+# anywhere.
+analyze:
+	$(PY) -m tools.analyze --json jaxguard_report.json
+
+# The whole static gate in one target: lint rules, telemetry rules + obs
+# unit tests, and the jaxguard dataflow pass. CI runs the pieces
+# separately (artifact uploads); this is the pre-push spelling.
+verify-static: lint obs-check analyze
 
 # Telemetry gate (ISSUE 2): the JX005 rule (raw perf_counter timing in
 # library code must go through obs.span/obs.timer) plus the obs unit

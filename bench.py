@@ -472,7 +472,7 @@ def worker(args: argparse.Namespace) -> None:
     )(key)
     jax.block_until_ready(params)
 
-    def run(p, seed: int, tag: str = "bench"):
+    def run(p, seed: int, tag: str = "bench"):  # jaxguard: hot
         # Fresh prompt every iteration and a full device→host transfer of
         # the result: the remote-device tunnel can serve repeated identical
         # executions from cache and does not reliably block on
@@ -488,19 +488,19 @@ def worker(args: argparse.Namespace) -> None:
             jax.random.PRNGKey(seed), (BATCH, PROMPT_LEN), 0,
             cfg.vocab_size, dtype=jnp.int32,
         )
-        np.asarray(prompt)
+        np.asarray(prompt)  # jaxguard: allow(JG101) pre-materialize the input OUTSIDE the timed window
         t0 = time.perf_counter()
         with obs.span(f"{tag}.prefill", tokens=BATCH * PROMPT_LEN) if tag \
                 else _null_span():
             caches, last, _pos = prefill(p, prompt, cfg, max_len)
-            np.asarray(last)
+            np.asarray(last)  # jaxguard: allow(JG101) tiny last-token transfer fences prefill (JX004)
         t_pre = time.perf_counter() - t0
         t1 = time.perf_counter()
         # pos as the static python int: decode's bound check must not cost a
         # device->host fetch inside the timed window.
         with obs.span(f"{tag}.decode", tokens=BATCH * DECODE_STEPS) if tag \
                 else _null_span():
-            out = np.asarray(decode(p, caches, last, PROMPT_LEN, cfg, DECODE_STEPS))
+            out = np.asarray(decode(p, caches, last, PROMPT_LEN, cfg, DECODE_STEPS))  # jaxguard: allow(JG101) the transfer IS the timing fence (JX004)
         return t_pre, time.perf_counter() - t1, out
 
     from contextlib import nullcontext as _null_span
@@ -520,16 +520,16 @@ def worker(args: argparse.Namespace) -> None:
     # ----- separate prefill metric: pallas flash vs XLA reference ----------
     prefill_flash = flash_eligible(PREFILL_LEN, PREFILL_LEN, cfg.head_dim)
 
-    def time_prefill(fn) -> float:
+    def time_prefill(fn) -> float:  # jaxguard: hot
         best = float("inf")
         for seed in range(4):
             toks = jax.random.randint(
                 jax.random.PRNGKey(100 + seed), (1, PREFILL_LEN), 0,
                 cfg.vocab_size, dtype=jnp.int32,
             )
-            np.asarray(toks)
+            np.asarray(toks)  # jaxguard: allow(JG101) pre-materialize the input OUTSIDE the timed window
             t0 = time.perf_counter()
-            np.asarray(fn(params, toks))
+            np.asarray(fn(params, toks))  # jaxguard: allow(JG101) the transfer IS the timing fence (JX004)
             elapsed = time.perf_counter() - t0
             if seed > 0:  # first run includes compile
                 best = min(best, elapsed)
@@ -723,7 +723,7 @@ def worker(args: argparse.Namespace) -> None:
             reqs(warm, 2 * BATCH, salt=1000)
             warm.run()
 
-            def timed_run(overlap, salt):  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
+            def timed_run(overlap, salt):  # jaxguard: hot  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
                 # Best-of-3 like the headline: one serving run is ~tens of
                 # ms at smoke shapes, well inside scheduler-noise range,
                 # and the A/B delta is the whole point of the section.
@@ -849,7 +849,7 @@ def worker(args: argparse.Namespace) -> None:
             )
             flops_per_step = 6 * matmul_params * tokens_per_step + attn_flops
 
-            def run_variant(attn_fn):
+            def run_variant(attn_fn):  # jaxguard: hot
                 # remat for both variants: the reference attention's [S,S]
                 # logits only fit by recomputation, and remat is the
                 # long-context recipe the train step ships with anyway.

@@ -39,6 +39,8 @@ import importlib
 import inspect
 import os
 import re
+import warnings
+from contextlib import ExitStack, contextmanager
 from typing import Any, Callable, Optional, Sequence
 
 
@@ -418,6 +420,146 @@ def enable_compilation_cache(
     return cache_dir
 
 
+# ----- strict mode: runtime enforcement of the jaxguard contract ------------
+#
+# tools/analyze (jaxguard) proves statically that no implicit host sync,
+# donation misuse, or rank surprise sits on the hot path — for the code it
+# can resolve. strict mode is the runtime side of the same contract, for
+# the code it cannot: under `jax.transfer_guard("disallow")` every
+# IMPLICIT host↔device transfer raises at its call site (numpy arrays or
+# Python scalars silently uploaded into a jitted dispatch — exactly the
+# host round-trip the overlapped serving loop exists to avoid), while
+# EXPLICIT transfers (jax.device_put / jnp.asarray / jax.device_get) stay
+# legal, so the two sanctioned sync points — DeviceFence retire and the
+# admission host read — pass through `allow_transfer()` hatches instead
+# of weakening the whole guard.
+
+_STRICT_ENV = "KATA_TPU_STRICT"
+_strict_warned = False
+
+
+def strict_enabled(env: Optional[dict] = None) -> bool:
+    """Is the ``KATA_TPU_STRICT=1`` env gate on? Serving reads this at
+    server construction (overridable per instance); the tier-1 CI job
+    exports it so transfer-guard violations fail tests, not just lint."""
+    src = env if env is not None else os.environ
+    return str(src.get(_STRICT_ENV, "")).lower() in ("1", "true", "yes", "on")
+
+
+def _strict_noop_warn(jax_mod: Any) -> None:
+    global _strict_warned
+    if not _strict_warned:
+        _strict_warned = True
+        warnings.warn(
+            f"jax {getattr(jax_mod, '__version__', '?')} lacks "
+            "transfer_guard — KATA_TPU_STRICT mode is a no-op on this "
+            "line (needs jax >= 0.3.18)",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
+@contextmanager
+def allow_transfer(reason: str = "", jax_mod: Any = None):
+    """Escape hatch inside :func:`strict_mode`: re-allow transfers for a
+    SANCTIONED synchronous region. ``reason`` documents the sanction at
+    the call site (it is not recorded — the point is the code reads like
+    the jaxguard pragma grammar). No-op when the guard is unsupported or
+    no strict scope is active (``transfer_guard("allow")`` is the
+    default level)."""
+    del reason
+    jm = jax_mod if jax_mod is not None else _jax
+    guard = getattr(jm, "transfer_guard", None)
+    if guard is None:
+        yield
+        return
+    with guard("allow"):
+        yield
+
+
+def _looks_like_guard_trip(err: BaseException) -> bool:
+    text = f"{type(err).__name__}: {err}"
+    return "transfer" in text.lower() and (
+        "disallow" in text.lower() or "guard" in text.lower()
+    )
+
+
+@contextmanager
+def strict_mode(
+    jax_mod: Any = None,
+    *,
+    transfer: str = "disallow",
+    rank_promotion: Optional[str] = "raise",
+    debug_nans: bool = False,
+    scope: str = "strict",
+):
+    """Enforce the jaxguard contract at runtime within this scope:
+
+    - ``jax.transfer_guard_{host_to_device,device_to_host}(transfer)`` —
+      implicit host↔device transfers raise (explicit ``device_put``/
+      ``device_get``/``jnp.asarray`` stay legal; see
+      :func:`allow_transfer` for sanctioned regions; device→device stays
+      free — see the inline comment);
+    - ``jax.numpy_rank_promotion(rank_promotion)`` — silent rank
+      promotion becomes an error (pass ``None`` to leave it alone);
+    - ``debug_nans=True`` adds ``jax.debug_nans`` (test-suite use: a NaN
+      produced under strict mode fails the test that made it).
+
+    On a JAX line without ``transfer_guard`` the whole context is a
+    warn-once no-op — old-JAX users lose enforcement, not serving.
+
+    A guard trip emits one ``strict``/``guard_trip`` event to the obs
+    sink (``scope`` names the guarded region) before the error
+    propagates, so production telemetry records WHERE the contract broke
+    even when the exception is swallowed upstream.
+
+    NOTE: the rank-promotion and debug-nans configs participate in jit's
+    trace context, so the first strict-scoped call of an executable
+    retraces it once; steady-state cost is zero.
+    """
+    jm = jax_mod if jax_mod is not None else _jax
+    guard = getattr(jm, "transfer_guard", None)
+    if guard is None:
+        _strict_noop_warn(jm)
+        yield
+        return
+    # Guard the HOST boundary only: host→device and device→host are the
+    # transfers that serialize the pipelined round (the contract JG101
+    # mirrors statically). Device→device stays allowed — under tensor-
+    # parallel serving, GSPMD replicates small dispatch inputs across the
+    # mesh (an intra-accelerator placement move, not a host sync), and
+    # disallowing it would outlaw mesh serving itself.
+    h2d = getattr(jm, "transfer_guard_host_to_device", None)
+    d2h = getattr(jm, "transfer_guard_device_to_host", None)
+    with ExitStack() as stack:
+        if h2d is not None and d2h is not None:
+            stack.enter_context(h2d(transfer))
+            stack.enter_context(d2h(transfer))
+        else:  # pragma: no cover - pre-granular-guard line
+            stack.enter_context(guard(transfer))
+        rank_ctx = getattr(jm, "numpy_rank_promotion", None)
+        if rank_promotion is not None and rank_ctx is not None:
+            stack.enter_context(rank_ctx(rank_promotion))
+        nan_ctx = getattr(jm, "debug_nans", None)
+        if debug_nans and nan_ctx is not None:
+            stack.enter_context(nan_ctx(True))
+        try:
+            yield
+        except Exception as err:
+            if _looks_like_guard_trip(err):
+                try:
+                    from .. import obs
+
+                    obs.emit(
+                        "strict", "guard_trip",
+                        scope=scope,
+                        error=f"{type(err).__name__}: {err}"[:300],
+                    )
+                except Exception:  # pragma: no cover - obs must not mask
+                    pass
+            raise
+
+
 # ----- tree utilities -------------------------------------------------------
 
 
@@ -487,7 +629,10 @@ __all__ = [
     "NamedSharding",
     "P",
     "PartitionSpec",
+    "allow_transfer",
     "axis_size",
+    "strict_enabled",
+    "strict_mode",
     "build_make_mesh",
     "build_shard_map",
     "enable_compilation_cache",

@@ -60,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..compat import jaxapi
 from ..models.transformer import (
     DecoderConfig,
     _decode_scan,
@@ -246,7 +247,8 @@ class GenerationServer:
                  top_p: float = 0.0, seed: int = 0, mesh: Any = None,
                  kv_quant: bool = False, prefill_buckets: tuple = (),
                  speculative_k: int = 0, ring_kv: bool = False,
-                 draft: Optional[tuple] = None, overlap: bool = True):
+                 draft: Optional[tuple] = None, overlap: bool = True,
+                 strict: Optional[bool] = None):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
@@ -308,6 +310,18 @@ class GenerationServer:
         self._do_sample, self._key = _sampling_args(
             temperature, top_k, jax.random.PRNGKey(seed), top_p
         )
+        # Strict mode (ISSUE 4): under KATA_TPU_STRICT=1 (or strict=True)
+        # every overlapped round runs inside compat.jaxapi.strict_mode —
+        # jax.transfer_guard("disallow") plus rank-promotion "raise"
+        # across the dispatch window, with allow_transfer() hatches at the
+        # two sanctioned sync points (admission, DeviceFence retire). An
+        # implicit host round-trip sneaking back into the dispatch path
+        # then raises instead of silently serializing the pipeline.
+        self.strict = jaxapi.strict_enabled() if strict is None else bool(strict)
+        # Device-resident temperature, hoisted once: jnp.float32(x) per
+        # dispatch is an implicit scalar upload — a per-round H2D the
+        # transfer guard rightly rejects.
+        self._temp_dev = jnp.float32(temperature)
         # kv_quant: int8 arena — ~2× less HBM per slot-token, so the same
         # chip serves ~2× the context/slots (per-vector scales; decode
         # dequant fuses into the attention dots). ring_kv: windowed layers
@@ -539,8 +553,8 @@ class GenerationServer:
 
     def _sample_first(self, logits: jax.Array) -> int:
         self._key, sub = jax.random.split(self._key)
-        return int(_next_token(logits, sub, self._do_sample,
-                               jnp.float32(self.temperature), self.top_k,
+        return int(_next_token(logits, sub, self._do_sample,  # jaxguard: allow(JG101) admission host read — sanctioned sync (runs under allow_transfer)
+                               self._temp_dev, self.top_k,
                                self.top_p)[0])
 
     def _fill_slot(self, b: int, req: _Request,
@@ -609,7 +623,7 @@ class GenerationServer:
             )
             self.draft_arena = _write_slot(self.draft_arena, d_caches, b)
         self._slot_req[b] = req
-        self._pos[b] = int(pos)
+        self._pos[b] = int(pos)  # jaxguard: allow(JG101) admission host read — slot position lands with the first token
         self._last[b] = first
         self._fresh_rows.add(b)  # overlap: override the in-flight row
         self._maybe_finish(b, [first])
@@ -640,12 +654,12 @@ class GenerationServer:
             )
             if self._do_sample:
                 self._key, sub = jax.random.split(self._key)
-                firsts = np.asarray(_next_token(
-                    last_logits, sub, True, jnp.float32(self.temperature),
+                firsts = np.asarray(_next_token(  # jaxguard: allow(JG101) admission host read — batched first tokens, sanctioned sync
+                    last_logits, sub, True, self._temp_dev,
                     self.top_k, self.top_p,
                 ))
             else:
-                firsts = np.asarray(jnp.argmax(last_logits, axis=-1))
+                firsts = np.asarray(jnp.argmax(last_logits, axis=-1))  # jaxguard: allow(JG101) admission host read — sanctioned sync
         self.arena = _write_slots(
             self.arena, caches, jnp.asarray(np.asarray(slots, np.int32))
         )
@@ -677,7 +691,16 @@ class GenerationServer:
         only regroups requests WITHIN that prefix by padded length, so
         fairness is unchanged. Loops because a request can finish during
         its own prefill (eos / 1-token budget) and the freed slot should be
-        re-offered immediately rather than idling for a whole chunk."""
+        re-offered immediately rather than idling for a whole chunk.
+
+        Admission is one of strict mode's two SANCTIONED sync regions
+        (the other: DeviceFence retire): the prefill uploads the prompt
+        and the first-token sample reads it back — inherently
+        synchronous, and outside the overlap window's steady state."""
+        with jaxapi.allow_transfer("admission prefill + first-token read"):
+            self._admit_unguarded()
+
+    def _admit_unguarded(self) -> None:
         while self._queue:
             free = [
                 b for b in range(self.max_batch) if self._slot_req[b] is None
@@ -733,8 +756,16 @@ class GenerationServer:
         Pipelined (default): dispatch the next chunk from the in-flight
         chunk's device state, THEN retire the in-flight chunk's tokens
         while the device runs — see :meth:`_step_overlapped`. Returns
-        False when queue, slots, and pipeline are all empty."""
+        False when queue, slots, and pipeline are all empty.
+
+        Under :attr:`strict` the overlapped round runs inside
+        ``compat.jaxapi.strict_mode`` — the transfer guard covers the
+        whole dispatch→retire window (lock-step and speculative rounds
+        fence synchronously by design, so they are not guarded)."""
         if self.overlap and not self.speculative_k:
+            if self.strict:
+                with jaxapi.strict_mode(scope="serving.decode_dispatch"):
+                    return self._step_overlapped()
             return self._step_overlapped()
         return self._step_lockstep()
 
@@ -788,10 +819,10 @@ class GenerationServer:
             toks, caches, last, pos = _serve_decode(
                 self.params, self.arena, jnp.asarray(self._last),
                 jnp.asarray(self._pos), self.cfg, self.chunk, self._do_sample,
-                self.top_k, jnp.float32(self.temperature), sub,
+                self.top_k, self._temp_dev, sub,
                 top_p=self.top_p, ring=self.ring_kv,
             )
-            toks = np.asarray(toks)  # [max_batch, chunk]
+            toks = np.asarray(toks)  # [max_batch, chunk]  # jaxguard: allow(JG101) lock-step round fence — the transfer IS the chunk boundary
         # Per-token decode latency as a client sees it: chunk wall time
         # over the chunk's steps (each step yields one token per slot).
         tok_lat = sp.duration_s / self.chunk
@@ -800,8 +831,8 @@ class GenerationServer:
         self.arena = caches
         # np.array (not asarray): device arrays convert read-only, and
         # _fill_slot writes these rows in place on refill.
-        self._last = np.array(last)
-        self._pos = np.array(pos)
+        self._last = np.array(last)  # jaxguard: allow(JG101) lock-step fence (writable host copy for refill)
+        self._pos = np.array(pos)  # jaxguard: allow(JG101) lock-step fence (writable host copy for refill)
         self._rounds += 1
         for b in active:
             new = toks[b].tolist()
@@ -897,7 +928,7 @@ class GenerationServer:
         )
         toks, caches, new_last, new_pos = _serve_decode(
             self.params, self.arena, last, pos, self.cfg, self.chunk,
-            self._do_sample, self.top_k, jnp.float32(self.temperature), sub,
+            self._do_sample, self.top_k, self._temp_dev, sub,
             top_p=self.top_p, ring=self.ring_kv,
         )
         sp.mark("dispatch")
@@ -981,9 +1012,9 @@ class GenerationServer:
             drafts_dev, q_dev, self.draft_arena = draft_sample_propose(
                 d_params, self.draft_arena, jnp.asarray(cur),
                 jnp.asarray(self._pos), d_cfg, k,
-                jnp.float32(self.temperature), sub,
+                self._temp_dev, sub,
             )
-            drafts = np.asarray(drafts_dev)
+            drafts = np.asarray(drafts_dev)  # jaxguard: allow(JG101) speculative rounds are lock-step by design (verify needs host drafts)
         elif self.draft is not None:
             # k+1 steps, first k kept — the same cache-hole avoidance as
             # models.speculative.draft_propose (its docstring has the
@@ -996,7 +1027,7 @@ class GenerationServer:
                 jnp.asarray(self._pos), d_cfg, k + 1, False, 0,
                 jnp.float32(0.0), jax.random.PRNGKey(0),
             )
-            drafts = np.asarray(toks_dev)[:, :k]
+            drafts = np.asarray(toks_dev)[:, :k]  # jaxguard: allow(JG101) speculative rounds are lock-step by design
         else:
             drafts = np.zeros((self.max_batch, k), np.int32)
             for b in active:
@@ -1017,16 +1048,16 @@ class GenerationServer:
             self._key, sub = jax.random.split(self._key)
             tok_acc, counts = sample_accept_device(
                 jnp.asarray(drafts), q_dev, logits,
-                jnp.float32(self.temperature), sub, k,
+                self._temp_dev, sub, k,
                 has_q=q_dev is not None,
             )
-            tok_acc, counts = np.asarray(tok_acc), np.asarray(counts)
+            tok_acc, counts = np.asarray(tok_acc), np.asarray(counts)  # jaxguard: allow(JG101) accept decision is host scheduling input
         else:
             greedy, self.arena = verify_step(
                 self.params, self.arena, jnp.asarray(toks),
                 jnp.asarray(self._pos), self.cfg, ring=self.ring_kv,
             )
-            greedy = np.asarray(greedy)
+            greedy = np.asarray(greedy)  # jaxguard: allow(JG101) accept decision is host scheduling input
         self._rounds += 1
         for b in active:
             if sampling:

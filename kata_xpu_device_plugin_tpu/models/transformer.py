@@ -30,6 +30,7 @@ from jax import lax
 from ..compat.jaxapi import tree_map
 from ..ops.quant import (
     QTensor,
+    broadcast_trailing,
     dequantize_kv,
     quantize_kv,
     weight_matmul,
@@ -276,7 +277,11 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     x32 = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
     normed = x32 * lax.rsqrt(var + eps)
-    return (normed * (1.0 + scale.astype(jnp.float32))).astype(x.dtype)
+    # Explicit trailing-dim broadcast: [D] → [1, ..., D]. Identical values,
+    # but legal under jax_numpy_rank_promotion="raise" (strict mode runs
+    # the serving decode window with promotion disallowed).
+    scale32 = broadcast_trailing(1.0 + scale.astype(jnp.float32), x.ndim)
+    return (normed * scale32).astype(x.dtype)
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float,
@@ -307,7 +312,11 @@ def rope(x: jax.Array, positions: jax.Array, theta: float,
             inv_freq / factor,
             jnp.where(wavelen < old_len / high_f, inv_freq, smoothed),
         )
-    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, D/2]
+    # inv_freq [D/2] → [1, 1, D/2]: explicit broadcast against the
+    # positions' [B, S, 1] — rank-promotion-clean under strict mode.
+    angles = positions[..., None].astype(jnp.float32) * broadcast_trailing(
+        inv_freq, positions.ndim + 1
+    )  # [B, S, D/2]
     angles = angles[:, :, None, :]  # [B, S, 1, D/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     # Half-split rotation reassembled with two pads + add, NOT with
@@ -462,7 +471,11 @@ def _layer(
         # QTensors (ops.quant), which halve that stream again.
         qkv = weight_matmul(h, layer["wqkv"])
         if "bqkv" in layer:  # Qwen2: fused q/k/v bias, one add
-            qkv = qkv + layer["bqkv"].astype(qkv.dtype)
+            # [3D] → [1, 1, 3D]: explicit trailing-dim broadcast (legal
+            # under strict mode's rank_promotion="raise").
+            qkv = qkv + broadcast_trailing(
+                layer["bqkv"].astype(qkv.dtype), qkv.ndim
+            )
         q = qkv[..., : cfg.q_dim]
         k = qkv[..., cfg.q_dim : cfg.q_dim + cfg.kv_dim]
         v = qkv[..., cfg.q_dim + cfg.kv_dim :]
@@ -471,9 +484,10 @@ def _layer(
         k = weight_matmul(h, layer["wk"])
         v = weight_matmul(h, layer["wv"])
         if "bq" in layer:  # Qwen2: q/k/v projection biases
-            q = q + layer["bq"].astype(q.dtype)
-            k = k + layer["bk"].astype(k.dtype)
-            v = v + layer["bv"].astype(v.dtype)
+            # explicit [1, 1, D] broadcast — see the fused branch above
+            q = q + broadcast_trailing(layer["bq"].astype(q.dtype), q.ndim)
+            k = k + broadcast_trailing(layer["bk"].astype(k.dtype), k.ndim)
+            v = v + broadcast_trailing(layer["bv"].astype(v.dtype), v.ndim)
     q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
     k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
@@ -917,7 +931,10 @@ def ring_positions(pos: jax.Array, window: int) -> jax.Array:
     ``pos`` tokens have been written (slot = position % window): the most
     recent position ≡ s (mod window) that is ≤ pos. Negative ⇒ unwritten
     (masked by ``reference_attention``'s ``k_positions`` path)."""
-    s = jnp.arange(window, dtype=jnp.int32)
+    # Explicit broadcast of the slot index against pos's leading dims
+    # ([B, 1] at decode, [1]/scalar at prefill-fold) — identical values,
+    # legal under strict mode's rank_promotion="raise".
+    s = broadcast_trailing(jnp.arange(window, dtype=jnp.int32), pos.ndim)
     return pos - ((pos - s) % window)
 
 
@@ -955,7 +972,7 @@ def cycle_ring_caches_from_prefill(caches, pos: jax.Array,
     for i, w in enumerate(cycle):
         sub = tree_map(lambda a: a[i::P], caches)  # [L/P, B, S, ...]
         if w > 0:
-            arena.append(ring_caches_from_prefill(sub, pos, w + margin))
+            arena.append(ring_caches_from_prefill(sub, pos, w + margin))  # jaxguard: allow(JG104) bounded: one executable per distinct window in the static cycle (≤ len(window_cycle))
         else:
             def pad(c):
                 full = jnp.zeros(c.shape[:2] + (max_len,) + c.shape[3:], c.dtype)
@@ -1117,7 +1134,7 @@ def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
         if steps > cache_len:
             raise ValueError(f"steps={steps} exceeds cache max_len={cache_len}")
         try:
-            pos_concrete = int(pos) if jnp.ndim(pos) == 0 else None
+            pos_concrete = int(pos) if jnp.ndim(pos) == 0 else None  # jaxguard: allow(JG101) opt-in bounds check; callers on the hot path pass a python int (bench does)
         except Exception:  # traced under an outer jit: caller owns the bound
             pos_concrete = None
         if pos_concrete is not None and pos_concrete + steps > cache_len:

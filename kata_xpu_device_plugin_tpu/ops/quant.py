@@ -94,6 +94,19 @@ def w8a8_enabled() -> bool:
     return _W8A8
 
 
+def broadcast_trailing(s: jax.Array, ndim: int) -> jax.Array:
+    """``[..., d]`` → ``[..., 1, ..., d]`` at rank ``ndim``: the explicit
+    trailing-dim broadcast, legal under strict mode's
+    rank_promotion="raise" (identical values — implicit rank promotion
+    would have inserted the same axes). Leading (e.g. per-expert) dims
+    are preserved; a value already at rank passes through. The ONE
+    implementation for every scale/bias/norm broadcast in the decoder
+    (rms_norm, rope, ring_positions, qkv biases, int8 scales)."""
+    if s.ndim >= ndim:
+        return s
+    return s.reshape(s.shape[:-1] + (1,) * (ndim - s.ndim) + s.shape[-1:])
+
+
 def weight_matmul(x: jax.Array, w: Any) -> jax.Array:
     """The one ``activation @ weight`` used by the decoder layer: a plain
     cast-to-activation-dtype matmul for arrays; for :class:`QTensor` the
@@ -112,12 +125,13 @@ def weight_matmul(x: jax.Array, w: Any) -> jax.Array:
             )
             # x-scale broadcasts over the out axis, w-scale over the rows.
             return (
-                y.astype(jnp.float32) * xq.scale * w.scale[..., 0, :]
+                y.astype(jnp.float32) * xq.scale
+                * broadcast_trailing(w.scale[..., 0, :], y.ndim)
             ).astype(x.dtype)
         y = jnp.matmul(
             x, w.q.astype(x.dtype), preferred_element_type=jnp.float32
         )
-        return (y * w.scale[..., 0, :]).astype(x.dtype)
+        return (y * broadcast_trailing(w.scale[..., 0, :], y.ndim)).astype(x.dtype)
     if isinstance(w, tuple):  # LoRAWeight (import deferred: lora → quant)
         from .lora import LoRAWeight, lora_matmul
 

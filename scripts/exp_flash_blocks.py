@@ -41,7 +41,7 @@ params = jax.jit(
 jax.block_until_ready(params)
 
 
-def time_prefill(attn_fn) -> float:
+def time_prefill(attn_fn) -> float:  # jaxguard: hot
     fn = jax.jit(lambda p, t: forward(p, t, cfg, attn_fn=attn_fn)[:, -1])
     best = float("inf")
     for seed in range(5):
@@ -49,9 +49,9 @@ def time_prefill(attn_fn) -> float:
             jax.random.PRNGKey(100 + seed), (1, S), 0, cfg.vocab_size,
             dtype=jnp.int32,
         )
-        np.asarray(toks)
+        np.asarray(toks)  # jaxguard: allow(JG101) pre-materialize the input OUTSIDE the timed window
         t0 = time.perf_counter()
-        np.asarray(fn(params, toks))
+        np.asarray(fn(params, toks))  # jaxguard: allow(JG101) the transfer IS the timing fence (JX004)
         elapsed = time.perf_counter() - t0
         if seed > 0:  # first run includes compile
             best = min(best, elapsed)

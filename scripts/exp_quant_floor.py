@@ -74,19 +74,23 @@ def matmuls_only(fp, tok, pos):
     return out.T
 
 
-def timeit(name, fn):
-    np.asarray(fn(qparams, jnp.zeros((B,), jnp.int32), jnp.int32(PROMPT)))  # compile
+def timeit(name, fn):  # jaxguard: hot
+    np.asarray(fn(qparams, jnp.zeros((B,), jnp.int32), jnp.int32(PROMPT)))  # compile  # jaxguard: allow(JG101) warm-up fence, outside the timed window
     best = float("inf")
     for s in range(3):
         tok2 = jax.random.randint(jax.random.PRNGKey(s), (B,), 0, cfg.vocab_size)
-        np.asarray(tok2)
+        np.asarray(tok2)  # jaxguard: allow(JG101) pre-materialize the input OUTSIDE the timed window
         t0 = time.perf_counter()
-        np.asarray(fn(qparams, tok2, jnp.int32(PROMPT)))
+        np.asarray(fn(qparams, tok2, jnp.int32(PROMPT)))  # jaxguard: allow(JG101) the transfer IS the timing fence (JX004)
         best = min(best, time.perf_counter() - t0)
     ms = best / STEPS * 1e3
     print(f"{name:16s} {ms:7.3f} ms/step  int8_roofline_frac={ideal_ms/ms:.3f}")
 
 
 caches = init_kv_caches(cfg, B, MAX_LEN)
-timeit("full-int8", lambda p, tok, pos: decode(p, caches, tok, int(pos), cfg, STEPS))
+# PROMPT as the static python int, NOT int(pos): pos is a device scalar, and
+# int() on it is a device→host sync INSIDE the timed window — the stray hot-
+# path sync jaxguard (JG101) exists to catch; it also skewed full-int8
+# against matmuls-only, which never paid the extra round-trip.
+timeit("full-int8", lambda p, tok, pos: decode(p, caches, tok, PROMPT, cfg, STEPS))
 timeit("matmuls-only", matmuls_only)

@@ -10,6 +10,10 @@ import pytest
 from tools.lint import check_source
 from tools.lint.cli import run
 
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 OPS_PATH = "kata_xpu_device_plugin_tpu/ops/example.py"
 COMPAT_PATH = "kata_xpu_device_plugin_tpu/compat/jaxapi.py"
 TEST_PATH = "tests/test_example.py"
@@ -289,17 +293,37 @@ def test_cli_red_on_seed_bug(tmp_path):
     bad.write_text("from jax import shard_map\n")
     proc = subprocess.run(
         [sys.executable, "-m", "tools.lint", str(bad), "--root", str(tmp_path)],
-        capture_output=True, text=True, cwd="/root/repo",
+        capture_output=True, text=True, cwd=_REPO_ROOT,
     )
     assert proc.returncode == 1
     assert "JX001" in proc.stdout
 
 
 def test_cli_list_rules():
+    """--list-rules prints BOTH catalogues: the per-function lint rules
+    and the jaxguard dataflow rules (ISSUE 4 satellite)."""
     proc = subprocess.run(
         [sys.executable, "-m", "tools.lint", "--list-rules"],
-        capture_output=True, text=True, cwd="/root/repo",
+        capture_output=True, text=True, cwd=_REPO_ROOT,
     )
     assert proc.returncode == 0
-    for rule in ("JX001", "JX002", "JX003", "JX004", "JX005", "TS001"):
+    for rule in ("JX001", "JX002", "JX003", "JX004", "JX005", "TS001",
+                 "JG101", "JG102", "JG103", "JG104"):
         assert rule in proc.stdout
+
+
+def test_pragma_multi_rule_and_shared_grammar():
+    """allow(RULE[, RULE...]) takes a list, and the grammar is shared
+    with jaxguard (tools.pragmas): a `# jaxguard:` prefix suppresses
+    lint rules too — ids are globally unique, the prefix is
+    documentation."""
+    src = (
+        "from jax import shard_map"
+        "  # lint: allow(JX001, JX002) fixture exercising the list form\n"
+    )
+    assert check_source(src, OPS_PATH) == []
+    src2 = (
+        "from jax.experimental import mesh_utils"
+        "  # jaxguard: allow(JX002) cross-prefix suppression\n"
+    )
+    assert check_source(src2, OPS_PATH) == []

@@ -1,0 +1,197 @@
+"""Runtime strict mode (ISSUE 4): ``compat.jaxapi.strict_mode`` /
+``allow_transfer`` / ``KATA_TPU_STRICT`` — the runtime half of the
+jaxguard contract.
+
+Covers: the env gate; rank-promotion and debug-nans enforcement inside
+the scope; the transfer guard catching an INJECTED implicit transfer in
+the overlapped decode loop (the exact pre-PR3 host-round-trip
+regression); the sanctioned DeviceFence/admission paths passing clean
+with token-identical output; the guard-trip obs event; and the
+warn-once no-op on JAX lines without ``transfer_guard``.
+"""
+import types
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu import obs
+from kata_xpu_device_plugin_tpu.compat import jaxapi
+from kata_xpu_device_plugin_tpu.guest import serving as serving_mod
+from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import init_params
+
+_HAS_GUARD = hasattr(jax, "transfer_guard")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = tiny_test_config()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _serve(params, cfg, n=4, **kw):
+    srv = GenerationServer(params, cfg, max_batch=2, max_len=64, chunk=4, **kw)
+    rids = [srv.submit(np.arange(1, 9, dtype=np.int32), 12) for _ in range(n)]
+    return srv, rids, srv.run()
+
+
+# ----- env gate --------------------------------------------------------------
+
+
+def test_strict_enabled_env_parsing():
+    assert not jaxapi.strict_enabled(env={})
+    for truthy in ("1", "true", "YES", "on"):
+        assert jaxapi.strict_enabled(env={"KATA_TPU_STRICT": truthy})
+    for falsy in ("0", "", "no", "off"):
+        assert not jaxapi.strict_enabled(env={"KATA_TPU_STRICT": falsy})
+
+
+def test_server_reads_env_gate(tiny, monkeypatch):
+    params, cfg = tiny
+    monkeypatch.setenv("KATA_TPU_STRICT", "1")
+    assert GenerationServer(params, cfg, max_batch=1, max_len=32).strict
+    monkeypatch.delenv("KATA_TPU_STRICT")
+    assert not GenerationServer(params, cfg, max_batch=1, max_len=32).strict
+    # explicit param overrides the env either way
+    monkeypatch.setenv("KATA_TPU_STRICT", "1")
+    assert not GenerationServer(
+        params, cfg, max_batch=1, max_len=32, strict=False
+    ).strict
+
+
+# ----- scope semantics -------------------------------------------------------
+
+
+@pytest.mark.skipif(not _HAS_GUARD, reason="jax lacks transfer_guard")
+def test_strict_mode_blocks_implicit_transfer_allows_explicit():
+    f = jax.jit(lambda a: a * 2)
+    x = jnp.arange(4.0)
+    f(x)  # compile outside
+    host = np.arange(4.0, dtype=np.float32)
+    with jaxapi.strict_mode(rank_promotion=None):
+        f(x)  # device inputs: clean
+        f(jax.device_put(host))  # explicit upload: clean
+        with pytest.raises(Exception, match="[Tt]ransfer"):
+            f(host)  # implicit upload: trips
+        with jaxapi.allow_transfer("sanctioned test read"):
+            f(host)  # hatch re-allows
+
+
+@pytest.mark.skipif(
+    not hasattr(jax, "numpy_rank_promotion"), reason="no rank ctx"
+)
+def test_strict_mode_rank_promotion_raises():
+    # Operands built OUTSIDE the scope: under the transfer guard, even a
+    # jnp.zeros literal is an implicit upload (that strictness is the
+    # point, but rank promotion is what THIS test pins).
+    a, b = jnp.zeros((3,)), jnp.zeros((2, 3))
+    with jaxapi.strict_mode():
+        with pytest.raises(ValueError, match="rank_promotion"):
+            a + b
+    # outside the scope the default behavior is restored
+    a + b
+
+
+@pytest.mark.skipif(not hasattr(jax, "debug_nans"), reason="no debug_nans")
+def test_strict_mode_debug_nans():
+    neg = jnp.float32(-1.0)  # built outside the transfer guard
+    with jaxapi.strict_mode(debug_nans=True):
+        with pytest.raises(FloatingPointError):
+            jnp.log(neg).block_until_ready()
+
+
+def test_strict_mode_noop_warns_once_without_guard():
+    fake_jax = types.SimpleNamespace(__version__="0.3.0")  # no transfer_guard
+    jaxapi._strict_warned = False
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            with jaxapi.strict_mode(jax_mod=fake_jax):
+                pass
+            with jaxapi.strict_mode(jax_mod=fake_jax):
+                pass
+        relevant = [w for w in caught if "transfer_guard" in str(w.message)]
+        assert len(relevant) == 1  # warn-once, then silent no-op
+    finally:
+        jaxapi._strict_warned = False
+
+
+def test_allow_transfer_is_safe_outside_strict():
+    with jaxapi.allow_transfer("no active guard"):
+        assert float(jnp.float32(3.0)) == 3.0
+
+
+# ----- serving integration ---------------------------------------------------
+
+
+@pytest.mark.skipif(not _HAS_GUARD, reason="jax lacks transfer_guard")
+def test_strict_overlapped_serving_matches_lockstep(tiny):
+    """The sanctioned paths — admission prefill reads and the DeviceFence
+    retire — pass under the guard, and strict output is token-identical
+    to the unguarded lock-step loop."""
+    params, cfg = tiny
+    _, rids_s, res_s = _serve(params, cfg, strict=True, overlap=True)
+    _, rids_l, res_l = _serve(params, cfg, strict=False, overlap=False)
+    for a, b in zip(rids_s, rids_l):
+        assert np.array_equal(res_s[a], res_l[b])
+
+
+@pytest.mark.skipif(not _HAS_GUARD, reason="jax lacks transfer_guard")
+def test_strict_catches_injected_implicit_transfer(tiny, monkeypatch,
+                                                   tmp_path):
+    """Reintroduce the pre-pipelining host round-trip (decode fed from
+    host numpy instead of on-device state): the guard must raise, and a
+    strict/guard_trip event must land in the obs stream."""
+    params, cfg = tiny
+    real = serving_mod._serve_decode
+
+    def leaky(params, caches, tok, pos, *args, **kw):
+        return real(params, caches, np.asarray(tok), pos, *args, **kw)
+
+    monkeypatch.setattr(serving_mod, "_serve_decode", leaky)
+    sink = obs.EventSink(str(tmp_path / "events.jsonl"))
+    old_sink = obs.default_sink()
+    obs.set_default_sink(sink)
+    try:
+        srv = GenerationServer(
+            params, cfg, max_batch=2, max_len=64, chunk=4, strict=True
+        )
+        srv.submit(np.arange(1, 9, dtype=np.int32), 12)
+        with pytest.raises(Exception, match="[Tt]ransfer"):
+            srv.run()
+    finally:
+        obs.set_default_sink(old_sink)
+    trips = [
+        e for e in obs.read_events(sink.path)
+        if e.get("kind") == "strict" and e.get("name") == "guard_trip"
+    ]
+    assert trips and trips[0]["scope"] == "serving.decode_dispatch"
+    # the unguarded server accepts the same injected transfer silently —
+    # that silence is what strict mode exists to remove
+    monkeypatch.setattr(serving_mod, "_serve_decode", real)
+    srv2 = GenerationServer(
+        params, cfg, max_batch=2, max_len=64, chunk=4, strict=False
+    )
+    srv2.submit(np.arange(1, 9, dtype=np.int32), 12)
+    assert srv2.run()
+
+
+@pytest.mark.skipif(not _HAS_GUARD, reason="jax lacks transfer_guard")
+def test_strict_batched_admission_and_buckets(tiny):
+    # The batched [N, bucket] admission prefill path also runs inside the
+    # guard (under the allow_transfer hatch) — burst arrival must not trip.
+    params, cfg = tiny
+    srv = GenerationServer(
+        params, cfg, max_batch=4, max_len=64, chunk=4, strict=True,
+        prefill_buckets=(16,),
+    )
+    rids = [srv.submit(np.arange(1, 6 + i, dtype=np.int32), 8)
+            for i in range(6)]
+    res = srv.run()
+    assert sorted(res) == sorted(rids)
+    assert srv.stats()["prefill_batches"] >= 1

@@ -1,0 +1,38 @@
+"""jaxguard: interprocedural AST + dataflow analysis for JAX hazards.
+
+The per-function linter (``tools.lint``) pattern-matches single
+functions; it cannot see that a value produced inside ``jax.jit`` flows
+into ``float()`` three calls later. This package builds a per-module
+symbol table and call graph over the repo (:mod:`.graph`), runs a
+device-value taint fixpoint across it (:mod:`.dataflow`), and reports:
+
+- **JG101** — implicit host sync in a hot path,
+- **JG102** — use-after-donation,
+- **JG103** — tracer leak,
+- **JG104** — recompile hazard.
+
+Every static rule is paired with a runtime strict-mode switch
+(``kata_xpu_device_plugin_tpu.compat.jaxapi.strict_mode`` /
+``KATA_TPU_STRICT=1``), so CI enforces the same contract both ways:
+jaxguard catches what never runs, the transfer guard catches what the
+analyzer cannot resolve. Suppression pragmas share the lint grammar:
+``# jaxguard: allow(JG101) <reason>`` (see ``tools.pragmas``).
+"""
+from .cli import analyze_source, analyze_sources, main, run, write_report
+from .dataflow import Analyzer, analyze_program
+from .graph import Program, load_program
+from .model import ALL_RULES, Finding
+
+__all__ = [
+    "ALL_RULES",
+    "Analyzer",
+    "Finding",
+    "Program",
+    "analyze_program",
+    "analyze_source",
+    "analyze_sources",
+    "load_program",
+    "main",
+    "run",
+    "write_report",
+]

@@ -1,0 +1,195 @@
+"""``python -m tools.analyze`` — run jaxguard over the repo surface.
+
+Exit status mirrors ``tools.lint``: 0 clean, 1 findings, 2 usage error.
+Findings print as ``path:line: RULE message``; ``--json FILE`` writes the
+machine-readable report (always, clean or not — CI uploads it as the
+per-PR artifact).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Iterable, Optional
+
+from ..pragmas import allowed_lines, suppress
+from .dataflow import analyze_program
+from .graph import load_program
+from .model import ALL_RULES, Finding
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}
+_SKIP_SUFFIXES = ("_pb2.py", "_pb2_grpc.py")
+
+# Default analysis surface: the package plus the bench/experiment scripts
+# whose timed windows carry `# jaxguard: hot` marks. Tests and tools are
+# out of scope — they neither serve traffic nor donate buffers in loops,
+# and fixture code intentionally writes rule-triggering patterns.
+DEFAULT_TARGETS = (
+    "kata_xpu_device_plugin_tpu",
+    "bench.py",
+    "scripts",
+)
+
+
+def _iter_py_files(target: str) -> Iterable[str]:
+    if os.path.isfile(target):
+        if target.endswith(".py"):
+            yield target
+        return
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+        for name in sorted(filenames):
+            if name.endswith(".py") and not name.endswith(_SKIP_SUFFIXES):
+                yield os.path.join(dirpath, name)
+
+
+def run(
+    targets: Optional[Iterable[str]] = None,
+    root: Optional[str] = None,
+    rules: Optional[Iterable[str]] = None,
+    sources: Optional[dict] = None,
+) -> list[Finding]:
+    """Analyze ``targets`` (or an in-memory ``{rel_path: src}`` map).
+
+    Interprocedural: the WHOLE selected file set is loaded into one
+    program before any rule runs — narrowing targets narrows what the
+    call graph can see, so CI runs the default surface.
+    """
+    root = root or os.getcwd()
+    if sources is None:
+        chosen = list(targets) if targets else [
+            t for t in DEFAULT_TARGETS
+            if os.path.exists(os.path.join(root, t))
+        ]
+        paths: list[str] = []
+        for target in chosen:
+            abs_target = (
+                target if os.path.isabs(target)
+                else os.path.join(root, target)
+            )
+            if not os.path.exists(abs_target):
+                raise FileNotFoundError(
+                    f"analyze target {target!r} does not exist"
+                )
+            paths.extend(_iter_py_files(abs_target))
+        if not paths:
+            # A gate that analyzed nothing must not report clean: an empty
+            # default surface means the cwd/root is wrong, not that the
+            # code is hazard-free.
+            raise FileNotFoundError(
+                f"no analyzable files under {root!r} — none of "
+                f"{', '.join(DEFAULT_TARGETS)} exist (wrong --root/cwd?)"
+            )
+        program, errors = load_program(paths, root)
+    else:
+        program, errors = load_program([], root, sources=sources)
+    findings = [
+        Finding(msg.split(":", 1)[0], int(msg.split(":", 2)[1]), "E999",
+                msg.split(":", 2)[2].strip())
+        for msg in errors
+    ]
+    findings.extend(analyze_program(program))
+    out: list[Finding] = []
+    by_path: dict[str, list] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    for path, fs in by_path.items():
+        mod = next(
+            (m for m in program.modules.values() if m.path == path), None
+        )
+        allowed = allowed_lines(mod.src) if mod is not None else {}
+        out.extend(suppress(fs, allowed, rules))
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def analyze_sources(
+    sources: dict, rules: Optional[Iterable[str]] = None
+) -> list[Finding]:
+    """Test-facing API: analyze an in-memory ``{rel_path: src}`` file set
+    as one program (interprocedural across the mapping)."""
+    return run(rules=rules, sources=sources)
+
+
+def analyze_source(
+    src: str, path: str = "mod_under_test.py",
+    rules: Optional[Iterable[str]] = None,
+) -> list[Finding]:
+    """Single-file convenience for fixture tests."""
+    return analyze_sources({path: src}, rules=rules)
+
+
+def write_report(findings: list, path: str, root: str) -> None:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    report = {
+        "tool": "jaxguard",
+        "root": os.path.abspath(root),
+        "rules": ALL_RULES,
+        "summary": {"total": len(findings), "by_rule": counts},
+        "findings": [f.to_dict() for f in findings],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description=(
+            "jaxguard: interprocedural dataflow analysis for JAX "
+            "tracer/transfer/donation hazards (JG101-JG104)."
+        ),
+    )
+    parser.add_argument(
+        "targets", nargs="*",
+        help="files/directories to analyze (default: package + bench + scripts)",
+    )
+    parser.add_argument(
+        "--rule", action="append", dest="rules", metavar="ID",
+        help="restrict to one or more rule ids (repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue"
+    )
+    parser.add_argument(
+        "--json", default=None, metavar="FILE",
+        help="write the machine-readable report here (CI artifact)",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root paths are reported relative to (default: cwd)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, summary in sorted(ALL_RULES.items()):
+            print(f"{rule}  {summary}")
+        return 0
+
+    if args.rules:
+        unknown = set(args.rules) - set(ALL_RULES)
+        if unknown:
+            print(f"unknown rule ids: {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    try:
+        findings = run(args.targets or None, args.root, args.rules)
+    except FileNotFoundError as err:
+        print(str(err), file=sys.stderr)
+        return 2
+
+    if args.json:
+        write_report(findings, args.json, args.root or os.getcwd())
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(
+            f"\n{len(findings)} finding(s). Rule docs: "
+            "docs/compat_and_lint.md#jaxguard",
+            file=sys.stderr,
+        )
+        return 1
+    return 0

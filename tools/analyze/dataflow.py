@@ -1,0 +1,747 @@
+"""jaxguard pass 2: interprocedural device-value dataflow + the JG rules.
+
+The engine runs three fixpoints over the :class:`~.graph.Program`'s call
+graph, then a collection pass per function:
+
+1. **returns-device** — a function whose return expression is tainted
+   (or that is jitted) marks its CALLERS' call results tainted, so a
+   value produced inside ``jax.jit`` is still device-tainted three calls
+   later (the case the per-function linter provably cannot see).
+2. **parameter taint** — a call site passing a tainted value marks the
+   callee's parameter tainted (context-insensitive: any caller taints
+   all contexts — errs toward finding the sync).
+3. **class-attribute taint** — ``self.X = <tainted>`` in any method
+   taints ``self.X`` reads in every method of that class (the serving
+   arena pattern).
+
+Taint sources: calls to jitted callables, calls resolved to
+returns-device functions, the :data:`~.model.DEVICE_FN_NAMES` /
+:data:`~.model.DEVICE_PREFIXES` conventions, and — inside jitted
+bodies — the non-static parameters themselves (they are tracers there).
+
+Rules (catalogue in :data:`~.model.ALL_RULES`): JG101 fires only in
+functions HOT (reachable from the serving/trainer step roots or marked
+``# jaxguard: hot``) and not themselves traced; JG102/JG104a/b fire at
+call sites of jitted callables anywhere; JG103/JG104c fire inside traced
+bodies. Suppression: ``# jaxguard: allow(JGxxx) reason`` on the finding
+line (shared grammar — ``tools.pragmas``).
+"""
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+from typing import Optional
+
+from .graph import FunctionInfo, Program, dotted
+from .model import (
+    ALL_RULES,
+    DEVICE_FN_NAMES,
+    DEVICE_PREFIXES,
+    Finding,
+    HOT_ROOT_SUFFIXES,
+    NONDEVICE_ATTRS,
+    SYNC_BUILTINS,
+    SYNC_METHODS,
+    SYNC_NUMPY,
+)
+
+_UNHASHABLE = (
+    ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp, ast.DictComp,
+    ast.GeneratorExp,
+)
+# Mutating methods that leak a traced value into the receiver. Only calls
+# whose RESULT is discarded (bare expression statements) count: optax's
+# `updates, state = optimizer.update(...)` is pure-functional despite the
+# name, and binding the result is the tell.
+_MUTATORS = frozenset({"append", "extend", "add", "insert", "update"})
+
+
+def _any(t) -> bool:
+    """Collapse a (possibly tuple-structured) taint to a plain bool."""
+    return any(t) if isinstance(t, tuple) else bool(t)
+
+
+def _merge_taint(a, b):
+    """Join two taints: True dominates; same-length tuples join
+    element-wise (mixed-return functions like ``(do_sample, key)`` keep
+    per-element precision); everything else collapses."""
+    if a is True or b is True:
+        return True
+    if isinstance(a, tuple) and isinstance(b, tuple):
+        if len(a) == len(b):
+            return tuple(x or y for x, y in zip(a, b))
+        return _any(a) or _any(b)
+    if a is False:
+        return b
+    if b is False:
+        return a
+    return _any(a) or _any(b)
+
+
+class Analyzer:
+    def __init__(self, program: Program):
+        self.prog = program
+        self.returns_device: dict[str, bool] = {}
+        self.tainted_params: dict[str, set] = defaultdict(set)
+        self.class_attrs: dict[tuple, set] = defaultdict(set)
+        self.call_edges: dict[str, set] = defaultdict(set)
+
+    # ----- driver -----------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        fns = self.prog.functions
+        for q, fn in fns.items():
+            if fn.jit is not None:
+                self.returns_device[q] = True
+        changed, passes = True, 0
+        while changed and passes < 12:
+            changed, passes = False, passes + 1
+            for q, fn in fns.items():
+                ev = _FnEval(self, fn)
+                ev.walk()
+                self.call_edges[q] = ev.edges
+                merged = _merge_taint(
+                    self.returns_device.get(q, False), ev.returns_struct
+                )
+                if merged != self.returns_device.get(q, False):
+                    self.returns_device[q] = merged
+                    changed = True
+                for callee_q, pname in ev.param_taints:
+                    if pname not in self.tainted_params[callee_q]:
+                        self.tainted_params[callee_q].add(pname)
+                        changed = True
+                for key, attr in ev.attr_taints:
+                    if attr not in self.class_attrs[key]:
+                        self.class_attrs[key].add(attr)
+                        changed = True
+        hot = self._hot_set()
+        findings: list[Finding] = []
+        seen = set()
+        for q, fn in fns.items():
+            ev = _FnEval(
+                self, fn,
+                collect=True,
+                hot=(q in hot) and not self.traced(fn),
+            )
+            ev.walk()
+            for f in ev.findings:
+                key = (f.path, f.line, f.rule, f.message)
+                if key not in seen:
+                    seen.add(key)
+                    findings.append(f)
+        return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+    def traced(self, fn: FunctionInfo) -> bool:
+        """Is ``fn``'s body traced when it runs — jitted itself, or nested
+        inside a jitted def (scan bodies, shard_map closures)?"""
+        if fn.jit is not None:
+            return True
+        qual = fn.qualname
+        while "." in qual.split(":", 1)[1]:
+            qual = qual.rsplit(".", 1)[0]
+            anc = self.prog.functions.get(qual)
+            if anc is not None and anc.jit is not None:
+                return True
+        return False
+
+    def _hot_set(self) -> set:
+        hot = set()
+        for q, fn in self.prog.functions.items():
+            flat = q.replace(":", ".")
+            if fn.hot_marked or any(
+                flat.endswith(s) for s in HOT_ROOT_SUFFIXES
+            ):
+                hot.add(q)
+        frontier = list(hot)
+        while frontier:
+            q = frontier.pop()
+            for callee in self.call_edges.get(q, ()):
+                fn = self.prog.functions.get(callee)
+                if fn is None or callee in hot:
+                    continue
+                if fn.jit is not None:
+                    continue  # device code: no host syncs inside
+                hot.add(callee)
+                frontier.append(callee)
+        return hot
+
+
+class _FnEval:
+    """One pass over one function body: taint propagation in statement
+    order with rule checks as side effects. ``collect=False`` runs the
+    same walk for the fixpoint facts only."""
+
+    def __init__(
+        self,
+        an: Analyzer,
+        fn: FunctionInfo,
+        collect: bool = False,
+        hot: bool = False,
+    ):
+        self.an = an
+        self.fn = fn
+        self.collect = collect
+        self.hot = hot
+        self.traced = an.traced(fn)
+        self.mod = an.prog.modules[fn.modname]
+        self.env: dict[str, bool] = {}
+        statics = fn.static_param_names()
+        for p in fn.params:
+            if self.traced:
+                self.env[p] = p not in statics and p not in ("self", "cls")
+            else:
+                self.env[p] = p in an.tainted_params.get(fn.qualname, ())
+        self.watches: dict[str, tuple] = {}  # dotted → (line, callee name)
+        self.loop_vars: list[set] = []
+        self.globals_decl: set = set()
+        self.edges: set = set()
+        self.param_taints: set = set()
+        self.attr_taints: set = set()
+        self.returns_struct = False  # bool | tuple[bool, ...]
+        self.findings: list[Finding] = []
+        self._pure = 0
+        self._expr_value: Optional[ast.AST] = None
+
+    # ----- helpers ----------------------------------------------------------
+
+    def _add(self, node: ast.AST, rule: str, message: str) -> None:
+        if not self.collect or self._pure:
+            return
+        self.findings.append(Finding(
+            self.fn.path, getattr(node, "lineno", 1), rule, message,
+            function=self.fn.qualname,
+        ))
+
+    def _sync(self, node: ast.AST, what: str) -> None:
+        if self.hot:
+            self._add(
+                node, "JG101",
+                f"{what} forces an implicit device→host sync in a hot "
+                "path — move it to a sanctioned sync point or annotate "
+                "'# jaxguard: allow(JG101) <reason>'",
+            )
+
+    def _check_watch(self, node: ast.AST, name: str) -> None:
+        """A load of ``name`` while a donation watch covers it (exact or
+        prefix) is a use-after-donation."""
+        if self._pure or not self.watches:
+            return
+        for watched, (line, callee) in self.watches.items():
+            if name == watched or name.startswith(watched + ".") or (
+                watched.startswith(name + ".")
+            ):
+                self._add(
+                    node, "JG102",
+                    f"'{name}' was donated to '{callee}' at line {line} "
+                    "and is read afterwards — donated buffers are deleted "
+                    "by XLA; rebind the call's result instead",
+                )
+
+    def _store(self, name: str) -> None:
+        if self._pure:
+            return
+        for watched in list(self.watches):
+            if watched == name or watched.startswith(name + "."):
+                del self.watches[watched]
+
+    def _in_loop_vars(self, expr: ast.AST) -> Optional[str]:
+        names = {n for scope in self.loop_vars for n in scope}
+        if not names:
+            return None
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in names:
+                return sub.id
+        return None
+
+    # ----- expression taint -------------------------------------------------
+
+    def taint(self, node: Optional[ast.AST]):
+        """Evaluate ``node``'s taint: bool, or a tuple of bools for tuple
+        literals / structured returns (per-element precision survives
+        unpacking)."""
+        if node is None:
+            return False
+        m = getattr(
+            self, f"_t_{type(node).__name__}", None
+        )
+        if m is not None:
+            return m(node)
+        # Default: visit children, propagate any taint.
+        out = False
+        for child in ast.iter_child_nodes(node):
+            out = _any(self.taint(child)) or out
+        return out
+
+    def _t_Constant(self, node) -> bool:
+        return False
+
+    def _t_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self._check_watch(node, node.id)
+        return self.env.get(node.id, False)
+
+    def _t_Attribute(self, node) -> bool:
+        d = dotted(node)
+        if d is not None and isinstance(node.ctx, ast.Load):
+            self._check_watch(node, d)
+        if (
+            d is not None
+            and d.startswith("self.")
+            and d.count(".") == 1
+            and self.fn.cls is not None
+        ):
+            return node.attr in self.an.class_attrs.get(
+                (self.fn.modname, self.fn.cls), ()
+            )
+        base = _any(self.taint(node.value))
+        if node.attr in NONDEVICE_ATTRS:
+            return False
+        return base
+
+    def _t_Subscript(self, node):
+        base = self.taint(node.value)
+        self.taint(node.slice)
+        if isinstance(base, tuple):
+            if isinstance(node.slice, ast.Constant) and isinstance(
+                node.slice.value, int
+            ) and -len(base) <= node.slice.value < len(base):
+                return base[node.slice.value]
+            return _any(base)
+        return base
+
+    def _t_Tuple(self, node):
+        return tuple(_any(self.taint(e)) for e in node.elts)
+
+    def _t_List(self, node) -> bool:
+        return any([_any(self.taint(e)) for e in node.elts])
+
+    _t_Set = _t_List
+
+    def _t_Dict(self, node) -> bool:
+        out = False
+        for k, v in zip(node.keys, node.values):
+            self.taint(k)
+            out = self.taint(v) or out
+        return out
+
+    def _t_BinOp(self, node) -> bool:
+        left = _any(self.taint(node.left))
+        return _any(self.taint(node.right)) or left
+
+    def _t_UnaryOp(self, node) -> bool:
+        return _any(self.taint(node.operand))
+
+    def _t_BoolOp(self, node) -> bool:
+        out = False
+        for v in node.values:
+            t = _any(self.taint(v))
+            if t:
+                self._sync(v, "truth-testing a device value (and/or)")
+            out = t or out
+        return out
+
+    def _t_Compare(self, node) -> bool:
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            self.taint(node.left)
+            for c in node.comparators:
+                self.taint(c)
+            return False
+        out = _any(self.taint(node.left))
+        for c in node.comparators:
+            out = _any(self.taint(c)) or out
+        return out
+
+    def _t_IfExp(self, node):
+        if _any(self.taint(node.test)):
+            self._sync(node.test, "branching on a device value (ternary)")
+        body = self.taint(node.body)
+        return _merge_taint(body, self.taint(node.orelse))
+
+    def _t_Lambda(self, node) -> bool:
+        return False  # opaque; its body runs in the callee's context
+
+    def _t_JoinedStr(self, node) -> bool:
+        for v in node.values:
+            self.taint(v)
+        return False
+
+    def _t_Await(self, node) -> bool:
+        return self.taint(node.value)
+
+    def _t_Starred(self, node) -> bool:
+        return self.taint(node.value)
+
+    def _comp(self, node) -> bool:
+        for gen in node.generators:
+            it = _any(self.taint(gen.iter))
+            self._assign_target(gen.target, it, None)
+            for cond in gen.ifs:
+                self.taint(cond)
+        if isinstance(node, ast.DictComp):
+            self.taint(node.key)
+            return _any(self.taint(node.value))
+        return _any(self.taint(node.elt))
+
+    _t_ListComp = _comp
+    _t_SetComp = _comp
+    _t_GeneratorExp = _comp
+    _t_DictComp = _comp
+
+    # ----- calls ------------------------------------------------------------
+
+    def _t_Call(self, node: ast.Call):
+        d = dotted(node.func) or ""
+        leaf = d.rsplit(".", 1)[-1]
+
+        # Evaluate the receiver ONCE, non-pure: a method call on a donated
+        # buffer (`arena.sum()`) is a read of it, and the base's taint
+        # feeds the method-sync and mutator checks below.
+        base_taint = False
+        if isinstance(node.func, ast.Attribute):
+            base_taint = _any(self.taint(node.func.value))
+
+        arg_taints = [_any(self.taint(a)) for a in node.args]
+        kw_taints = {
+            k.arg: _any(self.taint(k.value)) for k in node.keywords
+        }
+
+        # Host-sync sinks (result is host; the CALL is the event).
+        if d in SYNC_BUILTINS and arg_taints[:1] == [True]:
+            self._sync(node, f"{d}() of a device value")
+            return False
+        if d in SYNC_NUMPY and (
+            any(arg_taints) or any(kw_taints.values())
+        ):
+            self._sync(node, f"{d}() of a device value")
+            return False
+        if isinstance(node.func, ast.Attribute) and (
+            node.func.attr in SYNC_METHODS
+        ):
+            if base_taint:
+                self._sync(node, f".{node.func.attr}() of a device value")
+                return False
+
+        # Explicit, sanctioned host reads / fences.
+        if leaf == "device_get":
+            return False
+        if leaf == "block_until_ready":
+            return any(arg_taints)
+        if d == "len":
+            return False
+
+        callee = self.prog_resolve(d)
+        if callee is not None:
+            self.edges.add(callee.qualname)
+            self._record_param_taints(node, callee, arg_taints, kw_taints)
+            self._check_donation(node, callee, d)
+            self._check_statics(node, callee)
+            if callee.jit is not None:
+                return True
+            return self.an.returns_device.get(callee.qualname, False)
+
+        # Unresolved: fall back to the naming conventions.
+        if d.startswith(("np.", "numpy.")):
+            return False
+        if d.startswith(DEVICE_PREFIXES) or d in ("jnp", "jax"):
+            return True
+        if leaf in DEVICE_FN_NAMES:
+            return True
+        if isinstance(node.func, ast.Attribute):
+            # Mutator leak: list.append(tracer) on non-local state, and
+            # taint-through-mutation for locals (losses.append(loss)).
+            # Only discarded-result calls count as mutations — binding the
+            # result (optax's `updates, st = optimizer.update(...)`) is
+            # the pure-functional tell.
+            base_d = dotted(node.func.value)
+            if (
+                node.func.attr in _MUTATORS
+                and any(arg_taints)
+                and node is self._expr_value
+            ):
+                if base_d is not None and base_d in self.env:
+                    self.env[base_d] = True
+                elif self.traced:
+                    self._add(
+                        node, "JG103",
+                        f"'{base_d or '?'}.{node.func.attr}(...)' stores a "
+                        "traced value into state that outlives the traced "
+                        "call (tracer leak)",
+                    )
+            if base_taint:
+                return True  # x.astype / x.reshape / x.argmax … stay device
+        return False
+
+    def prog_resolve(self, d: str):
+        if not d:
+            return None
+        return self.an.prog.resolve_call(self.mod, self.fn.cls, d)
+
+    def _call_offset(self, callee: FunctionInfo, d: str) -> int:
+        return 1 if (
+            callee.cls is not None
+            and callee.params[:1] in (("self",), ("cls",))
+            and "." in d
+        ) else 0
+
+    def _record_param_taints(self, node, callee, arg_taints, kw_taints):
+        if callee.jit is not None:
+            return
+        off = self._call_offset(callee, dotted(node.func) or "")
+        for i, t in enumerate(arg_taints):
+            if t and i + off < len(callee.params):
+                self.param_taints.add(
+                    (callee.qualname, callee.params[i + off])
+                )
+        for name, t in kw_taints.items():
+            if t and name in callee.params:
+                self.param_taints.add((callee.qualname, name))
+
+    def _check_donation(self, node, callee, d):
+        if self._pure or callee.jit is None or not callee.jit.donates:
+            return
+        off = self._call_offset(callee, d)
+        donated = set(callee.donated_positions())
+        names = set(callee.jit.donate_argnames)
+        exprs = []
+        for i, arg in enumerate(node.args):
+            if i + off in donated:
+                exprs.append(arg)
+        for k in node.keywords:
+            if k.arg in names or (
+                k.arg in callee.params
+                and callee.params.index(k.arg) in donated
+            ):
+                exprs.append(k.value)
+        for expr in exprs:
+            name = dotted(expr)
+            if name is not None:
+                self.watches[name] = (node.lineno, callee.name)
+
+    def _check_statics(self, node, callee):
+        if self._pure or callee.jit is None:
+            return
+        statics = callee.static_param_names()
+        if not statics:
+            return
+        off = self._call_offset(callee, dotted(node.func) or "")
+        pairs = []
+        for i, arg in enumerate(node.args):
+            if i + off < len(callee.params) and (
+                callee.params[i + off] in statics
+            ):
+                pairs.append((callee.params[i + off], arg))
+        for k in node.keywords:
+            if k.arg in statics:
+                pairs.append((k.arg, k.value))
+        for pname, arg in pairs:
+            if isinstance(arg, _UNHASHABLE):
+                self._add(
+                    arg, "JG104",
+                    f"unhashable {type(arg).__name__} passed as static arg "
+                    f"'{pname}' of jitted '{callee.name}' — jit statics "
+                    "must be hashable (use a tuple)",
+                )
+                continue
+            var = self._in_loop_vars(arg)
+            if var is not None:
+                self._add(
+                    arg, "JG104",
+                    f"static arg '{pname}' of jitted '{callee.name}' varies "
+                    f"with loop variable '{var}' — one fresh executable "
+                    "compiles per iteration",
+                )
+
+    # ----- statements -------------------------------------------------------
+
+    def walk(self) -> None:
+        self._stmts(self.fn.node.body)
+
+    def _stmts(self, body) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, node) -> None:
+        kind = type(node).__name__
+        m = getattr(self, f"_s_{kind}", None)
+        if m is not None:
+            m(node)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # indexed and checked as their own functions
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.taint(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    def _s_Expr(self, node) -> None:
+        self._expr_value = node.value
+        self.taint(node.value)
+        self._expr_value = None
+
+    def _assign_target(self, target, t, value_node) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = t
+            self._store(target.id)
+            if self.traced and _any(t) and target.id in self.globals_decl:
+                self._add(
+                    target, "JG103",
+                    f"traced value stored to global '{target.id}' — it "
+                    "outlives the traced call (tracer leak)",
+                )
+        elif isinstance(target, ast.Attribute):
+            d = dotted(target)
+            if d is not None:
+                self._store(d)
+            if self.traced and _any(t):
+                self._add(
+                    target, "JG103",
+                    f"traced value stored to '{d or '?'}' — attribute "
+                    "state outlives the traced call (tracer leak)",
+                )
+            elif (
+                _any(t)
+                and d is not None
+                and d.startswith("self.")
+                and d.count(".") == 1
+                and self.fn.cls is not None
+            ):
+                self.attr_taints.add(
+                    ((self.fn.modname, self.fn.cls), target.attr)
+                )
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = target.elts
+            parts = (
+                t if isinstance(t, tuple) and len(t) == len(elts)
+                else [_any(t)] * len(elts)
+            )
+            for tgt, part in zip(elts, parts):
+                if isinstance(tgt, ast.Starred):
+                    tgt = tgt.value
+                self._assign_target(tgt, part, None)
+        elif isinstance(target, ast.Subscript):
+            # Writing INTO a watched (donated) buffer is a read of it.
+            self.taint(target.value)
+            self.taint(target.slice)
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, t, None)
+
+    def _s_Assign(self, node) -> None:
+        t = self.taint(node.value)
+        for target in node.targets:
+            self._assign_target(target, t, node.value)
+
+    def _s_AnnAssign(self, node) -> None:
+        if node.value is not None:
+            self._assign_target(node.target, self.taint(node.value), node.value)
+
+    def _s_AugAssign(self, node) -> None:
+        prior = _any(self.taint(node.target))  # load side (watch check incl.)
+        t = _any(self.taint(node.value)) or prior
+        self._assign_target(node.target, t, None)
+
+    def _s_Return(self, node) -> None:
+        if node.value is None:
+            return
+        t = self.taint(node.value)
+        if isinstance(t, tuple):
+            t = tuple(bool(x) for x in t)
+        self.returns_struct = _merge_taint(self.returns_struct, t)
+
+    def _branch_test(self, test, kind: str) -> None:
+        if _any(self.taint(test)):
+            self._sync(test, f"branching on a device value ({kind})")
+        if self.traced:
+            self._shape_branch(test, kind)
+
+    def _shape_branch(self, test, kind: str) -> None:
+        for sub in ast.walk(test):
+            hit = None
+            if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim"
+            ):
+                hit = sub.value
+            elif isinstance(sub, ast.Call) and dotted(sub.func) == "len" and (
+                sub.args
+            ):
+                hit = sub.args[0]
+            if hit is None:
+                continue
+            self._pure += 1
+            tainted = _any(self.taint(hit))
+            self._pure -= 1
+            if tainted:
+                self._add(
+                    sub, "JG104",
+                    f"shape-dependent Python {kind} inside a jitted body — "
+                    "one executable compiles per distinct shape (bucket "
+                    "inputs, or annotate '# jaxguard: allow(JG104) <why>')",
+                )
+
+    def _s_If(self, node) -> None:
+        self._branch_test(node.test, "if")
+        self._stmts(node.body)
+        self._stmts(node.orelse)
+
+    def _s_While(self, node) -> None:
+        self._branch_test(node.test, "while")
+        self._stmts(node.body)
+        self._stmts(node.body)  # loop-carried taint/donations
+        self._stmts(node.orelse)
+
+    def _s_For(self, node) -> None:
+        it = _any(self.taint(node.iter))
+        self._assign_target(node.target, it, None)
+        scope = {
+            n.id for n in ast.walk(node.target) if isinstance(n, ast.Name)
+        }
+        self.loop_vars.append(scope)
+        self._stmts(node.body)
+        self._stmts(node.body)  # loop-carried taint/donations
+        self.loop_vars.pop()
+        self._stmts(node.orelse)
+
+    _s_AsyncFor = _s_For
+
+    def _s_With(self, node) -> None:
+        for item in node.items:
+            t = self.taint(item.context_expr)
+            if item.optional_vars is not None:
+                self._assign_target(item.optional_vars, t, None)
+        self._stmts(node.body)
+
+    _s_AsyncWith = _s_With
+
+    def _s_Try(self, node) -> None:
+        self._stmts(node.body)
+        for handler in node.handlers:
+            if handler.name:
+                self.env[handler.name] = False
+            self._stmts(handler.body)
+        self._stmts(node.orelse)
+        self._stmts(node.finalbody)
+
+    def _s_Assert(self, node) -> None:
+        self._branch_test(node.test, "assert")
+        if node.msg is not None:
+            self.taint(node.msg)
+
+    def _s_Global(self, node) -> None:
+        self.globals_decl.update(node.names)
+
+    _s_Nonlocal = _s_Global
+
+    def _s_Delete(self, node) -> None:
+        for tgt in node.targets:
+            name = dotted(tgt)
+            if name is not None:
+                self._store(name)
+                self.env.pop(name, None)
+
+
+def analyze_program(program: Program) -> list[Finding]:
+    return Analyzer(program).run()
+
+
+__all__ = ["Analyzer", "analyze_program", "ALL_RULES", "Finding"]

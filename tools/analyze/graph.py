@@ -1,0 +1,358 @@
+"""jaxguard pass 1: per-module symbol tables and the callable index.
+
+Turns a set of Python sources into a :class:`Program`:
+
+- every module gets an import map (local alias → fully-dotted target,
+  relative imports resolved against the module's package), so a call
+  spelled ``prefill(...)`` in ``guest/serving.py`` resolves to the
+  function OBJECT defined in ``models/transformer.py``;
+- every function/method — including nested defs, which is where this
+  repo jits its train steps — is indexed with its jit wrapping parsed
+  off the decorators (``@jax.jit``, ``@partial(jax.jit, static_argnames=…,
+  donate_argnums=…)``) or off a module-level ``name = jax.jit(fn, …)``
+  assignment;
+- ``# jaxguard: hot`` markers on (or directly above) a ``def`` line are
+  recorded, so bench/script timing windows can opt into the hot-path
+  rules without being reachable from the serving/trainer roots.
+
+Resolution is name-based and best-effort by design: an unresolved call
+contributes no taint and no reachability — the analyzer errs quiet, and
+the runtime strict mode (``compat.jaxapi.strict_mode``) is the backstop
+for what static analysis cannot see.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .model import HOT_MARK
+
+_JIT_NAMES = frozenset({"jit", "jax.jit"})
+_PARTIAL_NAMES = frozenset({"partial", "functools.partial"})
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` attribute/name chain → ``"a.b.c"`` (None otherwise)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass(frozen=True)
+class JitInfo:
+    static_argnums: tuple = ()
+    static_argnames: tuple = ()
+    donate_argnums: tuple = ()
+    donate_argnames: tuple = ()
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donate_argnums or self.donate_argnames)
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str          # "pkg.mod:Class.meth" / "pkg.mod:fn" / "pkg.mod:outer.inner"
+    modname: str
+    path: str
+    name: str              # leaf name
+    cls: Optional[str]
+    node: ast.AST          # FunctionDef / AsyncFunctionDef
+    params: tuple          # positional+kwonly parameter names, in order
+    jit: Optional[JitInfo]
+    hot_marked: bool
+    nested: bool = False   # defined inside another function
+
+    def static_param_names(self) -> frozenset:
+        if self.jit is None:
+            return frozenset()
+        names = set(self.jit.static_argnames)
+        for i in self.jit.static_argnums:
+            if isinstance(i, int) and 0 <= i < len(self.params):
+                names.add(self.params[i])
+        return frozenset(names)
+
+    def donated_positions(self) -> tuple:
+        """Donated parameter indices (argnames mapped through the
+        signature), for matching positional args at call sites."""
+        if self.jit is None:
+            return ()
+        idx = set(
+            i for i in self.jit.donate_argnums if isinstance(i, int)
+        )
+        for name in self.jit.donate_argnames:
+            if name in self.params:
+                idx.add(self.params.index(name))
+        return tuple(sorted(idx))
+
+
+@dataclass
+class Module:
+    modname: str
+    path: str
+    src: str
+    tree: ast.AST
+    imports: dict = field(default_factory=dict)   # alias → dotted target
+    functions: dict = field(default_factory=dict)  # local name → FunctionInfo
+
+
+def _const_tuple(node: ast.AST) -> tuple:
+    """Literal int/str (or tuple/list of them) → python tuple; anything
+    dynamic → empty (the analyzer only trusts what it can read)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, str)):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(
+                elt.value, (int, str)
+            ):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def _jit_kwargs(keywords) -> JitInfo:
+    kw = {}
+    for k in keywords:
+        if k.arg in (
+            "static_argnums", "static_argnames",
+            "donate_argnums", "donate_argnames",
+        ):
+            vals = _const_tuple(k.value)
+            kw[k.arg] = tuple(v for v in vals if isinstance(v, str)) if (
+                k.arg.endswith("argnames")
+            ) else tuple(v for v in vals if isinstance(v, int))
+    return JitInfo(**kw)
+
+
+def parse_jit_decorator(dec: ast.AST) -> Optional[JitInfo]:
+    """Recognize the jit spellings this repo uses: ``@jax.jit``/``@jit``
+    and ``@partial(jax.jit, ...)`` (functools-qualified too)."""
+    d = dotted(dec)
+    if d in _JIT_NAMES:
+        return JitInfo()
+    if isinstance(dec, ast.Call):
+        fn = dotted(dec.func)
+        if fn in _JIT_NAMES:
+            return _jit_kwargs(dec.keywords)
+        if fn in _PARTIAL_NAMES and dec.args and dotted(
+            dec.args[0]
+        ) in _JIT_NAMES:
+            return _jit_kwargs(dec.keywords)
+    return None
+
+
+def _param_names(node: ast.AST) -> tuple:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    names += [p.arg for p in a.kwonlyargs]
+    return tuple(names)
+
+
+def _hot_marked(src_lines: list, node: ast.AST) -> bool:
+    for lineno in (node.lineno, node.lineno - 1):
+        if 1 <= lineno <= len(src_lines) and HOT_MARK in src_lines[lineno - 1]:
+            return True
+    return False
+
+
+def path_to_modname(rel_path: str) -> str:
+    p = rel_path.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.strip("/").replace("/", ".")
+
+
+class Program:
+    """The whole analyzed source set: modules, the function index, and
+    name resolution across them."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, Module] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self._by_dotted: dict[str, str] = {}  # dotted name → qualname
+
+    # ----- construction -----------------------------------------------------
+
+    def add_source(self, src: str, rel_path: str) -> Optional[str]:
+        """Parse and index one module; returns a syntax-error message
+        instead of raising (the CLI reports it as a finding)."""
+        modname = path_to_modname(rel_path)
+        try:
+            tree = ast.parse(src, filename=rel_path)
+        except SyntaxError as err:
+            return f"{rel_path}:{err.lineno or 1}: syntax error: {err.msg}"
+        mod = Module(modname, rel_path, src, tree)
+        self.modules[modname] = mod
+        self._index_imports(mod)
+        self._index_functions(mod)
+        return None
+
+    def _index_imports(self, mod: Module) -> None:
+        is_pkg = mod.path.replace("\\", "/").endswith("__init__.py")
+        parts = mod.modname.split(".") if mod.modname else []
+        pkg_parts = parts if is_pkg else parts[:-1]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else alias.name.split(".")[0]
+                    mod.imports[local] = target
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                else:
+                    base = []
+                src_mod = ".".join(
+                    base + (node.module.split(".") if node.module else [])
+                )
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    mod.imports[local] = (
+                        f"{src_mod}.{alias.name}" if src_mod else alias.name
+                    )
+
+    def _index_functions(self, mod: Module) -> None:
+        src_lines = mod.src.splitlines()
+
+        def visit(node, cls: Optional[str], fn_path: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name, fn_path)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    jit = None
+                    for dec in child.decorator_list:
+                        jit = parse_jit_decorator(dec) or jit
+                    local = (
+                        f"{fn_path}.{child.name}" if fn_path else (
+                            f"{cls}.{child.name}" if cls else child.name
+                        )
+                    )
+                    info = FunctionInfo(
+                        qualname=f"{mod.modname}:{local}",
+                        modname=mod.modname,
+                        path=mod.path,
+                        name=child.name,
+                        cls=cls,
+                        node=child,
+                        params=_param_names(child),
+                        jit=jit,
+                        hot_marked=_hot_marked(src_lines, child),
+                        nested=bool(fn_path),
+                    )
+                    self.functions[info.qualname] = info
+                    mod.functions[local] = info
+                    if not fn_path:
+                        self._by_dotted[f"{mod.modname}.{local}"] = info.qualname
+                    visit(child, None, local)
+                else:
+                    visit(child, cls, fn_path)
+
+        visit(mod.tree, None, "")
+        self._index_jit_assignments(mod)
+
+    def _index_jit_assignments(self, mod: Module) -> None:
+        """``decode_fast = jax.jit(decode_step, donate_argnums=(1,))`` at
+        module level: the wrapped local function gets the JitInfo and the
+        new name becomes an alias for it."""
+        for node in mod.tree.body:
+            if not (isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            )):
+                continue
+            if dotted(node.value.func) not in _JIT_NAMES:
+                continue
+            if not node.value.args:
+                continue
+            target_fn = dotted(node.value.args[0])
+            info = mod.functions.get(target_fn or "")
+            if info is None:
+                continue
+            info.jit = _jit_kwargs(node.value.keywords)
+            for tgt in node.targets:
+                name = dotted(tgt)
+                if name and "." not in name:
+                    mod.functions[name] = info
+                    self._by_dotted[f"{mod.modname}.{name}"] = info.qualname
+
+    # ----- resolution -------------------------------------------------------
+
+    def chase(self, dotted_name: str, depth: int = 0) -> Optional[FunctionInfo]:
+        """Fully-dotted name → FunctionInfo, following one re-export hop
+        per level (``pkg.obs.emit`` → ``pkg.obs.events.emit``)."""
+        if depth > 4:
+            return None
+        qual = self._by_dotted.get(dotted_name)
+        if qual is not None:
+            return self.functions[qual]
+        parts = dotted_name.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = self.modules.get(".".join(parts[:i]))
+            if mod is None:
+                continue
+            rest = parts[i:]
+            target = mod.imports.get(rest[0])
+            if target is None:
+                return None
+            return self.chase(".".join([target] + rest[1:]), depth + 1)
+        return None
+
+    def resolve_call(
+        self, mod: Module, cls: Optional[str], callee: str
+    ) -> Optional[FunctionInfo]:
+        """Resolve a call's dotted spelling from inside ``mod`` (method
+        context ``cls``). Returns None for anything dynamic."""
+        if callee.startswith("self.") and cls is not None:
+            rest = callee[len("self."):]
+            if "." in rest:  # self.obj.method — attribute types unknown
+                return None
+            return self.modules[mod.modname].functions.get(f"{cls}.{rest}")
+        head, _, rest = callee.partition(".")
+        if not rest:
+            info = mod.functions.get(callee)
+            if info is not None:
+                return info
+            target = mod.imports.get(callee)
+            return self.chase(target) if target else None
+        target = mod.imports.get(head)
+        if target is not None:
+            return self.chase(f"{target}.{rest}")
+        return None
+
+
+def load_program(
+    paths: list, root: str, sources: Optional[dict] = None
+) -> tuple[Program, list]:
+    """Build a Program from files on disk (``paths`` relative to or under
+    ``root``) or from an in-memory ``{rel_path: src}`` mapping (tests).
+    Returns ``(program, parse_error_messages)``."""
+    prog = Program()
+    errors = []
+    if sources is not None:
+        for rel, src in sources.items():
+            err = prog.add_source(src, rel)
+            if err:
+                errors.append(err)
+        return prog, errors
+    for path in paths:
+        abs_path = path if os.path.isabs(path) else os.path.join(root, path)
+        rel = os.path.relpath(abs_path, root)
+        with open(abs_path, encoding="utf-8") as fh:
+            err = prog.add_source(fh.read(), rel)
+        if err:
+            errors.append(err)
+    return prog, errors

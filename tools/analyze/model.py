@@ -1,0 +1,127 @@
+"""jaxguard's data model: findings, the rule catalogue, and the knobs
+that root the dataflow analysis in this repo's conventions.
+
+The analyzer (see :mod:`.graph` and :mod:`.dataflow`) is interprocedural
+but name-based — it resolves calls through import maps and ``self.``
+method dispatch, not through runtime types. The configuration here is
+what anchors that approximation to reality:
+
+- :data:`DEVICE_FN_NAMES` — callables whose results are device arrays
+  even when the analyzer cannot see their bodies (the ISSUE's roots:
+  ``prefill``/``decode_chunk``/``make_train_step`` results and friends,
+  plus the ``step_fn`` convention for train-step callables passed as
+  parameters).
+- :data:`DEVICE_PREFIXES` — dotted prefixes that produce device values
+  (``jnp.``, ``jax.random.``, …).
+- :data:`HOT_ROOT_SUFFIXES` — the serving/training step bodies every
+  function reachable from which is "hot": a host sync there stalls the
+  pipelined round loop. ``# jaxguard: hot`` on a def line adds a root
+  anywhere (bench/scripts mark their timed windows this way).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+ALL_RULES = {
+    "JG101": "implicit host sync in a hot path "
+             "(float/int/bool/.item/np.asarray/if on a device value)",
+    "JG102": "use-after-donation (a buffer donated to a jitted call is "
+             "read afterwards)",
+    "JG103": "tracer leak (traced value stored to self/global/closure "
+             "state that outlives the traced call)",
+    "JG104": "recompile hazard (unhashable or loop-varying static args; "
+             "shape-dependent Python branching in a jitted body)",
+}
+
+# Callables whose RESULTS are device values regardless of whether the
+# analyzer resolved their bodies. Matched against the call's leaf name, so
+# the convention covers both direct imports (`prefill(...)`) and callables
+# passed as parameters (`step_fn(state, batch)` — make_train_step's
+# contract).
+DEVICE_FN_NAMES = frozenset({
+    "prefill",
+    "prefill_batch",
+    "decode",
+    "decode_chunk",
+    "generate",
+    "forward",
+    "step_fn",
+    "make_train_step",
+    "init_params",
+    "init_sharded_params",
+    "init_kv_caches",
+    "init_cycle_kv_caches",
+    "device_put",
+    "block_until_ready",  # returns its (device) argument
+})
+
+# Dotted-call prefixes that produce device arrays. jax.device_get is the
+# explicit escape hatch (host result, sanctioned) — carved out in the
+# dataflow engine, not here.
+DEVICE_PREFIXES = (
+    "jnp.",
+    "jax.numpy.",
+    "jax.random.",
+    "jax.lax.",
+    "lax.",
+    "jax.nn.",
+    "jax.tree.",
+    "jax.tree_util.",
+)
+
+# Attribute reads that return host metadata, not a device view.
+NONDEVICE_ATTRS = frozenset({
+    "shape", "dtype", "ndim", "size", "nbytes", "itemsize", "sharding",
+    "addressable_shards", "device", "devices", "aval", "weak_type",
+})
+
+# Host-sync sinks: builtins coercing a device value, numpy materializers,
+# and array methods that force a transfer.
+SYNC_BUILTINS = frozenset({"float", "int", "bool", "complex"})
+SYNC_NUMPY = frozenset({
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+})
+SYNC_METHODS = frozenset({"item", "tolist"})
+
+# Hot roots: matched as suffixes of the analyzer's function qualnames
+# ("pkg.guest.serving:GenerationServer.step"). The serving round loop and
+# the trainer step body are hot by definition; everything they reach
+# inherits it. (The ISSUE names run_round/Trainer.fit; this repo's
+# spellings are GenerationServer.step/run and parallel.trainer.fit.)
+HOT_ROOT_SUFFIXES = (
+    "GenerationServer.run_round",
+    "GenerationServer.step",
+    "GenerationServer.run",
+    ".trainer.fit",
+    "Trainer.fit",
+)
+
+# Inline marker that makes any function a hot root (same comment channel
+# as the allow() pragmas; see tools.pragmas for the suppression side).
+HOT_MARK = "# jaxguard: hot"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer finding. Shape-compatible with ``tools.lint.rules
+    .Finding`` (path/line/rule/message) so the shared suppression logic
+    and CI formatting apply to both; ``function`` names the enclosing
+    callable for the JSON report."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+    function: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "function": self.function,
+            "message": self.message,
+        }

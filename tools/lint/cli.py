@@ -84,7 +84,17 @@ def main(argv: Optional[list[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     if args.list_rules:
+        # Both static-analysis surfaces in one catalogue: the per-function
+        # lint rules here, and the interprocedural jaxguard rules (their
+        # engine lives in tools.analyze; the pragma grammar is shared —
+        # tools.pragmas).
+        print("# tools.lint (per-function AST rules)")
         for rule, summary in sorted(ALL_RULES.items()):
+            print(f"{rule}  {summary}")
+        from ..analyze.model import ALL_RULES as JG_RULES
+
+        print("# tools.analyze / jaxguard (interprocedural dataflow rules)")
+        for rule, summary in sorted(JG_RULES.items()):
             print(f"{rule}  {summary}")
         return 0
 

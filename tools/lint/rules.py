@@ -30,15 +30,18 @@ change away from hitting):
   ``/dev/...`` device nodes (tests must target fake sysfs roots) or
   calling out to the network.
 
-A finding on a line carrying ``# lint: allow(RULE)`` is suppressed; the
-pragma should name its reason inline.
+A finding on a line carrying ``# lint: allow(RULE[, RULE...])`` is
+suppressed; the pragma should name its reason inline. The grammar (and
+the suppression semantics) are shared with the jaxguard analyzer — see
+``tools.pragmas``.
 """
 from __future__ import annotations
 
 import ast
-import re
 from dataclasses import dataclass
 from typing import Iterable, Optional
+
+from ..pragmas import allowed_lines, suppress
 
 # Symbols whose import location (or existence) differs across supported JAX
 # versions — resolved once in compat/jaxapi.py, nowhere else.
@@ -81,8 +84,6 @@ _FS_PROBE_CALLS = frozenset({
 # Calls that fence JAX's async dispatch before a timer is read.
 _TIMING_FENCES = frozenset({"block_until_ready", "device_get", "asarray", "array"})
 _TIMER_CALLS = frozenset({"perf_counter", "monotonic", "time"})
-
-_PRAGMA_RE = re.compile(r"#\s*lint:\s*allow\(([A-Z0-9, ]+)\)")
 
 ALL_RULES = {
     "JX001": "direct import of a version-drifted JAX symbol outside compat/",
@@ -129,16 +130,6 @@ def _walk_own_body(fn: ast.AST):
             continue
         yield node
         stack.extend(ast.iter_child_nodes(node))
-
-
-def _allowed_lines(src: str) -> dict[int, frozenset[str]]:
-    """line number → rules allowed by an inline ``# lint: allow(...)``."""
-    out: dict[int, frozenset[str]] = {}
-    for i, text in enumerate(src.splitlines(), start=1):
-        m = _PRAGMA_RE.search(text)
-        if m:
-            out[i] = frozenset(r.strip() for r in m.group(1).split(","))
-    return out
 
 
 def _scopes(path: str) -> dict[str, bool]:
@@ -329,15 +320,7 @@ def check_source(
         ]
     checker = _Checker(path, _scopes(path))
     checker.visit(tree)
-    allowed = _allowed_lines(src)
-    selected = set(rules) if rules is not None else None
-    out = []
-    for f in checker.findings:
-        if selected is not None and f.rule not in selected:
-            continue
-        if f.rule in allowed.get(f.line, frozenset()):
-            continue
-        out.append(f)
+    out = suppress(checker.findings, allowed_lines(src), rules)
     return sorted(out, key=lambda f: (f.path, f.line, f.rule))
 
 

@@ -1,0 +1,76 @@
+"""Shared inline-suppression grammar for the repo's static analyzers.
+
+Both rule engines — ``tools.lint`` (JX/TS rules) and ``tools.analyze``
+(jaxguard JG rules) — honor the same pragma shape::
+
+    # lint: allow(JX002) pallas has no stable home
+    # jaxguard: allow(JG101) admission host read is the sanctioned sync
+    # lint: allow(JX004, JX005) wall-clock watchdog
+
+``allow(RULE[, RULE...])`` takes any number of rule ids; the text after the
+closing paren should name the reason (convention, not enforced). The tool
+prefix is documentation — rule ids are globally unique (JX*/TS* belong to
+lint, JG* to jaxguard), so either prefix suppresses either family and a
+line carrying both tools' pragmas works with one or two comments.
+
+This module is the ONE place the grammar and the suppression semantics
+live: both engines call :func:`allowed_lines` on the source and
+:func:`suppress` on their raw findings, so the per-rule filtering logic
+cannot drift apart (it had already started to: the lint engine grew its
+own regex and filter loop, and a second copy in the analyzer would have
+been the third).
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterable, Optional, Protocol
+
+# One grammar for every engine: "<tool>: allow(RULES)". New engines add
+# their prefix here, not a new regex.
+PRAGMA_RE = re.compile(
+    r"#\s*(?:lint|jaxguard):\s*allow\(([A-Z0-9, ]+)\)"
+)
+
+
+class _FindingLike(Protocol):
+    rule: str
+    line: int
+
+
+def allowed_lines(src: str) -> dict[int, frozenset[str]]:
+    """line number → rule ids allowed by inline pragmas on that line.
+
+    Multiple pragmas on one line union (a line may carry both a
+    ``# lint:`` and a ``# jaxguard:`` comment).
+    """
+    out: dict[int, frozenset[str]] = {}
+    for i, text in enumerate(src.splitlines(), start=1):
+        rules: set[str] = set()
+        for m in PRAGMA_RE.finditer(text):
+            rules.update(r.strip() for r in m.group(1).split(",") if r.strip())
+        if rules:
+            out[i] = frozenset(rules)
+    return out
+
+
+def suppress(
+    findings: Iterable[_FindingLike],
+    allowed: dict[int, frozenset[str]],
+    selected: Optional[Iterable[str]] = None,
+) -> list:
+    """Drop findings suppressed by ``allowed`` (from :func:`allowed_lines`)
+    and, when ``selected`` is given, findings outside that rule subset.
+    A pragma suppresses findings anchored to ITS OWN line.
+
+    Parse failures (rule ``E999``) bypass the ``selected`` filter: a file
+    the engine could not read at all is never "out of scope" of a rule
+    selection — dropping it would report broken code as clean."""
+    chosen = set(selected) if selected is not None else None
+    out = []
+    for f in findings:
+        if chosen is not None and f.rule != "E999" and f.rule not in chosen:
+            continue
+        if f.rule in allowed.get(f.line, frozenset()):
+            continue
+        out.append(f)
+    return out

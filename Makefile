@@ -73,8 +73,10 @@ bench:
 
 # Harness validation in seconds (ISSUE 3): smoke-tiny shapes on CPU with
 # the persistent compilation cache on and the obs JSONL stream captured —
-# the serving section's overlap-vs-lockstep A/B and the compile/prefill/
-# decode phase breakdown both land in the emitted line; CI uploads
+# the serving section's overlap-vs-lockstep A/B, the shared-prefix
+# serving A/B (ISSUE 5: serving_prefix_* vs serving_prefix_cold_* — TTFT
+# speedup, hit ratio, reused-token fraction), and the compile/prefill/
+# decode phase breakdown all land in the emitted line; CI uploads
 # bench_smoke_events.jsonl next to the tier-1 timing artifact. The number
 # printed is NOT the headline metric.
 bench-smoke:

@@ -49,14 +49,18 @@ requests through an 8-slot arena, ``serving_tok_per_s`` — plus a
 draft-model speculative variant reporting ``serving_spec_tok_per_s`` and
 the draft acceptance rate; ``KATA_TPU_BENCH_SPEC=0`` skips it), and
 Gemma-2-style softcap prefill on the pallas flash path vs the XLA
-reference (``softcap_prefill_flash_speedup``), and a train-step MFU
+reference (``softcap_prefill_flash_speedup``), a shared-prefix serving
+A/B (``serving_prefix_*`` vs ``serving_prefix_cold_*`` — the same
+system-prefix burst through a prefix-KV-store server and cold, reporting
+the TTFT speedup and the fraction of prompt tokens whose prefill was
+reused; ISSUE 5), and a train-step MFU
 section — one Llama-3-style ~256M model, one optimizer step on a 1-device
 mesh, pallas-flash vs reference attention, reported against the chip's
 public peak bf16 FLOP/s (``train_mfu``, ``train_flash_speedup``) so the
 training path (flash fwd+bwd kernels, remat, GSPMD step) has chip
-evidence, not just the decode path. All four are crash-guarded side
+evidence, not just the decode path. All five are crash-guarded side
 sections emitted AFTER the banked headline line, each with its own
-``KATA_TPU_BENCH_{INT8,SERVING,SOFTCAP,TRAIN}=0`` kill switch (the
+``KATA_TPU_BENCH_{INT8,SERVING,PREFIX,SOFTCAP,TRAIN}=0`` kill switch (the
 supervisor flips all of them off on retries and in the CPU fallback); the
 optional ``KATA_TPU_BENCH_W8A8=1`` adds the int8×int8-dot decode variant
 inside the int8 section.
@@ -281,6 +285,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
             env["KATA_TPU_BENCH_SERVING"] = "0"
             env["KATA_TPU_BENCH_SOFTCAP"] = "0"
             env["KATA_TPU_BENCH_TRAIN"] = "0"
+            env["KATA_TPU_BENCH_PREFIX"] = "0"
         attempts += 1
         stage_timeout = SMOKE_TIMEOUT_S if args.smoke else ATTEMPT_TIMEOUT_S
         line, hung = run_once(
@@ -318,10 +323,21 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
         env["KATA_TPU_BENCH_SERVING"] = "0"
         env["KATA_TPU_BENCH_SOFTCAP"] = "0"
         env["KATA_TPU_BENCH_TRAIN"] = "0"
+        env["KATA_TPU_BENCH_PREFIX"] = "0"
         cmd = list(worker_cmd) + ["--smoke", "--fallback"]
         line, _hung = run_once(cmd, env, SMOKE_TIMEOUT_S, "cpu-fallback")
         if line is not None:
             line["attempts"] = attempts
+            if attempts == 0:
+                # Honest labeling (BENCH_r05 lesson): with attempts == 0 no
+                # TPU attempt was ever dispatched — "after TPU attempts
+                # failed" misdescribes the round whether the probe hung,
+                # the probe failed, or the budget ran out first. The error
+                # field keeps the actual post-mortem as-is.
+                line["note"] = (
+                    ("probe hung; " if tunnel_dead else "")
+                    + "no TPU attempt made — cpu fallback, not a TPU number"
+                )
             line["error"] = "; ".join(errors)[-600:]
             print(json.dumps(line), flush=True)
             return 0
@@ -691,6 +707,10 @@ def worker(args: argparse.Namespace) -> None:
                     params, cfg, max_batch=BATCH, max_len=PROMPT_LEN + 72,
                     chunk=srv_chunk, prefill_buckets=(PROMPT_LEN,),
                     overlap=overlap,
+                    # Explicit 0: a daemon-injected KATA_TPU_PREFIX_CACHE_
+                    # TOKENS env must not attach a prefix store to the
+                    # overlap A/B (measure_prefix owns that comparison).
+                    prefix_cache_tokens=0,
                 )
 
             rng = jax.random.PRNGKey(42)
@@ -803,6 +823,139 @@ def worker(args: argparse.Namespace) -> None:
             return out
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"serving_error": f"{type(exc).__name__}: {exc}"[:200]}
+
+    def measure_prefix() -> dict:  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
+        # Shared-prefix KV cache A/B (ISSUE 5): the same burst of prompts
+        # that all share a long system prefix, served once through a
+        # prefix-store server (suffix-only prefill) and once cold — the
+        # TTFT and prefill-FLOP reduction the radix store is worth on this
+        # platform. Runs in smoke too (the acceptance gate: ≥50% of prompt
+        # tokens reused at 100% hit rate on the timed phase). SIDE
+        # measurement with the usual protections: after the banked
+        # headline, crash-guarded, KATA_TPU_BENCH_PREFIX=0 disables.
+        if os.environ.get("KATA_TPU_BENCH_PREFIX", "1") == "0":
+            return {}
+        try:
+            from kata_xpu_device_plugin_tpu.guest.prefix_cache import (
+                PrefixStore,
+            )
+            from kata_xpu_device_plugin_tpu.guest.serving import (
+                GenerationServer,
+            )
+
+            shared_len = PROMPT_LEN          # the common system prefix
+            tail_len = max(2, PROMPT_LEN // 8)  # per-request unique suffix
+            n_prompt = shared_len + tail_len
+            # Ladder: one bucket for the suffix, one at the shared-prefix
+            # boundary (the match), one fitting the whole prompt (cold).
+            buckets = (tail_len, shared_len, n_prompt)
+            new_per_req = 16
+            rng = jax.random.PRNGKey(7)
+            shared = np.asarray(jax.random.randint(
+                rng, (shared_len,), 0, cfg.vocab_size, dtype=jnp.int32
+            ))
+
+            def make_prompts(count, salt):
+                out = []
+                for i in range(count):
+                    tail = np.asarray(jax.random.randint(
+                        jax.random.fold_in(rng, salt + i), (tail_len,), 0,
+                        cfg.vocab_size, dtype=jnp.int32,
+                    ))
+                    out.append(np.concatenate([shared, tail]))
+                return out
+
+            def make_server(store):
+                return GenerationServer(
+                    params, cfg, max_batch=BATCH,
+                    max_len=n_prompt + new_per_req, chunk=8,
+                    prefill_buckets=buckets,
+                    prefix_store=store,
+                    # Explicit 0: the COLD side (store=None) must stay
+                    # prefix-free even when the daemon injected a
+                    # KATA_TPU_PREFIX_CACHE_TOKENS default into this env —
+                    # otherwise the baseline would grow its own store and
+                    # the A/B would compare prefix against prefix.
+                    prefix_cache_tokens=0,
+                )
+
+            def timed(store, salt):  # jaxguard: hot  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
+                # Best-of-3 like the other serving sections: one run is
+                # tens of ms at smoke shapes, inside scheduler noise, and
+                # the A/B delta is the whole point. Fresh server per trial
+                # (clean TTFT stats), shared store (prefix stays warm),
+                # varied salts (the tunnel caches identical executions).
+                best, best_ttft = None, float("inf")
+                for trial in range(3):
+                    srv = make_server(store)
+                    prompts = make_prompts(2 * BATCH, salt + 50 * trial)
+                    rids = [srv.submit(p, new_per_req) for p in prompts]
+                    t0 = time.perf_counter()
+                    results = srv.run()
+                    dt_s = time.perf_counter() - t0
+                    total = sum(len(results[r]) for r in rids)
+                    st = srv.stats()
+                    best_ttft = min(
+                        best_ttft, (st["ttft_s"] or {}).get("mean", 0.0)
+                    )
+                    if best is None or dt_s < best[1]:
+                        best = (total, dt_s, st)
+                return best[0], best[1], best[2], best_ttft
+
+            # The store is shared between the warm and timed servers, so
+            # the timed phase starts with the prefix resident (100% hit
+            # rate — the steady state of a long-running deployment) and
+            # with every executable family compiled: suffix prefill,
+            # store gather/insert, and the cold batched/bucketed prefills.
+            # Two warm passes: the first request runs the COLD path and
+            # populates the store; the second pass (store now warm) runs
+            # the HIT path — lookups happen before inserts within one
+            # admission pass, so a single pass would warm only cold.
+            store = PrefixStore(cfg, capacity_tokens=4 * shared_len,
+                                buckets=buckets, label="bench")
+            warm = make_server(store)
+            warm.submit(make_prompts(1, salt=900)[0], new_per_req)
+            warm.run()
+            # Full-width hit pass: compiles the batched [BATCH, pad]
+            # suffix executable the timed burst admits with.
+            for p in make_prompts(2 * BATCH, salt=910):
+                warm.submit(p, new_per_req)
+            warm.run()
+            cold_warm = make_server(None)
+            for p in make_prompts(2 * BATCH, salt=800):
+                cold_warm.submit(p, new_per_req)
+            cold_warm.run()
+
+            total, dt_s, st, ttft = timed(store, salt=0)
+            c_total, c_dt, _c_st, c_ttft = timed(None, salt=200)
+            submitted_tokens = 2 * BATCH * n_prompt
+            out = {
+                "serving_prefix_tok_per_s": round(total / dt_s, 1),
+                "serving_prefix_s": round(dt_s, 3),
+                "serving_prefix_ttft_mean_s": round(ttft, 4),
+                "serving_prefix_hit_ratio": st["prefix_hit_ratio"],
+                "serving_prefix_tokens_reused_frac": round(
+                    st["prefix_tokens_reused"] / submitted_tokens, 4),
+                # Prefill FLOPs scale with tokens actually run through a
+                # forward, PADDED: cold admits [n_prompt]-bucket rows, the
+                # hit path [tail_len]-bucket suffix rows — the ratio of
+                # padded forward work is the honest FLOP reduction (it
+                # differs from the reused-token fraction when suffix
+                # padding adds work back; equal here by bucket choice).
+                "serving_prefix_prefill_flop_reduction": round(
+                    1.0 - tail_len / n_prompt, 4),
+                "serving_prefix_cold_tok_per_s": round(c_total / c_dt, 1),
+                "serving_prefix_cold_s": round(c_dt, 3),
+                "serving_prefix_cold_ttft_mean_s": round(c_ttft, 4),
+            }
+            cold_ttft = out["serving_prefix_cold_ttft_mean_s"]
+            hit_ttft = out["serving_prefix_ttft_mean_s"]
+            if hit_ttft > 0:
+                out["serving_prefix_ttft_speedup"] = round(
+                    cold_ttft / hit_ttft, 3)
+            return out
+        except Exception as exc:  # noqa: BLE001 — headline must survive
+            return {"prefix_error": f"{type(exc).__name__}: {exc}"[:200]}
 
     def measure_train() -> dict:
         # Train-step MFU (r5): the flash bwd kernels, remat, and the GSPMD
@@ -951,6 +1104,10 @@ def worker(args: argparse.Namespace) -> None:
     serving_out = measure_serving()
     if serving_out:
         out.update(serving_out)
+        print(json.dumps(out), flush=True)
+    prefix_out = measure_prefix()
+    if prefix_out:
+        out.update(prefix_out)
         print(json.dumps(out), flush=True)
     softcap_out = measure_softcap_prefill()
     if softcap_out:

@@ -59,6 +59,12 @@ ENV_TPU_SKIP_MDS_QUERY = "TPU_SKIP_MDS_QUERY"
 # daemon's --compile-cache-dir knob reaches every allocated workload.
 ENV_COMPILE_CACHE_DIR = "KATA_TPU_COMPILE_CACHE_DIR"
 
+# Default shared-prefix KV cache capacity handed to the guest (ISSUE 5):
+# guest.serving.GenerationServer reads this env when the caller passes no
+# prefix_cache_tokens, so the daemon's --prefix-cache-tokens knob sizes
+# the in-guest prefix KV store per node.
+ENV_PREFIX_CACHE_TOKENS = "KATA_TPU_PREFIX_CACHE_TOKENS"
+
 # Default location where containerd/CRI-O pick up CDI spec files
 # (ref pkg/device_plugin/device_plugin.go:20).
 DEFAULT_CDI_DIR = "/var/run/cdi"

@@ -94,6 +94,15 @@ class Config:
     # corruption, read-only fs).
     compile_cache_dir: str = ""
 
+    # Shared-prefix KV cache default (ISSUE 5): when > 0, the daemon
+    # injects KATA_TPU_PREFIX_CACHE_TOKENS into every TPU AllocateResponse
+    # (plugin/allocators.py) so in-guest GenerationServers default their
+    # prefix KV store capacity (guest/prefix_cache.py) from the node's
+    # sizing instead of per-workload flags — the same delivery path as
+    # compile_cache_dir. 0 leaves the guest default (disabled unless the
+    # server opts in via prefix_cache_tokens=).
+    prefix_cache_tokens: int = 0
+
     def __post_init__(self) -> None:
         if not self.kubelet_socket:
             self.kubelet_socket = os.path.join(self.kubelet_socket_dir, "kubelet.sock")

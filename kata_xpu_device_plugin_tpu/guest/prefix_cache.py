@@ -1,0 +1,475 @@
+"""Shared-prefix KV cache: a device-resident prefix store with a host-side
+radix (token-trie) index.
+
+Production serving traffic is dominated by prompts that share a long common
+prefix — the system prompt, a few-shot template, a conversation header.
+Cold admission re-prefills that prefix from scratch for every request, so
+the shared fraction of every prompt is pure repeated prefill FLOPs and
+repeated TTFT. vLLM's automatic prefix caching (PagedAttention) and
+SGLang's RadixAttention showed the fix: keep prefix KV resident on device,
+index it by token ids, and prefill only the suffix. This module is that
+capability for the slot/arena serving model of :mod:`.serving`:
+
+- **Device side** — a dedicated KV arena (``capacity_tokens`` rows per
+  layer, same leaf layout as a one-slot serving cache: ``[L, 1, cap, KV,
+  D]``, bf16 or int8 :class:`~..ops.quant.QTensor`). Prefix segments are
+  contiguous token ranges inside it; all copies in and out are jitted
+  device-to-device ops (no host sync — the rows never leave HBM).
+- **Host side** — a :class:`RadixIndex` (path-compressed token trie) maps
+  token prefixes to segments, with refcounts (a segment referenced by an
+  in-flight request is never evicted) and LRU eviction of unreferenced
+  segments under capacity pressure.
+
+**Bucket alignment.** Every cached boundary is a ``prefill_buckets`` value:
+insertion registers entries at each bucket boundary of the stored prefix,
+and :meth:`PrefixStore.lookup` returns the longest *bucket-aligned* match.
+That preserves the serving executable-count bound — suffix prefills and
+prefix-row copies compile one executable per bucket, exactly like cold
+bucketed prefill, instead of one per distinct match length.
+
+**Exactness.** A stored segment covers only REAL prompt tokens (the
+insertion bound is ≤ ``len(prompt) - 1``, strictly inside the prompt, so
+bucket-pad KV rows never enter the store), and a lookup pins its segment
+until the server releases it. The suffix-prefill path built on top
+(:func:`..models.transformer.prefill_suffix`) reproduces the cold path's
+greedy tokens (tested in ``tests/test_prefix_cache.py``).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import obs
+from ..models.transformer import DecoderConfig, init_kv_caches
+
+
+# ----- radix index ---------------------------------------------------------
+
+
+class _Node:
+    """One radix-tree node. ``edges`` maps a first token to ``(label,
+    child)`` where ``label`` is the compressed edge's full token array;
+    ``entry`` is the segment registered at exactly this node's depth (None
+    for structural nodes)."""
+
+    __slots__ = ("edges", "entry", "depth", "parent", "pkey")
+
+    def __init__(self, depth: int, parent: Optional["_Node"], pkey: int = -1):
+        self.edges: dict[int, tuple[np.ndarray, _Node]] = {}
+        self.entry: Any = None
+        self.depth = depth
+        self.parent = parent
+        self.pkey = pkey  # first token of the edge leading here (prune key)
+
+
+def _common_len(a: np.ndarray, b: np.ndarray) -> int:
+    n = min(len(a), len(b))
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    return int(neq[0]) if len(neq) else n
+
+
+class RadixIndex:
+    """Path-compressed token trie mapping exact-length token prefixes to
+    opaque values. Pure host code over numpy int arrays — no device state;
+    :class:`PrefixStore` owns the pairing with arena segments."""
+
+    def __init__(self) -> None:
+        self._root = _Node(0, None)
+        self._entries = 0
+
+    def __len__(self) -> int:
+        return self._entries
+
+    def longest_match(self, tokens: np.ndarray) -> tuple[int, Any]:
+        """Deepest registered entry along ``tokens`` (entries only count
+        when their full depth matches ``tokens`` exactly). Returns
+        ``(depth, value)`` — ``(0, None)`` when nothing matches."""
+        tokens = np.asarray(tokens)
+        node, i = self._root, 0
+        best: tuple[int, Any] = (0, None)
+        while i < len(tokens):
+            edge = node.edges.get(int(tokens[i]))
+            if edge is None:
+                break
+            label, child = edge
+            m = _common_len(label, tokens[i:])
+            if m < len(label):
+                break  # diverged mid-edge: child's depth not reached
+            node, i = child, i + m
+            if node.entry is not None:
+                best = (node.depth, node.entry)
+        return best
+
+    def insert(self, tokens: np.ndarray, value: Any) -> _Node:
+        """Register ``value`` at exactly ``len(tokens)``; returns the node
+        (the handle :meth:`remove` takes). An existing entry at that depth
+        is left in place (first writer wins) — callers check
+        :meth:`longest_match` first when they care."""
+        tokens = np.asarray(tokens, np.int32)
+        node, i = self._root, 0
+        while i < len(tokens):
+            t = int(tokens[i])
+            edge = node.edges.get(t)
+            if edge is None:
+                child = _Node(len(tokens), node, t)
+                node.edges[t] = (tokens[i:].copy(), child)
+                node, i = child, len(tokens)
+                break
+            label, child = edge
+            m = _common_len(label, tokens[i:])
+            if m == len(label):
+                node, i = child, i + m
+                continue
+            # Split the edge at the divergence point (node.depth == i at
+            # every loop head — the pointer only advances over full labels).
+            mid = _Node(i + m, node, t)
+            node.edges[t] = (label[:m], mid)
+            mid.edges[int(label[m])] = (label[m:], child)
+            child.parent, child.pkey = mid, int(label[m])
+            node, i = mid, i + m
+        if node.entry is None and value is not None:
+            node.entry = value
+            self._entries += 1
+        return node
+
+    def remove(self, node: _Node) -> None:
+        """Clear ``node``'s entry and prune now-useless structural nodes
+        (entry-free, childless) up the parent chain."""
+        if node.entry is not None:
+            node.entry = None
+            self._entries -= 1
+        while (
+            node.parent is not None
+            and node.entry is None
+            and not node.edges
+        ):
+            parent = node.parent
+            parent.edges.pop(node.pkey, None)
+            node = parent
+
+
+# ----- arena allocation ----------------------------------------------------
+
+
+class _FreeList:
+    """First-fit allocator over one token-range; ``free`` coalesces
+    neighbors so eviction churn cannot fragment the arena permanently."""
+
+    def __init__(self, capacity: int) -> None:
+        self._free: list[tuple[int, int]] = [(0, capacity)] if capacity else []
+
+    def alloc(self, n: int) -> Optional[int]:
+        for i, (off, size) in enumerate(self._free):
+            if size >= n:
+                if size == n:
+                    self._free.pop(i)
+                else:
+                    self._free[i] = (off + n, size - n)
+                return off
+        return None
+
+    def free(self, off: int, n: int) -> None:
+        self._free.append((off, n))
+        self._free.sort()
+        merged: list[tuple[int, int]] = []
+        for o, s in self._free:
+            if merged and merged[-1][0] + merged[-1][1] == o:
+                merged[-1] = (merged[-1][0], merged[-1][1] + s)
+            else:
+                merged.append((o, s))
+        self._free = merged
+
+
+@dataclass
+class _Segment:
+    """One contiguous prefix's KV rows in the store arena. ``refs`` counts
+    in-flight requests pinning it (lookup → release); ``tick`` is the LRU
+    clock; ``nodes`` are the radix entries (one per bucket boundary)
+    pointing into it."""
+
+    offset: int
+    length: int
+    refs: int = 0
+    tick: int = 0
+    nodes: list = field(default_factory=list)
+
+
+@dataclass(frozen=True)
+class PrefixHit:
+    """A pinned lookup result: ``length`` prefix tokens live at
+    ``segment.offset`` in the store arena. Hold it for the request's
+    lifetime and :meth:`PrefixStore.release` it exactly once."""
+
+    segment: _Segment
+    length: int
+
+
+# ----- device ops ----------------------------------------------------------
+#
+# All three are D2D copies inside jit — no host transfer anywhere on the
+# hit path (jaxguard-clean by construction; strict mode's transfer guard
+# leaves device-to-device moves free). `length` is static and always a
+# prefill bucket, so the executable count is bounded by len(buckets)
+# (times the admission-group N for _store_put, matching prefill_batch's
+# own bound).
+
+
+@partial(jax.jit, static_argnames=("length",), donate_argnums=(0,))
+def _store_put(store, caches, row, offset, length: int):
+    """Copy row ``row``'s first ``length`` token positions out of a prefill
+    cache pytree (leaves ``[L, N, S, ...]``) into the store arena at token
+    offset ``offset``. The store is donated — an insert must not copy the
+    whole arena."""
+    def put(s, c):
+        starts = (0, row) + (0,) * (c.ndim - 2)
+        sizes = (c.shape[0], 1, length) + c.shape[3:]
+        seg = jax.lax.dynamic_slice(c, starts, sizes)
+        at = (0, 0, offset) + (0,) * (s.ndim - 3)
+        return jax.lax.dynamic_update_slice(s, seg, at)
+
+    return jax.tree.map(put, store, caches)
+
+
+@partial(jax.jit,
+         static_argnames=("length", "cfg", "max_len", "quantized", "dtype",
+                          "n"))
+def _materialize(store, offset, length: int, cfg: DecoderConfig,
+                 max_len: int, quantized: bool, dtype, n: int = 1):
+    """Build a fresh ``n``-row cache pytree (``[L, n, max_len, ...]``) with
+    the store rows ``[offset, offset + length)`` landed in EVERY row at
+    positions ``[0, length)`` — the pre-populated caches
+    :func:`..models.transformer.prefill_suffix` resumes from (``n > 1``:
+    the batched-admission form, one shared prefix fanned out to n
+    same-match requests). One fused zeros+gather executable per
+    (bucket length, n)."""
+    caches = init_kv_caches(cfg, n, max_len, dtype=dtype, quantized=quantized)
+
+    def cp(c, s):
+        starts = (0, 0, offset) + (0,) * (s.ndim - 3)
+        sizes = s.shape[:2] + (length,) + s.shape[3:]
+        seg = jax.lax.dynamic_slice(s, starts, sizes)
+        seg = jnp.broadcast_to(seg, (seg.shape[0], n) + seg.shape[2:])
+        return jax.lax.dynamic_update_slice(c, seg, (0,) * c.ndim)
+
+    return jax.tree.map(cp, caches, store)
+
+
+# ----- the store -----------------------------------------------------------
+
+
+class PrefixStore:
+    """Device-resident prefix KV store, radix-indexed, bucket-aligned.
+
+    >>> store = PrefixStore(cfg, capacity_tokens=4096, buckets=(64, 256))
+    >>> srv = GenerationServer(params, cfg, prefill_buckets=(64, 256),
+    ...                        prefix_store=store)
+
+    One store may back several servers in a process (the same system
+    prompt served by every replica warms once); it is NOT thread-safe —
+    share it only between servers driven from one thread, like the
+    servers themselves.
+
+    ``capacity_tokens`` sizes the arena (per layer: ``capacity_tokens`` KV
+    rows, bf16 or int8 when ``kv_quant``). ``buckets`` must equal the
+    serving ``prefill_buckets`` ladder — every cached boundary is a bucket
+    value, which is what keeps the serving executable count bounded.
+    """
+
+    def __init__(self, cfg: DecoderConfig, capacity_tokens: int,
+                 buckets: tuple, *, kv_quant: bool = False,
+                 dtype=None, label: str = "") -> None:
+        buckets = tuple(sorted(buckets))
+        if not buckets:
+            raise ValueError(
+                "PrefixStore needs a prefill_buckets ladder — bucket-aligned "
+                "match boundaries are what bound the executable count"
+            )
+        if capacity_tokens < buckets[0]:
+            raise ValueError(
+                f"capacity_tokens={capacity_tokens} cannot hold even the "
+                f"smallest bucket ({buckets[0]})"
+            )
+        self.cfg, self.buckets = cfg, buckets
+        self.capacity_tokens = int(capacity_tokens)
+        self.kv_quant = bool(kv_quant)
+        self.dtype = dtype or cfg.dtype
+        self.label = label
+        self.arena = init_kv_caches(
+            cfg, 1, self.capacity_tokens, dtype=self.dtype, quantized=kv_quant
+        )
+        self._index = RadixIndex()
+        self._freelist = _FreeList(self.capacity_tokens)
+        self._segments: list[_Segment] = []
+        self._tick = 0
+        # Cumulative counters (stats()-style snapshot semantics).
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+        self.evictions = 0
+        self.inserts = 0
+        self.insert_skips = 0  # capacity pressure with everything pinned
+
+    # -- host-side index operations -----------------------------------------
+
+    def _next_tick(self) -> int:
+        self._tick += 1
+        return self._tick
+
+    def lookup(self, prompt: np.ndarray) -> Optional[PrefixHit]:
+        """Longest bucket-aligned cached prefix of ``prompt``, pinned.
+        The match is capped at ``len(prompt) - 1`` — at least one suffix
+        token must remain to prefill, because the suffix forward is what
+        produces the first sampled token's logits. Returns None on miss
+        (counted); a hit bumps the segment's LRU tick and refcount — the
+        caller owns exactly one :meth:`release`."""
+        prompt = np.asarray(prompt)
+        depth, seg = self._index.longest_match(prompt[: len(prompt) - 1])
+        if seg is None:
+            self.misses += 1
+            return None
+        seg.refs += 1
+        seg.tick = self._next_tick()
+        self.hits += 1
+        self.tokens_reused += depth
+        return PrefixHit(seg, depth)
+
+    def release(self, hit: PrefixHit) -> None:
+        hit.segment.refs -= 1
+        assert hit.segment.refs >= 0, "PrefixHit released twice"
+
+    def cancel(self, hit: PrefixHit) -> None:
+        """Release a hit that was never used (e.g. the caller's suffix
+        shape degraded and it fell back to cold admission) and reverse
+        the lookup's counters, so hit/miss stats reflect admissions
+        actually served from the store."""
+        self.release(hit)
+        self.hits -= 1
+        self.tokens_reused -= hit.length
+        self.misses += 1
+
+    def insert(self, prompt: np.ndarray, caches: Any, row) -> bool:
+        """Store ``prompt``'s longest bucket-aligned proper prefix from a
+        freshly prefilled cache pytree (``caches`` row ``row`` holds the
+        prompt's KV at positions ``0..len(prompt)-1``). Registers a radix
+        entry at EVERY bucket boundary of the stored range — all sharing
+        one contiguous segment — so a later prompt diverging early still
+        matches at the shorter boundary. Under capacity pressure,
+        unreferenced segments evict LRU-first; if pinned segments leave no
+        room the insert is skipped (never an error). Returns True when a
+        new segment was stored."""
+        prompt = np.asarray(prompt, np.int32)
+        bound = next(
+            (b for b in reversed(self.buckets) if b <= len(prompt) - 1), None
+        )
+        if bound is None:
+            return False  # prompt shorter than every bucket: nothing to key
+        have, have_seg = self._index.longest_match(prompt[:bound])
+        if have >= bound:
+            # The full insertable prefix is already stored — but a SHALLOW
+            # boundary entry may have been lost (its original segment
+            # evicted while a deeper overlapping one survived): repair by
+            # pointing missing boundaries at the surviving segment, whose
+            # rows cover them.
+            self._register_boundaries(prompt, have_seg, bound)
+            return False
+        offset = self._alloc(bound)
+        if offset is None:
+            self.insert_skips += 1
+            return False
+        self.arena = _store_put(
+            self.arena, caches, jnp.int32(row), jnp.int32(offset),
+            length=bound,
+        )
+        seg = _Segment(offset, bound, tick=self._next_tick())
+        self._register_boundaries(prompt, seg, bound)
+        self._segments.append(seg)
+        self.inserts += 1
+        return True
+
+    def _register_boundaries(self, prompt: np.ndarray, seg: _Segment,
+                             upto: int) -> None:
+        """Point every bucket boundary ≤ ``upto`` that has no entry yet at
+        ``seg`` (whose rows must cover it: ``upto <= seg.length``).
+        Boundaries already served — by this segment or an earlier one —
+        are left alone."""
+        for b in self.buckets:
+            if b > upto or b > seg.length:
+                break
+            depth, _ = self._index.longest_match(prompt[:b])
+            if depth >= b:
+                continue  # an existing segment already serves this boundary
+            seg.nodes.append(self._index.insert(prompt[:b], seg))
+
+    def _alloc(self, n: int) -> Optional[int]:
+        offset = self._freelist.alloc(n)
+        while offset is None:
+            if not self._evict_one():
+                return None
+            offset = self._freelist.alloc(n)
+        return offset
+
+    def _evict_one(self) -> bool:
+        """Drop the least-recently-used UNREFERENCED segment. Segments
+        pinned by in-flight requests (refs > 0) are never candidates —
+        capacity pressure while every segment is referenced fails the
+        allocation instead."""
+        victims = [s for s in self._segments if s.refs == 0]
+        if not victims:
+            return False
+        seg = min(victims, key=lambda s: s.tick)
+        for node in seg.nodes:
+            self._index.remove(node)
+        self._freelist.free(seg.offset, seg.length)
+        self._segments.remove(seg)
+        self.evictions += 1
+        obs.emit(
+            "serving", "prefix_evict",
+            store=self.label, tokens=seg.length, offset=seg.offset,
+            segments_left=len(self._segments),
+        )
+        return True
+
+    # -- device-side copies --------------------------------------------------
+
+    def materialize(self, hit: PrefixHit, max_len: int, n: int = 1):
+        """A fresh ``[L, n, max_len, ...]`` cache pytree with the hit's
+        prefix rows in every row at positions ``[0, hit.length)`` — feed
+        it to :func:`..models.transformer.prefill_suffix` with
+        ``offset=hit.length``. Pure device op (zeros + D2D gather);
+        ``n > 1`` fans one shared prefix out to a same-match admission
+        group."""
+        return _materialize(
+            self.arena, jnp.int32(hit.segment.offset), hit.length,
+            self.cfg, max_len, self.kv_quant, self.dtype, n=n,
+        )
+
+    # -- reporting -----------------------------------------------------------
+
+    @property
+    def tokens_used(self) -> int:
+        return sum(s.length for s in self._segments)
+
+    def occupancy(self) -> float:
+        return round(self.tokens_used / self.capacity_tokens, 4)
+
+    def stats(self) -> dict:
+        """Cumulative store counters + occupancy (snapshot semantics: this
+        never resets anything)."""
+        return {
+            "capacity_tokens": self.capacity_tokens,
+            "tokens_used": self.tokens_used,
+            "occupancy": self.occupancy(),
+            "segments": len(self._segments),
+            "entries": len(self._index),
+            "hits": self.hits,
+            "misses": self.misses,
+            "tokens_reused": self.tokens_reused,
+            "inserts": self.inserts,
+            "insert_skips": self.insert_skips,
+            "evictions": self.evictions,
+        }

@@ -46,9 +46,24 @@ itself batches: queued requests padding to the same prefill bucket run one
 ``[N, bucket]`` forward (``transformer.prefill_batch``) and scatter into
 their slots in one vectorized write, instead of N sequential weight
 streams — the dominant TTFT cost under burst arrival.
+
+SHARED-PREFIX KV CACHE (``prefix_cache_tokens`` / ``prefix_store``): most
+production prompts share a long common prefix (system prompt, few-shot
+template). With a :class:`.prefix_cache.PrefixStore` attached, cold
+admissions deposit each prompt's bucket-aligned prefix KV into a dedicated
+device arena (radix-indexed by token ids), and later admissions that match
+copy the prefix rows into their slot on device and prefill ONLY the suffix
+(``transformer.prefill_suffix`` — RoPE positions shifted, causal mask over
+``offset + suffix``). Greedy tokens are identical to cold admission
+(tested); TTFT and prefill FLOPs drop by the shared fraction. Match
+boundaries are ``prefill_buckets`` values, so the executable-count bound
+survives. ``ring_kv`` and draft-model servers fall back to cold admission
+(the ring/cycle folds re-layout prefix rows per slot and the draft arena
+would miss its own prefix — explicitly unsupported for now).
 """
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -71,8 +86,10 @@ from ..models.transformer import (
     init_kv_caches,
     prefill,
     prefill_batch,
+    prefill_suffix,
     ring_caches_from_prefill,
 )
+from .prefix_cache import PrefixHit, PrefixStore
 
 
 # Serving-stat gauges, created through obs.metrics' idempotent factory
@@ -91,7 +108,37 @@ _PROM_STATS = (
     ("arena_bytes", "KV arena HBM footprint (addressable shards summed)"),
     ("draft_acceptance", "Speculative draft acceptance rate"),
     ("prefill_batches", "Multi-request admission prefill forwards"),
+    ("prefix_hit_ratio", "Prefix-cache hit ratio (hits / lookups)"),
+    ("prefix_store_occupancy", "Prefix store fill (tokens used / capacity)"),
 )
+
+
+# Prefix-cache traffic counters (ISSUE 5): true Prometheus counters (the
+# scrape-time gauges above mirror stats(); these are incremented at the
+# moment of the lookup so rate() works even between scrapes).
+def _ctr_prefix_hits():
+    return obs.counter(
+        "kata_tpu_serving_prefix_hits",
+        "Admissions served from the prefix KV store (suffix-only prefill)",
+        ["server"],
+    )
+
+
+def _ctr_prefix_misses():
+    return obs.counter(
+        "kata_tpu_serving_prefix_misses",
+        "Admissions with no usable cached prefix (cold prefill)",
+        ["server"],
+    )
+
+
+def _ctr_prefix_tokens_reused():
+    return obs.counter(
+        "kata_tpu_serving_prefix_tokens_reused",
+        "Prompt tokens whose KV was copied from the prefix store "
+        "instead of re-prefilled",
+        ["server"],
+    )
 
 
 def _prom_gauges() -> dict:
@@ -239,6 +286,18 @@ class GenerationServer:
     (``speculative_k``) always runs lock-step: a verify round's inputs are
     the host-side accept decision of the previous round, so there is no
     schedule slack to hide transfers in.
+
+    ``prefix_cache_tokens > 0`` attaches a shared-prefix KV store of that
+    capacity (see the module header and :mod:`.prefix_cache`); it requires
+    ``prefill_buckets`` (bucket-aligned match boundaries are what bound
+    the executable count). ``None`` (default) reads the
+    ``KATA_TPU_PREFIX_CACHE_TOKENS`` env the device plugin can inject
+    (``config.prefix_cache_tokens``); ``0`` disables. ``prefix_store``
+    injects an existing :class:`.prefix_cache.PrefixStore` instead — e.g.
+    shared across servers in one process so a common system prompt warms
+    once — and must match this server's config/buckets/kv_quant. Under
+    ``ring_kv`` or a draft model the store is DISABLED (cold-admission
+    fallback, documented as unsupported) rather than refused.
     """
 
     def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
@@ -248,7 +307,9 @@ class GenerationServer:
                  kv_quant: bool = False, prefill_buckets: tuple = (),
                  speculative_k: int = 0, ring_kv: bool = False,
                  draft: Optional[tuple] = None, overlap: bool = True,
-                 strict: Optional[bool] = None):
+                 strict: Optional[bool] = None,
+                 prefix_cache_tokens: Optional[int] = None,
+                 prefix_store: Optional[PrefixStore] = None):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
@@ -388,10 +449,81 @@ class GenerationServer:
         # .labels() on every prefill/chunk is pure hot-path overhead —
         # export_metrics(label=...) re-resolves on rename.
         self._bind_histograms()
+        # Shared-prefix KV store (ISSUE 5). Per-server hit/miss counters
+        # stay separate from the store's own (a store may back several
+        # servers); per-slot handles pin a hit's segment until the request
+        # finishes, so a prefix serving live traffic can never be evicted.
+        self._slot_prefix: list[Optional[PrefixHit]] = [None] * max_batch
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_tokens_reused = 0
+        explicit = prefix_cache_tokens is not None
+        if prefix_cache_tokens is None:
+            raw = os.environ.get("KATA_TPU_PREFIX_CACHE_TOKENS", "")
+            try:
+                prefix_cache_tokens = int(raw or 0)
+            except ValueError:
+                # A malformed NODE-WIDE env (e.g. "16k") must degrade like
+                # every other implicit prefix-cache fallback, never crash
+                # a guest server that did not opt in.
+                obs.emit(
+                    "serving", "prefix_store_disabled",
+                    server=self._label, reason=f"bad_env:{raw[:32]}",
+                )
+                prefix_cache_tokens = 0
+        self.prefix_store: Optional[PrefixStore] = None
+        if prefix_store is not None or prefix_cache_tokens > 0:
+            if ring_kv or draft is not None:
+                # Unsupported modes fall back to cold admission rather than
+                # refusing the server: the ring/cycle folds re-layout prefix
+                # rows per slot, and a draft server's second arena would
+                # miss its own prefix KV. Documented in docs/guest_guide.md.
+                obs.emit(
+                    "serving", "prefix_store_disabled",
+                    server=self._label,
+                    reason="ring_kv" if ring_kv else "draft",
+                )
+            elif not self.prefill_buckets:
+                if explicit or prefix_store is not None:
+                    raise ValueError(
+                        "prefix caching requires prefill_buckets — matches "
+                        "are bucket-aligned so suffix prefills keep the "
+                        "bounded executable count"
+                    )
+                # Capacity came from the daemon-injected env default: a
+                # node-wide knob must never crash a guest server that was
+                # valid without it — degrade like the other implicit
+                # fallbacks and say so on the event stream.
+                obs.emit(
+                    "serving", "prefix_store_disabled",
+                    server=self._label, reason="no_prefill_buckets",
+                )
+            elif prefix_store is not None:
+                if (prefix_store.cfg != cfg
+                        or prefix_store.buckets != self.prefill_buckets
+                        or prefix_store.kv_quant != kv_quant
+                        or prefix_store.dtype != cfg.dtype):
+                    raise ValueError(
+                        "injected prefix_store does not match this server "
+                        "(cfg, prefill_buckets, kv_quant and cache dtype "
+                        "must all agree — its rows land verbatim in this "
+                        "arena)"
+                    )
+                self.prefix_store = prefix_store
+            else:
+                self.prefix_store = PrefixStore(
+                    cfg, prefix_cache_tokens, self.prefill_buckets,
+                    kv_quant=kv_quant, label=self._label,
+                )
 
     def _bind_histograms(self) -> None:
         self._h_ttft = _hist_ttft().labels(server=self._label)
         self._h_tok_lat = _hist_decode_token().labels(server=self._label)
+        self._c_prefix_hits = _ctr_prefix_hits().labels(server=self._label)
+        self._c_prefix_misses = _ctr_prefix_misses().labels(server=self._label)
+        self._c_prefix_reused = _ctr_prefix_tokens_reused().labels(
+            server=self._label
+        )
 
     def _shard_over(self, mesh) -> None:
         """Tensor-parallel serving: place params by their layout-aware
@@ -471,7 +603,25 @@ class GenerationServer:
         (``run()`` drains *results*, not telemetry). The latency summaries
         (``ttft_s``, ``decode_token_s``) are count/mean/min/max/p50/p95
         dicts from a bounded reservoir — cumulative counts, recent-window
-        quantiles."""
+        quantiles.
+
+        ``prefill_batches`` counts MULTI-request admission forwards only:
+        each engagement of a batched ``[N >= 2, bucket]`` admission
+        executable — cold ``transformer.prefill_batch`` or the batched
+        suffix path (``prefill_suffix`` with a ``[N]`` boundary vector) —
+        is one increment, however many rows it carried; single-request
+        admissions never touch it, so ``prefills`` (per-request) and this
+        field answer different questions. Tested in
+        ``tests/test_prefix_cache.py``.
+
+        Prefix-cache fields (ISSUE 5) are ALWAYS present so dashboards
+        need no schema branch: with the store disabled,
+        ``prefix_hit_ratio`` is 0.0 and the counters stay 0.
+        ``prefix_hit_ratio`` is hits / (hits + misses) over this server's
+        lookups; ``prefix_tokens_reused`` counts prompt tokens copied from
+        the store instead of re-prefilled; ``prefix_store_occupancy`` /
+        ``prefix_store_tokens`` / ``prefix_store_bytes`` describe the
+        (possibly shared) store's arena."""
         decoded = self._emitted - self._prefills
         busy = sum(r is not None for r in self._slot_req)
         out = {
@@ -502,6 +652,24 @@ class GenerationServer:
                 for leaf in jax.tree_util.tree_leaves(self.arena)
             ),
         }
+        lookups = self._prefix_hits + self._prefix_misses
+        store = self.prefix_store
+        out.update({
+            "prefix_hits": self._prefix_hits,
+            "prefix_misses": self._prefix_misses,
+            "prefix_tokens_reused": self._prefix_tokens_reused,
+            "prefix_hit_ratio": (
+                round(self._prefix_hits / lookups, 4) if lookups else 0.0
+            ),
+            "prefix_store_tokens": store.tokens_used if store else 0,
+            "prefix_store_occupancy": store.occupancy() if store else 0.0,
+            "prefix_store_bytes": (
+                sum(
+                    _hbm_bytes(leaf)
+                    for leaf in jax.tree_util.tree_leaves(store.arena)
+                ) if store else 0
+            ),
+        })
         if self.speculative_k:
             out["draft_acceptance"] = (
                 round(self._drafts_accepted / self._drafts_offered, 4)
@@ -557,6 +725,37 @@ class GenerationServer:
                                self._temp_dev, self.top_k,
                                self.top_p)[0])
 
+    def _finish_admission(self, b: int, req: _Request, first: int, pos: int,
+                          t_first: float, hit: Optional[PrefixHit] = None,
+                          **event_fields) -> None:
+        """The admission epilogue every fill path shares: first-token and
+        counter bookkeeping, the TTFT observation + event, slot-state
+        handoff (with the optional prefix pin), the overlap fresh-row
+        mark, and the immediate-finish check. ``t_first`` is the caller's
+        clock stamp from the moment the first token LANDED on the host
+        (the transfer that fenced the prefill forward) — TTFT must not
+        absorb the arena-write/store-insert dispatch that follows it.
+        ``event_fields`` extend the ttft event (``batched=n``,
+        ``prefix_reused=m``)."""
+        req.out.append(first)
+        self._prefills += 1
+        self._emitted += 1  # the prefill forward emits the first token
+        ttft = t_first - req.t_submit
+        self._ttft.observe(ttft)
+        self._h_ttft.observe(ttft)
+        obs.emit(
+            "serving", "ttft",
+            server=self._label, rid=req.rid, ttft_s=round(ttft, 6),
+            prompt_len=len(req.prompt), queued=len(self._queue),
+            **event_fields,
+        )
+        self._slot_req[b] = req
+        self._slot_prefix[b] = hit  # pinned until the request finishes
+        self._pos[b] = pos
+        self._last[b] = first
+        self._fresh_rows.add(b)  # overlap: override the in-flight row
+        self._maybe_finish(b, [first])
+
     def _fill_slot(self, b: int, req: _Request,
                    bucket: Optional[int]) -> None:
         """Prefill ``req``'s prompt into arena slot ``b``. ``bucket`` is
@@ -595,22 +794,14 @@ class GenerationServer:
                     caches, pos, self.cfg.window_cycle[0] + self._ring_margin
                 )
             first = self._sample_first(last_logits)
-        req.out.append(first)
-        self._prefills += 1
-        self._emitted += 1  # the prefill forward emits each request's first token
-        # TTFT: submit → first token. _sample_first's int() is a host
-        # transfer of the prefill logits, so the device work is fenced —
-        # the measurement includes queue wait by design (that is what the
-        # client experiences).
-        ttft = time.monotonic() - req.t_submit
-        self._ttft.observe(ttft)
-        self._h_ttft.observe(ttft)
-        obs.emit(
-            "serving", "ttft",
-            server=self._label, rid=req.rid, ttft_s=round(ttft, 6),
-            prompt_len=int(true_len), queued=len(self._queue),
-        )
+        t_first = time.monotonic()  # the int() above fenced the forward
         self.arena = _write_slot(self.arena, caches, b)
+        if self.prefix_store is not None:
+            # Populate the store from this full-prompt prefill: the cache
+            # rows [0, bucket-aligned bound) are exactly the prompt's real
+            # tokens' KV (the bound is < true_len, so pad rows never enter
+            # the store). Device-to-device copy; no host sync.
+            self.prefix_store.insert(req.prompt, caches, 0)
         if self.draft is not None:
             # The draft prefills the same prompt into its own arena slot
             # (cheap: the draft is a fraction of the target), so its cache
@@ -622,11 +813,158 @@ class GenerationServer:
                 true_len=jnp.int32(true_len) if bucket is not None else None,
             )
             self.draft_arena = _write_slot(self.draft_arena, d_caches, b)
-        self._slot_req[b] = req
-        self._pos[b] = int(pos)  # jaxguard: allow(JG101) admission host read — slot position lands with the first token
-        self._last[b] = first
-        self._fresh_rows.add(b)  # overlap: override the in-flight row
-        self._maybe_finish(b, [first])
+        self._finish_admission(b, req, first, int(pos), t_first)  # jaxguard: allow(JG101) admission host read — slot position lands with the first token
+
+    def _prefix_lookup(self, req: _Request) -> Optional[PrefixHit]:
+        """One store lookup per admission, with the per-server counters.
+        Returns None (and counts nothing) when the store is disabled;
+        counts a miss when the store is on but no bucket-aligned prefix of
+        the prompt is cached. A hit is PINNED — the handle rides in
+        ``_slot_prefix`` until the request leaves its slot."""
+        if self.prefix_store is None:
+            return None
+        hit = self.prefix_store.lookup(req.prompt)
+        if hit is not None:
+            s_len = len(req.prompt) - hit.length
+            no_bucket = self._suffix_bucket(hit.length, s_len) is None
+            if no_bucket and any(
+                k >= len(req.prompt) for k in self.prefill_buckets
+            ):
+                # Degraded hit: the suffix fits no bucket inside the arena
+                # (an exact-length suffix compiles one executable per
+                # distinct prompt length) while the WHOLE prompt does fit
+                # one — cold bucketed admission keeps the executable
+                # bound, so prefer it. Prompts longer than every bucket
+                # keep the hit: cold would be exact-length anyway, and
+                # the suffix forward is strictly smaller.
+                self.prefix_store.cancel(hit)
+                hit = None
+        if hit is None:
+            self._prefix_misses += 1
+            self._c_prefix_misses.inc()
+            return None
+        self._prefix_hits += 1
+        self._prefix_tokens_reused += hit.length
+        self._c_prefix_hits.inc()
+        self._c_prefix_reused.inc(hit.length)
+        return hit
+
+    def _fill_slot_suffix(self, b: int, req: _Request,
+                          hit: PrefixHit) -> None:
+        """Prefix-hit admission: gather the matched ``hit.length`` prefix
+        rows out of the store into fresh slot caches (device-to-device),
+        prefill ONLY the suffix at that offset
+        (``transformer.prefill_suffix``), and write the slot — the cold
+        path minus the prefix's forward FLOPs. The suffix right-pads to
+        the smallest bucket that still fits the arena (one executable per
+        bucket, like cold admission); greedy tokens are identical to the
+        cold path (tested)."""
+        prompt, n, m = req.prompt, len(req.prompt), hit.length
+        suffix, s_len = prompt[m:], n - m
+        pad = self._suffix_pad(m, s_len)
+        if pad > s_len:
+            suffix = np.pad(suffix, (0, pad - s_len))
+        # Span fence: _sample_first's int() transfers the sampled token,
+        # which depends on the gather and the whole suffix forward.
+        with obs.span(
+            "serving.prefill_suffix",
+            server=self._label, rid=req.rid, slot=b,
+            prompt_len=n, reused=m, suffix_len=s_len,
+            padded_len=len(suffix), tokens=s_len,
+        ):
+            caches = self.prefix_store.materialize(hit, self.max_len)
+            caches, last_logits, _pos = prefill_suffix(
+                self.params, jnp.asarray(suffix)[None, :], self.cfg, caches,
+                jnp.int32(m), return_logits=True, true_len=jnp.int32(s_len),
+            )
+            first = self._sample_first(last_logits)
+        t_first = time.monotonic()  # the int() above fenced the forward
+        self.arena = _write_slot(self.arena, caches, b)
+        # DEEPEN on hit: the slot caches now hold the WHOLE prompt's KV,
+        # so a bucket boundary beyond the match (e.g. the first prompt of
+        # a lineage was short and capped the stored depth) becomes
+        # storable — insert() no-ops when the match was already the
+        # deepest boundary, so arrival order cannot freeze reuse.
+        self.prefix_store.insert(req.prompt, caches, 0)
+        # pos is host-known (offset + true suffix length): no device read.
+        self._finish_admission(b, req, first, n, t_first, hit=hit,
+                               prefix_reused=m)
+
+    def _suffix_bucket(self, m: int, s_len: int) -> Optional[int]:
+        """The ONE suffix-bucket predicate (routing and padding must not
+        drift apart): the smallest bucket that fits the suffix AND the
+        arena (``m + pad <= max_len`` — ``dynamic_update_slice`` clamps
+        out-of-range writes, which would silently shift real suffix
+        rows), or None when no bucket qualifies."""
+        return next(
+            (k for k in self.prefill_buckets
+             if k >= s_len and m + k <= self.max_len),
+            None,
+        )
+
+    def _suffix_pad(self, m: int, s_len: int) -> int:
+        """Padded suffix length for a prefix hit at ``m``: the
+        :meth:`_suffix_bucket`, or the exact length when none qualifies
+        (``m + s_len = prompt_len <= max_len`` always fits)."""
+        pad = self._suffix_bucket(m, s_len)
+        return pad if pad is not None else s_len
+
+    def _fill_slots_suffix_batched(self, slots: list[int], pairs: list,
+                                   pad_len: int) -> None:
+        """Batched prefix-hit admission: N requests matching the SAME
+        store segment at the same boundary ``m`` run one ``[N, pad_len]``
+        suffix forward over one fanned-out prefix gather, scattering into
+        their slots in one vectorized write (:func:`_write_slots`) — the
+        suffix-path sibling of :meth:`_fill_slots_batched`, and the shape
+        burst arrival with a shared system prompt actually takes. Per-row
+        ``true_len`` masking keeps it exact."""
+        n = len(pairs)
+        m = pairs[0][1].length
+        suffixes = np.zeros((n, pad_len), np.int32)
+        true_lens = np.array(
+            [len(req.prompt) - m for req, _ in pairs], np.int32
+        )
+        for i, (req, _) in enumerate(pairs):
+            suffixes[i, : true_lens[i]] = req.prompt[m:]
+        # Span fence: the firsts transfer below depends on the gather and
+        # every row's suffix forward.
+        with obs.span(
+            "serving.prefill_suffix_batch",
+            server=self._label, n=n, reused=m, padded_len=pad_len,
+            tokens=int(true_lens.sum()),
+            rids=[req.rid for req, _ in pairs], slots=list(slots),
+        ):
+            caches = self.prefix_store.materialize(
+                pairs[0][1], self.max_len, n=n
+            )
+            caches, last_logits, _pos = prefill_suffix(
+                self.params, jnp.asarray(suffixes), self.cfg, caches,
+                jnp.int32(m), return_logits=True,
+                true_len=jnp.asarray(true_lens),
+            )
+            if self._do_sample:
+                self._key, sub = jax.random.split(self._key)
+                firsts = np.asarray(_next_token(  # jaxguard: allow(JG101) admission host read — batched first tokens, sanctioned sync
+                    last_logits, sub, True, self._temp_dev,
+                    self.top_k, self.top_p,
+                ))
+            else:
+                firsts = np.asarray(jnp.argmax(last_logits, axis=-1))  # jaxguard: allow(JG101) admission host read — sanctioned sync
+        t_first = time.monotonic()  # the firsts transfer fenced the forward
+        self.arena = _write_slots(
+            self.arena, caches, jnp.asarray(np.asarray(slots, np.int32))
+        )
+        # DEEPEN on hit (see _fill_slot_suffix): rows now hold whole
+        # prompts' KV; insert() no-ops unless a deeper bucket boundary
+        # than the match became storable, and dedups within the group.
+        for i, (req, _hit) in enumerate(pairs):
+            self.prefix_store.insert(req.prompt, caches, i)
+        self._batch_prefills += 1
+        for i, (b, (req, hit)) in enumerate(zip(slots, pairs)):
+            self._finish_admission(
+                b, req, int(firsts[i]), m + int(true_lens[i]), t_first,
+                hit=hit, batched=n, prefix_reused=m,
+            )
 
     def _fill_slots_batched(self, slots: list[int], reqs: list,
                             pad_len: int) -> None:
@@ -660,30 +998,20 @@ class GenerationServer:
                 ))
             else:
                 firsts = np.asarray(jnp.argmax(last_logits, axis=-1))  # jaxguard: allow(JG101) admission host read — sanctioned sync
+        t_first = time.monotonic()  # the firsts transfer fenced the forward
         self.arena = _write_slots(
             self.arena, caches, jnp.asarray(np.asarray(slots, np.int32))
         )
+        if self.prefix_store is not None:
+            # Each row populates the store (insert() dedups identical
+            # prefixes within the group via its longest-match check).
+            for i, req in enumerate(reqs):
+                self.prefix_store.insert(req.prompt, caches, i)
         self._batch_prefills += 1
-        now = time.monotonic()  # after the firsts transfer fenced the forward
         for i, (b, req) in enumerate(zip(slots, reqs)):
-            first = int(firsts[i])
-            req.out.append(first)
-            self._prefills += 1
-            self._emitted += 1
-            ttft = now - req.t_submit
-            self._ttft.observe(ttft)
-            self._h_ttft.observe(ttft)
-            obs.emit(
-                "serving", "ttft",
-                server=self._label, rid=req.rid, ttft_s=round(ttft, 6),
-                prompt_len=int(true_lens[i]), queued=len(self._queue),
-                batched=n,
+            self._finish_admission(
+                b, req, int(firsts[i]), int(true_lens[i]), t_first, batched=n
             )
-            self._slot_req[b] = req
-            self._pos[b] = int(true_lens[i])
-            self._last[b] = first
-            self._fresh_rows.add(b)
-            self._maybe_finish(b, [first])
 
     def _admit(self) -> None:
         """Refill every free slot from the queue (FIFO). The admitted set
@@ -711,17 +1039,42 @@ class GenerationServer:
                 self._queue.popleft()
                 for _ in range(min(len(free), len(self._queue)))
             ]
+            # Prefix-store routing first: a hit takes the suffix-only path
+            # (its executable is keyed to the SUFFIX bucket, not the
+            # prompt's), misses proceed to cold grouping below. Hits on
+            # the SAME segment/boundary/suffix-shape batch into one
+            # forward, mirroring the cold grouping. Within-pass reordering
+            # between hits and cold groups is the same pass-granular FIFO
+            # trade the bucket grouping already makes — the admitted SET
+            # is still the FIFO prefix.
+            hit_groups: dict[tuple, list] = {}
             # Group by PADDED length (bucket when one fits, exact length
             # otherwise): rows of one prefill executable must share a
             # shape. dict preserves insertion order, so groups stay FIFO.
             groups: dict[int, list] = {}
             for req in take:
+                hit = self._prefix_lookup(req)
+                if hit is not None:
+                    s_len = len(req.prompt) - hit.length
+                    pad_len = self._suffix_pad(hit.length, s_len)
+                    hit_groups.setdefault(
+                        (id(hit.segment), hit.length, pad_len), []
+                    ).append((req, hit))
+                    continue
                 true_len = len(req.prompt)
                 bucket = next(
                     (k for k in self.prefill_buckets if k >= true_len), None
                 )
                 groups.setdefault(bucket or true_len, []).append(req)
             it = iter(free)
+            for (_seg, _m, pad_len), pairs in hit_groups.items():
+                if len(pairs) >= 2 and self._can_batch_prefill:
+                    self._fill_slots_suffix_batched(
+                        [next(it) for _ in pairs], pairs, pad_len
+                    )
+                else:
+                    for req, hit in pairs:
+                        self._fill_slot_suffix(next(it), req, hit)
             for pad_len, reqs in groups.items():
                 if len(reqs) >= 2 and self._can_batch_prefill:
                     self._fill_slots_batched(
@@ -749,6 +1102,12 @@ class GenerationServer:
             self._results[req.rid] = np.asarray(req.out, np.int32)
             req.done = True
             self._slot_req[b] = None
+            handle = self._slot_prefix[b]
+            if handle is not None:
+                # Unpin the request's prefix segment: it becomes LRU-
+                # evictable again once no other in-flight request holds it.
+                self.prefix_store.release(handle)
+                self._slot_prefix[b] = None
 
     def step(self) -> bool:
         """One scheduler round. Lock-step (``overlap=False`` or

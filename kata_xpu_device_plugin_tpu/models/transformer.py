@@ -1028,6 +1028,72 @@ def prefill(params: Params, prompt: jax.Array, cfg: DecoderConfig,
     return caches, last, pos
 
 
+@partial(jax.jit, static_argnames=("cfg", "attn_fn", "return_logits"))
+def prefill_suffix(params: Params, suffix: jax.Array, cfg: DecoderConfig,
+                   caches, offset: jax.Array,
+                   attn_fn: Optional[AttnFn] = None,
+                   return_logits: bool = False,
+                   true_len: Optional[jax.Array] = None):
+    """Suffix-only prefill: resume a prefill from PRE-POPULATED KV rows.
+
+    ``caches`` already holds a prefix's k/v at positions ``[0, offset)``
+    (e.g. gathered out of a :class:`..guest.prefix_cache.PrefixStore`);
+    ``suffix [B, S]`` is the remainder of the prompt. The forward runs with
+    RoPE positions shifted by ``offset`` and the causal mask spanning
+    ``offset + S`` — suffix token ``i`` writes its k/v at ``offset + i``
+    and attends to the cached prefix plus the fresh suffix, exactly the
+    window the same token saw in a cold full-length prefill. Returns
+    ``(caches, next_token_or_logits, pos)`` with the same contract as
+    :func:`prefill`; for greedy decoding the resulting token stream is
+    identical to the cold path (tested in ``tests/test_prefix_cache.py``).
+
+    ``offset`` and ``true_len`` are TRACED — one executable per suffix
+    SHAPE (bucket), never per prefix length. ``true_len`` supports
+    right-padded suffixes the same way :func:`prefill` does: logits are
+    taken at suffix index ``true_len - 1`` and ``pos`` returns
+    ``offset + true_len``; pad rows land at positions decode's index mask
+    never reads before overwriting. A ``[B]`` ``true_len`` vector is the
+    batched-admission form (the :func:`prefill_batch` sibling): B suffixes
+    sharing one matched prefix length run ONE forward, each row's logits
+    gathered at its own boundary.
+
+    The attention here reads BACK the cache (``q_offset`` path), so on TPU
+    it takes the XLA reference path rather than the pallas self-attention
+    kernel — the suffix is the short end of the prompt, which is the whole
+    point. int8 ``QTensor`` caches work transparently: the prefix rows are
+    already quantized, the fresh suffix quantizes on write, and attention
+    dequantizes fused — the same numerics as every other decode-into-cache
+    step."""
+    if attn_fn is None:
+        from ..ops.attention import flash_attention
+
+        attn_fn = flash_attention
+    B, S = suffix.shape
+    offset = jnp.asarray(offset, jnp.int32)
+    positions = offset + jnp.broadcast_to(
+        jnp.arange(S, dtype=jnp.int32), (B, S)
+    )
+    logits, caches = forward(
+        params, suffix, cfg, attn_fn=attn_fn, positions=positions,
+        kv_caches=caches, cache_offset=offset,
+    )
+    if true_len is None:
+        last, pos = logits[:, -1, :], offset + jnp.int32(S)
+    else:
+        tl = jnp.asarray(true_len, jnp.int32)
+        pos = offset + tl
+        if tl.ndim == 0:  # jaxguard: allow(JG104) bounded: scalar vs [B] true_len is one executable per admission FORM, and suffix shapes are already bucket-bound
+            last = jax.lax.dynamic_index_in_dim(logits, tl - 1, axis=1,
+                                                keepdims=False)
+        else:  # [B] per-row boundaries (batched suffix admission)
+            last = jnp.take_along_axis(
+                logits, (tl - 1)[:, None, None], axis=1
+            )[:, 0, :]
+    if not return_logits:
+        last = greedy_token(last)
+    return caches, last, pos
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_len", "attn_fn",
                                    "return_logits", "kv_quantized"))
 def prefill_batch(params: Params, prompts: jax.Array, cfg: DecoderConfig,

@@ -43,6 +43,7 @@ class TpuAllocator:
         libtpu_host_path: str = "",
         revalidate: Optional[Callable[[object], bool]] = None,
         compile_cache_dir: str = "",
+        prefix_cache_tokens: int = 0,
     ):
         self._inventory = inventory
         self._vendor = vendor
@@ -54,6 +55,11 @@ class TpuAllocator:
         # rides the AllocateResponse env so every granted workload points
         # jax's on-disk executable cache at the same per-node directory.
         self._compile_cache_dir = compile_cache_dir
+        # Guest-side shared-prefix KV store default capacity
+        # (config.prefix_cache_tokens): same delivery path — in-guest
+        # GenerationServers read KATA_TPU_PREFIX_CACHE_TOKENS when no
+        # explicit prefix_cache_tokens is passed.
+        self._prefix_cache_tokens = int(prefix_cache_tokens)
         # Driver-level liveness check supplied by the manager
         # (``manager.tpu_chip_alive``: node_alive over the same
         # dev+driver-state pair health watches); bare existence would hand a
@@ -105,6 +111,10 @@ class TpuAllocator:
         resp.envs[C.ENV_TPU_VISIBLE_CHIPS] = ",".join(str(c.index) for c in chips)
         if self._compile_cache_dir:
             resp.envs[C.ENV_COMPILE_CACHE_DIR] = self._compile_cache_dir
+        if self._prefix_cache_tokens > 0:
+            resp.envs[C.ENV_PREFIX_CACHE_TOKENS] = str(
+                self._prefix_cache_tokens
+            )
         return resp
 
     def preferred(

@@ -398,6 +398,7 @@ class PluginManager:
                     chip, cfg.sysfs_root, cfg.dev_root
                 ),
                 compile_cache_dir=cfg.compile_cache_dir,
+                prefix_cache_tokens=cfg.prefix_cache_tokens,
             ),
             socket_dir=cfg.kubelet_socket_dir,
             kubelet_socket=cfg.kubelet_socket,

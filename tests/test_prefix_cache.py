@@ -1,0 +1,627 @@
+"""Shared-prefix KV cache (guest/prefix_cache.py + suffix-only prefill).
+
+Oracle, as everywhere in serving: the prefix store is a SCHEDULING/reuse
+optimization — greedy tokens must equal the cold server (and therefore the
+per-request ``generate()`` oracle) for every composition, while the radix
+index, refcounts, and LRU eviction obey their documented semantics.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu.guest.prefix_cache import (
+    PrefixStore,
+    RadixIndex,
+    _FreeList,
+)
+from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import (
+    init_params,
+    prefill,
+    prefill_suffix,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _shared_prefix_prompts(cfg, n, prefix_len=10, tails=(2, 3, 4), seed=1):
+    key = jax.random.PRNGKey(seed)
+    shared = np.asarray(
+        jax.random.randint(key, (prefix_len,), 0, cfg.vocab_size), np.int32
+    )
+    out = []
+    for i in range(n):
+        tail = np.asarray(jax.random.randint(
+            jax.random.fold_in(key, i), (tails[i % len(tails)],), 0,
+            cfg.vocab_size,
+        ), np.int32)
+        out.append(np.concatenate([shared, tail]))
+    return out
+
+
+# ----- radix index ---------------------------------------------------------
+
+
+def test_radix_insert_and_longest_match():
+    idx = RadixIndex()
+    a = np.array([1, 2, 3, 4], np.int32)
+    idx.insert(a, "A")
+    assert idx.longest_match(a) == (4, "A")
+    # Longer query still matches the registered depth.
+    assert idx.longest_match(np.array([1, 2, 3, 4, 9], np.int32)) == (4, "A")
+    # Prefix of the entry (mid-edge) matches nothing.
+    assert idx.longest_match(np.array([1, 2, 3], np.int32)) == (0, None)
+    # Divergence before the entry depth matches nothing.
+    assert idx.longest_match(np.array([1, 2, 9, 4], np.int32)) == (0, None)
+    assert idx.longest_match(np.array([7], np.int32)) == (0, None)
+
+
+def test_radix_edge_split_and_nesting():
+    idx = RadixIndex()
+    idx.insert(np.array([1, 2, 3, 4], np.int32), "long")
+    # Inserting a strict prefix splits the compressed edge.
+    idx.insert(np.array([1, 2], np.int32), "short")
+    assert idx.longest_match(np.array([1, 2, 3, 4], np.int32)) == (4, "long")
+    assert idx.longest_match(np.array([1, 2, 3], np.int32)) == (2, "short")
+    assert idx.longest_match(np.array([1, 2, 9], np.int32)) == (2, "short")
+    # A diverging branch below the split point.
+    idx.insert(np.array([1, 2, 7, 7], np.int32), "branch")
+    assert idx.longest_match(np.array([1, 2, 7, 7, 1], np.int32)) == (4, "branch")
+    assert idx.longest_match(np.array([1, 2, 3, 4], np.int32)) == (4, "long")
+    assert len(idx) == 3
+
+
+def test_radix_remove_prunes():
+    idx = RadixIndex()
+    n1 = idx.insert(np.array([1, 2, 3, 4], np.int32), "A")
+    n2 = idx.insert(np.array([1, 2], np.int32), "B")
+    idx.remove(n1)
+    assert idx.longest_match(np.array([1, 2, 3, 4], np.int32)) == (2, "B")
+    idx.remove(n2)
+    assert idx.longest_match(np.array([1, 2, 3, 4], np.int32)) == (0, None)
+    assert len(idx) == 0
+
+
+def test_freelist_coalesces():
+    fl = _FreeList(16)
+    a = fl.alloc(8)
+    b = fl.alloc(8)
+    assert {a, b} == {0, 8} and fl.alloc(1) is None
+    fl.free(a, 8)
+    fl.free(b, 8)
+    assert fl.alloc(16) == 0  # neighbors merged back into one range
+
+
+# ----- store semantics -----------------------------------------------------
+
+
+def _store_with(cfg, params, prompts, capacity, buckets):
+    store = PrefixStore(cfg, capacity, buckets)
+    for p in prompts:
+        caches, _, _ = prefill(
+            params, jnp.asarray(p)[None, :], cfg, 32, return_logits=True
+        )
+        store.insert(p, caches, 0)
+    return store
+
+
+def test_store_bucket_aligned_boundaries(model):
+    cfg, params = model
+    p = np.arange(1, 14, dtype=np.int32)  # 13 tokens
+    store = _store_with(cfg, params, [p], capacity=32, buckets=(4, 8, 16))
+    # Insert bound: largest bucket <= len - 1 = 12 → 8; entries at 4 and 8.
+    assert store.tokens_used == 8
+    hit = store.lookup(p)
+    assert hit is not None and hit.length == 8
+    store.release(hit)
+    # A prompt diverging after 5 tokens still matches the 4-boundary.
+    q = np.concatenate([p[:5], np.array([99, 98, 97], np.int32)])
+    hq = store.lookup(q)
+    assert hq is not None and hq.length == 4
+    store.release(hq)
+    # The match is capped at len(prompt) - 1: an 8-token prompt equal to
+    # the cached prefix must match at 4, leaving >= 1 suffix token.
+    h8 = store.lookup(p[:8])
+    assert h8 is not None and h8.length == 4
+    store.release(h8)
+    # Shorter than every bucket: no match, counted as a miss.
+    assert store.lookup(p[:3]) is None
+    assert store.misses == 1
+
+
+def test_store_refcount_blocks_eviction_and_lru_order(model):
+    cfg, params = model
+    p1 = np.arange(0, 10, dtype=np.int32)
+    p2 = np.arange(50, 60, dtype=np.int32)
+    store = _store_with(cfg, params, [p1, p2], capacity=16, buckets=(8,))
+    assert store.tokens_used == 16  # full
+    h1 = store.lookup(p1)  # pins p1's segment AND makes it most-recent
+    assert h1 is not None
+
+    def insert(p):
+        caches, _, _ = prefill(
+            params, jnp.asarray(p)[None, :], cfg, 32, return_logits=True
+        )
+        return store.insert(p, caches, 0)
+
+    # Eviction under capacity pressure while a referencing request is in
+    # flight: p2 (unreferenced) must be the victim, never pinned p1.
+    assert insert(np.arange(100, 110, dtype=np.int32))
+    assert store.lookup(p2) is None  # evicted
+    h1b = store.lookup(p1)
+    assert h1b is not None  # survived while referenced
+    assert store.evictions == 1
+    # Everything pinned → insertion skips instead of evicting.
+    h3 = store.lookup(np.arange(100, 110, dtype=np.int32))
+    assert h3 is not None
+    assert not insert(np.arange(200, 210, dtype=np.int32))
+    assert store.insert_skips == 1
+    for h in (h1, h1b, h3):
+        store.release(h)
+    # Unpinned again: LRU now evictable, insert succeeds.
+    assert insert(np.arange(200, 210, dtype=np.int32))
+    assert store.evictions == 2
+
+
+def test_store_lru_prefers_least_recent(model):
+    cfg, params = model
+    p1 = np.arange(0, 10, dtype=np.int32)
+    p2 = np.arange(50, 60, dtype=np.int32)
+    store = _store_with(cfg, params, [p1, p2], capacity=16, buckets=(8,))
+    # Touch p1 (lookup/release) so p2 becomes least-recently-used.
+    store.release(store.lookup(p1))
+    caches, _, _ = prefill(
+        params, jnp.asarray(np.arange(100, 110, dtype=np.int32))[None, :],
+        cfg, 32, return_logits=True,
+    )
+    store.insert(np.arange(100, 110, dtype=np.int32), caches, 0)
+    assert store.lookup(p2) is None  # LRU victim
+    h = store.lookup(p1)
+    assert h is not None  # recently-used survivor
+    store.release(h)
+
+
+def test_store_eviction_emits_event(model, tmp_path):
+    from kata_xpu_device_plugin_tpu import obs
+
+    cfg, params = model
+    sink = obs.EventSink(str(tmp_path / "events.jsonl"))
+    prev = obs.set_default_sink(sink)
+    try:
+        p1 = np.arange(0, 10, dtype=np.int32)
+        store = _store_with(cfg, params, [p1], capacity=8, buckets=(8,))
+        caches, _, _ = prefill(
+            params, jnp.asarray(np.arange(60, 70, dtype=np.int32))[None, :],
+            cfg, 32, return_logits=True,
+        )
+        store.insert(np.arange(60, 70, dtype=np.int32), caches, 0)
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    evicts = [
+        ev for ev in obs.read_events(str(tmp_path / "events.jsonl"))
+        if ev.get("name") == "prefix_evict"
+    ]
+    assert len(evicts) == 1 and evicts[0]["tokens"] == 8
+
+
+def test_store_validation(model):
+    cfg, _params = model
+    with pytest.raises(ValueError, match="buckets"):
+        PrefixStore(cfg, 64, ())
+    with pytest.raises(ValueError, match="capacity"):
+        PrefixStore(cfg, 4, (8,))
+
+
+# ----- suffix prefill numerics --------------------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_prefill_suffix_matches_cold_prefill(model, kv_quant):
+    """Cold full-length prefill vs copy-prefix + suffix-only prefill: the
+    caches agree on every real row and the boundary logits agree — the
+    greedy continuation is therefore identical (the server-level tests
+    lock the full token streams)."""
+    cfg, params = model
+    prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(3), (12,), 0, cfg.vocab_size
+    ), np.int32)
+    m, max_len = 8, 24
+    cold_caches, cold_logits, cold_pos = prefill(
+        params, jnp.asarray(prompt)[None, :], cfg, max_len,
+        return_logits=True, kv_quantized=kv_quant,
+    )
+    # Store the prefix, gather it back, prefill only the suffix.
+    store = PrefixStore(cfg, 16, (m,), kv_quant=kv_quant)
+    store.insert(prompt, cold_caches, 0)
+    hit = store.lookup(prompt)
+    assert hit is not None and hit.length == m
+    caches = store.materialize(hit, max_len)
+    sfx_caches, sfx_logits, sfx_pos = prefill_suffix(
+        params, jnp.asarray(prompt[m:])[None, :], cfg, caches,
+        jnp.int32(m), return_logits=True,
+    )
+    store.release(hit)
+    assert int(sfx_pos) == int(cold_pos) == len(prompt)
+    if kv_quant:
+        # int8 arenas: the suffix forward reads the QUANTIZED prefix back
+        # (exactly what decode does), while the cold prefill attended to
+        # the pre-quantization k/v — logits agree to quantization noise,
+        # and the greedy stream identity is locked by the server tests.
+        np.testing.assert_allclose(
+            np.asarray(sfx_logits), np.asarray(cold_logits),
+            rtol=0.1, atol=0.5,
+        )
+        for cold, sfx in zip(
+            jax.tree_util.tree_leaves(cold_caches),
+            jax.tree_util.tree_leaves(sfx_caches),
+        ):
+            # Prefix rows are copied VERBATIM — bit-identical int8/scales.
+            np.testing.assert_array_equal(
+                np.asarray(cold[:, :, :m]), np.asarray(sfx[:, :, :m])
+            )
+    else:
+        np.testing.assert_allclose(
+            np.asarray(sfx_logits), np.asarray(cold_logits), rtol=2e-5,
+            atol=2e-5,
+        )
+        for cold, sfx in zip(
+            jax.tree_util.tree_leaves(cold_caches),
+            jax.tree_util.tree_leaves(sfx_caches),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(cold[:, :, : len(prompt)]),
+                np.asarray(sfx[:, :, : len(prompt)]),
+                rtol=2e-5, atol=2e-5,
+            )
+    assert (
+        np.asarray(jnp.argmax(sfx_logits, -1))
+        == np.asarray(jnp.argmax(cold_logits, -1))
+    ).all()
+
+
+def test_prefill_suffix_padded_true_len_matches_exact(model):
+    cfg, params = model
+    prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(5), (11,), 0, cfg.vocab_size
+    ), np.int32)
+    m, max_len = 8, 24
+    cold_caches, _, _ = prefill(
+        params, jnp.asarray(prompt)[None, :], cfg, max_len, return_logits=True
+    )
+    store = PrefixStore(cfg, 16, (8,))
+    store.insert(prompt, cold_caches, 0)
+    hit = store.lookup(prompt)
+    exact_c, exact_l, exact_p = prefill_suffix(
+        params, jnp.asarray(prompt[m:])[None, :], cfg,
+        store.materialize(hit, max_len), jnp.int32(m), return_logits=True,
+    )
+    padded = np.pad(prompt[m:], (0, 5))  # right-pad the suffix
+    pad_c, pad_l, pad_p = prefill_suffix(
+        params, jnp.asarray(padded)[None, :], cfg,
+        store.materialize(hit, max_len), jnp.int32(m), return_logits=True,
+        true_len=jnp.int32(len(prompt) - m),
+    )
+    store.release(hit)
+    assert int(pad_p) == int(exact_p) == len(prompt)
+    # Padded vs exact run different executables (different shapes tile
+    # their reductions differently) — value-identical math, last-ulp fp.
+    np.testing.assert_allclose(np.asarray(pad_l), np.asarray(exact_l),
+                               rtol=1e-5, atol=1e-5)
+    assert (
+        np.asarray(jnp.argmax(pad_l, -1)) == np.asarray(jnp.argmax(exact_l, -1))
+    ).all()
+    for e, p in zip(jax.tree_util.tree_leaves(exact_c),
+                    jax.tree_util.tree_leaves(pad_c)):
+        np.testing.assert_allclose(
+            np.asarray(e[:, :, : len(prompt)]),
+            np.asarray(p[:, :, : len(prompt)]), rtol=1e-5, atol=1e-5,
+        )
+
+
+# ----- server integration --------------------------------------------------
+
+
+def _serve(params, cfg, prompts, budgets=8, **kw):
+    srv = GenerationServer(params, cfg, **kw)
+    if isinstance(budgets, int):
+        budgets = [budgets] * len(prompts)
+    rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+    res = srv.run()
+    return [res[r] for r in rids], srv
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+@pytest.mark.parametrize("overlap", [True, False])
+def test_prefix_serving_greedy_identical_to_cold(model, kv_quant, overlap):
+    """The acceptance-criteria oracle: greedy outputs bit-identical between
+    the prefix-hit path and the cold path, over bucketed shared-prefix
+    prompts, for bf16/fp32 AND int8 (kv_quant) arenas, pipelined and
+    lock-step."""
+    cfg, params = model
+    prompts = _shared_prefix_prompts(cfg, 6)
+    common = dict(max_batch=2, max_len=48, chunk=4,
+                  prefill_buckets=(4, 8, 16), kv_quant=kv_quant,
+                  overlap=overlap)
+    ref, _ = _serve(params, cfg, prompts, **common)
+    out, srv = _serve(params, cfg, prompts, prefix_cache_tokens=64, **common)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    st = srv.stats()
+    assert st["prefix_hits"] >= 4  # everything after the first admission
+    assert st["prefix_hit_ratio"] > 0.5
+    assert st["prefix_tokens_reused"] == 8 * st["prefix_hits"]
+
+
+def test_prefix_serving_batched_suffix_admission(model):
+    """A burst of same-prefix requests admits through ONE batched suffix
+    forward (prefill_batches counts it), token-identical to cold."""
+    cfg, params = model
+    # 8 requests, 4 slots: first pass misses cold-batched, later passes
+    # hit — with equal tails they group into batched suffix forwards.
+    prompts = _shared_prefix_prompts(cfg, 8, tails=(3,))
+    common = dict(max_batch=4, max_len=48, chunk=4, prefill_buckets=(4, 8))
+    ref, _ = _serve(params, cfg, prompts, **common)
+    out, srv = _serve(params, cfg, prompts, prefix_cache_tokens=64, **common)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    st = srv.stats()
+    assert st["prefix_hits"] == 4
+    assert st["prefill_batches"] >= 2  # cold [4, 8] batch + suffix batch
+
+
+def test_stats_prefill_batches_counts_multi_request_forwards_only(model):
+    """Satellite contract: prefill_batches counts MULTI-request admission
+    forwards (cold prefill_batch or batched suffix), never single-request
+    admissions — prefills is the per-request count."""
+    cfg, params = model
+    prompts = _shared_prefix_prompts(cfg, 3, tails=(3,))
+    # One slot: every admission is single-request → 0 batches, N prefills.
+    _, solo = _serve(params, cfg, prompts, max_batch=1, max_len=48,
+                     prefill_buckets=(4, 8), prefix_cache_tokens=64)
+    assert solo.stats()["prefills"] == 3
+    assert solo.stats()["prefill_batches"] == 0
+    # Two slots: the first pass cold-batches 2 rows → exactly 1 increment
+    # for 2 requests (per-forward, not per-row).
+    _, duo = _serve(params, cfg, prompts[:2], max_batch=2, max_len=48,
+                    prefill_buckets=(4, 8))
+    assert duo.stats()["prefills"] == 2
+    assert duo.stats()["prefill_batches"] == 1
+
+
+def test_prefix_hit_ratio_present_when_disabled(model):
+    """Dashboards need no schema branch: prefix fields exist (and are
+    zero) on servers without a store."""
+    cfg, params = model
+    prompts = _shared_prefix_prompts(cfg, 2)
+    _, srv = _serve(params, cfg, prompts, max_batch=2, max_len=48)
+    st = srv.stats()
+    assert st["prefix_hit_ratio"] == 0.0
+    assert st["prefix_hits"] == 0 and st["prefix_misses"] == 0
+    assert st["prefix_tokens_reused"] == 0
+    assert st["prefix_store_tokens"] == 0
+    assert st["prefix_store_occupancy"] == 0.0
+
+
+def test_ring_kv_falls_back_to_cold_admission():
+    """Miss-path fallback for ring_kv=True (explicitly unsupported): the
+    store is disabled, serving stays correct, stats report 0.0."""
+    from kata_xpu_device_plugin_tpu.models import mistral_test_config
+
+    cfg = tiny_test_config(dtype=jnp.float32)  # noqa: F841 — fixture dtype
+    mcfg = mistral_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(4), mcfg, dtype=jnp.float32)
+    prompts = _shared_prefix_prompts(mcfg, 4)
+    common = dict(max_batch=2, max_len=64, chunk=4, prefill_buckets=(4, 8, 16))
+    ref, _ = _serve(params, mcfg, prompts, budgets=10, **common)
+    out, srv = _serve(params, mcfg, prompts, budgets=10, ring_kv=True,
+                      prefix_cache_tokens=64, **common)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    assert srv.prefix_store is None
+    assert srv.stats()["prefix_hit_ratio"] == 0.0
+
+
+def test_prefix_serving_in_flight_pin_and_release(model):
+    """A prefix hit pins its segment for the request's lifetime (eviction
+    under capacity pressure must skip it) and releases at finish."""
+    cfg, params = model
+    prompts = _shared_prefix_prompts(cfg, 3, tails=(3,))
+    srv = GenerationServer(params, cfg, max_batch=1, max_len=48, chunk=4,
+                           prefill_buckets=(8,), prefix_cache_tokens=8)
+    srv.submit(prompts[0], 2)
+    srv.run()  # cold: populates the 8-token store to capacity
+    store = srv.prefix_store
+    assert store.tokens_used == 8
+    srv.submit(prompts[1], 30)  # hit: pins the segment
+    assert srv.step()  # admission + first chunk; request still in flight
+    seg = next(h.segment for h in srv._slot_prefix if h is not None)
+    assert seg.refs == 1
+    # Capacity pressure while the referencing request is in flight: the
+    # pinned segment must not evict — insertion skips instead.
+    other = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(9), (10,), 0, cfg.vocab_size), np.int32)
+    caches, _, _ = prefill(params, jnp.asarray(other)[None, :], cfg, 48,
+                           return_logits=True)
+    assert not store.insert(other, caches, 0)
+    assert store.insert_skips == 1 and store.evictions == 0
+    srv.run()  # drain: finish releases the pin
+    assert all(h is None for h in srv._slot_prefix)
+    assert seg.refs == 0
+
+
+def test_prefix_store_deepens_on_hit(model):
+    """A hit whose prompt extends past the matched boundary re-inserts
+    from its completed slot caches, so an early SHORT prompt cannot
+    permanently cap reuse for its lineage at a small bucket."""
+    cfg, params = model
+    key = jax.random.PRNGKey(17)
+    shared = np.asarray(
+        jax.random.randint(key, (20,), 0, cfg.vocab_size), np.int32
+    )
+    tails = [np.asarray(jax.random.randint(
+        jax.random.fold_in(key, i), (3,), 0, cfg.vocab_size), np.int32)
+        for i in range(3)]
+    prompts = [shared[:10],                      # short: caps insert at 8
+               np.concatenate([shared, tails[0]]),  # hits 8, deepens to 16
+               np.concatenate([shared, tails[1]])]  # must now hit at 16
+    common = dict(max_batch=1, max_len=48, chunk=4, prefill_buckets=(4, 8, 16))
+    ref, _ = _serve(params, cfg, prompts, **common)
+    out, srv = _serve(params, cfg, prompts, prefix_cache_tokens=64, **common)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    st = srv.stats()
+    assert st["prefix_hits"] == 2
+    assert st["prefix_tokens_reused"] == 8 + 16  # the deepened boundary hit
+    assert srv.prefix_store.tokens_used == 8 + 16  # short + deepened segments
+
+
+def test_degraded_suffix_shape_falls_back_to_cold(model):
+    """A hit whose suffix fits no bucket inside the arena — while the
+    whole prompt does — is cancelled in favor of cold bucketed admission
+    (the executable-count bound wins), with store counters reflecting it."""
+    cfg, params = model
+    key = jax.random.PRNGKey(23)
+    shared = np.asarray(
+        jax.random.randint(key, (21,), 0, cfg.vocab_size), np.int32
+    )
+    # buckets (8, 21), max_len 28: the 21-token prompt hits at 8, its
+    # 13-token suffix needs bucket 21 but 8 + 21 > 28 → degraded.
+    common = dict(max_batch=1, max_len=28, chunk=4, prefill_buckets=(8, 21))
+    prompts = [shared[:10], shared]
+    ref, _ = _serve(params, cfg, prompts, budgets=6, **common)
+    out, srv = _serve(params, cfg, prompts, budgets=6,
+                      prefix_cache_tokens=64, **common)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    st = srv.stats()
+    assert st["prefix_hits"] == 0 and st["prefix_misses"] == 2
+    store_st = srv.prefix_store.stats()
+    assert store_st["hits"] == 0 and store_st["misses"] == 2  # cancel() undid it
+
+
+def test_shared_store_across_servers(model):
+    """One PrefixStore backing two servers: the second server's first
+    request hits a prefix the first server deposited."""
+    cfg, params = model
+    prompts = _shared_prefix_prompts(cfg, 3, tails=(3,))
+    store = PrefixStore(cfg, 64, (4, 8, 16))
+    ref, _ = _serve(params, cfg, prompts, max_batch=2, max_len=48,
+                    prefill_buckets=(4, 8, 16))
+    _, srv1 = _serve(params, cfg, prompts[:1], max_batch=2, max_len=48,
+                     prefill_buckets=(4, 8, 16), prefix_store=store)
+    out2, srv2 = _serve(params, cfg, prompts[1:], max_batch=2, max_len=48,
+                        prefill_buckets=(4, 8, 16), prefix_store=store)
+    for r, o in zip(ref[1:], out2):
+        np.testing.assert_array_equal(o, r)
+    assert srv1.stats()["prefix_hits"] == 0
+    assert srv2.stats()["prefix_hits"] == 2  # warm from server 1's insert
+    assert srv2.stats()["prefix_hit_ratio"] == 1.0
+
+
+def test_prefix_server_validation(model):
+    cfg, params = model
+    with pytest.raises(ValueError, match="prefill_buckets"):
+        GenerationServer(params, cfg, max_len=32, prefix_cache_tokens=64)
+    store = PrefixStore(cfg, 64, (4, 8))
+    with pytest.raises(ValueError, match="prefix_store"):
+        GenerationServer(params, cfg, max_len=32, prefill_buckets=(4, 16),
+                         prefix_store=store)  # bucket mismatch
+    with pytest.raises(ValueError, match="prefix_store"):
+        GenerationServer(params, cfg, max_len=32, prefill_buckets=(4, 8),
+                         kv_quant=True, prefix_store=store)  # dtype mismatch
+
+
+def test_prefix_env_default(model, monkeypatch):
+    """KATA_TPU_PREFIX_CACHE_TOKENS (the env the daemon's
+    --prefix-cache-tokens knob injects into AllocateResponse) sizes the
+    store when the caller passes nothing; an explicit 0 overrides it; and
+    on a server WITHOUT prefill_buckets the node-wide env must degrade
+    (store disabled) instead of crashing a previously-valid server —
+    only an explicit prefix_cache_tokens= argument raises."""
+    cfg, params = model
+    monkeypatch.setenv("KATA_TPU_PREFIX_CACHE_TOKENS", "32")
+    srv = GenerationServer(params, cfg, max_len=32, prefill_buckets=(4, 8))
+    assert srv.prefix_store is not None
+    assert srv.prefix_store.capacity_tokens == 32
+    off = GenerationServer(params, cfg, max_len=32, prefill_buckets=(4, 8),
+                           prefix_cache_tokens=0)
+    assert off.prefix_store is None
+    no_buckets = GenerationServer(params, cfg, max_len=32)  # env-only: degrade
+    assert no_buckets.prefix_store is None
+    assert no_buckets.stats()["prefix_hit_ratio"] == 0.0
+    with pytest.raises(ValueError, match="prefill_buckets"):
+        GenerationServer(params, cfg, max_len=32, prefix_cache_tokens=32)
+    # A malformed node-wide env degrades too — it must never crash guests.
+    monkeypatch.setenv("KATA_TPU_PREFIX_CACHE_TOKENS", "16k")
+    bad = GenerationServer(params, cfg, max_len=32, prefill_buckets=(4, 8))
+    assert bad.prefix_store is None
+
+
+def test_store_repairs_lost_shallow_boundary(model):
+    """Eviction of a shallow segment whose boundary a deeper overlapping
+    segment also covers: the next insert of the lineage re-registers the
+    shallow boundary against the surviving segment (whose rows contain
+    exactly those tokens), so reuse does not silently degrade forever."""
+    cfg, params = model
+    lineage = np.arange(1, 13, dtype=np.int32)  # 12 tokens
+
+    def mkcache(p):
+        c, _, _ = prefill(params, jnp.asarray(p)[None, :], cfg, 32,
+                          return_logits=True)
+        return c
+
+    store = PrefixStore(cfg, 12, (4, 8))
+    store.insert(lineage[:6], mkcache(lineage[:6]), 0)   # A: 4 tokens, entry@4
+    store.insert(lineage, mkcache(lineage), 0)           # B: 8 tokens, entry@8
+    assert store.tokens_used == 12
+    # Pressure from an unrelated lineage (needing one 4-token slot)
+    # evicts A (LRU) — the depth-4 entry dies with it even though B's
+    # rows still cover [0, 4).
+    other = np.arange(50, 55, dtype=np.int32)
+    store.insert(other, mkcache(other), 0)
+    assert store.evictions == 1
+    assert store.lookup(lineage[:6]) is None  # the hole
+    # The next full-lineage insert repairs it against B instead of
+    # storing anything new.
+    assert not store.insert(lineage, mkcache(lineage), 0)
+    h = store.lookup(lineage[:6])
+    assert h is not None and h.length == 4
+    h8 = store.lookup(lineage)
+    assert h8 is not None and h8.length == 8
+    assert h.segment is h8.segment  # the shallow entry points into B
+    store.release(h)
+    store.release(h8)
+
+
+def test_allocator_injects_prefix_cache_env():
+    """Daemon side of the same knob: config.prefix_cache_tokens rides the
+    TPU AllocateResponse env (plugin/allocators.py), mirroring
+    compile_cache_dir's delivery path. Host-only — no jax."""
+    from kata_xpu_device_plugin_tpu.cdi import constants as C
+    from kata_xpu_device_plugin_tpu.discovery.tpu import TpuChip, TpuInventory
+    from kata_xpu_device_plugin_tpu.plugin import TpuAllocator
+    from kata_xpu_device_plugin_tpu.topology.slice import HostTopology
+
+    inv = TpuInventory(
+        chips=(TpuChip(index=0, dev_path="/dev/accel0"),),
+        topology=HostTopology.from_accelerator_type("v5litepod-8"),
+        model_suffix="TPU_V5E",
+    )
+    alive = lambda _chip: True  # noqa: E731 — no real /dev in this test
+    wired = TpuAllocator(
+        lambda: inv, "google.com", "tpu", revalidate=alive,
+        prefix_cache_tokens=8192,
+    ).allocate(["0"])
+    assert wired.envs[C.ENV_PREFIX_CACHE_TOKENS] == "8192"
+    bare = TpuAllocator(
+        lambda: inv, "google.com", "tpu", revalidate=alive
+    ).allocate(["0"])
+    assert C.ENV_PREFIX_CACHE_TOKENS not in bare.envs

@@ -75,7 +75,10 @@ bench:
 # the persistent compilation cache on and the obs JSONL stream captured —
 # the serving section's overlap-vs-lockstep A/B, the shared-prefix
 # serving A/B (ISSUE 5: serving_prefix_* vs serving_prefix_cold_* — TTFT
-# speedup, hit ratio, reused-token fraction), and the compile/prefill/
+# speedup, hit ratio, reused-token fraction), the oversubscribed
+# paged-vs-slotted A/B (ISSUE 6: serving_paged_* vs
+# serving_paged_slotted_* — more queued requests than the legacy slot
+# count, TTFT/inter-token p50/p99, preemptions), and the compile/prefill/
 # decode phase breakdown all land in the emitted line; CI uploads
 # bench_smoke_events.jsonl next to the tier-1 timing artifact. The number
 # printed is NOT the headline metric.

@@ -286,6 +286,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
             env["KATA_TPU_BENCH_SOFTCAP"] = "0"
             env["KATA_TPU_BENCH_TRAIN"] = "0"
             env["KATA_TPU_BENCH_PREFIX"] = "0"
+            env["KATA_TPU_BENCH_PAGED"] = "0"
         attempts += 1
         stage_timeout = SMOKE_TIMEOUT_S if args.smoke else ATTEMPT_TIMEOUT_S
         line, hung = run_once(
@@ -324,6 +325,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
         env["KATA_TPU_BENCH_SOFTCAP"] = "0"
         env["KATA_TPU_BENCH_TRAIN"] = "0"
         env["KATA_TPU_BENCH_PREFIX"] = "0"
+        env["KATA_TPU_BENCH_PAGED"] = "0"
         cmd = list(worker_cmd) + ["--smoke", "--fallback"]
         line, _hung = run_once(cmd, env, SMOKE_TIMEOUT_S, "cpu-fallback")
         if line is not None:
@@ -755,18 +757,28 @@ def worker(args: argparse.Namespace) -> None:
                     results = srv.run()
                     dt_s = time.perf_counter() - t0
                     total = sum(len(results[r]) for r in rids)
-                    ttft = (srv.stats()["ttft_s"] or {}).get("mean", 0.0)
+                    st = srv.stats()
                     if best is None or dt_s < best[1]:
-                        best = (total, dt_s, ttft, len(rids))
+                        best = (total, dt_s, st, len(rids))
                 return best
 
             overlap_on = not args.no_overlap
-            total, dt_s, ttft_mean, n_req = timed_run(overlap_on, salt=0)
+            total, dt_s, st, n_req = timed_run(overlap_on, salt=0)
+            ttft_sum = st["ttft_s"] or {}
+            itl_sum = st["decode_token_s"] or {}
             out = {
                 "serving_tok_per_s": round(total / dt_s, 1),
                 "serving_requests": n_req,
                 "serving_s": round(dt_s, 3),
-                "serving_ttft_mean_s": round(ttft_mean, 4),
+                "serving_ttft_mean_s": round(ttft_sum.get("mean", 0.0), 4),
+                # Latency percentiles (ISSUE 6 satellite → ROADMAP item 4's
+                # latency-under-load bench): TTFT and inter-token latency
+                # p50/p99 from the server's Rolling summaries — the
+                # figures users of a loaded deployment actually feel.
+                "serving_ttft_p50_s": round(ttft_sum.get("p50", 0.0), 4),
+                "serving_ttft_p99_s": round(ttft_sum.get("p99", 0.0), 4),
+                "serving_itl_p50_s": round(itl_sum.get("p50", 0.0), 5),
+                "serving_itl_p99_s": round(itl_sum.get("p99", 0.0), 5),
                 "serving_overlap": overlap_on,
             }
             if overlap_on:
@@ -774,7 +786,8 @@ def worker(args: argparse.Namespace) -> None:
                 # lock-step loop — the tok/s and TTFT deltas the pipeline
                 # is worth on this platform. (--no-overlap instead makes
                 # lock-step the PRIMARY config, for two-run A/Bs.)
-                nv_total, nv_dt, nv_ttft, _ = timed_run(False, salt=5000)
+                nv_total, nv_dt, nv_st, _ = timed_run(False, salt=5000)
+                nv_ttft = (nv_st["ttft_s"] or {}).get("mean", 0.0)
                 out.update({
                     "serving_noverlap_tok_per_s": round(nv_total / nv_dt, 1),
                     "serving_noverlap_s": round(nv_dt, 3),
@@ -957,6 +970,104 @@ def worker(args: argparse.Namespace) -> None:
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"prefix_error": f"{type(exc).__name__}: {exc}"[:200]}
 
+    def measure_paged() -> dict:  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
+        # Paged KV arena A/B (ISSUE 6): an OVERSUBSCRIBED burst — more
+        # queued requests than the legacy slot count — served once through
+        # the paged pool (token-budget continuous batching over
+        # guest/kv_arena.py, twice the decode lanes over a pool smaller
+        # than the lanes' dense footprint) and once through the fixed
+        # [BATCH, max_len] slot grid, which can only serve the same burst
+        # by queueing. Runs in smoke too. SIDE measurement with the usual
+        # protections: after the banked headline, crash-guarded,
+        # KATA_TPU_BENCH_PAGED=0 disables.
+        if os.environ.get("KATA_TPU_BENCH_PAGED", "1") == "0":
+            return {}
+        try:
+            from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+
+            srv_max_len = PROMPT_LEN + 72
+            new_per_req = 64
+            n_req = 3 * BATCH          # > BATCH legacy slots: oversubscribed
+            lanes = 2 * BATCH
+            # Pool holds ~1.5 lanes' worth of FULL-length requests: more
+            # concurrency than the slot grid in less memory, with real
+            # allocation pressure (block tables grow per chunk; the tail
+            # of the burst rides admission backpressure, not a crash).
+            pool_tokens = (3 * BATCH // 2) * srv_max_len + 64
+            rng = jax.random.PRNGKey(43)
+            len_step = max(1, PROMPT_LEN // 8)
+
+            def make_server(paged):
+                return GenerationServer(
+                    params, cfg, max_batch=lanes if paged else BATCH,
+                    max_len=srv_max_len, chunk=8 if args.smoke else 16,
+                    prefill_buckets=(PROMPT_LEN,),
+                    # Explicit args on BOTH sides: a daemon-injected
+                    # KATA_TPU_KV_POOL_TOKENS / ..PREFIX_CACHE_TOKENS env
+                    # must not flip the baseline's config.
+                    kv_pool_tokens=pool_tokens if paged else 0,
+                    prefix_cache_tokens=0,
+                )
+
+            def reqs(srv, count, salt=0):
+                out = []
+                for i in range(count):
+                    n = PROMPT_LEN - (i % 4) * len_step  # mixed, one bucket
+                    p = jax.random.randint(
+                        jax.random.fold_in(rng, salt + i), (n,), 0,
+                        cfg.vocab_size, dtype=jnp.int32,
+                    )
+                    out.append(srv.submit(np.asarray(p), new_per_req))
+                return out
+
+            # Warm BOTH executable families (paged decode gathers through
+            # block tables — a different executable from the dense arena's)
+            # so neither timed side pays a compile.
+            for paged in (True, False):
+                warm = make_server(paged)
+                reqs(warm, n_req, salt=7000)
+                warm.run()
+
+            def timed(paged, salt):  # jaxguard: hot  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
+                best = None
+                for trial in range(3):
+                    srv = make_server(paged)
+                    rids = reqs(srv, n_req, salt=salt + 100 * trial)
+                    t0 = time.perf_counter()
+                    results = srv.run()
+                    dt_s = time.perf_counter() - t0
+                    total = sum(len(results[r]) for r in rids)
+                    if best is None or dt_s < best[1]:
+                        best = (total, dt_s, srv.stats())
+                return best
+
+            p_total, p_dt, p_st = timed(True, salt=0)
+            s_total, s_dt, s_st = timed(False, salt=500)
+            p_ttft, p_itl = p_st["ttft_s"] or {}, p_st["decode_token_s"] or {}
+            s_ttft = s_st["ttft_s"] or {}
+            return {
+                "serving_paged_tok_per_s": round(p_total / p_dt, 1),
+                "serving_paged_s": round(p_dt, 3),
+                "serving_paged_requests": n_req,
+                "serving_paged_lanes": lanes,
+                "serving_paged_pool_tokens": pool_tokens,
+                "serving_paged_ttft_p50_s": round(p_ttft.get("p50", 0.0), 4),
+                "serving_paged_ttft_p99_s": round(p_ttft.get("p99", 0.0), 4),
+                "serving_paged_itl_p50_s": round(p_itl.get("p50", 0.0), 5),
+                "serving_paged_itl_p99_s": round(p_itl.get("p99", 0.0), 5),
+                "serving_paged_preemptions": p_st["preemptions"],
+                "serving_paged_cow_copies": p_st["cow_copies"],
+                "serving_paged_slotted_tok_per_s": round(s_total / s_dt, 1),
+                "serving_paged_slotted_s": round(s_dt, 3),
+                "serving_paged_slotted_slots": BATCH,
+                "serving_paged_slotted_ttft_p99_s": round(
+                    s_ttft.get("p99", 0.0), 4),
+                "serving_paged_speedup": round(
+                    (p_total / p_dt) / (s_total / s_dt), 3),
+            }
+        except Exception as exc:  # noqa: BLE001 — headline must survive
+            return {"paged_error": f"{type(exc).__name__}: {exc}"[:200]}
+
     def measure_train() -> dict:
         # Train-step MFU (r5): the flash bwd kernels, remat, and the GSPMD
         # train step were inference-unmeasured claims until this section —
@@ -1108,6 +1219,10 @@ def worker(args: argparse.Namespace) -> None:
     prefix_out = measure_prefix()
     if prefix_out:
         out.update(prefix_out)
+        print(json.dumps(out), flush=True)
+    paged_out = measure_paged()
+    if paged_out:
+        out.update(paged_out)
         print(json.dumps(out), flush=True)
     softcap_out = measure_softcap_prefill()
     if softcap_out:

@@ -65,6 +65,12 @@ ENV_COMPILE_CACHE_DIR = "KATA_TPU_COMPILE_CACHE_DIR"
 # the in-guest prefix KV store per node.
 ENV_PREFIX_CACHE_TOKENS = "KATA_TPU_PREFIX_CACHE_TOKENS"
 
+# Default paged KV pool capacity handed to the guest (ISSUE 6):
+# guest.serving.GenerationServer reads this env when the caller passes no
+# kv_pool_tokens, switching admission to token-budget continuous batching
+# over one shared block pool (guest/kv_arena.py) sized per node.
+ENV_KV_POOL_TOKENS = "KATA_TPU_KV_POOL_TOKENS"
+
 # Default location where containerd/CRI-O pick up CDI spec files
 # (ref pkg/device_plugin/device_plugin.go:20).
 DEFAULT_CDI_DIR = "/var/run/cdi"

@@ -103,6 +103,17 @@ class Config:
     # server opts in via prefix_cache_tokens=).
     prefix_cache_tokens: int = 0
 
+    # Paged KV pool default (ISSUE 6): when > 0, the daemon injects
+    # KATA_TPU_KV_POOL_TOKENS into every TPU AllocateResponse so in-guest
+    # GenerationServers default to the paged block pool
+    # (guest/kv_arena.py) — admission by token budget with preemption/
+    # requeue instead of the fixed slot grid. Same delivery path as
+    # compile_cache_dir / prefix_cache_tokens; servers in incompatible
+    # modes (ring_kv, speculative, mesh) degrade to fixed slots with a
+    # kv_pool_disabled event rather than crashing. 0 leaves the guest
+    # default (fixed slots unless the server opts in via kv_pool_tokens=).
+    kv_pool_tokens: int = 0
+
     def __post_init__(self) -> None:
         if not self.kubelet_socket:
             self.kubelet_socket = os.path.join(self.kubelet_socket_dir, "kubelet.sock")

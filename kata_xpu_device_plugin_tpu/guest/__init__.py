@@ -2,6 +2,7 @@
 compute check, all-reduce smoke) run inside the Kata guest the plugin
 provisioned, plus the continuous-batching generation server."""
 from .distributed import initialize_from_env, resolve
+from .kv_arena import KVPool, PagedPrefixTier
 from .prefix_cache import PrefixStore, RadixIndex
 from .probe import probe_all_reduce, probe_compute, probe_devices, run_ladder
 from .serving import GenerationServer, serve_batch
@@ -9,6 +10,8 @@ from .serving import GenerationServer, serve_batch
 __all__ = [
     "GenerationServer",
     "serve_batch",
+    "KVPool",
+    "PagedPrefixTier",
     "PrefixStore",
     "RadixIndex",
     "initialize_from_env",

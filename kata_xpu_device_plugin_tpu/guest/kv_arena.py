@@ -1,0 +1,532 @@
+"""Paged KV arena: one block pool shared by every in-flight request.
+
+The fixed-slot serving arena (``[L, max_batch, max_len, KV, D]``) charges
+every admitted request the FULL ``max_len`` KV footprint up front, so
+concurrency is capped by slot count and long-tail requests strand device
+memory — the opposite of elastic accelerator allocation. vLLM's
+PagedAttention showed the fix, and FlexNPU (PAPERS.md) showed why it
+matters on accelerators: carve the KV memory into fixed-size token BLOCKS,
+give each request a block table mapping logical positions to physical
+blocks, and admit by token budget instead of slot count. This module is
+that capability for the :mod:`.serving` arena model:
+
+- :class:`KVPool` — the device-resident block pool (one ``[L, 1,
+  num_blocks * block_size, KV, D]`` cache pytree, bf16 or int8
+  :class:`~..ops.quant.QTensor` — the same leaf layout as a one-slot
+  serving cache, so every existing cache op tree-maps over it) plus the
+  host-side free list and per-block refcounts. Two blocks are reserved:
+  ZERO — never written, so when the paged view gathers an unmapped
+  table entry it reads the zeros a fresh dense arena would hold — and
+  SCRATCH, the block-table filler, which absorbs writes that must not
+  land anywhere real (decode writes from lanes with no live request,
+  admission-scatter chunks covering tier-shared blocks); the view
+  remaps SCRATCH entries to ZERO before gathering, so SCRATCH contents
+  never surface on the read side.
+- Device ops — jitted D2D scatter/gather between contiguous per-request
+  caches (what ``prefill``/``prefill_suffix`` produce) and pool blocks,
+  plus the spill/restore pair preemption uses.
+- :class:`PagedPrefixTier` — the shared-prefix radix store of
+  :mod:`.prefix_cache` re-homed INSIDE the pool: segments are block
+  lists, hits share full blocks with the admitted request's table
+  (refcounted, read-only; a partially-covered boundary block is
+  copied-on-write into a private block), and LRU eviction returns
+  unreferenced segments' blocks to the same free list decode allocates
+  from.
+
+**Bit-identity.** The paged decode path (``models.transformer`` paged
+branch) gathers each row's block-table view back into the same
+``[B, max_len]`` operand the dense arena presents: mapped positions hold
+the verbatim rows the dense path would hold (the scatters copy prefill
+caches unchanged, decode writes the same computed k/v), unmapped
+positions read the never-written ZERO block (the zeros a fresh dense
+arena holds), and every position ``> pos`` is replaced by the attention
+mask before softmax anyway (the same argument the dense path makes for
+its pad/stale rows). Every
+position ``<= pos`` sits inside the lane's allocation by construction,
+so greedy tokens are bit-identical to the fixed-slot path (tested in
+``tests/test_kv_arena.py``).
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import obs
+from ..models.transformer import (
+    PAGED_SCRATCH_BLOCK,
+    PAGED_ZERO_BLOCK,
+    DecoderConfig,
+    init_kv_caches,
+)
+from .prefix_cache import RadixIndex
+
+# The reserved physical blocks (the layout contract lives next to the
+# paged ops in models.transformer; re-exported here for the pool's
+# clients). SCRATCH absorbs writes that must not land anywhere real —
+# decode writes from lanes with no live request, overrun writes of a
+# finished lane, admission-scatter chunks covering tier-shared blocks —
+# and is what unmapped block-table entries hold; the paged view remaps
+# SCRATCH to ZERO (never written) before gathering, so unmapped reads
+# see the zeros a fresh dense arena would hold (see the module header's
+# bit-identity note).
+ZERO_BLOCK = PAGED_ZERO_BLOCK
+SCRATCH_BLOCK = PAGED_SCRATCH_BLOCK
+RESERVED_BLOCKS = 2
+
+
+# ----- device ops ----------------------------------------------------------
+#
+# All D2D copies inside jit (no host sync; strict mode's transfer guard
+# leaves device-to-device moves free). Executable counts are bounded: the
+# traced block-table length is a SHAPE, so pool_write_seq compiles one
+# executable per admission width (ceil(bucket / block_size) — bounded by
+# the prefill bucket ladder), and the spill/restore pair always runs at
+# the full table width (exactly one executable each).
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("block_size",))
+def pool_write_seq(pool, caches, row, table, block_size: int):
+    """Scatter row ``row`` of a contiguous cache pytree (leaves
+    ``[L, N, S, ...]``) into pool blocks: rows ``[j*bs, (j+1)*bs)`` of the
+    cache land in pool block ``table[j]``. ``SCRATCH_BLOCK`` entries mask
+    chunks that must not land (tier-shared blocks a hit admission reads
+    but must not overwrite). The pool is donated — an admission must not
+    copy the whole arena. Rows past the cache's length pad with zeros
+    (they sit beyond ``max_len`` and are never gathered)."""
+    bs = block_size
+    nb = table.shape[0]
+    dest = (table[:, None] * bs + jnp.arange(bs)[None, :]).reshape(-1)
+
+    def put(p, c):
+        seq = jax.lax.dynamic_index_in_dim(c, row, axis=1, keepdims=False)
+        if seq.shape[1] < nb * bs:  # jaxguard: allow(JG104) bounded — one executable per admission width (ceil(bucket/bs), the prefill bucket ladder)
+            pad = [(0, 0)] * seq.ndim
+            pad[1] = (0, nb * bs - seq.shape[1])
+            seq = jnp.pad(seq, pad)
+        return p.at[:, 0, dest].set(seq[:, : nb * bs])
+
+    return jax.tree.map(put, pool, caches)
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("block_size",))
+def pool_write_batch(pool, caches, tables, block_size: int):
+    """Batched :func:`pool_write_seq`: cache row ``i`` lands in blocks
+    ``tables[i]`` — ONE donated scatter dispatch for a whole batched
+    admission (N same-bucket requests) instead of N sequential ones.
+    SCRATCH entries mask per-row chunks exactly as in the single-row
+    form; distinct requests' real blocks are disjoint, and rows
+    colliding on SCRATCH are don't-care (the paged view remaps SCRATCH
+    to ZERO, so nothing live ever reads them)."""
+    bs = block_size
+    n, nb = tables.shape
+    dest = (
+        tables[:, :, None] * bs + jnp.arange(bs)[None, None, :]
+    ).reshape(-1)
+
+    def put(p, c):
+        seq = c[:, :n]
+        if seq.shape[2] < nb * bs:  # jaxguard: allow(JG104) bounded — one executable per (group size, admission width), both ladder-bounded
+            pad = [(0, 0)] * seq.ndim
+            pad[2] = (0, nb * bs - seq.shape[2])
+            seq = jnp.pad(seq, pad)
+        seq = seq[:, :, : nb * bs].reshape(
+            (seq.shape[0], n * nb * bs) + seq.shape[3:]
+        )
+        return p.at[:, 0, dest].set(seq)
+
+    return jax.tree.map(put, pool, caches)
+
+
+@partial(jax.jit, static_argnames=("block_size",))
+def pool_gather_rows(pool, table, block_size: int):
+    """Gather the token rows of blocks ``table`` out of the pool into a
+    contiguous ``[L, len(table)*bs, ...]`` pytree — the preemption SPILL
+    read (the caller copies it to host). Always called at the full table
+    width (SCRATCH-padded), so there is exactly one executable."""
+    bs = block_size
+    src = (table[:, None] * bs + jnp.arange(bs)[None, :]).reshape(-1)
+    return jax.tree.map(lambda p: p[:, 0, src], pool)
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("block_size",))
+def pool_scatter_rows(pool, rows, table, block_size: int):
+    """Inverse of :func:`pool_gather_rows`: land contiguous token rows
+    (leaves ``[L, len(table)*bs, ...]``) into blocks ``table`` — the
+    preemption RESTORE write (rows arrive as an explicit host upload).
+    SCRATCH entries absorb the padding tail."""
+    bs = block_size
+    dest = (table[:, None] * bs + jnp.arange(bs)[None, :]).reshape(-1)
+    return jax.tree.map(lambda p, r: p.at[:, 0, dest].set(r), pool, rows)
+
+
+@partial(jax.jit,
+         static_argnames=("length", "cfg", "max_len", "quantized", "dtype",
+                          "n", "block_size"))
+def pool_materialize(pool, table, length: int, cfg: DecoderConfig,
+                     max_len: int, quantized: bool, dtype, n: int,
+                     block_size: int):
+    """Build a fresh ``[L, n, max_len, ...]`` cache pytree with the pool
+    rows of blocks ``table`` landed in EVERY row at positions
+    ``[0, length)`` — the pre-populated caches
+    :func:`..models.transformer.prefill_suffix` resumes from (``n > 1``:
+    one shared prefix fanned out to n same-match admissions). The paged
+    sibling of ``prefix_cache._materialize``; one executable per
+    (bucket length, n)."""
+    caches = init_kv_caches(cfg, n, max_len, dtype=dtype, quantized=quantized)
+    bs = block_size
+    src = (table[:, None] * bs + jnp.arange(bs)[None, :]).reshape(-1)[:length]
+
+    def cp(c, p):
+        seg = p[:, 0, src]  # [L, length, ...]
+        seg = jnp.broadcast_to(
+            seg[:, None], (seg.shape[0], n) + seg.shape[1:]
+        )
+        return jax.lax.dynamic_update_slice(c, seg, (0,) * c.ndim)
+
+    return jax.tree.map(cp, caches, pool)
+
+
+# ----- the pool ------------------------------------------------------------
+
+
+class KVPool:
+    """Device-resident paged KV pool + host-side block accounting.
+
+    ``pool_tokens`` sizes the arena (rounded down to whole blocks; two
+    blocks are reserved — see the module header). Blocks are refcounted:
+    :meth:`try_alloc` hands out blocks at refcount 1, :meth:`ref` adds a
+    holder (a lane's table sharing a prefix-tier block), and
+    :meth:`unref` returns a block to the free list when its last holder
+    lets go — so a tier segment and three lanes can all reference one
+    physical block and it is recycled exactly once.
+    """
+
+    def __init__(self, cfg: DecoderConfig, pool_tokens: int,
+                 block_size: int = 16, *, kv_quant: bool = False,
+                 dtype=None, label: str = "") -> None:
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        num_blocks = int(pool_tokens) // int(block_size)
+        if num_blocks - RESERVED_BLOCKS < 1:
+            raise ValueError(
+                f"pool_tokens={pool_tokens} holds {num_blocks} blocks of "
+                f"{block_size} — need at least {RESERVED_BLOCKS + 1} "
+                "(two reserved + one usable)"
+            )
+        self.cfg = cfg
+        self.block_size = int(block_size)
+        self.num_blocks = num_blocks
+        self.kv_quant = bool(kv_quant)
+        self.dtype = dtype or cfg.dtype
+        self.label = label
+        self.arena = init_kv_caches(
+            cfg, 1, num_blocks * self.block_size, dtype=self.dtype,
+            quantized=kv_quant,
+        )
+        self._free: deque[int] = deque(range(RESERVED_BLOCKS, num_blocks))
+        self._refs = np.zeros(num_blocks, np.int64)
+
+    # -- block accounting ----------------------------------------------------
+
+    @property
+    def blocks_total(self) -> int:
+        """Usable (non-reserved) blocks."""
+        return self.num_blocks - RESERVED_BLOCKS
+
+    @property
+    def blocks_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.blocks_total - len(self._free)
+
+    @property
+    def capacity_tokens(self) -> int:
+        return self.blocks_total * self.block_size
+
+    def occupancy(self) -> float:
+        return round(self.blocks_in_use / max(1, self.blocks_total), 4)
+
+    def try_alloc(self, n: int) -> Optional[list[int]]:
+        """``n`` blocks at refcount 1, or None (all-or-nothing — a partial
+        grant would deadlock two growing lanes against each other)."""
+        if n < 0:
+            raise ValueError(f"try_alloc({n})")
+        if len(self._free) < n:
+            return None
+        out = [self._free.popleft() for _ in range(n)]
+        self._refs[out] += 1
+        return out
+
+    def ref(self, blocks) -> None:
+        """Add a holder to already-allocated blocks (tier-shared prefix
+        blocks entering a lane's table)."""
+        for b in blocks:
+            assert self._refs[b] > 0, f"ref of unallocated block {b}"
+            self._refs[b] += 1
+
+    def unref(self, blocks) -> None:
+        """Drop one holder per block; blocks at refcount 0 return to the
+        free list."""
+        for b in blocks:
+            assert b >= RESERVED_BLOCKS, f"unref of reserved block {b}"
+            self._refs[b] -= 1
+            assert self._refs[b] >= 0, f"block {b} over-released"
+            if self._refs[b] == 0:
+                self._free.append(b)
+
+    def stats(self) -> dict:
+        return {
+            "block_size": self.block_size,
+            "blocks_total": self.blocks_total,
+            "blocks_in_use": self.blocks_in_use,
+            "blocks_free": self.blocks_free,
+            "capacity_tokens": self.capacity_tokens,
+            "occupancy": self.occupancy(),
+        }
+
+
+# ----- the shared-prefix tier ----------------------------------------------
+
+
+@dataclass
+class _TierSegment:
+    """One cached prefix: rows ``[0, length)`` live in ``blocks`` (the
+    last block may be partially covered). ``refs`` counts in-flight hit
+    pins; ``tick`` is the LRU clock; ``nodes`` are the radix entries (one
+    per bucket boundary) pointing here."""
+
+    blocks: list
+    length: int
+    refs: int = 0
+    tick: int = 0
+    nodes: list = field(default_factory=list)
+
+
+@dataclass(frozen=True)
+class TierHit:
+    """A pinned tier lookup: ``length`` prefix tokens live in
+    ``segment.blocks``. Hold for the request's lifetime; release exactly
+    once. Duck-types :class:`.prefix_cache.PrefixHit` (``.segment``,
+    ``.length``) so the serving admission paths are shared."""
+
+    segment: _TierSegment
+    length: int
+
+
+class PagedPrefixTier:
+    """The radix shared-prefix store of :mod:`.prefix_cache`, re-homed as
+    a TIER of one :class:`KVPool` instead of a separate arena: segments
+    are pool block lists, hit admissions SHARE the fully-covered blocks
+    with the request's own block table (pool refcounts; the partially
+    covered boundary block is copied-on-write by the admission scatter),
+    and eviction returns blocks to the same free list decode grows from —
+    so prefix reuse and decode KV compete for, and elastically split, one
+    memory budget.
+
+    API-compatible with :class:`.prefix_cache.PrefixStore` where the
+    serving loop touches it (``lookup``/``release``/``cancel``/``insert``
+    /``materialize``/counters/``stats``), plus :meth:`shared_blocks` and
+    :meth:`evict_one` for the pool's allocation pressure path. Inserts
+    copy rows into tier-owned blocks (one jitted D2D scatter, exactly like
+    the standalone store) and SKIP under pool pressure rather than evict
+    live decode state — decode always outranks the cache."""
+
+    def __init__(self, pool: KVPool, cfg: DecoderConfig, buckets: tuple,
+                 *, label: str = "") -> None:
+        buckets = tuple(sorted(buckets))
+        if not buckets:
+            raise ValueError(
+                "PagedPrefixTier needs a prefill_buckets ladder — bucket-"
+                "aligned match boundaries bound the executable count"
+            )
+        self.pool = pool
+        self.cfg, self.buckets = cfg, buckets
+        self.kv_quant = pool.kv_quant
+        self.dtype = pool.dtype
+        self.label = label
+        self._index = RadixIndex()
+        self._segments: list[_TierSegment] = []
+        self._tick = 0
+        # Cumulative counters (stats()-style snapshot semantics), matching
+        # the standalone PrefixStore's schema.
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+        self.evictions = 0
+        self.inserts = 0
+        self.insert_skips = 0
+
+    # -- host-side index operations -----------------------------------------
+
+    def _next_tick(self) -> int:
+        self._tick += 1
+        return self._tick
+
+    def lookup(self, prompt: np.ndarray) -> Optional[TierHit]:
+        """Longest bucket-aligned cached prefix of ``prompt``, pinned
+        (same contract as ``PrefixStore.lookup``: capped at
+        ``len(prompt) - 1`` so at least one suffix token remains)."""
+        prompt = np.asarray(prompt)
+        depth, seg = self._index.longest_match(prompt[: len(prompt) - 1])
+        if seg is None:
+            self.misses += 1
+            return None
+        seg.refs += 1
+        seg.tick = self._next_tick()
+        self.hits += 1
+        self.tokens_reused += depth
+        return TierHit(seg, depth)
+
+    def release(self, hit: TierHit) -> None:
+        hit.segment.refs -= 1
+        assert hit.segment.refs >= 0, "TierHit released twice"
+
+    def cancel(self, hit: TierHit) -> None:
+        """Release an unused hit and reverse the lookup's counters (the
+        caller fell back to cold admission — e.g. no pool blocks for the
+        suffix right now)."""
+        self.release(hit)
+        self.hits -= 1
+        self.tokens_reused -= hit.length
+        self.misses += 1
+
+    def unlookup(self, hit: Optional[TierHit]) -> None:
+        """Reverse one :meth:`lookup` entirely — counters AND pin — as if
+        it never happened (same contract as ``PrefixStore.unlookup``):
+        the caller's head-of-line block reservation failed, the request
+        stays queued and will be looked up again when it re-offers, so
+        neither a hit nor a miss must stick for this pass."""
+        if hit is not None:
+            self.cancel(hit)
+        self.misses -= 1
+
+    def shared_blocks(self, hit: TierHit) -> list:
+        """The segment blocks FULLY covered by the match — the blocks an
+        admitted request's table may reference directly (read-only,
+        refcounted by the caller via ``pool.ref``). A partially covered
+        boundary block is never shared: the admission scatter writes its
+        private copy (the copy-on-write)."""
+        return list(hit.segment.blocks[: hit.length // self.pool.block_size])
+
+    def insert(self, prompt: np.ndarray, caches: Any, row) -> bool:
+        """Store ``prompt``'s longest bucket-aligned proper prefix from a
+        freshly prefilled cache pytree into tier-owned pool blocks.
+        Registers a radix entry at every bucket boundary of the stored
+        range (one shared segment). Under pool pressure, unreferenced
+        tier segments evict LRU-first; if live state leaves no room the
+        insert is SKIPPED (never an error, never a preemption)."""
+        prompt = np.asarray(prompt, np.int32)
+        bound = next(
+            (b for b in reversed(self.buckets) if b <= len(prompt) - 1), None
+        )
+        if bound is None:
+            return False
+        have, have_seg = self._index.longest_match(prompt[:bound])
+        if have >= bound:
+            # Already stored to this depth — repair any shallow boundary
+            # entry lost to eviction (see PrefixStore.insert).
+            self._register_boundaries(prompt, have_seg, bound)
+            return False
+        bs = self.pool.block_size
+        nb = -(-bound // bs)
+        blocks = self.pool.try_alloc(nb)
+        while blocks is None:
+            if not self.evict_one():
+                self.insert_skips += 1
+                return False
+            blocks = self.pool.try_alloc(nb)
+        self.pool.arena = pool_write_seq(
+            self.pool.arena, caches, jnp.int32(row),
+            jnp.asarray(np.asarray(blocks, np.int32)), block_size=bs,
+        )
+        seg = _TierSegment(blocks, bound, tick=self._next_tick())
+        self._register_boundaries(prompt, seg, bound)
+        self._segments.append(seg)
+        self.inserts += 1
+        return True
+
+    def _register_boundaries(self, prompt: np.ndarray, seg: _TierSegment,
+                             upto: int) -> None:
+        for b in self.buckets:
+            if b > upto or b > seg.length:
+                break
+            depth, _ = self._index.longest_match(prompt[:b])
+            if depth >= b:
+                continue
+            seg.nodes.append(self._index.insert(prompt[:b], seg))
+
+    def evict_one(self) -> bool:
+        """Drop the least-recently-used UNREFERENCED segment, returning
+        its pool refs (blocks recycle once any lane tables sharing them
+        finish). False when every segment is pinned by an in-flight
+        hit."""
+        victims = [s for s in self._segments if s.refs == 0]
+        if not victims:
+            return False
+        seg = min(victims, key=lambda s: s.tick)
+        for node in seg.nodes:
+            self._index.remove(node)
+        self.pool.unref(seg.blocks)
+        self._segments.remove(seg)
+        self.evictions += 1
+        obs.emit(
+            "serving", "prefix_evict",
+            store=self.label, tokens=seg.length, blocks=len(seg.blocks),
+            segments_left=len(self._segments), tier="kv_pool",
+        )
+        return True
+
+    # -- device-side copies --------------------------------------------------
+
+    def materialize(self, hit: TierHit, max_len: int, n: int = 1):
+        """A fresh ``[L, n, max_len, ...]`` cache pytree with the hit's
+        prefix rows in every row at ``[0, hit.length)`` — what
+        ``prefill_suffix`` resumes from. Pure device gather."""
+        bs = self.pool.block_size
+        nb = -(-hit.length // bs)
+        return pool_materialize(
+            self.pool.arena,
+            jnp.asarray(np.asarray(hit.segment.blocks[:nb], np.int32)),
+            hit.length, self.cfg, max_len, self.kv_quant, self.dtype,
+            n, bs,
+        )
+
+    # -- reporting -----------------------------------------------------------
+
+    @property
+    def tokens_used(self) -> int:
+        return sum(s.length for s in self._segments)
+
+    @property
+    def blocks_used(self) -> int:
+        """Pool blocks the tier's segments hold a reference on (some may
+        also be shared into lane tables)."""
+        return sum(len(s.blocks) for s in self._segments)
+
+    def occupancy(self) -> float:
+        """Tier fill as a fraction of the WHOLE pool — the tier is a
+        tenant of the shared budget, not an arena of its own."""
+        return round(self.tokens_used / max(1, self.pool.capacity_tokens), 4)
+
+    def stats(self) -> dict:
+        return {
+            "capacity_tokens": self.pool.capacity_tokens,
+            "tokens_used": self.tokens_used,
+            "occupancy": self.occupancy(),
+            "segments": len(self._segments),
+            "entries": len(self._index),
+            "hits": self.hits,
+            "misses": self.misses,
+            "tokens_reused": self.tokens_reused,
+            "inserts": self.inserts,
+            "insert_skips": self.insert_skips,
+            "evictions": self.evictions,
+        }

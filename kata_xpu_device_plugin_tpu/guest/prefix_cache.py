@@ -352,6 +352,17 @@ class PrefixStore:
         self.tokens_reused -= hit.length
         self.misses += 1
 
+    def unlookup(self, hit: Optional[PrefixHit]) -> None:
+        """Reverse one :meth:`lookup` entirely — counters AND pin — as if
+        it never happened. Unlike :meth:`cancel` (the request proceeds
+        cold, so the store records a miss), the caller here is NOT
+        admitting the request this pass (paged head-of-line block
+        reservation failed) and will look it up again when it re-offers —
+        retries must not inflate the miss counter."""
+        if hit is not None:
+            self.cancel(hit)
+        self.misses -= 1
+
     def insert(self, prompt: np.ndarray, caches: Any, row) -> bool:
         """Store ``prompt``'s longest bucket-aligned proper prefix from a
         freshly prefilled cache pytree (``caches`` row ``row`` holds the

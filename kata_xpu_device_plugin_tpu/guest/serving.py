@@ -89,6 +89,16 @@ from ..models.transformer import (
     prefill_suffix,
     ring_caches_from_prefill,
 )
+from .kv_arena import (
+    RESERVED_BLOCKS,
+    SCRATCH_BLOCK,
+    KVPool,
+    PagedPrefixTier,
+    pool_gather_rows,
+    pool_scatter_rows,
+    pool_write_batch,
+    pool_write_seq,
+)
 from .prefix_cache import PrefixHit, PrefixStore
 
 
@@ -110,6 +120,10 @@ _PROM_STATS = (
     ("prefill_batches", "Multi-request admission prefill forwards"),
     ("prefix_hit_ratio", "Prefix-cache hit ratio (hits / lookups)"),
     ("prefix_store_occupancy", "Prefix store fill (tokens used / capacity)"),
+    ("kv_pool_occupancy", "Paged KV pool fill (blocks in use / usable)"),
+    ("kv_blocks_in_use", "Paged KV pool blocks currently referenced"),
+    ("preemptions", "Requests preempted (KV spilled, requeued FIFO)"),
+    ("cow_copies", "Prefix-tier boundary blocks privatized copy-on-write"),
 )
 
 
@@ -137,6 +151,25 @@ def _ctr_prefix_tokens_reused():
         "kata_tpu_serving_prefix_tokens_reused",
         "Prompt tokens whose KV was copied from the prefix store "
         "instead of re-prefilled",
+        ["server"],
+    )
+
+
+# Paged-pool traffic counters (ISSUE 6): incremented at the moment of the
+# event so rate() works even between scrapes. The ``_total`` suffix keeps
+# them distinct from the same-named scrape-time stats() gauges above.
+def _ctr_preemptions():
+    return obs.counter(
+        "kata_tpu_serving_kv_preemptions_total",
+        "Requests preempted under KV pool pressure (spilled + requeued)",
+        ["server"],
+    )
+
+
+def _ctr_cow_copies():
+    return obs.counter(
+        "kata_tpu_serving_kv_cow_copies_total",
+        "Prefix-tier boundary blocks privatized copy-on-write at admission",
         ["server"],
     )
 
@@ -186,6 +219,35 @@ class _Request:
     t_submit: float = 0.0  # monotonic clock at submit() — TTFT anchor
     out: list = field(default_factory=list)
     done: bool = False
+
+
+@dataclass
+class _Preempted:
+    """One preempted request waiting FIFO for the pool to drain: its KV
+    rows spilled to host (full-table-width pytree, block-granular), plus
+    the host scheduling state (``pos``/``last``) a restore needs. The
+    emitted tokens so far stay on ``req.out`` — restore resumes decode
+    exactly where the spill cut it, so greedy output is unchanged."""
+
+    req: "_Request"
+    kv: Any  # host pytree, leaves [L, nb_max * block_size, ...]
+    pos: int
+    last: int
+
+
+@dataclass
+class _LanePlan:
+    """A paged admission's block reservation, made BEFORE the prefill
+    forward runs (allocation failure must requeue the request, not waste
+    a forward). ``table[:n_shared]`` are prefix-tier blocks the lane
+    references read-only (pool-refcounted); the admission scatter masks
+    them with SCRATCH so shared rows are never rewritten — the partially
+    covered boundary block, when the match is not block-aligned, is the
+    first PRIVATE entry and receives its copy-on-write fill from the
+    materialized cache."""
+
+    table: list
+    n_shared: int
 
 
 @dataclass
@@ -243,21 +305,27 @@ def _merge_rows(dev_vals, host_vals, fresh):
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "do_sample", "top_k",
-                                   "top_p", "ring"),
+                                   "top_p", "ring", "block_size",
+                                   "paged_len"),
          donate_argnums=(1,))
 def _serve_decode(params, caches, tok, pos, cfg, steps: int, do_sample: bool,
                   top_k: int, temperature, key, top_p: float = 0.0,
-                  ring: bool = False):
+                  ring: bool = False, block_tables=None,
+                  block_size: int = 0, paged_len: int = 0):
     """The server's one decode executable: a fixed-``steps`` ragged chunk
     with the KV arena DONATED — without donation XLA must copy every arena
     tensor each chunk (the first in-scan cache write would otherwise alias
     a live buffer), pure HBM traffic charged against the bandwidth decode
     is bound by. ``ring``: the arena is a per-slot ring buffer — one
     ``window``-slot pair, or the window-cycle tuple layout (see
-    ``GenerationServer(ring_kv=True)``)."""
+    ``GenerationServer(ring_kv=True)``). ``block_tables`` (+ static
+    ``block_size``/``paged_len``): the arena is the shared paged block
+    pool and each row decodes through its table (``kv_pool_tokens``)."""
     return _decode_scan(params, caches, tok, pos, cfg, steps, None,
                         do_sample, top_k, temperature, key,
-                        return_state=True, top_p=top_p, ring=ring)
+                        return_state=True, top_p=top_p, ring=ring,
+                        block_tables=block_tables, block_size=block_size,
+                        paged_len=paged_len)
 
 
 class GenerationServer:
@@ -309,7 +377,9 @@ class GenerationServer:
                  draft: Optional[tuple] = None, overlap: bool = True,
                  strict: Optional[bool] = None,
                  prefix_cache_tokens: Optional[int] = None,
-                 prefix_store: Optional[PrefixStore] = None):
+                 prefix_store: Optional[PrefixStore] = None,
+                 kv_pool_tokens: Optional[int] = None,
+                 kv_block_size: int = 16):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
@@ -392,7 +462,76 @@ class GenerationServer:
         # Windowed rings get speculative_k margin slots (see the ring_kv
         # comment above); plain decode (k=0) keeps exactly window slots.
         self._ring_margin = speculative_k if ring_kv else 0
-        if self._cycle:
+        # Label + latency summaries early: the env-degrade events below
+        # (pool, prefix) carry the server label.
+        self._label = f"server{next(GenerationServer._instance_ids)}"
+        self._ttft = obs.Rolling()
+        self._tok_lat = obs.Rolling()
+        # Labeled histogram children resolved ONCE: registry lookup +
+        # .labels() on every prefill/chunk is pure hot-path overhead —
+        # export_metrics(label=...) re-resolves on rename.
+        self._bind_histograms()
+        # Paged KV pool (ISSUE 6): one block pool shared by all in-flight
+        # requests replaces the fixed [max_batch, max_len] slot grid —
+        # admission becomes token-budget continuous batching with
+        # preemption/requeue, and max_batch turns into the decode LANE
+        # count (cheap block-table rows) instead of a memory commitment.
+        self.kv_block = int(kv_block_size)
+        self.paged = False
+        self.kv_pool: Optional[KVPool] = None
+        explicit_pool = kv_pool_tokens is not None
+        if kv_pool_tokens is None:
+            raw = os.environ.get("KATA_TPU_KV_POOL_TOKENS", "")
+            try:
+                kv_pool_tokens = int(raw or 0)
+            except ValueError:
+                # A malformed NODE-WIDE env must degrade to the fixed-slot
+                # path with an event, never crash a guest that did not opt
+                # in (mirrors KATA_TPU_PREFIX_CACHE_TOKENS).
+                obs.emit(
+                    "serving", "kv_pool_disabled",
+                    server=self._label, reason=f"bad_env:{raw[:32]}",
+                )
+                kv_pool_tokens = 0
+        if kv_pool_tokens > 0:
+            reason = self._pool_conflict(
+                kv_pool_tokens, ring_kv, draft, speculative_k, mesh,
+                prefix_store,
+            )
+            if reason is not None:
+                if explicit_pool:
+                    raise ValueError(
+                        f"kv_pool_tokens={kv_pool_tokens} is incompatible "
+                        f"with this server ({reason}) — see the paged-KV "
+                        "compatibility matrix in docs/guest_guide.md"
+                    )
+                # Node-injected default on an incompatible server: degrade
+                # to the fixed-slot path, say so on the event stream.
+                obs.emit(
+                    "serving", "kv_pool_disabled",
+                    server=self._label, reason=reason,
+                )
+            else:
+                self.paged = True
+        if self.paged:
+            self.arena = None  # the pool IS the arena — no slot grid
+            self.kv_pool = KVPool(
+                cfg, kv_pool_tokens, self.kv_block, kv_quant=kv_quant,
+                label=self._label,
+            )
+            self._nb_max = -(-max_len // self.kv_block)
+            self._lane_blocks: list[list[int]] = [
+                [] for _ in range(max_batch)
+            ]
+            # Device-mirrored block tables: SCRATCH filler means a lane
+            # with no live request (or a finished lane overrunning) writes
+            # into the scratch block, never another lane's KV.
+            self._bt_host = np.full(
+                (max_batch, self._nb_max), SCRATCH_BLOCK, np.int32
+            )
+            self._preempted: deque[_Preempted] = deque()
+            self._plans: dict[int, _LanePlan] = {}
+        elif self._cycle:
             self.arena = init_cycle_kv_caches(
                 cfg, max_batch, max_len, quantized=kv_quant,
                 margin=self._ring_margin,
@@ -404,7 +543,7 @@ class GenerationServer:
             self.arena = init_kv_caches(
                 cfg, max_batch, arena_len, quantized=kv_quant
             )
-        if mesh is not None:
+        if mesh is not None and not self.paged:
             self._shard_over(mesh)
         # Host-side slot state: which request occupies each slot, its
         # absolute position (next cache write index), and its last token.
@@ -439,16 +578,9 @@ class GenerationServer:
         self._batch_prefills = 0
         self._drafts_offered = 0
         self._drafts_accepted = 0
-        # Latency summaries (ISSUE 2): host-side Rolling for stats()
-        # quantiles, mirrored into the prometheus histograms at record
-        # time under this server's label.
-        self._label = f"server{next(GenerationServer._instance_ids)}"
-        self._ttft = obs.Rolling()
-        self._tok_lat = obs.Rolling()
-        # Labeled histogram children resolved ONCE: registry lookup +
-        # .labels() on every prefill/chunk is pure hot-path overhead —
-        # export_metrics(label=...) re-resolves on rename.
-        self._bind_histograms()
+        # Paged-pool counters (stats()-snapshot semantics like the rest).
+        self._preemptions = 0
+        self._cow_copies = 0
         # Shared-prefix KV store (ISSUE 5). Per-server hit/miss counters
         # stay separate from the store's own (a store may back several
         # servers); per-slot handles pin a hit's segment until the request
@@ -498,6 +630,19 @@ class GenerationServer:
                     "serving", "prefix_store_disabled",
                     server=self._label, reason="no_prefill_buckets",
                 )
+            elif self.paged:
+                # The radix prefix store becomes the shared-prefix TIER of
+                # the paged pool (ISSUE 6): segments live in pool blocks,
+                # hit admissions share fully-covered blocks with the
+                # request's own table (copy-on-write at the boundary), and
+                # eviction competes with decode for one budget — so
+                # prefix_cache_tokens here is an ENABLE switch, capacity
+                # is the pool's. (An injected separate-arena prefix_store
+                # disables the pool instead — see _pool_conflict.)
+                self.prefix_store = PagedPrefixTier(
+                    self.kv_pool, cfg, self.prefill_buckets,
+                    label=self._label,
+                )
             elif prefix_store is not None:
                 if (prefix_store.cfg != cfg
                         or prefix_store.buckets != self.prefill_buckets
@@ -524,6 +669,36 @@ class GenerationServer:
         self._c_prefix_reused = _ctr_prefix_tokens_reused().labels(
             server=self._label
         )
+        self._c_preempt = _ctr_preemptions().labels(server=self._label)
+        self._c_cow = _ctr_cow_copies().labels(server=self._label)
+
+    def _pool_conflict(self, pool_tokens: int, ring_kv: bool, draft,
+                       speculative_k: int, mesh,
+                       prefix_store) -> Optional[str]:
+        """Why this server cannot run paged — None when it can. The paged
+        path shares the dense ragged-decode numerics but not the ring/
+        cycle folds (block gather would re-layout the band), the draft
+        arena (a second pool), speculative verification (multi-token
+        spans), mesh sharding (the pool is single-chip for now), or an
+        injected separate-arena PrefixStore (the pool-backed tier is the
+        prefix path here). Documented as the compatibility matrix in
+        docs/guest_guide.md."""
+        if self.kv_block < 1:
+            return f"bad_block_size:{self.kv_block}"
+        if ring_kv:
+            return "ring_kv"
+        if draft is not None or speculative_k:
+            return "speculative"
+        if mesh is not None:
+            return "mesh"
+        if prefix_store is not None:
+            return "injected_prefix_store"
+        usable = pool_tokens // self.kv_block - RESERVED_BLOCKS
+        if usable < -(-self.max_len // self.kv_block):
+            # Progress guarantee: the drained pool must hold at least one
+            # full-length request, or the oldest request could deadlock.
+            return f"pool_too_small:{pool_tokens}"
+        return None
 
     def _shard_over(self, mesh) -> None:
         """Tensor-parallel serving: place params by their layout-aware
@@ -646,12 +821,28 @@ class GenerationServer:
             # not logical nbytes: when the arena replicates under tensor
             # parallelism (n_kv_heads % tp != 0 → kv_spec = P()), every
             # device holds a full copy and real HBM is mesh-size × the
-            # logical figure — the stat reports the real cost.
+            # logical figure — the stat reports the real cost. Paged
+            # servers report the block pool (the pool IS the arena).
             "arena_bytes": sum(
                 _hbm_bytes(leaf)
-                for leaf in jax.tree_util.tree_leaves(self.arena)
+                for leaf in jax.tree_util.tree_leaves(
+                    self.kv_pool.arena if self.paged else self.arena
+                )
             ),
         }
+        # Paged-pool fields (ISSUE 6): ALWAYS present — 0/0.0 on slotted
+        # servers — so dashboards need no schema branch (the _PROM_STATS
+        # gauges scrape these by name).
+        pool = self.kv_pool
+        out.update({
+            "kv_pool_occupancy": pool.occupancy() if pool else 0.0,
+            "kv_blocks_in_use": pool.blocks_in_use if pool else 0,
+            "kv_blocks_total": pool.blocks_total if pool else 0,
+            "kv_pool_tokens": pool.capacity_tokens if pool else 0,
+            "preemptions": self._preemptions,
+            "preempted_waiting": len(self._preempted) if self.paged else 0,
+            "cow_copies": self._cow_copies,
+        })
         lookups = self._prefix_hits + self._prefix_misses
         store = self.prefix_store
         out.update({
@@ -663,8 +854,12 @@ class GenerationServer:
             ),
             "prefix_store_tokens": store.tokens_used if store else 0,
             "prefix_store_occupancy": store.occupancy() if store else 0.0,
+            # Paged tier: no arena of its own — its footprint is the pool
+            # fraction its segment blocks hold (shared budget, ISSUE 6).
             "prefix_store_bytes": (
-                sum(
+                out["arena_bytes"] * store.blocks_used
+                // self.kv_pool.num_blocks
+                if isinstance(store, PagedPrefixTier) else sum(
                     _hbm_bytes(leaf)
                     for leaf in jax.tree_util.tree_leaves(store.arena)
                 ) if store else 0
@@ -795,12 +990,17 @@ class GenerationServer:
                 )
             first = self._sample_first(last_logits)
         t_first = time.monotonic()  # the int() above fenced the forward
-        self.arena = _write_slot(self.arena, caches, b)
+        if self.paged:
+            self._paged_commit(b, req, caches, 0)
+        else:
+            self.arena = _write_slot(self.arena, caches, b)
         if self.prefix_store is not None:
             # Populate the store from this full-prompt prefill: the cache
             # rows [0, bucket-aligned bound) are exactly the prompt's real
             # tokens' KV (the bound is < true_len, so pad rows never enter
-            # the store). Device-to-device copy; no host sync.
+            # the store). Device-to-device copy; no host sync. (Paged: the
+            # tier copies into its own pool blocks, skipping under pool
+            # pressure — decode outranks the cache.)
             self.prefix_store.insert(req.prompt, caches, 0)
         if self.draft is not None:
             # The draft prefills the same prompt into its own arena slot
@@ -815,12 +1015,12 @@ class GenerationServer:
             self.draft_arena = _write_slot(self.draft_arena, d_caches, b)
         self._finish_admission(b, req, first, int(pos), t_first)  # jaxguard: allow(JG101) admission host read — slot position lands with the first token
 
-    def _prefix_lookup(self, req: _Request) -> Optional[PrefixHit]:
-        """One store lookup per admission, with the per-server counters.
-        Returns None (and counts nothing) when the store is disabled;
-        counts a miss when the store is on but no bucket-aligned prefix of
-        the prompt is cached. A hit is PINNED — the handle rides in
-        ``_slot_prefix`` until the request leaves its slot."""
+    def _prefix_lookup_raw(self, req: _Request) -> Optional[PrefixHit]:
+        """Store lookup WITHOUT the per-server counters (the paged path
+        must reserve pool blocks between lookup and counting — a failed
+        reservation cancels the hit before anything monotonic recorded
+        it). Returns None when the store is disabled or nothing usable is
+        cached; a non-None hit is PINNED."""
         if self.prefix_store is None:
             return None
         hit = self.prefix_store.lookup(req.prompt)
@@ -839,15 +1039,22 @@ class GenerationServer:
                 # the suffix forward is strictly smaller.
                 self.prefix_store.cancel(hit)
                 hit = None
+        return hit
+
+    def _count_prefix(self, hit: Optional[PrefixHit]) -> None:
+        """Record the per-server hit/miss counters for one ADMITTED
+        lookup (no-op when the store is disabled — disabled servers must
+        keep hit_ratio 0.0 without counting misses)."""
+        if self.prefix_store is None:
+            return
         if hit is None:
             self._prefix_misses += 1
             self._c_prefix_misses.inc()
-            return None
+            return
         self._prefix_hits += 1
         self._prefix_tokens_reused += hit.length
         self._c_prefix_hits.inc()
         self._c_prefix_reused.inc(hit.length)
-        return hit
 
     def _fill_slot_suffix(self, b: int, req: _Request,
                           hit: PrefixHit) -> None:
@@ -879,7 +1086,10 @@ class GenerationServer:
             )
             first = self._sample_first(last_logits)
         t_first = time.monotonic()  # the int() above fenced the forward
-        self.arena = _write_slot(self.arena, caches, b)
+        if self.paged:
+            self._paged_commit(b, req, caches, 0)
+        else:
+            self.arena = _write_slot(self.arena, caches, b)
         # DEEPEN on hit: the slot caches now hold the WHOLE prompt's KV,
         # so a bucket boundary beyond the match (e.g. the first prompt of
         # a lineage was short and capped the stored depth) becomes
@@ -951,9 +1161,13 @@ class GenerationServer:
             else:
                 firsts = np.asarray(jnp.argmax(last_logits, axis=-1))  # jaxguard: allow(JG101) admission host read — sanctioned sync
         t_first = time.monotonic()  # the firsts transfer fenced the forward
-        self.arena = _write_slots(
-            self.arena, caches, jnp.asarray(np.asarray(slots, np.int32))
-        )
+        if self.paged:
+            self._paged_commit_batch(slots, [req for req, _ in pairs],
+                                     caches)
+        else:
+            self.arena = _write_slots(
+                self.arena, caches, jnp.asarray(np.asarray(slots, np.int32))
+            )
         # DEEPEN on hit (see _fill_slot_suffix): rows now hold whole
         # prompts' KV; insert() no-ops unless a deeper bucket boundary
         # than the match became storable, and dedups within the group.
@@ -999,9 +1213,12 @@ class GenerationServer:
             else:
                 firsts = np.asarray(jnp.argmax(last_logits, axis=-1))  # jaxguard: allow(JG101) admission host read — sanctioned sync
         t_first = time.monotonic()  # the firsts transfer fenced the forward
-        self.arena = _write_slots(
-            self.arena, caches, jnp.asarray(np.asarray(slots, np.int32))
-        )
+        if self.paged:
+            self._paged_commit_batch(slots, reqs, caches)
+        else:
+            self.arena = _write_slots(
+                self.arena, caches, jnp.asarray(np.asarray(slots, np.int32))
+            )
         if self.prefix_store is not None:
             # Each row populates the store (insert() dedups identical
             # prefixes within the group via its longest-match check).
@@ -1029,16 +1246,45 @@ class GenerationServer:
             self._admit_unguarded()
 
     def _admit_unguarded(self) -> None:
-        while self._queue:
+        while True:
             free = [
                 b for b in range(self.max_batch) if self._slot_req[b] is None
             ]
             if not free:
                 return
-            take = [
+            if self.paged and self._preempted:
+                # Preempted requests are OLDER than anything still queued:
+                # strict FIFO means nothing admits past them while they
+                # wait for the pool to drain.
+                if not self._resume_one(free[0]):
+                    return
+                continue
+            if not self._queue:
+                return
+            # The admitted set this pass: the FIFO prefix that fits the
+            # free lanes AND (paged) whose block reservations succeed —
+            # the first request the pool cannot hold stops admission
+            # (head-of-line, preserving FIFO; it re-offers when the pool
+            # drains). Lookups pin their hit; a failed reservation
+            # unwinds the lookup — pin and store counters — before any
+            # monotonic counter recorded it.
+            take: list[tuple[_Request, Optional[PrefixHit]]] = []
+            while self._queue and len(take) < len(free):
+                req = self._queue[0]
+                hit = self._prefix_lookup_raw(req)
+                if self.paged and not self._reserve_lane_blocks(req, hit):
+                    if self.prefix_store is not None:
+                        # Reverse the lookup wholesale (pin AND counters,
+                        # miss included): the request stays queued and
+                        # re-looks-up when the pool drains — cancel()
+                        # would count every retry pass as a tier miss.
+                        self.prefix_store.unlookup(hit)
+                    break
+                self._count_prefix(hit)
                 self._queue.popleft()
-                for _ in range(min(len(free), len(self._queue)))
-            ]
+                take.append((req, hit))
+            if not take:
+                return
             # Prefix-store routing first: a hit takes the suffix-only path
             # (its executable is keyed to the SUFFIX bucket, not the
             # prompt's), misses proceed to cold grouping below. Hits on
@@ -1052,8 +1298,7 @@ class GenerationServer:
             # otherwise): rows of one prefill executable must share a
             # shape. dict preserves insertion order, so groups stay FIFO.
             groups: dict[int, list] = {}
-            for req in take:
-                hit = self._prefix_lookup(req)
+            for req, hit in take:
                 if hit is not None:
                     s_len = len(req.prompt) - hit.length
                     pad_len = self._suffix_pad(hit.length, s_len)
@@ -1108,6 +1353,241 @@ class GenerationServer:
                 # evictable again once no other in-flight request holds it.
                 self.prefix_store.release(handle)
                 self._slot_prefix[b] = None
+            if self.paged:
+                # Return the lane's block refs: private blocks recycle
+                # now, tier-shared ones once the tier (and any other lane)
+                # lets go. The table resets to SCRATCH so in-flight writes
+                # for this lane land in the scratch block.
+                self._free_lane(b)
+
+    # ----- paged pool scheduling (ISSUE 6) ---------------------------------
+
+    def _set_lane_table(self, b: int, table: list) -> None:
+        """One writer for a lane's block table and its device mirror.
+        Entries past the allocation stay SCRATCH (writes of a finished or
+        overrunning lane land in the scratch block — never another lane's
+        KV; the paged view remaps SCRATCH entries to the never-written
+        ZERO block, so reads past the allocation see fresh-arena zeros,
+        and positions <= pos always sit inside the allocation by
+        construction)."""
+        self._lane_blocks[b] = list(table)
+        self._bt_host[b, : len(table)] = table
+        self._bt_host[b, len(table):] = SCRATCH_BLOCK
+
+    def _free_lane(self, b: int) -> None:
+        self.kv_pool.unref(self._lane_blocks[b])
+        self._set_lane_table(b, [])
+
+    def _alloc_blocks(self, n: int) -> Optional[list]:
+        """``n`` pool blocks, evicting unreferenced prefix-tier segments
+        LRU-first under pressure (decode outranks the cache); None when
+        live state holds everything."""
+        got = self.kv_pool.try_alloc(n)
+        while got is None:
+            tier = self.prefix_store
+            if not isinstance(tier, PagedPrefixTier) or not tier.evict_one():
+                return None
+            got = self.kv_pool.try_alloc(n)
+        return got
+
+    def _reserve_lane_blocks(self, req: _Request,
+                             hit: Optional[PrefixHit]) -> bool:
+        """Reserve the blocks ``req``'s admission scatter needs BEFORE its
+        prefill forward runs (a failed reservation must requeue, not waste
+        a forward). A hit shares the tier segment's fully-covered blocks
+        (pool-refcounted, read-only) and allocates private blocks for the
+        rest — including the copy-on-write boundary block when the match
+        is not block-aligned. The plan rides in ``_plans`` until the fill
+        path commits it."""
+        bs = self.kv_block
+        n = len(req.prompt)
+        shared: list = []
+        if hit is not None:
+            m = hit.length
+            rows = m + self._suffix_pad(m, n - m)
+            shared = self.prefix_store.shared_blocks(hit)
+        else:
+            bucket = next(
+                (k for k in self.prefill_buckets if k >= n), None
+            )
+            rows = bucket or n
+        need = -(-rows // bs) - len(shared)
+        priv = self._alloc_blocks(need)
+        if priv is None:
+            return False
+        self.kv_pool.ref(shared)
+        if hit is not None and hit.length % bs:
+            # The boundary block is only partially covered by the match:
+            # its private copy is filled from the materialized cache by
+            # the admission scatter — the copy-on-write.
+            self._cow_copies += 1
+            self._c_cow.inc()
+        self._plans[req.rid] = _LanePlan(shared + priv, len(shared))
+        return True
+
+    def _paged_commit(self, b: int, req: _Request, caches, row) -> None:
+        """Land one admission's cache row in the pool: scatter the
+        PRIVATE table entries from the freshly prefilled caches (shared
+        tier blocks are masked with SCRATCH — their rows are already
+        resident and must not be rewritten under the readers sharing
+        them) and install the lane table."""
+        plan = self._plans.pop(req.rid)
+        scatter = (
+            [SCRATCH_BLOCK] * plan.n_shared + plan.table[plan.n_shared:]
+        )
+        self.kv_pool.arena = pool_write_seq(
+            self.kv_pool.arena, caches, jnp.int32(row),
+            jnp.asarray(np.asarray(scatter, np.int32)),
+            block_size=self.kv_block,
+        )
+        self._set_lane_table(b, plan.table)
+
+    def _paged_commit_batch(self, slots: list[int], reqs: list,
+                            caches) -> None:
+        """Batched :meth:`_paged_commit`: land a whole same-bucket
+        admission group with ONE donated :func:`pool_write_batch`
+        dispatch (cache row ``i`` → ``slots[i]``'s private blocks)
+        instead of N sequential pool scatters. Shared tier entries are
+        SCRATCH-masked per row exactly as in the single form, and tables
+        are SCRATCH-padded to the group's widest plan — pad and mask
+        entries collide only on SCRATCH, which nothing live reads."""
+        plans = [self._plans.pop(req.rid) for req in reqs]
+        width = max(len(p.table) for p in plans)
+        tables = np.full((len(plans), width), SCRATCH_BLOCK, np.int32)
+        for i, plan in enumerate(plans):
+            tables[i, plan.n_shared:len(plan.table)] = \
+                plan.table[plan.n_shared:]
+        self.kv_pool.arena = pool_write_batch(
+            self.kv_pool.arena, caches, jnp.asarray(tables),
+            block_size=self.kv_block,
+        )
+        for b, plan in zip(slots, plans):
+            self._set_lane_table(b, plan.table)
+
+    def _full_table(self, b: int) -> np.ndarray:
+        """The lane's table at FULL width (SCRATCH-padded) — what the
+        single spill/restore executable takes."""
+        return np.asarray(self._bt_host[b], np.int32)
+
+    def _preempt_lane(self, b: int, reason: str) -> None:
+        """Preempt the request in lane ``b`` under pool pressure: spill
+        its written KV rows to host (block-granular D2D gather, then one
+        sanctioned D2H copy — preemption is a scheduling slow path, not
+        the decode hot path), release its blocks and prefix pin, and
+        requeue it FIFO. Greedy output is unchanged: restore re-lands the
+        spilled rows verbatim and decode resumes at the same ``pos`` with
+        the same ``last`` token. Tokens of an in-flight chunk carrying
+        this lane are discarded by retire's slot-identity check — wasted
+        FLOPs, never wrong tokens."""
+        req = self._slot_req[b]
+        with jaxapi.allow_transfer("kv pool preemption spill"):
+            spilled = jax.tree.map(
+                np.asarray,  # jaxguard: allow(JG101) preemption spill — sanctioned slow-path sync (guarded by allow_transfer)
+                pool_gather_rows(
+                    self.kv_pool.arena, jnp.asarray(self._full_table(b)),
+                    block_size=self.kv_block,
+                ),
+            )
+        self.kv_pool.unref(self._lane_blocks[b])
+        self._set_lane_table(b, [])
+        handle = self._slot_prefix[b]
+        if handle is not None:
+            self.prefix_store.release(handle)
+            self._slot_prefix[b] = None
+        # Keep the wait list rid-SORTED: _ensure_blocks preempts
+        # youngest-first (descending rid) within a pass, and older
+        # requests may already be waiting — resume order must be the
+        # SUBMIT order for the strict-FIFO requeue guarantee to hold.
+        self._preempted.append(_Preempted(
+            req=req, kv=spilled, pos=int(self._pos[b]),
+            last=int(self._last[b]),
+        ))
+        self._preempted = deque(
+            sorted(self._preempted, key=lambda p: p.req.rid)
+        )
+        self._slot_req[b] = None
+        self._preemptions += 1
+        self._c_preempt.inc()
+        obs.emit(
+            "serving", "kv_preempt",
+            server=self._label, rid=req.rid, pos=int(self._pos[b]),
+            reason=reason, waiting=len(self._preempted),
+            queued=len(self._queue),
+        )
+
+    def _resume_one(self, b: int) -> bool:
+        """Re-admit the OLDEST preempted request into lane ``b``: allocate
+        fresh private blocks for its spilled rows, re-land them (one
+        full-width restore executable), and resume decode at the exact
+        position the spill cut. False when the pool still cannot hold it
+        (the caller waits — strict FIFO, nothing admits past it)."""
+        pre = self._preempted[0]
+        nb = -(-pre.pos // self.kv_block)
+        blocks = self._alloc_blocks(nb)
+        if blocks is None:
+            return False
+        self._preempted.popleft()
+        full = np.full(self._nb_max, SCRATCH_BLOCK, np.int32)
+        full[:nb] = blocks
+        self.kv_pool.arena = pool_scatter_rows(
+            self.kv_pool.arena, jax.tree.map(jnp.asarray, pre.kv),
+            jnp.asarray(full), block_size=self.kv_block,
+        )
+        self._set_lane_table(b, blocks)
+        self._slot_req[b] = pre.req
+        self._slot_prefix[b] = None
+        self._pos[b] = pre.pos
+        self._last[b] = pre.last
+        self._fresh_rows.add(b)  # overlap: override the in-flight row
+        obs.emit(
+            "serving", "kv_resume",
+            server=self._label, rid=pre.req.rid, pos=pre.pos,
+            waiting=len(self._preempted), queued=len(self._queue),
+        )
+        return True
+
+    def _ensure_blocks(self) -> None:
+        """Grow every live lane's block table to cover the next dispatch
+        window (token-budget continuous batching's allocation step),
+        OLDEST request first. On pool exhaustion the YOUNGEST live lane is
+        preempted (spilled + requeued FIFO) until the older lanes fit —
+        progress for the head of the line is guaranteed because a drained
+        pool holds at least one full-length request (checked at
+        construction). Growth is capped by each request's own budget
+        (``prompt + max_new_tokens``): writes past a finished request's
+        budget aim at SCRATCH by table-filler design, so no block is ever
+        spent on provably dead rows."""
+        if not self.paged:
+            return
+        bs = self.kv_block
+        # Overlap keeps one chunk in flight beyond the host-known pos, so
+        # the next dispatch can write up to two chunks ahead of it.
+        lookahead = self.chunk * (2 if self.overlap else 1)
+        lanes = sorted(
+            (b for b in range(self.max_batch)
+             if self._slot_req[b] is not None),
+            key=lambda b: self._slot_req[b].rid,
+        )
+        for b in lanes:
+            req = self._slot_req[b]
+            if req is None:
+                continue  # preempted while growing an older lane
+            cap = -(-(len(req.prompt) + req.max_new_tokens) // bs)
+            need = min(
+                -(-(int(self._pos[b]) + lookahead) // bs), cap, self._nb_max
+            )
+            while (len(self._lane_blocks[b]) < need
+                   and self._slot_req[b] is req):
+                got = self._alloc_blocks(need - len(self._lane_blocks[b]))
+                if got is not None:
+                    self._set_lane_table(b, self._lane_blocks[b] + got)
+                    break
+                victim = max(
+                    (v for v in range(self.max_batch)
+                     if self._slot_req[v] is not None),
+                    key=lambda v: self._slot_req[v].rid,
+                )
+                self._preempt_lane(victim, reason="pool_exhausted")
 
     def step(self) -> bool:
         """One scheduler round. Lock-step (``overlap=False`` or
@@ -1128,12 +1608,40 @@ class GenerationServer:
             return self._step_overlapped()
         return self._step_lockstep()
 
+    def _dispatch_decode(self, last, pos, sub):
+        """The one ``_serve_decode`` call site (lock-step and overlapped
+        share it): paged servers decode through the block pool (tables
+        uploaded host→device each chunk — a few KB riding the dispatch,
+        like ``last``/``pos``; allocation itself is pure host work), slot
+        servers through the dense arena. Returns ``(toks, last, pos)``
+        futures; the donated arena's successor is stored back."""
+        if self.paged:
+            toks, caches, new_last, new_pos = _serve_decode(
+                self.params, self.kv_pool.arena, last, pos, self.cfg,
+                self.chunk, self._do_sample, self.top_k, self._temp_dev,
+                sub, top_p=self.top_p, ring=False,
+                block_tables=jnp.asarray(self._bt_host),
+                block_size=self.kv_block, paged_len=self.max_len,
+            )
+            self.kv_pool.arena = caches
+        else:
+            toks, caches, new_last, new_pos = _serve_decode(
+                self.params, self.arena, last, pos, self.cfg, self.chunk,
+                self._do_sample, self.top_k, self._temp_dev, sub,
+                top_p=self.top_p, ring=self.ring_kv,
+            )
+            self.arena = caches
+        return toks, new_last, new_pos
+
     def _step_lockstep(self) -> bool:
         self._admit()
+        self._ensure_blocks()  # paged: grow tables / preempt before dispatch
         self._fresh_rows.clear()  # lock-step dispatch reads host rows
         active = [b for b in range(self.max_batch) if self._slot_req[b] is not None]
         if not active:
-            return bool(self._queue)
+            return bool(self._queue) or bool(
+                self.paged and self._preempted
+            )
 
         if self.speculative_k:
             # The round's verify transfer (np.asarray inside) is the
@@ -1175,11 +1683,8 @@ class GenerationServer:
             slots_busy=len(active), queued=len(self._queue),
             batch_occupancy=round(len(active) / self.max_batch, 4),
         ) as sp:
-            toks, caches, last, pos = _serve_decode(
-                self.params, self.arena, jnp.asarray(self._last),
-                jnp.asarray(self._pos), self.cfg, self.chunk, self._do_sample,
-                self.top_k, self._temp_dev, sub,
-                top_p=self.top_p, ring=self.ring_kv,
+            toks, last, pos = self._dispatch_decode(
+                jnp.asarray(self._last), jnp.asarray(self._pos), sub
             )
             toks = np.asarray(toks)  # [max_batch, chunk]  # jaxguard: allow(JG101) lock-step round fence — the transfer IS the chunk boundary
         # Per-token decode latency as a client sees it: chunk wall time
@@ -1187,7 +1692,6 @@ class GenerationServer:
         tok_lat = sp.duration_s / self.chunk
         self._tok_lat.observe(tok_lat)
         self._h_tok_lat.observe(tok_lat)
-        self.arena = caches
         # np.array (not asarray): device arrays convert read-only, and
         # _fill_slot writes these rows in place on refill.
         self._last = np.array(last)  # jaxguard: allow(JG101) lock-step fence (writable host copy for refill)
@@ -1219,6 +1723,10 @@ class GenerationServer:
             self._admit()  # pipeline empty: admission feeds this dispatch
         busy = any(r is not None for r in self._slot_req)
         if busy and (prev is None or self._any_survives(prev)):
+            # Paged: grow every live lane's table to cover this dispatch's
+            # window (preempting youngest-first under pool pressure)
+            # BEFORE the tables upload with the chunk.
+            self._ensure_blocks()
             if prev is None:
                 last, pos = jnp.asarray(self._last), jnp.asarray(self._pos)
             elif self._fresh_rows:
@@ -1237,6 +1745,7 @@ class GenerationServer:
             self._inflight is not None
             or bool(self._queue)
             or any(r is not None for r in self._slot_req)
+            or bool(self.paged and self._preempted)
         )
 
     def _any_survives(self, prev: _Inflight) -> bool:
@@ -1285,13 +1794,8 @@ class GenerationServer:
             batch_occupancy=round(len(active) / self.max_batch, 4),
             overlapped=True,
         )
-        toks, caches, new_last, new_pos = _serve_decode(
-            self.params, self.arena, last, pos, self.cfg, self.chunk,
-            self._do_sample, self.top_k, self._temp_dev, sub,
-            top_p=self.top_p, ring=self.ring_kv,
-        )
+        toks, new_last, new_pos = self._dispatch_decode(last, pos, sub)
         sp.mark("dispatch")
-        self.arena = caches
         self._inflight = _Inflight(
             fence=obs.DeviceFence(toks=toks, last=new_last, pos=new_pos),
             last=new_last, pos=new_pos, slots=active, span=sp,

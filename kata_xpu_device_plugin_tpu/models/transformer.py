@@ -410,6 +410,42 @@ def _cache_write_rows(cache, x: jax.Array, rows, idx,
     return cache.at[rows2, cols].set(x.astype(cache.dtype))
 
 
+# Reserved physical blocks of the paged pool — the layout contract with
+# guest.kv_arena.KVPool (which re-exports these). Block 0 is ZERO: never
+# written, so unmapped view entries gather the zeros a fresh dense arena
+# would hold. Block 1 is SCRATCH: the block-table filler, absorbing
+# writes that must not land anywhere real (dead lanes, overruns).
+PAGED_ZERO_BLOCK = 0
+PAGED_SCRATCH_BLOCK = 1
+
+
+def _paged_write_token(cache, x: jax.Array, phys: jax.Array):
+    """Paged decode write: row ``b``'s single fresh k/v vector lands at
+    PHYSICAL pool row ``phys[b]`` (block_table[b, pos//bs] * bs + pos%bs,
+    resolved by the caller). ``cache`` is a pool slice ``[1, NT, KV, D]``
+    (or int8 QTensor pair); x: [B, 1, KV, D]. The scheduler guarantees
+    live lanes map distinct physical rows; lanes with no live request aim
+    at the scratch block (never read), so duplicate scatter order there
+    is irrelevant."""
+    if isinstance(cache, QTensor):
+        qt = quantize_kv(x)
+        return QTensor(
+            cache.q.at[0, phys].set(qt.q[:, 0]),
+            cache.scale.at[0, phys].set(qt.scale[:, 0]),
+        )
+    return cache.at[0, phys].set(x[:, 0].astype(cache.dtype))
+
+
+def _paged_view(cache, idx: jax.Array):
+    """Gather each row's block-table view out of the pool:
+    ``cache [1, NT, ...]`` + ``idx [B, Lm]`` physical row indices →
+    ``[B, Lm, ...]`` — the same dense operand shape the fixed-slot arena
+    presents to attention (unmapped entries index the zero block)."""
+    if isinstance(cache, QTensor):
+        return QTensor(cache.q[0][idx], cache.scale[0][idx])
+    return cache[0][idx]
+
+
 def _layer(
     cfg: DecoderConfig,
     attn_fn: AttnFn,
@@ -424,6 +460,9 @@ def _layer(
     window: Optional[int] = None,
     rope_theta: Optional[float] = None,
     rope_linear: float = 1.0,
+    block_tables: Optional[jax.Array] = None,
+    block_size: int = 0,
+    paged_len: int = 0,
 ):
     """One decoder block. x: [B, S, D]. Returns (x, new_kv, aux) where aux
     is the layer's MoE load-balancing loss (0.0 for dense layers).
@@ -554,6 +593,43 @@ def _layer(
             logits_softcap=cfg.attn_logits_softcap,
         )
         new_cache = (ck, cv)
+    elif kv_cache is not None and block_tables is not None:
+        # PAGED ragged decode (S == 1): the cache pair is this layer's
+        # [1, NT, KV, D] slice of the shared block pool
+        # (guest.kv_arena.KVPool); ``block_tables`` [B, NB] maps row b's
+        # logical block j to pool block ``block_tables[b, j]``. Write the
+        # fresh k/v at its physical row, then gather each row's view back
+        # into the SAME [B, paged_len] dense operand the fixed-slot arena
+        # presents (mapped entries hold verbatim the rows the dense path
+        # would hold, unmapped entries read the reserved zero block, and
+        # the mask replaces every column > pos before softmax) — so the
+        # attention math, and greedy tokens, are bit-identical to the
+        # fixed-slot path. Out-of-range block indexes (a finished lane
+        # overrunning its budget, same class as the dense clamp-at-
+        # max_len-1) clamp to the last table entry, whose filler is the
+        # scratch block — garbage lands where nothing live reads.
+        assert S == 1, "paged decode is single-token (S == 1)"
+        ck, cv = kv_cache
+        bs = block_size
+        rows = jnp.arange(B)
+        blk = jnp.minimum(cache_offset // bs, block_tables.shape[1] - 1)
+        phys = block_tables[rows, blk] * bs + cache_offset % bs  # [B]
+        ck = _paged_write_token(ck, k, phys)
+        cv = _paged_write_token(cv, v, phys)
+        view_tables = jnp.where(
+            block_tables == PAGED_SCRATCH_BLOCK, PAGED_ZERO_BLOCK,
+            block_tables,
+        )
+        view_idx = (
+            (view_tables * bs)[:, :, None]
+            + jnp.arange(bs)[None, None, :]
+        ).reshape(B, -1)[:, :paged_len]
+        attn_out = attn_fn(
+            q, dequantize_kv(_paged_view(ck, view_idx), x.dtype),
+            dequantize_kv(_paged_view(cv, view_idx), x.dtype),
+            causal=True, q_offset=cache_offset, **wkw,
+        )
+        new_cache = (ck, cv)
     elif kv_cache is not None and jnp.ndim(cache_offset) == 1:
         # Ragged decode ([B] offsets): each batch row writes its S k/v
         # vectors at its OWN positions — continuous batching (S == 1) and
@@ -643,8 +719,17 @@ def forward(
     return_aux: bool = False,
     remat: bool = False,
     ring: bool = False,
+    block_tables: Optional[jax.Array] = None,
+    block_size: int = 0,
+    paged_len: int = 0,
 ):
     """Full forward. tokens: [B, S] int32 → logits [B, S, vocab].
+
+    ``block_tables`` (+ static ``block_size``/``paged_len``) switches the
+    cache branch to PAGED decode: ``kv_caches`` is the shared block pool
+    (``guest.kv_arena.KVPool.arena``, leaves [L, 1, NT, ...]) and each
+    row reads/writes through its block table — see ``_layer``'s paged
+    branch for the bit-identity argument.
 
     ``remat=True`` wraps each layer in ``jax.checkpoint``: the backward pass
     recomputes layer activations instead of storing all L of them — memory
@@ -709,6 +794,8 @@ def forward(
             cfg, attn_fn, x, layer, positions, cache, cache_offset,
             prefill=prefill, moe_mesh=moe_mesh, ring=ring and w > 0,
             window=w, rope_theta=theta, rope_linear=linear,
+            block_tables=block_tables, block_size=block_size,
+            paged_len=paged_len,
         )
 
     def body(carry, group_and_cache):
@@ -1140,12 +1227,15 @@ def prefill_batch(params: Params, prompts: jax.Array, cfg: DecoderConfig,
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn", "do_sample",
-                                   "top_k", "top_p", "return_state", "ring"))
+                                   "top_k", "top_p", "return_state", "ring",
+                                   "block_size", "paged_len"))
 def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
                  cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn],
                  do_sample: bool, top_k: int, temperature, key: jax.Array,
                  return_state: bool = False, ring: bool = False,
-                 top_p: float = 0.0):
+                 top_p: float = 0.0,
+                 block_tables: Optional[jax.Array] = None,
+                 block_size: int = 0, paged_len: int = 0):
     if attn_fn is None:
         from ..ops.attention import flash_attention
 
@@ -1160,6 +1250,8 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
         logits, caches = forward(
             params, tok[:, None], cfg, attn_fn=attn_fn, positions=positions,
             kv_caches=caches, cache_offset=pos, ring=ring,
+            block_tables=block_tables, block_size=block_size,
+            paged_len=paged_len,
         )
         nxt = _next_token(logits[:, -1, :], step_key, do_sample, temperature,
                           top_k, top_p)

@@ -155,7 +155,7 @@ def serve(
 
 class Rolling:
     """Host-side summary: count/sum/min/max plus a bounded reservoir of the
-    most recent values for p50/p95 — the dict-snapshot complement of a
+    most recent values for p50/p95/p99 — the dict-snapshot complement of a
     prometheus histogram (whose quantiles only exist server-side).
 
     Thread-safe; ``summary()`` returns a plain-floats dict ready for
@@ -200,4 +200,5 @@ class Rolling:
                 "max": round(self.max or 0.0, 6),
                 "p50": round(self._quantile(0.50), 6),
                 "p95": round(self._quantile(0.95), 6),
+                "p99": round(self._quantile(0.99), 6),
             }

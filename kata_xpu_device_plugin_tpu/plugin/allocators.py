@@ -44,6 +44,7 @@ class TpuAllocator:
         revalidate: Optional[Callable[[object], bool]] = None,
         compile_cache_dir: str = "",
         prefix_cache_tokens: int = 0,
+        kv_pool_tokens: int = 0,
     ):
         self._inventory = inventory
         self._vendor = vendor
@@ -60,6 +61,10 @@ class TpuAllocator:
         # GenerationServers read KATA_TPU_PREFIX_CACHE_TOKENS when no
         # explicit prefix_cache_tokens is passed.
         self._prefix_cache_tokens = int(prefix_cache_tokens)
+        # Guest-side paged KV pool default capacity (config.kv_pool_tokens):
+        # same delivery path — in-guest GenerationServers read
+        # KATA_TPU_KV_POOL_TOKENS when no explicit kv_pool_tokens is passed.
+        self._kv_pool_tokens = int(kv_pool_tokens)
         # Driver-level liveness check supplied by the manager
         # (``manager.tpu_chip_alive``: node_alive over the same
         # dev+driver-state pair health watches); bare existence would hand a
@@ -115,6 +120,8 @@ class TpuAllocator:
             resp.envs[C.ENV_PREFIX_CACHE_TOKENS] = str(
                 self._prefix_cache_tokens
             )
+        if self._kv_pool_tokens > 0:
+            resp.envs[C.ENV_KV_POOL_TOKENS] = str(self._kv_pool_tokens)
         return resp
 
     def preferred(

@@ -399,6 +399,7 @@ class PluginManager:
                 ),
                 compile_cache_dir=cfg.compile_cache_dir,
                 prefix_cache_tokens=cfg.prefix_cache_tokens,
+                kv_pool_tokens=cfg.kv_pool_tokens,
             ),
             socket_dir=cfg.kubelet_socket_dir,
             kubelet_socket=cfg.kubelet_socket,

@@ -1,0 +1,617 @@
+"""Paged KV arena + continuous batching (guest/kv_arena.py, ISSUE 6).
+
+Oracle, as everywhere in serving: the paged pool is a SCHEDULING/memory
+optimization — greedy tokens must be bit-identical to the fixed-slot
+server for every composition (overlap × kv_quant, prefix hits, COW,
+preemption/resume), while the block accounting (refcounts, all-or-nothing
+allocation, tier LRU eviction, FIFO requeue) obeys its documented
+semantics.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu.guest.kv_arena import (
+    RESERVED_BLOCKS,
+    SCRATCH_BLOCK,
+    KVPool,
+    PagedPrefixTier,
+    pool_gather_rows,
+    pool_scatter_rows,
+    pool_write_batch,
+    pool_write_seq,
+)
+from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import init_params, prefill
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _prompts(cfg, lengths, seed=1, shared=0):
+    key = jax.random.PRNGKey(seed)
+    head = np.asarray(
+        jax.random.randint(key, (shared,), 0, cfg.vocab_size), np.int32
+    ) if shared else np.zeros((0,), np.int32)
+    out = []
+    for i, n in enumerate(lengths):
+        tail = np.asarray(jax.random.randint(
+            jax.random.fold_in(key, i), (n,), 0, cfg.vocab_size
+        ), np.int32)
+        out.append(np.concatenate([head, tail]))
+    return out
+
+
+def _serve(params, cfg, prompts, budgets=10, **kw):
+    srv = GenerationServer(params, cfg, **kw)
+    if isinstance(budgets, int):
+        budgets = [budgets] * len(prompts)
+    rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+    res = srv.run()
+    return [res[r] for r in rids], srv
+
+
+def _events(path):
+    from kata_xpu_device_plugin_tpu import obs
+
+    return obs.read_events(str(path))
+
+
+# ----- KVPool block accounting --------------------------------------------
+
+
+def test_pool_alloc_is_all_or_nothing(model):
+    cfg, _ = model
+    pool = KVPool(cfg, pool_tokens=6 * 4, block_size=4)  # 4 usable blocks
+    assert pool.blocks_total == 4
+    got = pool.try_alloc(3)
+    assert got is not None and len(got) == 3
+    assert all(b >= RESERVED_BLOCKS for b in got)
+    assert pool.try_alloc(2) is None       # only 1 free: no partial grant
+    assert pool.blocks_free == 1           # ...and nothing was consumed
+    pool.unref(got)
+    assert pool.blocks_free == 4
+
+
+def test_pool_refcount_recycles_exactly_once(model):
+    cfg, _ = model
+    pool = KVPool(cfg, pool_tokens=6 * 4, block_size=4)
+    (b,) = pool.try_alloc(1)
+    pool.ref([b])                          # tier + lane share the block
+    pool.ref([b])
+    pool.unref([b])
+    pool.unref([b])
+    assert pool.blocks_free == 3           # still held by the last ref
+    pool.unref([b])
+    assert pool.blocks_free == 4
+    with pytest.raises(AssertionError):
+        pool.unref([b])                    # over-release is a bug, loudly
+
+
+def test_pool_too_small_rejected(model):
+    cfg, _ = model
+    with pytest.raises(ValueError, match="pool_tokens"):
+        KVPool(cfg, pool_tokens=RESERVED_BLOCKS * 4, block_size=4)
+
+
+# ----- device ops ----------------------------------------------------------
+
+
+def test_pool_write_gather_scatter_roundtrip(model):
+    """Scatter a contiguous cache into blocks, gather it back, restore it
+    into different blocks: every hop is row-exact, and SCRATCH-masked
+    chunks never land."""
+    cfg, params = model
+    pool = KVPool(cfg, pool_tokens=8 * 4, block_size=4)
+    prompt = np.arange(1, 9, dtype=np.int32)   # 8 tokens = 2 blocks
+    caches, _, _ = prefill(params, jnp.asarray(prompt)[None, :], cfg, 16,
+                           return_logits=True)
+    ref_rows = jax.tree.map(lambda c: np.asarray(c[:, 0, :8]), caches)
+    table = pool.try_alloc(2)
+    pool.arena = pool_write_seq(
+        pool.arena, caches, jnp.int32(0),
+        jnp.asarray(np.asarray(table, np.int32)), block_size=4,
+    )
+    got = jax.tree.map(
+        np.asarray,
+        pool_gather_rows(pool.arena, jnp.asarray(np.asarray(table, np.int32)),
+                         block_size=4),
+    )
+    jax.tree.map(np.testing.assert_array_equal, got, ref_rows)
+    # Restore into a fresh pair of blocks; gather must round-trip again.
+    table2 = pool.try_alloc(2)
+    pool.arena = pool_scatter_rows(
+        pool.arena, jax.tree.map(jnp.asarray, got),
+        jnp.asarray(np.asarray(table2, np.int32)), block_size=4,
+    )
+    got2 = jax.tree.map(
+        np.asarray,
+        pool_gather_rows(pool.arena,
+                         jnp.asarray(np.asarray(table2, np.int32)),
+                         block_size=4),
+    )
+    jax.tree.map(np.testing.assert_array_equal, got2, ref_rows)
+    # SCRATCH-masked chunk: rewriting block 0's chunk toward SCRATCH must
+    # leave the real block untouched.
+    before = jax.tree.map(np.asarray, pool.arena)
+    pool.arena = pool_write_seq(
+        pool.arena, jax.tree.map(lambda c: c * 0 + 1, caches), jnp.int32(0),
+        jnp.asarray(np.asarray([SCRATCH_BLOCK, table[1]], np.int32)),
+        block_size=4,
+    )
+    after = jax.tree.map(np.asarray, pool.arena)
+    b0 = table[0]
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            x[:, 0, b0 * 4:(b0 + 1) * 4], y[:, 0, b0 * 4:(b0 + 1) * 4]
+        ),
+        before, after,
+    )
+
+
+def test_pool_write_batch_matches_sequential(model):
+    """One batched admission scatter lands exactly what N sequential
+    ``pool_write_seq`` calls would: per-row SCRATCH masking holds, and
+    SCRATCH-padding a narrower row to the group's width is a no-op."""
+    cfg, params = model
+    prompts = [np.arange(1, 9, dtype=np.int32),      # 8 tokens = 2 blocks
+               np.arange(20, 32, dtype=np.int32)]    # 12 tokens = 3 blocks
+    batch = np.zeros((2, 12), np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, : len(p)] = p
+    caches, _, _ = prefill(params, jnp.asarray(batch), cfg, 16,
+                           return_logits=True)
+
+    pool_a = KVPool(cfg, pool_tokens=8 * 4, block_size=4)
+    pool_b = KVPool(cfg, pool_tokens=8 * 4, block_size=4)
+    t0, t1 = pool_a.try_alloc(2), pool_a.try_alloc(3)
+    assert [pool_b.try_alloc(2), pool_b.try_alloc(3)] == [t0, t1]
+    # Row 0 masks its first block (a tier-shared entry) and is narrower
+    # than row 1 — the batched form pads it with SCRATCH to width 3.
+    rows = [[SCRATCH_BLOCK, t0[1]], [SCRATCH_BLOCK] + t1[1:]]
+    for i, tab in enumerate(rows):
+        pool_a.arena = pool_write_seq(
+            pool_a.arena, caches, jnp.int32(i),
+            jnp.asarray(np.asarray(tab, np.int32)), block_size=4,
+        )
+    tables = np.full((2, 3), SCRATCH_BLOCK, np.int32)
+    for i, tab in enumerate(rows):
+        tables[i, : len(tab)] = tab
+    pool_b.arena = pool_write_batch(
+        pool_b.arena, caches, jnp.asarray(tables), block_size=4,
+    )
+    for tab in (t0, t1):
+        full = jnp.asarray(np.asarray(tab, np.int32))
+        jax.tree.map(
+            np.testing.assert_array_equal,
+            jax.tree.map(np.asarray,
+                         pool_gather_rows(pool_a.arena, full, block_size=4)),
+            jax.tree.map(np.asarray,
+                         pool_gather_rows(pool_b.arena, full, block_size=4)),
+        )
+
+
+# ----- the shared-prefix tier ---------------------------------------------
+
+
+def _tier(cfg, params, buckets=(4, 8), pool_tokens=10 * 4, bs=4):
+    pool = KVPool(cfg, pool_tokens=pool_tokens, block_size=bs)
+    return pool, PagedPrefixTier(pool, cfg, buckets)
+
+
+def _cache_for(params, cfg, prompt, max_len=32):
+    caches, _, _ = prefill(params, jnp.asarray(prompt)[None, :], cfg,
+                           max_len, return_logits=True)
+    return caches
+
+
+def test_tier_insert_lookup_pin_and_lru_eviction(model):
+    cfg, params = model
+    pool, tier = _tier(cfg, params, pool_tokens=6 * 4)  # 4 usable blocks
+    p1 = np.arange(0, 10, dtype=np.int32)
+    p2 = np.arange(40, 50, dtype=np.int32)
+    assert tier.insert(p1, _cache_for(params, cfg, p1), 0)   # 8 tok = 2 blk
+    hit = tier.lookup(p1)
+    assert hit is not None and hit.length == 8
+    assert tier.shared_blocks(hit) == hit.segment.blocks[:2]
+    # Pool pressure with the segment PINNED: insert skips, never evicts
+    # live-referenced rows, never errors.
+    held = pool.try_alloc(2)
+    assert not tier.insert(p2, _cache_for(params, cfg, p2), 0)
+    assert tier.insert_skips == 1 and tier.evictions == 0
+    # Release the pin: the same insert now evicts p1's segment LRU-first.
+    tier.release(hit)
+    assert tier.insert(p2, _cache_for(params, cfg, p2), 0)
+    assert tier.evictions == 1
+    assert tier.lookup(p1) is None
+    pool.unref(held)
+
+
+def test_tier_cancel_reverses_lookup_counters(model):
+    cfg, params = model
+    _pool, tier = _tier(cfg, params)
+    p = np.arange(0, 10, dtype=np.int32)
+    tier.insert(p, _cache_for(params, cfg, p), 0)
+    hit = tier.lookup(p)
+    assert (tier.hits, tier.tokens_reused) == (1, 8)
+    tier.cancel(hit)
+    assert (tier.hits, tier.misses, tier.tokens_reused) == (0, 1, 0)
+    assert hit.segment.refs == 0
+
+
+def test_tier_unlookup_leaves_no_trace(model):
+    """Head-of-line retry accounting: a failed block reservation unwinds
+    the pass's lookup wholesale — hit OR miss — so a request that
+    re-offers N times before admission still counts exactly once
+    (cancel() would record a tier miss per retry)."""
+    cfg, params = model
+    _pool, tier = _tier(cfg, params)
+    p = np.arange(0, 10, dtype=np.int32)
+    assert tier.lookup(p) is None          # miss retry
+    tier.unlookup(None)
+    assert (tier.hits, tier.misses) == (0, 0)
+    tier.insert(p, _cache_for(params, cfg, p), 0)
+    hit = tier.lookup(p)                   # hit retry
+    tier.unlookup(hit)
+    assert (tier.hits, tier.misses, tier.tokens_reused) == (0, 0, 0)
+    assert hit.segment.refs == 0           # pin released — evictable again
+
+
+# ----- serving: paged vs slotted bit-identity ------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+@pytest.mark.parametrize("overlap", [True, False])
+def test_paged_greedy_identical_to_slotted(model, kv_quant, overlap):
+    """The acceptance-criteria oracle: greedy outputs bit-identical
+    between the paged pool and the fixed slot grid, mixed prompt lengths
+    through queue pressure, bf16/fp32 AND int8 arenas, pipelined and
+    lock-step."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 9, 6, 12, 3, 7])
+    common = dict(max_batch=3, max_len=32, chunk=4, overlap=overlap,
+                  kv_quant=kv_quant)
+    ref, _ = _serve(params, cfg, prompts, **common)
+    out, srv = _serve(params, cfg, prompts, kv_pool_tokens=3 * 32 + 16,
+                      kv_block_size=8, **common)
+    assert srv.paged
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    st = srv.stats()
+    assert st["kv_blocks_in_use"] == 0      # drained pool: all recycled
+    assert st["kv_blocks_total"] > 0
+
+
+@pytest.mark.parametrize("overlap", [True, False])
+def test_paged_preemption_keeps_outputs_and_fifo(model, overlap, tmp_path):
+    """A pool barely above one full-length request forces spill/requeue:
+    outputs stay bit-identical, preempted requests resume FIFO (nothing
+    admits past them — ttft events stay rid-sorted), and the preempt/
+    resume events land on the stream."""
+    from kata_xpu_device_plugin_tpu import obs
+
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 9, 6, 12, 3, 7, 5, 8], seed=2)
+    common = dict(max_batch=4, max_len=32, chunk=4, overlap=overlap)
+    ref, _ = _serve(params, cfg, prompts, budgets=14, **common)
+    sink = obs.EventSink(str(tmp_path / "ev.jsonl"))
+    prev = obs.set_default_sink(sink)
+    try:
+        out, srv = _serve(params, cfg, prompts, budgets=14,
+                          kv_pool_tokens=32 + 3 * 8, kv_block_size=8,
+                          **common)
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    st = srv.stats()
+    assert st["preemptions"] >= 1
+    assert st["preempted_waiting"] == 0
+    evs = _events(tmp_path / "ev.jsonl")
+    preempts = [e for e in evs if e.get("name") == "kv_preempt"]
+    resumes = [e for e in evs if e.get("name") == "kv_resume"]
+    assert len(preempts) == st["preemptions"] == len(resumes)
+    # Every preempted request resumed at the exact position it spilled.
+    assert {e["rid"] for e in preempts} == {e["rid"] for e in resumes}
+    # Strict-FIFO requeue: replaying the event stream, every resume must
+    # pick the OLDEST (lowest-rid) currently-preempted request — the
+    # youngest-first preemption order must not leak into resume order.
+    waiting: set = set()
+    for e in evs:
+        if e.get("name") == "kv_preempt":
+            waiting.add(e["rid"])
+        elif e.get("name") == "kv_resume":
+            assert e["rid"] == min(waiting), "resumed past an older request"
+            waiting.remove(e["rid"])
+    # A preempted request produces ONE ttft (tokens ride req.out through
+    # the spill), so every rid appears exactly once.
+    ttft_rids = [e["rid"] for e in evs if e.get("name") == "ttft"]
+    assert sorted(ttft_rids) == list(range(len(prompts)))
+
+
+def test_paged_oversubscribed_completes_more_lanes_than_slots(model):
+    """The A/B shape bench-smoke runs: more queued requests than the old
+    slot count, twice the lanes over a pool SMALLER than their dense
+    footprint — the paged server admits more concurrently than the slot
+    grid ever could, and completes with identical tokens."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 6, 9, 5, 7, 8, 3, 10, 6, 4], seed=3)
+    ref, _ = _serve(params, cfg, prompts, max_batch=2, max_len=32, chunk=4)
+    out, srv = _serve(params, cfg, prompts, max_batch=6, max_len=32,
+                      chunk=4, kv_pool_tokens=4 * 32, kv_block_size=8)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    assert srv.paged and srv.max_batch == 6
+    # 6 lanes over a 4-request-footprint pool: the dense grid for 6 slots
+    # would need 6*32 tokens; the pool held 4*32.
+    assert srv.kv_pool.capacity_tokens < 6 * 32
+
+
+# ----- serving: the prefix tier, sharing, and copy-on-write ---------------
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+@pytest.mark.parametrize("overlap", [True, False])
+def test_paged_prefix_tier_identity_and_sharing(model, kv_quant, overlap):
+    """Shared-prefix traffic through the pool-backed tier: bit-identical
+    to the slotted no-store server, hits share tier blocks (refcounted),
+    and a block-aligned match copies nothing."""
+    cfg, params = model
+    prompts = _prompts(cfg, [3, 6, 2, 9, 4, 5], seed=4, shared=10)
+    common = dict(max_batch=3, max_len=40, chunk=4, overlap=overlap,
+                  kv_quant=kv_quant, prefill_buckets=(8, 16, 24))
+    ref, _ = _serve(params, cfg, prompts, **common)
+    out, srv = _serve(params, cfg, prompts, kv_pool_tokens=3 * 40 + 32,
+                      kv_block_size=8, prefix_cache_tokens=1, **common)
+    assert isinstance(srv.prefix_store, PagedPrefixTier)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    st = srv.stats()
+    assert st["prefix_hits"] >= 3
+    assert st["cow_copies"] == 0            # matches at 8 = block-aligned
+    assert st["prefix_store_tokens"] > 0
+    assert st["prefix_store_bytes"] > 0
+
+
+@pytest.mark.parametrize("overlap", [True, False])
+def test_paged_cow_boundary_block(model, overlap):
+    """A match that is NOT block-aligned privatizes the boundary block
+    copy-on-write: cow_copies counts it, the tier's copy stays resident
+    and shared rows are never rewritten (outputs identical)."""
+    cfg, params = model
+    prompts = _prompts(cfg, [3, 6, 2, 9], seed=5, shared=10)
+    common = dict(max_batch=2, max_len=40, chunk=4, overlap=overlap,
+                  prefill_buckets=(8, 16, 24))
+    ref, _ = _serve(params, cfg, prompts, **common)
+    out, srv = _serve(params, cfg, prompts, kv_pool_tokens=2 * 40 + 64,
+                      kv_block_size=16,     # match@8 sits mid-block → COW
+                      prefix_cache_tokens=1, **common)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    st = srv.stats()
+    assert st["prefix_hits"] >= 1
+    assert st["cow_copies"] >= 1
+    assert st["cow_copies"] == srv._cow_copies
+
+
+def test_paged_decode_pressure_evicts_unpinned_tier_lru(model):
+    """Decode growth outranks the cache: when lanes need blocks, the
+    tier's UNREFERENCED segments evict LRU-first (prefix_evict with
+    tier=kv_pool) instead of preempting live requests."""
+    from kata_xpu_device_plugin_tpu import obs
+
+    cfg, params = model
+    # Small pool + long decode budgets: after cold admissions populate
+    # the tier, lane growth must reclaim tier blocks.
+    prompts = _prompts(cfg, [9, 9, 9, 9], seed=6, shared=0)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        sink = obs.EventSink(td + "/ev.jsonl")
+        prev = obs.set_default_sink(sink)
+        try:
+            out, srv = _serve(params, cfg, prompts, budgets=20,
+                              max_batch=2, max_len=32, chunk=4,
+                              kv_pool_tokens=32 + 4 * 8, kv_block_size=8,
+                              prefill_buckets=(8,), prefix_cache_tokens=1)
+        finally:
+            obs.set_default_sink(prev)
+            sink.close()
+        evs = _events(td + "/ev.jsonl")
+    ref, _ = _serve(params, cfg, prompts, budgets=20,
+                    max_batch=2, max_len=32, chunk=4)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    tier_evicts = [e for e in evs if e.get("name") == "prefix_evict"
+                   and e.get("tier") == "kv_pool"]
+    assert tier_evicts, "decode pressure should have reclaimed tier blocks"
+    # Retry passes (head-of-line reservation failures) must not inflate
+    # the tier's counters: each admission nets exactly one hit or miss.
+    tier = srv.prefix_store
+    assert tier.hits + tier.misses == len(prompts)
+
+
+# ----- config / env / degrade ---------------------------------------------
+
+
+def test_kv_pool_env_default_and_malformed_degrade(model, monkeypatch,
+                                                   tmp_path):
+    """KATA_TPU_KV_POOL_TOKENS (the env the daemon's --kv-pool-tokens
+    knob injects) turns paging on when the caller passes nothing; an
+    explicit 0 overrides; malformed or too-small values DEGRADE to the
+    fixed-slot path with a kv_pool_disabled event — a node-wide knob
+    must never crash a guest."""
+    from kata_xpu_device_plugin_tpu import obs
+
+    cfg, params = model
+    monkeypatch.setenv("KATA_TPU_KV_POOL_TOKENS", "128")
+    srv = GenerationServer(params, cfg, max_batch=2, max_len=32)
+    assert srv.paged and srv.kv_pool is not None
+    off = GenerationServer(params, cfg, max_batch=2, max_len=32,
+                           kv_pool_tokens=0)
+    assert not off.paged
+    events = []
+    for raw, reason_prefix in [("64k", "bad_env"), ("8", "pool_too_small")]:
+        monkeypatch.setenv("KATA_TPU_KV_POOL_TOKENS", raw)
+        sink = obs.EventSink(str(tmp_path / f"ev_{raw}.jsonl"))
+        prev = obs.set_default_sink(sink)
+        try:
+            bad = GenerationServer(params, cfg, max_batch=2, max_len=32)
+        finally:
+            obs.set_default_sink(prev)
+            sink.close()
+        assert not bad.paged and bad.arena is not None
+        evs = [e for e in _events(tmp_path / f"ev_{raw}.jsonl")
+               if e.get("name") == "kv_pool_disabled"]
+        assert len(evs) == 1 and evs[0]["reason"].startswith(reason_prefix)
+        events.extend(evs)
+    # The degraded server still serves correctly on the slot grid.
+    prompts = _prompts(cfg, [4, 6])
+    ref, _ = _serve(params, cfg, prompts, max_batch=2, max_len=32)
+    monkeypatch.setenv("KATA_TPU_KV_POOL_TOKENS", "not-a-number")
+    out, _ = _serve(params, cfg, prompts, max_batch=2, max_len=32)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+
+def test_kv_pool_incompatible_modes(model, monkeypatch, tmp_path):
+    """The compatibility matrix (docs/guest_guide.md): an EXPLICIT
+    kv_pool_tokens on an incompatible server raises with the reason; the
+    env-injected default degrades with a kv_pool_disabled event carrying
+    the same reason."""
+    from kata_xpu_device_plugin_tpu import obs
+    from kata_xpu_device_plugin_tpu.guest.prefix_cache import PrefixStore
+    from kata_xpu_device_plugin_tpu.models import mistral_test_config
+
+    cfg, params = model
+    with pytest.raises(ValueError, match="speculative"):
+        GenerationServer(params, cfg, max_batch=2, max_len=32,
+                         kv_pool_tokens=128, speculative_k=2)
+    store = PrefixStore(cfg, 64, (8,))
+    with pytest.raises(ValueError, match="injected_prefix_store"):
+        GenerationServer(params, cfg, max_batch=2, max_len=32,
+                         prefill_buckets=(8,), kv_pool_tokens=128,
+                         prefix_store=store)
+    mcfg = mistral_test_config(dtype=jnp.float32)
+    mparams = init_params(jax.random.PRNGKey(4), mcfg, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="ring_kv"):
+        GenerationServer(mparams, mcfg, max_batch=2, max_len=64,
+                         kv_pool_tokens=256, ring_kv=True)
+    # Same conflicts via the node-wide env: degrade + event, not a crash.
+    monkeypatch.setenv("KATA_TPU_KV_POOL_TOKENS", "256")
+    sink = obs.EventSink(str(tmp_path / "ev.jsonl"))
+    prev = obs.set_default_sink(sink)
+    try:
+        srv = GenerationServer(mparams, mcfg, max_batch=2, max_len=64,
+                               ring_kv=True)
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    assert not srv.paged
+    evs = [e for e in _events(tmp_path / "ev.jsonl")
+           if e.get("name") == "kv_pool_disabled"]
+    assert len(evs) == 1 and evs[0]["reason"] == "ring_kv"
+
+
+def test_prefix_store_disabled_event_carries_reason(model, tmp_path):
+    """PR 5's documented gap, closed: ring_kv/draft servers that disable
+    the prefix store say so ONCE per server on the event stream, with the
+    reason the compatibility matrix documents."""
+    from kata_xpu_device_plugin_tpu import obs
+    from kata_xpu_device_plugin_tpu.models import (
+        mistral_test_config,
+        self_draft,
+    )
+
+    cfg, params = model
+    mcfg = mistral_test_config(dtype=jnp.float32)
+    mparams = init_params(jax.random.PRNGKey(4), mcfg, dtype=jnp.float32)
+    sink = obs.EventSink(str(tmp_path / "ev.jsonl"))
+    prev = obs.set_default_sink(sink)
+    try:
+        GenerationServer(mparams, mcfg, max_batch=2, max_len=64,
+                         prefill_buckets=(8,), prefix_cache_tokens=64,
+                         ring_kv=True)
+        GenerationServer(params, cfg, max_batch=2, max_len=32,
+                         prefill_buckets=(8,), prefix_cache_tokens=64,
+                         speculative_k=2, draft=self_draft(params, cfg, 1))
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    evs = [e for e in _events(tmp_path / "ev.jsonl")
+           if e.get("name") == "prefix_store_disabled"]
+    assert [e["reason"] for e in evs] == ["ring_kv", "draft"]
+    assert len({e["server"] for e in evs}) == 2  # once per server
+
+
+def test_allocator_injects_kv_pool_env():
+    """Daemon side of the knob: config.kv_pool_tokens rides the TPU
+    AllocateResponse env (plugin/allocators.py), mirroring the
+    compile-cache and prefix-cache delivery paths. Host-only — no jax."""
+    from kata_xpu_device_plugin_tpu.cdi import constants as C
+    from kata_xpu_device_plugin_tpu.discovery.tpu import TpuChip, TpuInventory
+    from kata_xpu_device_plugin_tpu.plugin import TpuAllocator
+    from kata_xpu_device_plugin_tpu.topology.slice import HostTopology
+
+    inv = TpuInventory(
+        chips=(TpuChip(index=0, dev_path="/dev/accel0"),),
+        topology=HostTopology.from_accelerator_type("v5litepod-8"),
+        model_suffix="TPU_V5E",
+    )
+    alive = lambda _chip: True  # noqa: E731 — no real /dev in this test
+    wired = TpuAllocator(
+        lambda: inv, "google.com", "tpu", revalidate=alive,
+        kv_pool_tokens=262144,
+    ).allocate(["0"])
+    assert wired.envs[C.ENV_KV_POOL_TOKENS] == "262144"
+    bare = TpuAllocator(
+        lambda: inv, "google.com", "tpu", revalidate=alive
+    ).allocate(["0"])
+    assert C.ENV_KV_POOL_TOKENS not in bare.envs
+
+
+# ----- stats / metrics schema ---------------------------------------------
+
+
+def test_stats_paged_fields_always_present(model):
+    cfg, params = model
+    slotted = GenerationServer(params, cfg, max_batch=2, max_len=32,
+                               kv_pool_tokens=0)
+    st = slotted.stats()
+    assert st["kv_pool_occupancy"] == 0.0
+    assert st["kv_blocks_in_use"] == 0
+    assert st["preemptions"] == 0 and st["cow_copies"] == 0
+    paged = GenerationServer(params, cfg, max_batch=2, max_len=32,
+                             kv_pool_tokens=128, kv_block_size=8)
+    st = paged.stats()
+    assert st["kv_blocks_total"] == 128 // 8 - RESERVED_BLOCKS
+    assert st["kv_pool_tokens"] == st["kv_blocks_total"] * 8
+    assert st["arena_bytes"] > 0           # the pool IS the arena
+    # Latency summaries expose the p99 the bench percentiles read.
+    paged.submit(np.arange(1, 5, dtype=np.int32), 6)
+    paged.run()
+    assert "p99" in paged.stats()["ttft_s"]
+
+
+def test_export_metrics_includes_pool_gauges(model):
+    from prometheus_client import REGISTRY, generate_latest
+
+    cfg, params = model
+    srv = GenerationServer(params, cfg, max_batch=2, max_len=32,
+                           kv_pool_tokens=128, kv_block_size=8)
+    label = srv.export_metrics()
+    srv.submit(np.arange(1, 6, dtype=np.int32), 4)
+    srv.run()
+    text = generate_latest(REGISTRY).decode()
+    for gauge in ("kv_pool_occupancy", "kv_blocks_in_use",
+                  "preemptions", "cow_copies"):
+        assert f'kata_tpu_serving_{gauge}{{server="{label}"}}' in text
+    # The rate()-able traffic counters exist alongside the gauges.
+    assert f'kata_tpu_serving_kv_preemptions_total{{server="{label}"}}' in text

@@ -507,6 +507,23 @@ def test_degraded_suffix_shape_falls_back_to_cold(model):
     assert store_st["hits"] == 0 and store_st["misses"] == 2  # cancel() undid it
 
 
+def test_store_unlookup_leaves_no_trace(model):
+    """unlookup() (the paged head-of-line retry primitive, same contract
+    as PagedPrefixTier.unlookup) reverses a lookup wholesale: unlike
+    cancel(), no miss sticks, and the pin is released."""
+    cfg, params = model
+    p = np.arange(1, 14, dtype=np.int32)
+    store = _store_with(cfg, params, [p], capacity=32, buckets=(4, 8, 16))
+    assert store.lookup(np.arange(50, 60, dtype=np.int32)) is None
+    store.unlookup(None)
+    assert (store.hits, store.misses) == (0, 0)
+    hit = store.lookup(p)
+    assert hit is not None
+    store.unlookup(hit)
+    assert (store.hits, store.misses, store.tokens_reused) == (0, 0, 0)
+    assert hit.segment.refs == 0
+
+
 def test_shared_store_across_servers(model):
     """One PrefixStore backing two servers: the second server's first
     request hits a prefix the first server deposited."""

@@ -71,6 +71,18 @@ ENV_PREFIX_CACHE_TOKENS = "KATA_TPU_PREFIX_CACHE_TOKENS"
 # over one shared block pool (guest/kv_arena.py) sized per node.
 ENV_KV_POOL_TOKENS = "KATA_TPU_KV_POOL_TOKENS"
 
+# Recovery-checkpoint cadence handed to the guest (ISSUE 7):
+# guest.serving.GenerationServer snapshots live-lane KV to host every N
+# rounds when the caller passes no checkpoint_rounds, so the daemon's
+# --checkpoint-rounds knob arms crash-tolerant serving node-wide.
+ENV_CHECKPOINT_ROUNDS = "KATA_TPU_CHECKPOINT_ROUNDS"
+
+# Fault-injection schedule handed to the guest (ISSUE 7): the daemon's
+# --faults chaos knob rides the same path, so a whole node's serving
+# workloads replay one deterministic fault schedule
+# (guest/resilience.py FaultInjector.from_env; malformed entries degrade).
+ENV_FAULT_SCHEDULE = "KATA_TPU_FAULTS"
+
 # Default location where containerd/CRI-O pick up CDI spec files
 # (ref pkg/device_plugin/device_plugin.go:20).
 DEFAULT_CDI_DIR = "/var/run/cdi"

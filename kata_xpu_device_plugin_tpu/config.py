@@ -114,6 +114,30 @@ class Config:
     # default (fixed slots unless the server opts in via kv_pool_tokens=).
     kv_pool_tokens: int = 0
 
+    # Crash-tolerant serving defaults (ISSUE 7): when > 0, the daemon
+    # injects KATA_TPU_CHECKPOINT_ROUNDS into every TPU AllocateResponse
+    # so in-guest GenerationServers snapshot live-lane KV to host every N
+    # rounds and recover from dispatch failures/stalls by checkpointed
+    # replay instead of dropping the queue (guest/resilience.py +
+    # guest/serving.py supervisor). Same delivery path as the compile/
+    # prefix/pool knobs. 0 leaves the guest default (recovery still works
+    # via full replay; checkpoints bound how much is replayed).
+    checkpoint_rounds: int = 0
+
+    # Chaos-testing schedule (ISSUE 7): when set, injected as
+    # KATA_TPU_FAULTS so every serving workload on the node replays one
+    # deterministic fault schedule ("<seam>:<round>[:<kind>],...", see
+    # docs/resilience.md). Malformed entries degrade in-guest with a
+    # fault_schedule_error event — the knob can never crash a workload.
+    faults: str = ""
+
+    # Kubelet registration retry policy (ISSUE 7 satellite): attempts ×
+    # exponential backoff (plus jitter) before a plugin gives up with a
+    # registration_exhausted event. The old hardcoded 5 × 1 s ladder gave
+    # up for good after ~31 s of kubelet downtime.
+    register_attempts: int = 5
+    register_backoff_s: float = 1.0
+
     def __post_init__(self) -> None:
         if not self.kubelet_socket:
             self.kubelet_socket = os.path.join(self.kubelet_socket_dir, "kubelet.sock")
@@ -127,6 +151,14 @@ class Config:
         if self.num_slices > 1 and not 0 <= self.slice_id < self.num_slices:
             raise ValueError(
                 f"slice-id {self.slice_id} out of range for {self.num_slices} slices"
+            )
+        if self.register_attempts < 1:
+            raise ValueError(
+                f"register-attempts must be >= 1, got {self.register_attempts}"
+            )
+        if self.register_backoff_s < 0:
+            raise ValueError(
+                f"register-backoff-s must be >= 0, got {self.register_backoff_s}"
             )
         if len(set(self.worker_hostnames)) != len(self.worker_hostnames):
             raise ValueError("worker-hostnames contains duplicates")

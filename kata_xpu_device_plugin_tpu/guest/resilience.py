@@ -1,0 +1,469 @@
+"""Fault injection + crash-tolerance primitives for the serving loop.
+
+A production server for millions of users cannot lose every in-flight
+request because one XLA dispatch raised ``RESOURCE_EXHAUSTED`` or one
+fence hung on a TPU maintenance event — yet "the server survives faults"
+is unfalsifiable without a way to CAUSE faults deterministically. This
+module supplies both halves:
+
+- :class:`FaultInjector` — a seeded, schedule-driven injector with named
+  SEAMS wrapped around the serving loop's real failure points
+  (``decode_dispatch``, ``prefill``, ``admission_commit``, ``fence``,
+  ``pool_alloc``, ``store_gather``). A schedule is a comma-separated
+  ``<seam>:<round>[:<kind>]`` list (``KATA_TPU_FAULTS`` env), where
+  ``round`` is the seam's 0-based invocation count and ``kind`` is one
+  of ``raise-transient`` (default), ``raise-oom``, ``hang``. Each entry
+  fires exactly once, so a chaos run is REPLAYABLE: the same schedule
+  against the same workload produces the same fault sequence (tested),
+  which is what lets the recovery supervisor's bit-identity claim be a
+  test matrix instead of a hope. Malformed entries degrade (skipped with
+  a ``fault_schedule_error`` event) — a node-injected chaos knob must
+  never crash a guest that did not opt in.
+- :func:`fence_with_timeout` — the watchdog fence. Every blocking
+  device→host wait in serving routes through it; with a deadline
+  configured (``KATA_TPU_FENCE_TIMEOUT_S``) the wait runs on a watcher
+  thread and a ``device_stall`` event + :class:`DeviceStallError` replace
+  the infinite hang. With the deadline unset (the default) it calls the
+  wait inline — zero threads, zero new syncs on the hot path.
+- :func:`recoverable` — the supervisor's catch predicate: injected
+  faults, stalls, and XLA runtime errors whose status markers indicate a
+  transient device condition. Everything else (assertion errors, strict-
+  mode transfer-guard trips, user bugs) propagates unchanged.
+- :func:`wire_drain` — graceful-drain wiring: SIGTERM and/or a
+  maintenance-notice file watch (``KATA_TPU_MAINTENANCE_FILE``, the
+  host's TPU-maintenance signal surface) call the server's
+  ``request_drain`` so in-flight work finishes and queued work fails
+  loudly instead of vanishing with the process.
+
+The recovery supervisor itself lives in :class:`.serving.GenerationServer`
+(checkpointed restore via the PR 6 spill machinery); this module is jax-
+free at import so the injector and drain wiring also serve host-side
+tests.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import random
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+from .. import obs
+
+# Named seams — the serving loop's real failure surfaces. fire() rejects
+# anything else so a typo'd schedule cannot silently never fire.
+SEAMS = (
+    "decode_dispatch",   # the chunked decode executable dispatch
+    "prefill",           # an admission's prefill forward
+    "admission_commit",  # the arena/pool write landing an admission
+    "fence",             # a blocking device->host wait (retire, lock-step)
+    "pool_alloc",        # paged block allocation (OOM surface)
+    "store_gather",      # prefix-store gather/materialize on a hit
+)
+
+KIND_TRANSIENT = "raise-transient"
+KIND_OOM = "raise-oom"
+KIND_HANG = "hang"
+KINDS = (KIND_TRANSIENT, KIND_OOM, KIND_HANG)
+
+ENV_FAULTS = "KATA_TPU_FAULTS"
+ENV_FAULTS_SEED = "KATA_TPU_FAULTS_SEED"
+ENV_FENCE_TIMEOUT = "KATA_TPU_FENCE_TIMEOUT_S"
+ENV_MAINTENANCE_FILE = "KATA_TPU_MAINTENANCE_FILE"
+
+
+class TransientFault(RuntimeError):
+    """Injected transient dispatch failure (the retryable class)."""
+
+
+class InjectedOom(RuntimeError):
+    """Injected allocation failure; message carries RESOURCE_EXHAUSTED so
+    it routes through the same :func:`recoverable` marker match a real
+    XLA OOM would."""
+
+
+class DeviceStallError(TimeoutError):
+    """A device fence exceeded its watchdog deadline (real or injected) —
+    the bounded replacement for a ``block_until_ready`` that never
+    returns."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault: fire ``kind`` at the ``round``-th invocation
+    (0-based, counted per seam) of ``seam``."""
+
+    seam: str
+    round: int
+    kind: str = KIND_TRANSIENT
+
+
+def parse_schedule(raw: str) -> tuple[list[FaultSpec], list[str]]:
+    """Parse a ``<seam>:<round>[:<kind>],...`` schedule string into specs
+    plus the malformed entries (the caller decides whether to event or
+    raise on those — the env path degrades, the explicit path raises)."""
+    specs: list[FaultSpec] = []
+    bad: list[str] = []
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (2, 3) or parts[0] not in SEAMS:
+            bad.append(entry)
+            continue
+        kind = parts[2] if len(parts) == 3 else KIND_TRANSIENT
+        if kind not in KINDS:
+            bad.append(entry)
+            continue
+        try:
+            rnd = int(parts[1])
+        except ValueError:
+            bad.append(entry)
+            continue
+        if rnd < 0:
+            bad.append(entry)
+            continue
+        specs.append(FaultSpec(parts[0], rnd, kind))
+    return specs, bad
+
+
+@dataclass
+class FaultInjector:
+    """Deterministic scheduled fault source. ``fire(seam)`` is called at
+    every seam crossing; when the seam's invocation count matches a
+    scheduled entry, the entry is consumed and the fault raised
+    (``fault_injected`` event first). Disarmed (empty schedule — the
+    production default) the per-call cost is one attribute test.
+
+    ``seed`` keys the injector's RNG — today only hang jitter draws from
+    it, but it is part of the replay contract: (seed, schedule) fully
+    determines the fired sequence, recorded in :attr:`fired`.
+    """
+
+    schedule: Iterable[FaultSpec] = ()
+    seed: int = 0
+    label: str = ""
+    hang_s: float = 0.0  # optional real delay before an injected stall
+    fired: list = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        pending: dict[tuple[str, int], str] = {}
+        for spec in self.schedule:
+            if spec.seam not in SEAMS:
+                raise ValueError(
+                    f"unknown fault seam {spec.seam!r} (have {SEAMS})"
+                )
+            if spec.kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {spec.kind!r} (have {KINDS})"
+                )
+            pending[(spec.seam, spec.round)] = spec.kind
+        self._pending = pending
+        self._counts: dict[str, int] = {}
+        self._rng = random.Random(self.seed)
+
+    @classmethod
+    def from_env(cls, label: str = "") -> "FaultInjector":
+        """The injector the serving loop builds by default: schedule from
+        ``KATA_TPU_FAULTS`` (the env the daemon's ``--faults`` chaos knob
+        injects), seed from ``KATA_TPU_FAULTS_SEED``. Malformed entries
+        are skipped with one ``fault_schedule_error`` event each — the
+        node-wide knob must never crash a guest."""
+        raw = os.environ.get(ENV_FAULTS, "")
+        specs, bad = parse_schedule(raw) if raw else ([], [])
+        for entry in bad:
+            obs.emit(
+                "serving", "fault_schedule_error",
+                server=label, entry=entry[:64],
+            )
+        try:
+            seed = int(os.environ.get(ENV_FAULTS_SEED, "0") or 0)
+        except ValueError:
+            seed = 0
+        return cls(schedule=specs, seed=seed, label=label)
+
+    @property
+    def armed(self) -> bool:
+        return bool(self._pending)
+
+    def fire(self, seam: str) -> None:
+        """Cross ``seam``: raise the scheduled fault for this invocation,
+        if any. No-op (one dict truth-test) when the schedule is drained
+        or empty."""
+        if not self._pending:
+            return
+        if seam not in SEAMS:
+            raise ValueError(f"unknown fault seam {seam!r}")
+        n = self._counts.get(seam, 0)
+        self._counts[seam] = n + 1
+        kind = self._pending.pop((seam, n), None)
+        if kind is None:
+            return
+        self.fired.append((seam, n, kind))
+        obs.emit(
+            "serving", "fault_injected",
+            server=self.label, seam=seam, round=n, fault_kind=kind,
+        )
+        if kind == KIND_TRANSIENT:
+            raise TransientFault(f"injected transient fault at {seam}#{n}")
+        if kind == KIND_OOM:
+            raise InjectedOom(
+                f"RESOURCE_EXHAUSTED: injected allocation failure at "
+                f"{seam}#{n}"
+            )
+        # hang: a simulated stall — the watchdog deadline is short-
+        # circuited deterministically (an optional real hang_s delay keeps
+        # wall-clock shape when wanted) so chaos tests never actually wait
+        # out a production deadline.
+        if self.hang_s > 0:
+            time.sleep(self.hang_s * (0.5 + self._rng.random()))
+        obs.emit(
+            "serving", "device_stall",
+            server=self.label, seam=seam, injected=True,
+        )
+        raise DeviceStallError(f"injected device stall at {seam}#{n}")
+
+
+class _FenceWorker:
+    """One reusable watchdog thread. Armed fences borrow a worker from
+    the pool instead of paying a thread spawn per wait (the armed path
+    runs at the decode-chunk cadence); a wait that times out ABANDONS
+    its worker — the thread is stuck inside the hung call, nothing can
+    interrupt a stuck transport — and the next fence draws a fresh one.
+    A completed wait returns its worker to the pool."""
+
+    def __init__(self) -> None:
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self.abandoned = False
+        threading.Thread(target=self._loop, name="katatpu-fence-watchdog",
+                         daemon=True).start()
+
+    def _loop(self) -> None:
+        while True:
+            wait, box, done = self._q.get()
+            try:
+                box["value"] = wait()
+            except BaseException as e:  # noqa: BLE001 — relayed to caller
+                box["error"] = e
+            finally:
+                done.set()
+            if self.abandoned:
+                # The caller timed out while we ran and forgot us. (A
+                # caller racing its abandoned-mark against this check can
+                # at worst strand one idle thread — same order of leak as
+                # the hung wait itself.)
+                return
+            with _FENCE_POOL_LOCK:
+                _FENCE_POOL.append(self)
+
+
+_FENCE_POOL: list[_FenceWorker] = []
+_FENCE_POOL_LOCK = threading.Lock()
+
+
+def _borrow_fence_worker() -> _FenceWorker:
+    with _FENCE_POOL_LOCK:
+        while _FENCE_POOL:
+            w = _FENCE_POOL.pop()
+            if not w.abandoned:
+                return w
+    return _FenceWorker()
+
+
+def fence_with_timeout(
+    wait: Callable[[], object],
+    *,
+    timeout_s: float = 0.0,
+    seam: str = "fence",
+    injector: Optional[FaultInjector] = None,
+    server: str = "",
+) -> object:
+    """Run a blocking device wait (``wait`` is a zero-arg callable — a
+    ``DeviceFence.wait`` / ``block_until_ready`` / host-transfer closure)
+    under the watchdog contract: with ``timeout_s > 0`` the wait runs on
+    a daemon thread and exceeding the deadline emits a ``device_stall``
+    event and raises :class:`DeviceStallError` instead of hanging the
+    scheduler forever (the abandoned thread keeps blocking — nothing can
+    interrupt a stuck transport, but the SERVER regains control and can
+    rebuild). With ``timeout_s`` unset (default) the wait runs inline —
+    no thread, no overhead, bit-for-bit the pre-watchdog behavior.
+
+    ``injector`` crosses the ``seam`` first, so a scheduled ``hang``
+    becomes a deterministic stall without waiting out the deadline."""
+    if injector is not None:
+        injector.fire(seam)
+    if not timeout_s or timeout_s <= 0:
+        return wait()
+    worker = _borrow_fence_worker()
+    box, done = {}, threading.Event()
+    worker._q.put((wait, box, done))
+    if not done.wait(timeout_s):
+        worker.abandoned = True
+        obs.emit(
+            "serving", "device_stall",
+            server=server, seam=seam, timeout_s=round(float(timeout_s), 3),
+            injected=False,
+        )
+        raise DeviceStallError(
+            f"device fence {seam!r} exceeded {timeout_s}s watchdog deadline"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+# Status markers in an XLA runtime error that indicate a transient device
+# condition the supervisor may retry; anything else (shape errors, strict-
+# mode transfer-guard trips, user bugs) must propagate unchanged.
+_TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "UNAVAILABLE",
+    "ABORTED",
+    "DATA_LOSS",
+    "DEADLINE_EXCEEDED",
+)
+
+
+def recoverable(exc: BaseException) -> bool:
+    """Should the recovery supervisor catch this and rebuild, rather than
+    let it unwind the server? Injected faults and watchdog stalls always;
+    real XLA runtime errors only when their status marker says transient
+    (matched by type NAME so a jax-free host process can import this
+    module)."""
+    if isinstance(exc, (TransientFault, InjectedOom, DeviceStallError)):
+        return True
+    if type(exc).__name__ == "XlaRuntimeError":
+        msg = str(exc)
+        return any(marker in msg for marker in _TRANSIENT_MARKERS)
+    return False
+
+
+def env_int(name: str, default: int, *, event: str = "",
+            server: str = "") -> int:
+    """Integer env knob with the repo's degrade contract: a malformed
+    node-injected value falls back to ``default`` with one ``event``
+    (reason ``bad_env:<raw>``) instead of crashing the guest."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        if event:
+            obs.emit("serving", event, server=server,
+                     reason=f"bad_env:{raw[:32]}")
+        return default
+
+
+def env_float(name: str, default: float, *, event: str = "",
+              server: str = "") -> float:
+    """Float sibling of :func:`env_int` (same degrade contract)."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        if event:
+            obs.emit("serving", event, server=server,
+                     reason=f"bad_env:{raw[:32]}")
+        return default
+
+
+class DrainWiring:
+    """Handle returned by :func:`wire_drain`: owns the maintenance-watch
+    thread and the restored SIGTERM disposition. ``stop()`` detaches both
+    (idempotent); ``poll_once()`` runs one maintenance check inline for
+    deterministic tests."""
+
+    def __init__(self, server, maintenance_file: str = "",
+                 poll_s: float = 1.0):
+        self._server = server
+        self._file = maintenance_file
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._prev_handler = None
+        self._sigterm_installed = False
+
+    def poll_once(self) -> bool:
+        """One maintenance-notice check; True when it triggered a drain."""
+        if self._file and os.path.exists(self._file):
+            self._server.request_drain(reason="maintenance_notice")
+            return True
+        return False
+
+    def _watch(self) -> None:
+        while not self._stop.is_set():
+            if self.poll_once():
+                return
+            self._stop.wait(self._poll_s)
+
+    def _start_watch(self) -> None:
+        self._thread = threading.Thread(
+            target=self._watch, name="katatpu-maintenance-watch", daemon=True
+        )
+        self._thread.start()
+
+    def _install_sigterm(self) -> None:
+        def handler(signum, frame):
+            self._server.request_drain(reason="sigterm")
+            # Chain a CALLABLE prior handler so a process manager layering
+            # its own hook still observes the signal. A SIG_DFL prior
+            # disposition is deliberately NOT chained — immediate
+            # termination is exactly what the drain exists to prevent;
+            # exiting once run() returns is the caller's job.
+            if callable(self._prev_handler):
+                self._prev_handler(signum, frame)
+
+        try:
+            self._prev_handler = signal.signal(signal.SIGTERM, handler)
+            self._sigterm_installed = True
+        except ValueError:
+            # Not the main thread: signal wiring is unavailable there by
+            # interpreter rule; the maintenance watch still works.
+            self._sigterm_installed = False
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self._sigterm_installed:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_handler
+                              or signal.SIG_DFL)
+            except ValueError:
+                pass
+            self._sigterm_installed = False
+
+    def __enter__(self) -> "DrainWiring":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def wire_drain(server, *, sigterm: bool = True,
+               maintenance_file: Optional[str] = None,
+               poll_s: float = 1.0) -> DrainWiring:
+    """Wire a server's graceful drain to the two production triggers:
+    SIGTERM (pod termination) and a maintenance-notice file
+    (``maintenance_file``, default ``KATA_TPU_MAINTENANCE_FILE`` env —
+    the path the host surfaces a TPU maintenance event on). Either
+    trigger calls ``server.request_drain(...)``: admission stops,
+    in-flight work finishes, still-queued requests surface in
+    ``failures()``. Returns a :class:`DrainWiring`; call ``stop()`` (or
+    use as a context manager) to detach."""
+    if maintenance_file is None:
+        maintenance_file = os.environ.get(ENV_MAINTENANCE_FILE, "")
+    wiring = DrainWiring(server, maintenance_file, poll_s)
+    if sigterm:
+        wiring._install_sigterm()
+    if maintenance_file:
+        wiring._start_watch()
+    return wiring

@@ -60,6 +60,29 @@ boundaries are ``prefill_buckets`` values, so the executable-count bound
 survives. ``ring_kv`` and draft-model servers fall back to cold admission
 (the ring/cycle folds re-layout prefix rows per slot and the draft arena
 would miss its own prefix — explicitly unsupported for now).
+
+CRASH-TOLERANT SERVING (:mod:`.resilience`): a recovery SUPERVISOR wraps
+every scheduler round. A recoverable dispatch failure (injected fault,
+watchdog stall, transient XLA status — :func:`.resilience.recoverable`)
+no longer unwinds ``run()`` and drops the queue: the supervisor rebuilds
+the pool/arena from scratch (the failed round may have poisoned donated
+buffers), restores every lane that has a host checkpoint (taken every
+``KATA_TPU_CHECKPOINT_ROUNDS`` rounds through the PR 6 spill machinery —
+sanctioned ``allow_transfer``, off the overlapped critical path), requeues
+the rest strict-FIFO for a from-the-prompt replay, and retries with
+bounded exponential backoff. Greedy determinism makes recovery invisible
+in the output: replaying a suffix (or a whole prompt) reproduces the same
+tokens bit-for-bit, so recovered results equal a fault-free run (the
+tested matrix: fault-kind × paged/slotted × overlap × strict). A request
+implicated in ``KATA_TPU_QUARANTINE_K`` consecutive failed rounds is
+QUARANTINED — failed individually into :meth:`GenerationServer.failures`
+with a ``request_failed`` event — so one poison request cannot wedge
+retries forever. :meth:`GenerationServer.drain` (wired to SIGTERM and a
+maintenance-notice file by :func:`.resilience.wire_drain`) stops
+admission, finishes in-flight work, fails still-queued requests loudly,
+and emits a final checkpoint event. With every knob at its default the
+hot path is untouched: the injector is disarmed, the watchdog inline,
+and no new host syncs exist (jaxguard-clean).
 """
 from __future__ import annotations
 
@@ -89,6 +112,7 @@ from ..models.transformer import (
     prefill_suffix,
     ring_caches_from_prefill,
 )
+from . import resilience
 from .kv_arena import (
     RESERVED_BLOCKS,
     SCRATCH_BLOCK,
@@ -100,6 +124,7 @@ from .kv_arena import (
     pool_write_seq,
 )
 from .prefix_cache import PrefixHit, PrefixStore
+from .resilience import DeviceStallError, FaultInjector
 
 
 # Serving-stat gauges, created through obs.metrics' idempotent factory
@@ -124,6 +149,10 @@ _PROM_STATS = (
     ("kv_blocks_in_use", "Paged KV pool blocks currently referenced"),
     ("preemptions", "Requests preempted (KV spilled, requeued FIFO)"),
     ("cow_copies", "Prefix-tier boundary blocks privatized copy-on-write"),
+    ("recoveries", "Supervisor recoveries from a failed scheduler round"),
+    ("quarantined", "Requests failed after K consecutive implicated rounds"),
+    ("device_stalls", "Watchdog fence deadlines exceeded (real or injected)"),
+    ("checkpoints", "Host KV checkpoints taken for crash recovery"),
 )
 
 
@@ -174,6 +203,32 @@ def _ctr_cow_copies():
     )
 
 
+# Resilience traffic counters (ISSUE 7): incremented at the moment of the
+# event so rate() works between scrapes, like the pool counters above.
+def _ctr_recoveries():
+    return obs.counter(
+        "kata_tpu_serving_crash_recoveries_total",
+        "Supervisor recoveries from a failed scheduler round",
+        ["server"],
+    )
+
+
+def _ctr_quarantined():
+    return obs.counter(
+        "kata_tpu_serving_requests_quarantined_total",
+        "Requests failed individually after K consecutive implicated rounds",
+        ["server"],
+    )
+
+
+def _ctr_stalls():
+    return obs.counter(
+        "kata_tpu_serving_fence_stalls_total",
+        "Watchdog fence deadlines exceeded (real or injected)",
+        ["server"],
+    )
+
+
 def _prom_gauges() -> dict:
     return {
         name: obs.gauge(f"kata_tpu_serving_{name}", desc, ["server"])
@@ -219,6 +274,30 @@ class _Request:
     t_submit: float = 0.0  # monotonic clock at submit() — TTFT anchor
     out: list = field(default_factory=list)
     done: bool = False
+    # Consecutive failed rounds this request was implicated in (reset on
+    # any round it survives); at the quarantine threshold the supervisor
+    # fails it individually instead of retrying forever (ISSUE 7).
+    fails: int = 0
+    # Times this request was requeued for a from-the-prompt replay by
+    # crash recovery — its re-admission ttft event is labeled with it.
+    replays: int = 0
+
+
+@dataclass
+class _CkptEntry:
+    """One live lane's recovery checkpoint: the request, its emitted
+    tokens AS OF the snapshot (a copy — ``req.out`` keeps growing), the
+    host scheduling state, and the lane's KV rows on host (full-table
+    width for paged servers — the ``_Preempted`` layout — or the
+    ``[L, 1, arena_len, ...]`` slot slice for slotted ones). Restore +
+    greedy determinism replays the post-checkpoint suffix bit-identically
+    to a fault-free run."""
+
+    req: "_Request"
+    out: list
+    pos: int
+    last: int
+    kv: Any  # host pytree
 
 
 @dataclass
@@ -366,6 +445,18 @@ class GenerationServer:
     once — and must match this server's config/buckets/kv_quant. Under
     ``ring_kv`` or a draft model the store is DISABLED (cold-admission
     fallback, documented as unsupported) rather than refused.
+
+    RESILIENCE (ISSUE 7, ``docs/resilience.md``): ``checkpoint_rounds``
+    (default ``KATA_TPU_CHECKPOINT_ROUNDS`` env, 0 = off) sets the
+    host-KV recovery checkpoint cadence; ``fault_injector`` overrides the
+    ``KATA_TPU_FAULTS``-driven default injector; ``fence_timeout_s``
+    (``KATA_TPU_FENCE_TIMEOUT_S``) arms the watchdog fence;
+    ``quarantine_after`` (``KATA_TPU_QUARANTINE_K``, default 3) is the
+    consecutive-implicated-failure threshold before a request fails
+    individually into :meth:`failures`; ``recovery_backoff_s``
+    (``KATA_TPU_RECOVERY_BACKOFF_S``) seeds the bounded exponential
+    retry backoff. ``KATA_TPU_RECOVERY=0`` disables supervision entirely
+    (every exception unwinds, the pre-ISSUE-7 behavior).
     """
 
     def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
@@ -379,7 +470,12 @@ class GenerationServer:
                  prefix_cache_tokens: Optional[int] = None,
                  prefix_store: Optional[PrefixStore] = None,
                  kv_pool_tokens: Optional[int] = None,
-                 kv_block_size: int = 16):
+                 kv_block_size: int = 16,
+                 checkpoint_rounds: Optional[int] = None,
+                 fault_injector: Optional[FaultInjector] = None,
+                 fence_timeout_s: Optional[float] = None,
+                 quarantine_after: Optional[int] = None,
+                 recovery_backoff_s: Optional[float] = None):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
@@ -471,6 +567,80 @@ class GenerationServer:
         # .labels() on every prefill/chunk is pure hot-path overhead —
         # export_metrics(label=...) re-resolves on rename.
         self._bind_histograms()
+        # Recovery supervisor (ISSUE 7). Every knob defaults through the
+        # daemon env-injection path and degrades on malformed values —
+        # node-wide chaos/cadence knobs must never crash a guest. With
+        # everything at its default (no schedule, no deadline, cadence 0)
+        # the hot path is untouched: fire() is one truth-test, the fence
+        # wrapper calls through inline, and no checkpoint gathers run.
+        self._inj = (
+            fault_injector if fault_injector is not None
+            else FaultInjector.from_env(label=self._label)
+        )
+        self._fence_timeout_s = (
+            resilience.env_float(
+                resilience.ENV_FENCE_TIMEOUT, 0.0,
+                event="fence_timeout_disabled", server=self._label,
+            )
+            if fence_timeout_s is None else float(fence_timeout_s)
+        )
+        self._quarantine_k = max(1, (
+            resilience.env_int("KATA_TPU_QUARANTINE_K", 3,
+                               event="quarantine_k_invalid",
+                               server=self._label)
+            if quarantine_after is None else int(quarantine_after)
+        ))
+        self._backoff_s = (
+            resilience.env_float("KATA_TPU_RECOVERY_BACKOFF_S", 0.05,
+                                 event="recovery_backoff_invalid",
+                                 server=self._label)
+            if recovery_backoff_s is None else float(recovery_backoff_s)
+        )
+        self._supervised = os.environ.get("KATA_TPU_RECOVERY", "1") != "0"
+        ckpt = (
+            resilience.env_int("KATA_TPU_CHECKPOINT_ROUNDS", 0,
+                               event="checkpoint_disabled",
+                               server=self._label)
+            if checkpoint_rounds is None else int(checkpoint_rounds)
+        )
+        if ckpt > 0 and (draft is not None or speculative_k):
+            # The draft arena is a second cache the lane snapshot does not
+            # cover, and speculative rounds are host-driven lock-step —
+            # checkpointed restore is unsupported there. Explicit opt-in
+            # raises; the env default degrades with an event (recovery
+            # still works via from-the-prompt replay, which rebuilds both
+            # arenas through the normal admission path).
+            if checkpoint_rounds is not None:
+                raise ValueError(
+                    f"checkpoint_rounds={ckpt} is incompatible with "
+                    "speculative/draft serving — recovery falls back to "
+                    "full replay there (docs/resilience.md)"
+                )
+            obs.emit(
+                "serving", "checkpoint_disabled",
+                server=self._label, reason="speculative",
+            )
+            ckpt = 0
+        self._ckpt_every = max(0, ckpt)
+        self._ckpt: dict[int, _CkptEntry] = {}
+        self._ckpt_round = 0
+        self._failures: dict[int, str] = {}
+        self._recoveries = 0
+        self._quarantined_n = 0
+        self._stalls = 0
+        self._checkpoints = 0
+        self._fail_streak = 0  # consecutive failed rounds (backoff input)
+        # Mid-admission bookkeeping for crash unwind: requests popped from
+        # the queue but not yet landed in a lane, and the subset the
+        # currently-running fill call is admitting (the blast radius a
+        # prefill-seam fault is attributed to).
+        self._admitting: list[tuple[_Request, Optional[PrefixHit]]] = []
+        self._admit_current: list[_Request] = []
+        self._draining = False
+        self._drain_done = False
+        self._drain_announced = False
+        self._drain_reason = ""
+        self._mesh = mesh
         # Paged KV pool (ISSUE 6): one block pool shared by all in-flight
         # requests replaces the fixed [max_batch, max_len] slot grid —
         # admission becomes token-budget continuous batching with
@@ -671,6 +841,9 @@ class GenerationServer:
         )
         self._c_preempt = _ctr_preemptions().labels(server=self._label)
         self._c_cow = _ctr_cow_copies().labels(server=self._label)
+        self._c_recover = _ctr_recoveries().labels(server=self._label)
+        self._c_quarantine = _ctr_quarantined().labels(server=self._label)
+        self._c_stall = _ctr_stalls().labels(server=self._label)
 
     def _pool_conflict(self, pool_tokens: int, ring_kv: bool, draft,
                        speculative_k: int, mesh,
@@ -717,6 +890,20 @@ class GenerationServer:
         from ..parallel.sharding import shard_params
 
         self.params = shard_params(self.params, mesh)
+        if self.draft is not None:
+            d_params, d_cfg = self.draft
+            self.draft = (shard_params(d_params, mesh), d_cfg)
+        self._place_arenas(mesh)
+
+    def _place_arenas(self, mesh) -> None:
+        """Device placement of the KV arena(s) for tensor-parallel
+        serving — split from :meth:`_shard_over` so crash recovery can
+        re-place a freshly rebuilt arena without re-sharding params."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import AXIS_MODEL
+
         tp = mesh.shape.get(AXIS_MODEL, 1)
         kv_spec = (
             P(None, None, None, AXIS_MODEL, None)
@@ -728,13 +915,12 @@ class GenerationServer:
             lambda c: jax.device_put(c, sh), self.arena
         )
         if self.draft is not None:
-            d_params, d_cfg = self.draft
+            _d_params, d_cfg = self.draft
             d_spec = (
                 P(None, None, None, AXIS_MODEL, None)
-                if d_cfg.n_kv_heads % tp == 0
+                if d_cfg.n_kv_heads % tp == 0  # jaxguard: allow(JG101) d_cfg is the host-side DecoderConfig (attr-taint false positive); reachable from step only via crash recovery — a scheduling slow path
                 else P()
             )
-            self.draft = (shard_params(d_params, mesh), d_cfg)
             d_sh = NamedSharding(mesh, d_spec)
             self.draft_arena = jax.tree.map(
                 lambda c: jax.device_put(c, d_sh), self.draft_arena
@@ -743,6 +929,12 @@ class GenerationServer:
     # ----- public API ------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int = 64) -> int:
+        if self._draining:
+            raise RuntimeError(
+                f"server {self._label} is draining "
+                f"({self._drain_reason or 'requested'}): not accepting new "
+                "requests"
+            )
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
@@ -759,11 +951,43 @@ class GenerationServer:
         return rid
 
     def run(self) -> dict[int, np.ndarray]:
-        """Drain queue + slots to completion; returns {rid: tokens[new]}."""
+        """Drain queue + slots to completion; returns {rid: tokens[new]}.
+        Requests that were quarantined or drained are NOT in the result —
+        they surface in :meth:`failures` (every submitted rid appears in
+        exactly one of the two; none vanish)."""
         while self.step():
             pass
         out, self._results = self._results, {}
         return out
+
+    def failures(self) -> dict[int, str]:
+        """Per-request terminal failures: ``{rid: error}`` for every
+        request the supervisor quarantined (K consecutive implicated
+        rounds) or the drain failed before it started. CUMULATIVE
+        snapshot semantics like :meth:`stats` — ``run()`` drains results,
+        never failures."""
+        return dict(self._failures)
+
+    def request_drain(self, reason: str = "api") -> None:
+        """Flag a graceful drain (idempotent, async-signal-safe: it ONLY
+        sets state — the ``drain_begin`` event is emitted by the serving
+        loop, because obs sinks take locks a signal handler must never
+        contend on): admission of queued work stops, in-flight lanes (and
+        preempted requests — work that already started) run to
+        completion, and when the server is idle the remaining queue fails
+        into :meth:`failures` with a final checkpoint event. ``submit()``
+        refuses new work from this point on."""
+        if self._draining:
+            return
+        self._drain_reason = reason
+        self._draining = True
+
+    def drain(self, reason: str = "api") -> dict[int, np.ndarray]:
+        """Synchronous graceful drain: :meth:`request_drain` then
+        :meth:`run`. Returns the completed results; everything that never
+        started is in :meth:`failures`."""
+        self.request_drain(reason)
+        return self.run()
 
     def stats(self) -> dict:
         """Serving counters: device rounds, tokens emitted (pre-trim),
@@ -842,6 +1066,17 @@ class GenerationServer:
             "preemptions": self._preemptions,
             "preempted_waiting": len(self._preempted) if self.paged else 0,
             "cow_copies": self._cow_copies,
+        })
+        # Resilience fields (ISSUE 7): ALWAYS present — zeros on a server
+        # that never failed — so dashboards need no schema branch.
+        out.update({
+            "recoveries": self._recoveries,
+            "quarantined": self._quarantined_n,
+            "device_stalls": self._stalls,
+            "checkpoints": self._checkpoints,
+            "checkpoint_rounds": self._ckpt_every,
+            "failed_requests": len(self._failures),
+            "draining": self._draining,
         })
         lookups = self._prefix_hits + self._prefix_misses
         store = self.prefix_store
@@ -938,6 +1173,12 @@ class GenerationServer:
         ttft = t_first - req.t_submit
         self._ttft.observe(ttft)
         self._h_ttft.observe(ttft)
+        if req.replays:
+            # A crash-recovery replay (ISSUE 7): honest TTFT — the
+            # re-observation absorbs the recovery — but labeled, so
+            # first-admission consumers (FIFO-order tests, dashboards
+            # separating clean TTFT from recovery tail) can filter.
+            event_fields = {**event_fields, "replay": req.replays}
         obs.emit(
             "serving", "ttft",
             server=self._label, rid=req.rid, ttft_s=round(ttft, 6),
@@ -949,6 +1190,10 @@ class GenerationServer:
         self._pos[b] = pos
         self._last[b] = first
         self._fresh_rows.add(b)  # overlap: override the in-flight row
+        # Landed in a lane: no longer mid-admission for crash unwind.
+        self._admitting = [
+            (r, h) for r, h in self._admitting if r is not req
+        ]
         self._maybe_finish(b, [first])
 
     def _fill_slot(self, b: int, req: _Request,
@@ -960,6 +1205,7 @@ class GenerationServer:
         bucketed prompt is right-padded to it — one prefill executable per
         bucket rather than one per distinct prompt length (exact: see
         ``transformer.prefill``'s ``true_len``)."""
+        self._inj.fire("prefill")
         prompt, true_len = req.prompt, len(req.prompt)
         if bucket is not None and bucket > true_len:
             prompt = np.pad(prompt, (0, bucket - true_len))
@@ -990,6 +1236,7 @@ class GenerationServer:
                 )
             first = self._sample_first(last_logits)
         t_first = time.monotonic()  # the int() above fenced the forward
+        self._inj.fire("admission_commit")
         if self.paged:
             self._paged_commit(b, req, caches, 0)
         else:
@@ -1066,6 +1313,7 @@ class GenerationServer:
         the smallest bucket that still fits the arena (one executable per
         bucket, like cold admission); greedy tokens are identical to the
         cold path (tested)."""
+        self._inj.fire("prefill")
         prompt, n, m = req.prompt, len(req.prompt), hit.length
         suffix, s_len = prompt[m:], n - m
         pad = self._suffix_pad(m, s_len)
@@ -1079,6 +1327,7 @@ class GenerationServer:
             prompt_len=n, reused=m, suffix_len=s_len,
             padded_len=len(suffix), tokens=s_len,
         ):
+            self._inj.fire("store_gather")
             caches = self.prefix_store.materialize(hit, self.max_len)
             caches, last_logits, _pos = prefill_suffix(
                 self.params, jnp.asarray(suffix)[None, :], self.cfg, caches,
@@ -1086,6 +1335,7 @@ class GenerationServer:
             )
             first = self._sample_first(last_logits)
         t_first = time.monotonic()  # the int() above fenced the forward
+        self._inj.fire("admission_commit")
         if self.paged:
             self._paged_commit(b, req, caches, 0)
         else:
@@ -1128,6 +1378,7 @@ class GenerationServer:
         suffix-path sibling of :meth:`_fill_slots_batched`, and the shape
         burst arrival with a shared system prompt actually takes. Per-row
         ``true_len`` masking keeps it exact."""
+        self._inj.fire("prefill")
         n = len(pairs)
         m = pairs[0][1].length
         suffixes = np.zeros((n, pad_len), np.int32)
@@ -1144,6 +1395,7 @@ class GenerationServer:
             tokens=int(true_lens.sum()),
             rids=[req.rid for req, _ in pairs], slots=list(slots),
         ):
+            self._inj.fire("store_gather")
             caches = self.prefix_store.materialize(
                 pairs[0][1], self.max_len, n=n
             )
@@ -1161,6 +1413,7 @@ class GenerationServer:
             else:
                 firsts = np.asarray(jnp.argmax(last_logits, axis=-1))  # jaxguard: allow(JG101) admission host read — sanctioned sync
         t_first = time.monotonic()  # the firsts transfer fenced the forward
+        self._inj.fire("admission_commit")
         if self.paged:
             self._paged_commit_batch(slots, [req for req, _ in pairs],
                                      caches)
@@ -1187,6 +1440,7 @@ class GenerationServer:
         scatter (:func:`_write_slots`) — N weight streams collapse to one,
         the dominant TTFT cost under burst arrival. Exactness is per-row
         ``true_len`` masking, same as the sequential bucket path."""
+        self._inj.fire("prefill")
         n = len(reqs)
         prompts = np.zeros((n, pad_len), np.int32)
         true_lens = np.array([len(r.prompt) for r in reqs], np.int32)
@@ -1213,6 +1467,7 @@ class GenerationServer:
             else:
                 firsts = np.asarray(jnp.argmax(last_logits, axis=-1))  # jaxguard: allow(JG101) admission host read — sanctioned sync
         t_first = time.monotonic()  # the firsts transfer fenced the forward
+        self._inj.fire("admission_commit")
         if self.paged:
             self._paged_commit_batch(slots, reqs, caches)
         else:
@@ -1252,14 +1507,36 @@ class GenerationServer:
             ]
             if not free:
                 return
-            if self.paged and self._preempted:
-                # Preempted requests are OLDER than anything still queued:
-                # strict FIFO means nothing admits past them while they
-                # wait for the pool to drain.
+            if self.paged and self._preempted and (
+                    not self._queue
+                    or self._preempted[0].req.rid < self._queue[0].rid):
+                # Preempted requests are older than anything still queued
+                # (strict FIFO: nothing admits past them while they wait
+                # for the pool to drain) — EXCEPT crash-recovery replays,
+                # which front-requeue lane residents that can be older
+                # still; the rid comparison keeps global FIFO across both.
                 if not self._resume_one(free[0]):
+                    if self._draining and len(free) == self.max_batch:
+                        # Every lane is free and the full rebuilt pool
+                        # still cannot hold the spill — it can never
+                        # re-admit; fail it rather than wedging the drain.
+                        pre = self._preempted.popleft()
+                        self._fail_request(
+                            pre.req, reason="drained",
+                            error="drained mid-flight "
+                                  f"({self._drain_reason}): cannot re-admit",
+                        )
+                        continue
                     return
                 continue
+            # Draining: preempted requests above still resume, and so do
+            # crash-recovery REPLAYS (req.replays > 0 — work that already
+            # started and lost its lane to a fault mid-drain must finish,
+            # not fail as "drained before start"); nothing genuinely new
+            # admits — _finish_drain fails it once the server idles.
             if not self._queue:
+                return
+            if self._draining and not self._queue[0].replays:
                 return
             # The admitted set this pass: the FIFO prefix that fits the
             # free lanes AND (paged) whose block reservations succeed —
@@ -1268,11 +1545,37 @@ class GenerationServer:
             # drains). Lookups pin their hit; a failed reservation
             # unwinds the lookup — pin and store counters — before any
             # monotonic counter recorded it.
-            take: list[tuple[_Request, Optional[PrefixHit]]] = []
+            # Crash-unwind bookkeeping (ISSUE 7): each popped request is
+            # appended to ``_admitting`` IN THE SAME STEP — from that
+            # moment it is in neither the queue nor a lane, and a fault
+            # anywhere in this pass (a later request's reservation, the
+            # fill paths below) must find it there to requeue it, or it
+            # would vanish. _finish_admission retires entries one by one.
+            take = self._admitting = []
             while self._queue and len(take) < len(free):
                 req = self._queue[0]
+                if self._draining and not req.replays:
+                    # The replayed prefix is admitted; everything behind
+                    # it never started and stays queued for _finish_drain.
+                    break
+                # Attribute a reservation-phase fault to the head-of-line
+                # request being reserved, not the innocent lane residents
+                # (_recover pulls a blamed-but-still-queued request into
+                # the lost set so its quarantine streak is tracked).
+                self._admit_current = [req]
                 hit = self._prefix_lookup_raw(req)
-                if self.paged and not self._reserve_lane_blocks(req, hit):
+                try:
+                    reserved = (not self.paged
+                                or self._reserve_lane_blocks(req, hit))
+                except BaseException:
+                    # A fault inside the reservation (pool_alloc seam, or
+                    # a real allocator error): the request is still queued
+                    # but its lookup pin must not leak past the raise.
+                    if self.prefix_store is not None:
+                        self.prefix_store.unlookup(hit)
+                    raise
+                self._admit_current = []
+                if not reserved:
                     if self.prefix_store is not None:
                         # Reverse the lookup wholesale (pin AND counters,
                         # miss included): the request stays queued and
@@ -1314,14 +1617,17 @@ class GenerationServer:
             it = iter(free)
             for (_seg, _m, pad_len), pairs in hit_groups.items():
                 if len(pairs) >= 2 and self._can_batch_prefill:
+                    self._admit_current = [req for req, _ in pairs]
                     self._fill_slots_suffix_batched(
                         [next(it) for _ in pairs], pairs, pad_len
                     )
                 else:
                     for req, hit in pairs:
+                        self._admit_current = [req]
                         self._fill_slot_suffix(next(it), req, hit)
             for pad_len, reqs in groups.items():
                 if len(reqs) >= 2 and self._can_batch_prefill:
+                    self._admit_current = list(reqs)
                     self._fill_slots_batched(
                         [next(it) for _ in reqs], reqs, pad_len
                     )
@@ -1333,7 +1639,10 @@ class GenerationServer:
                         pad_len if pad_len in self.prefill_buckets else None
                     )
                     for req in reqs:
+                        self._admit_current = [req]
                         self._fill_slot(next(it), req, bucket)
+            self._admitting = []
+            self._admit_current = []
 
     def _maybe_finish(self, b: int, new_tokens: list) -> None:
         req = self._slot_req[b]
@@ -1382,6 +1691,7 @@ class GenerationServer:
         """``n`` pool blocks, evicting unreferenced prefix-tier segments
         LRU-first under pressure (decode outranks the cache); None when
         live state holds everything."""
+        self._inj.fire("pool_alloc")
         got = self.kv_pool.try_alloc(n)
         while got is None:
             tier = self.prefix_store
@@ -1526,7 +1836,6 @@ class GenerationServer:
         blocks = self._alloc_blocks(nb)
         if blocks is None:
             return False
-        self._preempted.popleft()
         full = np.full(self._nb_max, SCRATCH_BLOCK, np.int32)
         full[:nb] = blocks
         self.kv_pool.arena = pool_scatter_rows(
@@ -1539,6 +1848,10 @@ class GenerationServer:
         self._pos[b] = pre.pos
         self._last[b] = pre.last
         self._fresh_rows.add(b)  # overlap: override the in-flight row
+        # Popped only once LANDED: a recoverable fault inside the restore
+        # scatter must still find the request in _preempted (the lost-set
+        # source for spilled work) or it would vanish from recovery.
+        self._preempted.popleft()
         obs.emit(
             "serving", "kv_resume",
             server=self._label, rid=pre.req.rid, pos=pre.pos,
@@ -1590,23 +1903,424 @@ class GenerationServer:
                 self._preempt_lane(victim, reason="pool_exhausted")
 
     def step(self) -> bool:
-        """One scheduler round. Lock-step (``overlap=False`` or
-        speculative): refill free slots, then one fenced decode chunk.
+        """One SUPERVISED scheduler round. Lock-step (``overlap=False``
+        or speculative): refill free slots, then one fenced decode chunk.
         Pipelined (default): dispatch the next chunk from the in-flight
         chunk's device state, THEN retire the in-flight chunk's tokens
         while the device runs — see :meth:`_step_overlapped`. Returns
         False when queue, slots, and pipeline are all empty.
 
+        The recovery supervisor (ISSUE 7) wraps the round: a recoverable
+        failure (:func:`.resilience.recoverable` — injected faults,
+        watchdog stalls, transient XLA statuses) triggers
+        :meth:`_recover` instead of unwinding ``run()``; everything else
+        (user bugs, strict-mode guard trips) propagates unchanged. A
+        successful round resets failure streaks and takes the periodic
+        recovery checkpoint; a requested drain finishes here once the
+        server idles.
+
         Under :attr:`strict` the overlapped round runs inside
         ``compat.jaxapi.strict_mode`` — the transfer guard covers the
         whole dispatch→retire window (lock-step and speculative rounds
         fence synchronously by design, so they are not guarded)."""
+        if self._draining and not self._drain_announced:
+            # Deferred from request_drain (async-signal-safe there): the
+            # loop announces the drain from its own thread.
+            self._drain_announced = True
+            obs.emit(
+                "serving", "drain_begin",
+                server=self._label, reason=self._drain_reason,
+                queued=len(self._queue),
+                slots_busy=sum(r is not None for r in self._slot_req),
+            )
+        try:
+            alive = self._step_inner()
+            # The periodic checkpoint runs INSIDE the supervised region:
+            # its device→host gather is itself a dispatch that can raise
+            # transiently, and the crash-tolerance machinery must not be
+            # the thing that unwinds run().
+            self._note_progress()
+        except BaseException as exc:
+            if not (self._supervised and resilience.recoverable(exc)):
+                raise
+            alive = self._recover(exc)
+        if self._draining and not self._drain_done and self._drain_idle():
+            self._finish_drain()
+            alive = False
+        return alive
+
+    def _step_inner(self) -> bool:
         if self.overlap and not self.speculative_k:
             if self.strict:
                 with jaxapi.strict_mode(scope="serving.decode_dispatch"):
                     return self._step_overlapped()
             return self._step_overlapped()
         return self._step_lockstep()
+
+    # ----- recovery supervisor (ISSUE 7) -----------------------------------
+
+    def _note_progress(self) -> None:
+        """A round completed without a fault: reset the backoff streak
+        and every surviving lane resident's implication count, then take
+        the periodic recovery checkpoint when the cadence says so."""
+        self._fail_streak = 0
+        for req in self._slot_req:
+            if req is not None:
+                req.fails = 0
+        if (self._ckpt_every
+                and self._rounds - self._ckpt_round >= self._ckpt_every):
+            self._checkpoint()
+
+    def _drain_idle(self) -> bool:
+        """Nothing in flight anymore: lanes empty, pipeline empty, no
+        mid-admission work (preempted requests resume through _admit
+        while lanes free up, so an empty lane set with an empty pipeline
+        means they drained too — or could not fit and will be failed)."""
+        return (
+            self._inflight is None
+            and not self._admitting
+            and all(r is None for r in self._slot_req)
+            # Crash-recovery replays in the queue are STARTED work — a
+            # fault mid-drain requeued them; they re-admit (the drain
+            # gate in _admit lets them through) before the drain closes.
+            and not any(r.replays for r in self._queue)
+            # Preempted spills are started work too: with lanes now free
+            # the next _admit resumes them (or fails them in place when
+            # even the full pool cannot hold the spill) — the drain must
+            # not close over their heads.
+            and not (self.paged and self._preempted)
+        )
+
+    def _checkpoint(self) -> None:
+        """Snapshot every live lane's KV to host plus the scheduling
+        state a restore needs (the PR 6 spill layout). One sanctioned
+        ``allow_transfer`` region on the scheduling slow path — at the
+        checkpoint cadence, never per round; under overlap the gather
+        orders after the in-flight chunk's donated writes, and the host
+        ``pos``/``out`` snapshot is the RETIRED state, which is exactly
+        what a restore replays from (rows past ``pos`` are masked)."""
+        entries: dict[int, _CkptEntry] = {}
+        tokens = 0
+        with jaxapi.allow_transfer("recovery checkpoint spill"):
+            for b in range(self.max_batch):
+                req = self._slot_req[b]
+                if req is None or req.done:
+                    continue
+                # Each lane gather is watchdog-bounded (inject=False: the
+                # checkpoint is recovery machinery, not an injection seam
+                # — chaos schedules keep their crossing counts) so a hung
+                # transport raises into the supervisor here too.
+                if self.paged:
+                    kv = self._fence_wait(
+                        lambda b=b: jax.tree.map(
+                            np.asarray,  # jaxguard: allow(JG101) checkpoint spill — sanctioned slow-path sync (guarded by allow_transfer)
+                            pool_gather_rows(
+                                self.kv_pool.arena,
+                                jnp.asarray(self._full_table(b)),
+                                block_size=self.kv_block,
+                            ),
+                        ),
+                        seam="checkpoint", inject=False,
+                    )
+                else:
+                    kv = self._fence_wait(
+                        lambda b=b: jax.tree.map(
+                            lambda a: np.asarray(a[:, b:b + 1]),  # jaxguard: allow(JG101) checkpoint spill — sanctioned slow-path sync (guarded by allow_transfer)
+                            self.arena,
+                        ),
+                        seam="checkpoint", inject=False,
+                    )
+                entries[req.rid] = _CkptEntry(
+                    req=req, out=list(req.out), pos=int(self._pos[b]),
+                    last=int(self._last[b]), kv=kv,
+                )
+                tokens += int(self._pos[b])
+        self._ckpt = entries
+        self._ckpt_round = self._rounds
+        self._checkpoints += 1
+        obs.emit(
+            "serving", "checkpoint",
+            server=self._label, round=self._rounds, lanes=len(entries),
+            tokens=tokens,
+        )
+
+    def _fail_request(self, req: _Request, reason: str,
+                      error: str = "") -> None:
+        """Terminal per-request failure: surfaced through
+        :meth:`failures` and a ``request_failed`` event — never silently
+        dropped, never retried again."""
+        req.done = True
+        self._failures[req.rid] = error or reason
+        obs.emit(
+            "serving", "request_failed",
+            server=self._label, rid=req.rid, reason=reason,
+            error=(error or reason)[:200], emitted=len(req.out),
+        )
+
+    def _recover(self, exc: BaseException) -> bool:
+        """Rebuild after a failed round. The device state is rebuilt from
+        scratch (the failed round may have poisoned donated buffers);
+        every implicated request either restores from the last host
+        checkpoint (bounded replay — the post-checkpoint suffix
+        regenerates bit-identically under greedy decoding), requeues
+        strict-FIFO for a from-the-prompt replay, or — after
+        ``quarantine_after`` consecutive implicated failures — fails
+        individually into :meth:`failures` so one poison request cannot
+        wedge retries forever. Retries back off exponentially (bounded),
+        keyed by the consecutive-failure streak."""
+        err = f"{type(exc).__name__}: {exc}"[:200]
+        self._fail_streak += 1
+        self._recoveries += 1
+        self._c_recover.inc()
+        if isinstance(exc, DeviceStallError):
+            self._stalls += 1
+            self._c_stall.inc()
+        # The implicated set: who loses progress to this round. A fault
+        # inside a fill path is attributed to the requests of THAT fill
+        # (_admit_current) — their batch-mates just requeue without an
+        # implication mark, so a poison prompt quarantines alone instead
+        # of dragging the whole admission pass with it. Decode/fence
+        # faults implicate every lane resident and the in-flight chunk's
+        # pins (the whole cohort shares one executable there).
+        blamed = {req.rid for req in self._admit_current if not req.done}
+        if not blamed:
+            for b in range(self.max_batch):
+                req = self._slot_req[b]
+                if req is not None and not req.done:
+                    blamed.add(req.rid)
+            if self._inflight is not None:
+                for _b, req in self._inflight.slots:
+                    if not req.done:
+                        blamed.add(req.rid)
+        lost: dict[int, _Request] = {}
+        for b in range(self.max_batch):
+            req = self._slot_req[b]
+            if req is not None and not req.done:
+                lost[req.rid] = req
+        if self._inflight is not None:
+            for _b, req in self._inflight.slots:
+                if not req.done:
+                    lost[req.rid] = req
+        for req, _hit in self._admitting:
+            if not req.done:
+                lost[req.rid] = req
+        # A blamed request still sitting in the queue (a reservation-
+        # phase fault: peeked, never popped) joins the lost set — pulled
+        # out of the queue so its quarantine streak is tracked and it
+        # requeues strict-FIFO with everyone else instead of retrying
+        # forever with fails pinned at zero.
+        if blamed - set(lost):
+            for req in list(self._queue):
+                if req.rid in blamed and req.rid not in lost:
+                    self._queue.remove(req)
+                    lost[req.rid] = req
+        # Release prefix pins. A standalone store's arena survives (decode
+        # never donates it); a pool-backed tier is rebuilt with the pool.
+        if (self.prefix_store is not None
+                and not isinstance(self.prefix_store, PagedPrefixTier)):
+            for handle in self._slot_prefix:
+                if handle is not None:
+                    self.prefix_store.release(handle)
+            for _req, hit in self._admitting:
+                if hit is not None:
+                    self.prefix_store.cancel(hit)
+        self._slot_prefix = [None] * self.max_batch
+        quarantined = 0
+        survivors: list[_Request] = []
+        for rid in sorted(lost):
+            req = lost[rid]
+            if rid in blamed:
+                req.fails += 1
+            if req.fails >= self._quarantine_k:
+                self._fail_request(req, reason="quarantined", error=err)
+                self._ckpt.pop(rid, None)
+                self._quarantined_n += 1
+                self._c_quarantine.inc()
+                quarantined += 1
+            else:
+                survivors.append(req)
+        self._reset_device_state()
+        # Restore checkpointed survivors into fresh lanes; everything
+        # else replays from its prompt via a strict-FIFO front-requeue.
+        restored = 0
+        replay: list[_Request] = []
+        lanes = (b for b in range(self.max_batch))
+        try:
+            with jaxapi.allow_transfer("crash recovery restore"):
+                for req in survivors:  # already rid-sorted
+                    entry = self._ckpt.get(req.rid)
+                    if entry is not None and self._restore_lane(
+                            next(lanes), entry):
+                        restored += 1
+                    else:
+                        req.out = []
+                        req.replays += 1
+                        replay.append(req)
+        except BaseException as exc2:
+            if not (self._supervised and resilience.recoverable(exc2)):
+                raise
+            # A recoverable fault inside the restore itself (pool_alloc
+            # seam, a transient error mid-scatter): the half-restored
+            # device state is untrustworthy — reset once more and replay
+            # EVERY survivor from its prompt. Full replay is always
+            # correct, and none vanish.
+            self._reset_device_state()
+            counted = {r.rid for r in replay}
+            restored = 0
+            for req in survivors:
+                if req.rid not in counted:
+                    req.replays += 1
+                req.out = []
+            replay = list(survivors)
+        if replay:
+            self._queue.extendleft(reversed(replay))
+        if self.paged:
+            self._preempted = deque(
+                sorted(self._preempted, key=lambda p: p.req.rid)
+            )
+        backoff = 0.0
+        if self._backoff_s > 0:
+            backoff = min(self._backoff_s * (2 ** (self._fail_streak - 1)),
+                          5.0)
+        obs.emit(
+            "serving", "recovery",
+            server=self._label, error=err, restored=restored,
+            requeued=len(replay), quarantined=quarantined,
+            streak=self._fail_streak, backoff_s=round(backoff, 4),
+        )
+        if backoff:
+            time.sleep(backoff)
+        return (
+            bool(self._queue)
+            or any(r is not None for r in self._slot_req)
+            or bool(self.paged and self._preempted)
+        )
+
+    def _reset_device_state(self) -> None:
+        """Fresh pool/arena + cleared device-coupled host mirrors. After
+        a failed round the old arena may alias buffers a raising dispatch
+        donated away (or hold writes of a half-landed admission) —
+        rebuilding is the only state the supervisor can trust. Host-side
+        request state (queue, results, failures, checkpoint, preempted
+        spills — all host-resident) survives untouched."""
+        if self.paged:
+            self.kv_pool = KVPool(
+                self.cfg, self.kv_pool.num_blocks * self.kv_block,
+                self.kv_block, kv_quant=self.kv_quant, label=self._label,
+            )
+            self._lane_blocks = [[] for _ in range(self.max_batch)]
+            self._bt_host[:] = SCRATCH_BLOCK
+            self._plans.clear()
+            if isinstance(self.prefix_store, PagedPrefixTier):
+                self.prefix_store = PagedPrefixTier(
+                    self.kv_pool, self.cfg, self.prefill_buckets,
+                    label=self._label,
+                )
+        else:
+            if self._cycle:
+                self.arena = init_cycle_kv_caches(
+                    self.cfg, self.max_batch, self.max_len,
+                    quantized=self.kv_quant, margin=self._ring_margin,
+                )
+            else:
+                arena_len = (
+                    self.cfg.window_cycle[0] + self._ring_margin
+                    if self.ring_kv else self.max_len
+                )
+                self.arena = init_kv_caches(
+                    self.cfg, self.max_batch, arena_len,
+                    quantized=self.kv_quant,
+                )
+            if self.draft is not None:
+                self.draft_arena = init_kv_caches(
+                    self.draft[1], self.max_batch, self.max_len
+                )
+            if self._mesh is not None:
+                self._place_arenas(self._mesh)
+        self._slot_req = [None] * self.max_batch
+        self._inflight = None
+        self._fresh_rows.clear()
+        self._admitting = []
+        self._admit_current = []
+
+    def _restore_lane(self, b: int, entry: _CkptEntry) -> bool:
+        """Re-land one checkpointed request into lane ``b`` of the fresh
+        device state: KV rows verbatim (the spill/restore pair), emitted
+        tokens truncated to the snapshot, decode resuming at the
+        snapshot's ``pos``/``last`` — the same verbatim-restore argument
+        as PR 6 preemption, so greedy output is unchanged. False when a
+        paged pool cannot hold the rows right now (caller requeues for a
+        full replay instead)."""
+        req = entry.req
+        if self.paged:
+            nb = -(-entry.pos // self.kv_block)
+            blocks = self._alloc_blocks(nb)
+            if blocks is None:
+                return False
+            full = np.full(self._nb_max, SCRATCH_BLOCK, np.int32)
+            full[:nb] = blocks
+            self.kv_pool.arena = pool_scatter_rows(
+                self.kv_pool.arena, jax.tree.map(jnp.asarray, entry.kv),
+                jnp.asarray(full), block_size=self.kv_block,
+            )
+            self._set_lane_table(b, blocks)
+        else:
+            self.arena = _write_slot(
+                self.arena, jax.tree.map(jnp.asarray, entry.kv), b
+            )
+        req.out = list(entry.out)
+        self._slot_req[b] = req
+        self._slot_prefix[b] = None
+        self._pos[b] = entry.pos
+        self._last[b] = entry.last
+        self._fresh_rows.add(b)
+        return True
+
+    def _finish_drain(self) -> None:
+        """The drain epilogue, once the server idles: fail everything
+        that never started (queued, plus any preempted request the pool
+        could not re-admit), emit the final checkpoint event, and mark
+        the drain complete. Every submitted rid is now in ``results`` or
+        :meth:`failures` — none vanish."""
+        failed = 0
+        while self.paged and self._preempted:
+            pre = self._preempted.popleft()
+            self._fail_request(pre.req, reason="drained",
+                               error="drained mid-flight "
+                                     f"({self._drain_reason})")
+            failed += 1
+        while self._queue:
+            req = self._queue.popleft()
+            self._fail_request(req, reason="drained",
+                               error="drained before start "
+                                     f"({self._drain_reason})")
+            failed += 1
+        self._ckpt = {}
+        obs.emit(
+            "serving", "checkpoint",
+            server=self._label, round=self._rounds, lanes=0, tokens=0,
+            final=True,
+        )
+        obs.emit(
+            "serving", "drain",
+            server=self._label, reason=self._drain_reason,
+            completed=len(self._results), failed=failed,
+        )
+        self._drain_done = True
+
+    def _fence_wait(self, wait, seam: str = "fence", inject: bool = True):
+        """Route one blocking device→host wait through the watchdog
+        fence (:func:`.resilience.fence_with_timeout`): the injector's
+        ``fence`` seam crosses first (``inject=False`` skips it — used
+        by the checkpoint gather, which is recovery machinery rather
+        than an injection seam), and a configured ``fence_timeout_s``
+        bounds the wait — a hung transport raises
+        :class:`DeviceStallError` into the supervisor instead of
+        freezing the scheduler. Defaults are a straight call-through."""
+        return resilience.fence_with_timeout(
+            wait, timeout_s=self._fence_timeout_s, seam=seam,
+            injector=self._inj if inject else None, server=self._label,
+        )
 
     def _dispatch_decode(self, last, pos, sub):
         """The one ``_serve_decode`` call site (lock-step and overlapped
@@ -1615,6 +2329,7 @@ class GenerationServer:
         like ``last``/``pos``; allocation itself is pure host work), slot
         servers through the dense arena. Returns ``(toks, last, pos)``
         futures; the donated arena's successor is stored back."""
+        self._inj.fire("decode_dispatch")
         if self.paged:
             toks, caches, new_last, new_pos = _serve_decode(
                 self.params, self.kv_pool.arena, last, pos, self.cfg,
@@ -1686,7 +2401,8 @@ class GenerationServer:
             toks, last, pos = self._dispatch_decode(
                 jnp.asarray(self._last), jnp.asarray(self._pos), sub
             )
-            toks = np.asarray(toks)  # [max_batch, chunk]  # jaxguard: allow(JG101) lock-step round fence — the transfer IS the chunk boundary
+            # Watchdog-fenced chunk boundary: [max_batch, chunk] tokens.
+            toks = self._fence_wait(lambda: np.asarray(toks))  # jaxguard: allow(JG101) lock-step round fence — the transfer IS the chunk boundary
         # Per-token decode latency as a client sees it: chunk wall time
         # over the chunk's steps (each step yields one token per slot).
         tok_lat = sp.duration_s / self.chunk
@@ -1808,7 +2524,7 @@ class GenerationServer:
         slots, then refill freed slots — those prefills affect the chunk
         after next, and their ``_write_slot`` updates chain behind the
         already-dispatched chunk's donated arena."""
-        host = fl.fence.wait()
+        host = self._fence_wait(fl.fence.wait)
         # Honest per-token latency under pipelining is the round CADENCE —
         # retire→retire (one chunk period at steady state), falling back to
         # this chunk's own dispatch anchor when the pipeline was empty (an

@@ -45,6 +45,8 @@ class TpuAllocator:
         compile_cache_dir: str = "",
         prefix_cache_tokens: int = 0,
         kv_pool_tokens: int = 0,
+        checkpoint_rounds: int = 0,
+        fault_schedule: str = "",
     ):
         self._inventory = inventory
         self._vendor = vendor
@@ -65,6 +67,13 @@ class TpuAllocator:
         # same delivery path — in-guest GenerationServers read
         # KATA_TPU_KV_POOL_TOKENS when no explicit kv_pool_tokens is passed.
         self._kv_pool_tokens = int(kv_pool_tokens)
+        # Crash-tolerance knobs (ISSUE 7, config.checkpoint_rounds /
+        # config.faults): recovery-checkpoint cadence and the chaos
+        # fault schedule, same delivery path — in-guest servers read
+        # KATA_TPU_CHECKPOINT_ROUNDS / KATA_TPU_FAULTS when the caller
+        # passes nothing explicit.
+        self._checkpoint_rounds = int(checkpoint_rounds)
+        self._fault_schedule = str(fault_schedule)
         # Driver-level liveness check supplied by the manager
         # (``manager.tpu_chip_alive``: node_alive over the same
         # dev+driver-state pair health watches); bare existence would hand a
@@ -122,6 +131,10 @@ class TpuAllocator:
             )
         if self._kv_pool_tokens > 0:
             resp.envs[C.ENV_KV_POOL_TOKENS] = str(self._kv_pool_tokens)
+        if self._checkpoint_rounds > 0:
+            resp.envs[C.ENV_CHECKPOINT_ROUNDS] = str(self._checkpoint_rounds)
+        if self._fault_schedule:
+            resp.envs[C.ENV_FAULT_SCHEDULE] = self._fault_schedule
         return resp
 
     def preferred(

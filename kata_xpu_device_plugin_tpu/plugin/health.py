@@ -31,8 +31,10 @@ import errno
 import os
 import stat
 import threading
+import time
 from typing import Sequence
 
+from .. import obs
 from ..utils import inotify, log, metrics
 from .api import glue
 from .server import DevicePluginServer
@@ -77,12 +79,25 @@ class HealthWatcher(threading.Thread):
         plugins: Sequence[DevicePluginServer],
         poll_interval_s: float = 5.0,
         use_inotify: bool = True,
+        restart_backoff_s: float = 1.0,
+        restart_backoff_max_s: float = 60.0,
+        clock=time.monotonic,
     ):
         super().__init__(name="health-watcher", daemon=True)
         self._plugins = list(plugins)
         self._poll_interval = poll_interval_s
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        # Restart retry state (ISSUE 7 satellite): a failed
+        # plugin.restart() used to be logged once and forgotten until the
+        # next *socket event* — with events lost (char devices are flaky
+        # emitters) the plugin stayed dead indefinitely. Now every
+        # evaluate() pass re-offers the restart under bounded exponential
+        # backoff: {id(plugin): (consecutive_failures, not_before_t)}.
+        self._restart_backoff_s = restart_backoff_s
+        self._restart_backoff_max_s = restart_backoff_max_s
+        self._restart_state: dict[int, tuple[int, float]] = {}
+        self._clock = clock
         self._ino: inotify.Inotify | None = None
         if use_inotify:
             try:
@@ -176,14 +191,51 @@ class HealthWatcher(threading.Thread):
                     )
             # Kubelet restart wipes the plugin-socket dir (ref :444-453).
             if plugin.serving and not os.path.exists(plugin.socket_path):
-                LOG.info(
-                    "plugin socket removed (kubelet restart?), re-registering",
-                    extra=log.kv(resource=plugin.resource_name),
-                )
-                try:
-                    plugin.restart()
-                except Exception as e:
-                    LOG.error(
-                        "plugin restart failed",
-                        extra=log.kv(resource=plugin.resource_name, err=str(e)),
-                    )
+                self._try_restart(plugin)
+
+    def _try_restart(self, plugin: DevicePluginServer) -> bool:
+        """One bounded-backoff restart offer. A failure schedules the next
+        attempt (exponential, capped) and is re-offered by every later
+        evaluate() pass — the periodic poll guarantees convergence even
+        when no further socket event arrives; success clears the backoff.
+        Both outcomes land on ``plugin_restarts_total{ok=...}`` and
+        failures additionally emit a ``plugin_restart_failed`` obs
+        event."""
+        fails, not_before = self._restart_state.get(id(plugin), (0, 0.0))
+        now = self._clock()
+        if now < not_before:
+            return False  # backing off; a later pass re-offers
+        LOG.info(
+            "plugin socket removed (kubelet restart?), re-registering",
+            extra=log.kv(resource=plugin.resource_name, attempt=fails + 1),
+        )
+        try:
+            plugin.restart()
+        except Exception as e:
+            fails += 1
+            delay = min(
+                self._restart_backoff_s * (2 ** (fails - 1)),
+                self._restart_backoff_max_s,
+            )
+            self._restart_state[id(plugin)] = (fails, now + delay)
+            metrics.plugin_restarts_total.labels(
+                resource=plugin.resource_name, ok="false"
+            ).inc()
+            obs.emit(
+                "plugin", "plugin_restart_failed",
+                resource=plugin.resource_name, attempt=fails,
+                err=str(e)[:200], retry_in_s=round(delay, 3),
+            )
+            LOG.error(
+                "plugin restart failed",
+                extra=log.kv(
+                    resource=plugin.resource_name, err=str(e),
+                    attempt=fails, retry_in_s=delay,
+                ),
+            )
+            return False
+        self._restart_state.pop(id(plugin), None)
+        metrics.plugin_restarts_total.labels(
+            resource=plugin.resource_name, ok="true"
+        ).inc()
+        return True

@@ -400,9 +400,13 @@ class PluginManager:
                 compile_cache_dir=cfg.compile_cache_dir,
                 prefix_cache_tokens=cfg.prefix_cache_tokens,
                 kv_pool_tokens=cfg.kv_pool_tokens,
+                checkpoint_rounds=cfg.checkpoint_rounds,
+                fault_schedule=cfg.faults,
             ),
             socket_dir=cfg.kubelet_socket_dir,
             kubelet_socket=cfg.kubelet_socket,
+            register_attempts=cfg.register_attempts,
+            register_backoff_s=cfg.register_backoff_s,
         )
         # The plugin must be visible to request_stop() BEFORE start() blocks
         # in registration backoff, or a signal landing in between would miss
@@ -445,6 +449,8 @@ class PluginManager:
             ),
             socket_dir=cfg.kubelet_socket_dir,
             kubelet_socket=cfg.kubelet_socket,
+            register_attempts=cfg.register_attempts,
+            register_backoff_s=cfg.register_backoff_s,
         )
         # Visible to request_stop() before start() can block (see start()).
         # Locked: the signal-watcher thread iterates plugins() concurrently.

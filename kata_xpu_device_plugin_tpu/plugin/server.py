@@ -153,6 +153,10 @@ class DevicePluginServer(glue.DevicePluginServicer):
         kubelet_socket: str = "",
         pre_start_required: bool = False,
         on_allocate: Optional[Callable[[Sequence[str]], None]] = None,
+        register_attempts: int = 5,
+        register_backoff_s: float = 1.0,
+        register_backoff_max_s: float = 30.0,
+        register_dial_timeout_s: float = 5.0,
     ):
         self.resource_name = resource_name
         self.state = state
@@ -161,6 +165,14 @@ class DevicePluginServer(glue.DevicePluginServicer):
         self.kubelet_socket = kubelet_socket or os.path.join(socket_dir, "kubelet.sock")
         self.pre_start_required = pre_start_required
         self.on_allocate = on_allocate
+        # Registration retry policy (ISSUE 7 satellite): configurable via
+        # Config (--register-attempts / --register-backoff-s) instead of
+        # the old hardcoded 5 × 1 s exponential ladder that gave up for
+        # good after ~31 s of kubelet downtime.
+        self.register_attempts = int(register_attempts)
+        self.register_backoff_s = float(register_backoff_s)
+        self.register_backoff_max_s = float(register_backoff_max_s)
+        self.register_dial_timeout_s = float(register_dial_timeout_s)
         self.endpoint = f"{SOCKET_PREFIX}-{resource_name.replace('/', '-')}.sock"
         self._server: Optional[grpc.Server] = None
         self._stop = threading.Event()  # one lifecycle event, never replaced
@@ -206,17 +218,33 @@ class DevicePluginServer(glue.DevicePluginServicer):
         with grpc.insecure_channel(f"unix://{self.socket_path}") as ch:
             grpc.channel_ready_future(ch).result(timeout=timeout)
 
-    def register(self, attempts: int = 5, backoff_s: float = 1.0) -> None:
+    def register(self, attempts: Optional[int] = None,
+                 backoff_s: Optional[float] = None) -> None:
         """Register with retry/backoff — a restarting kubelet can take longer
         than one dial timeout to come back (the reference fails hard once,
-        generic_device_plugin.go:204-209)."""
+        generic_device_plugin.go:204-209). Policy comes from the
+        constructor (``Config.register_attempts`` / ``register_backoff_s``
+        on the daemon path); the exponential backoff is CAPPED at
+        ``register_backoff_max_s`` and JITTERED (up to +25%) so a node's
+        plugins do not hammer a recovering kubelet in lockstep. Exhausting
+        every attempt emits a ``registration_exhausted`` obs event before
+        raising — the daemon's death is diagnosable from the event
+        stream, not silent."""
+        import random
+
+        from .. import obs
+
+        attempts = self.register_attempts if attempts is None else attempts
+        backoff_s = self.register_backoff_s if backoff_s is None else backoff_s
         last: Exception | None = None
         for attempt in range(attempts):
             if self._stop.is_set():
                 return
             try:
                 with grpc.insecure_channel(f"unix://{self.kubelet_socket}") as ch:
-                    grpc.channel_ready_future(ch).result(timeout=5.0)
+                    grpc.channel_ready_future(ch).result(
+                        timeout=self.register_dial_timeout_s
+                    )
                     glue.RegistrationStub(ch).Register(
                         pb.RegisterRequest(
                             version=glue.DEVICE_PLUGIN_VERSION,
@@ -241,8 +269,19 @@ class DevicePluginServer(glue.DevicePluginServicer):
                         err=str(e) or type(e).__name__,
                     ),
                 )
-                self._stop.wait(backoff_s * (2**attempt))
+                if attempt < attempts - 1:
+                    # No dead sleep after the FINAL attempt: exhaustion
+                    # should surface (event + raise) immediately.
+                    delay = min(
+                        backoff_s * (2**attempt), self.register_backoff_max_s
+                    )
+                    self._stop.wait(delay * (1.0 + 0.25 * random.random()))
         assert last is not None
+        obs.emit(
+            "plugin", "registration_exhausted",
+            resource=self.resource_name, attempts=attempts,
+            err=(str(last) or type(last).__name__)[:200],
+        )
         raise last
 
     def restart(self) -> None:

@@ -46,6 +46,12 @@ health_transitions_total = obs_metrics.counter(
 rescans_total = obs_metrics.counter(
     f"{NS}_rescans_total", "Discovery rescans", ["changed"]
 )
+plugin_restarts_total = obs_metrics.counter(
+    f"{NS}_plugin_restarts_total",
+    "Plugin re-serve/re-register attempts after a socket loss "
+    "(kubelet restart), by outcome",
+    ["resource", "ok"],
+)
 
 # gRPC handler latency (ISSUE 2): one histogram, labeled by method —
 # Allocate / GetPreferredAllocation / ListAndWatch_update share it.

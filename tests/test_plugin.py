@@ -604,3 +604,130 @@ def test_vfio_preferred_numa_affinity():
     # Larger than any one node: same-node prefix first, then spill.
     picked = alloc.preferred(["1", "2", "3", "4", "5"], [], 4)
     assert len(picked) == 4
+
+
+# ----- robustness satellites (ISSUE 7) -------------------------------------
+
+
+class _FlakyPlugin:
+    """Stand-in for DevicePluginServer in restart-retry tests: serving,
+    socket gone, restart() fails a scripted number of times."""
+
+    def __init__(self, short_dir, fail_times):
+        self.resource_name = "google.com/tpu"
+        self.serving = True
+        self.stopped = False
+        self.socket_path = os.path.join(short_dir, "never-created.sock")
+        self.fail_times = fail_times
+        self.calls = 0
+        self.state = type("S", (), {"snapshot": lambda self: []})()
+
+    def restart(self):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise RuntimeError(f"kubelet not back (attempt {self.calls})")
+
+
+def test_health_watcher_restart_retries_with_backoff(short_dir, tmp_path):
+    """A failed plugin.restart() is no longer forgotten until the next
+    socket event: every evaluate() pass re-offers it under bounded
+    exponential backoff, failures emit plugin_restart_failed events and
+    land on plugin_restarts_total{ok="false"}, and success clears the
+    backoff state."""
+    from prometheus_client import REGISTRY, generate_latest
+
+    from kata_xpu_device_plugin_tpu import obs
+
+    plugin = _FlakyPlugin(short_dir, fail_times=2)
+    now = [100.0]
+    watcher = HealthWatcher([plugin], use_inotify=False,
+                            restart_backoff_s=1.0, restart_backoff_max_s=8.0,
+                            clock=lambda: now[0])
+    sink = obs.EventSink(str(tmp_path / "ev.jsonl"))
+    prev = obs.set_default_sink(sink)
+    try:
+        watcher.evaluate()  # attempt 1: fails, next not before t+1
+        assert plugin.calls == 1
+        watcher.evaluate()  # backing off: no attempt
+        assert plugin.calls == 1
+        now[0] += 1.1
+        watcher.evaluate()  # attempt 2: fails, delay doubles to 2 s
+        assert plugin.calls == 2
+        now[0] += 1.1
+        watcher.evaluate()  # still inside the doubled window
+        assert plugin.calls == 2
+        now[0] += 1.1
+        watcher.evaluate()  # attempt 3: succeeds, state cleared
+        assert plugin.calls == 3
+        now[0] += 0.01
+        watcher.evaluate()  # socket still missing: retry IMMEDIATELY
+        assert plugin.calls == 4  # (no stale backoff after a success)
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    evs = [e for e in obs.read_events(str(tmp_path / "ev.jsonl"))
+           if e.get("name") == "plugin_restart_failed"]
+    assert [e["attempt"] for e in evs] == [1, 2]
+    assert all(e["retry_in_s"] > 0 and "kubelet" in e["err"] for e in evs)
+    text = generate_latest(REGISTRY).decode()
+    assert ('plugin_restarts_total{ok="false",resource="google.com/tpu"}'
+            in text)
+    assert ('plugin_restarts_total{ok="true",resource="google.com/tpu"}'
+            in text)
+
+
+def test_register_exhaustion_emits_event_and_respects_config(short_dir,
+                                                             tmp_path):
+    """register() policy is configurable (Config.register_attempts /
+    register_backoff_s on the daemon path) and exhausting every attempt
+    emits a registration_exhausted obs event before raising — no more
+    silent permanent give-up after the old hardcoded ladder."""
+    from kata_xpu_device_plugin_tpu import obs
+    from kata_xpu_device_plugin_tpu.plugin import DevicePluginServer, DeviceState
+
+    server = DevicePluginServer(
+        resource_name="google.com/tpu",
+        state=DeviceState([]),
+        allocator=None,
+        socket_dir=short_dir,
+        kubelet_socket=os.path.join(short_dir, "no-kubelet.sock"),
+        register_attempts=2,
+        register_backoff_s=0.01,
+        register_dial_timeout_s=0.05,
+    )
+    sink = obs.EventSink(str(tmp_path / "ev.jsonl"))
+    prev = obs.set_default_sink(sink)
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(Exception):
+            server.register()
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    assert time.monotonic() - t0 < 5  # the short policy was honored
+    (ev,) = [e for e in obs.read_events(str(tmp_path / "ev.jsonl"))
+             if e.get("name") == "registration_exhausted"]
+    assert ev["resource"] == "google.com/tpu" and ev["attempts"] == 2
+    assert ev["err"]
+
+
+def test_config_register_policy_validation_and_plumbing(v5e8, kubelet,
+                                                        short_dir):
+    """Config validates the new register knobs and the manager hands them
+    to every plugin it builds."""
+    with pytest.raises(ValueError, match="register-attempts"):
+        make_config(v5e8, kubelet, short_dir, register_attempts=0)
+    with pytest.raises(ValueError, match="register-backoff-s"):
+        make_config(v5e8, kubelet, short_dir, register_backoff_s=-1.0)
+
+    mgr = PluginManager(make_config(v5e8, kubelet, short_dir,
+                                    register_attempts=7,
+                                    register_backoff_s=0.25))
+    mgr.start()
+    try:
+        assert kubelet.registered.wait(5)
+        plugin = mgr.plugins()[0]
+        assert plugin.register_attempts == 7
+        assert plugin.register_backoff_s == 0.25
+    finally:
+        mgr.stop()

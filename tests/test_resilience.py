@@ -1,0 +1,260 @@
+"""Fault-injection harness + watchdog fence units (guest/resilience.py,
+ISSUE 7).
+
+Oracle: the injector is DETERMINISTIC — (seed, schedule) fully determines
+the fired sequence and its event stream — and every env knob follows the
+repo's degrade contract (malformed node-injected values fall back with an
+event, never crash a guest). The recovery matrix itself lives in
+tests/test_recovery.py; this file pins the primitives it builds on.
+"""
+import time
+
+import pytest
+
+from kata_xpu_device_plugin_tpu import obs
+from kata_xpu_device_plugin_tpu.guest import resilience
+from kata_xpu_device_plugin_tpu.guest.resilience import (
+    KIND_HANG,
+    KIND_OOM,
+    KIND_TRANSIENT,
+    SEAMS,
+    DeviceStallError,
+    FaultInjector,
+    FaultSpec,
+    InjectedOom,
+    TransientFault,
+    fence_with_timeout,
+    parse_schedule,
+    recoverable,
+)
+
+
+def _events(path):
+    return obs.read_events(str(path))
+
+
+def _capture(tmp_path, name="ev.jsonl"):
+    sink = obs.EventSink(str(tmp_path / name))
+    prev = obs.set_default_sink(sink)
+    return sink, prev
+
+
+# ----- schedule grammar ----------------------------------------------------
+
+
+def test_parse_schedule_grammar():
+    specs, bad = parse_schedule(
+        "decode_dispatch:2,fence:0:hang,prefill:1:raise-oom, pool_alloc:3 "
+    )
+    assert specs == [
+        FaultSpec("decode_dispatch", 2, KIND_TRANSIENT),
+        FaultSpec("fence", 0, KIND_HANG),
+        FaultSpec("prefill", 1, KIND_OOM),
+        FaultSpec("pool_alloc", 3, KIND_TRANSIENT),
+    ]
+    assert bad == []
+
+
+def test_parse_schedule_rejects_malformed_entries_individually():
+    specs, bad = parse_schedule(
+        "bogus_seam:1,prefill:x,prefill:1:weird,fence:-2,prefill,"
+        "decode_dispatch:0"
+    )
+    # The one valid entry survives; each malformed one is reported.
+    assert specs == [FaultSpec("decode_dispatch", 0, KIND_TRANSIENT)]
+    assert sorted(bad) == sorted(
+        ["bogus_seam:1", "prefill:x", "prefill:1:weird", "fence:-2",
+         "prefill"]
+    )
+
+
+def test_from_env_degrades_malformed_entries_with_event(monkeypatch,
+                                                        tmp_path):
+    monkeypatch.setenv("KATA_TPU_FAULTS", "prefill:0,garbage:9,fence:zzz")
+    sink, prev = _capture(tmp_path)
+    try:
+        inj = FaultInjector.from_env(label="t")
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    assert inj.armed
+    evs = [e for e in _events(tmp_path / "ev.jsonl")
+           if e.get("name") == "fault_schedule_error"]
+    assert sorted(e["entry"] for e in evs) == ["fence:zzz", "garbage:9"]
+    # The valid entry still fires.
+    with pytest.raises(TransientFault):
+        inj.fire("prefill")
+
+
+def test_constructor_rejects_unknown_seam_and_kind():
+    with pytest.raises(ValueError, match="seam"):
+        FaultInjector([FaultSpec("nope", 0)])
+    with pytest.raises(ValueError, match="kind"):
+        FaultInjector([FaultSpec("prefill", 0, "explode")])
+
+
+# ----- deterministic firing ------------------------------------------------
+
+
+def _drive(inj, sequence):
+    """Cross seams in order, recording what each crossing did."""
+    log = []
+    for seam in sequence:
+        try:
+            inj.fire(seam)
+            log.append((seam, None))
+        except (TransientFault, InjectedOom, DeviceStallError) as e:
+            log.append((seam, type(e).__name__))
+    return log
+
+
+def test_injector_same_seed_schedule_same_sequence(tmp_path):
+    """The replay contract: same seed + schedule ⇒ same fired sequence
+    AND the same event stream, crossing for crossing."""
+    schedule = [
+        FaultSpec("prefill", 1),
+        FaultSpec("decode_dispatch", 2, KIND_OOM),
+        FaultSpec("fence", 0, KIND_HANG),
+    ]
+    sequence = (["prefill"] * 3 + ["decode_dispatch"] * 4 + ["fence"]
+                + ["prefill"])
+    runs = []
+    for trial in range(2):
+        sink, prev = _capture(tmp_path, f"run{trial}.jsonl")
+        try:
+            inj = FaultInjector(schedule, seed=7, label="det")
+            log = _drive(inj, sequence)
+        finally:
+            obs.set_default_sink(prev)
+            sink.close()
+        evs = [
+            {k: v for k, v in e.items() if k != "ts"}
+            for e in _events(tmp_path / f"run{trial}.jsonl")
+        ]
+        runs.append((log, list(inj.fired), evs))
+    assert runs[0] == runs[1]
+    log, fired, _ = runs[0]
+    # Round counts are per-seam invocation indexes, 0-based.
+    assert fired == [
+        ("prefill", 1, KIND_TRANSIENT),
+        ("decode_dispatch", 2, KIND_OOM),
+        ("fence", 0, KIND_HANG),
+    ]
+    assert log[1] == ("prefill", "TransientFault")
+    assert log[5] == ("decode_dispatch", "InjectedOom")
+    assert log[7] == ("fence", "DeviceStallError")
+    # Each entry fires exactly once; every other crossing is a no-op.
+    assert sum(1 for _s, err in log if err) == 3
+
+
+def test_fire_each_entry_once_and_disarm():
+    inj = FaultInjector([FaultSpec("prefill", 0)])
+    assert inj.armed
+    with pytest.raises(TransientFault):
+        inj.fire("prefill")
+    assert not inj.armed
+    inj.fire("prefill")  # consumed: never fires again
+
+
+def test_injected_oom_carries_resource_exhausted_marker():
+    inj = FaultInjector([FaultSpec("pool_alloc", 0, KIND_OOM)])
+    with pytest.raises(InjectedOom, match="RESOURCE_EXHAUSTED"):
+        inj.fire("pool_alloc")
+
+
+def test_hang_emits_device_stall_event(tmp_path):
+    sink, prev = _capture(tmp_path)
+    try:
+        inj = FaultInjector([FaultSpec("fence", 0, KIND_HANG)], label="h")
+        with pytest.raises(DeviceStallError):
+            inj.fire("fence")
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    evs = _events(tmp_path / "ev.jsonl")
+    assert [e["name"] for e in evs] == ["fault_injected", "device_stall"]
+    assert evs[1]["injected"] is True
+
+
+# ----- the watchdog fence --------------------------------------------------
+
+
+def test_fence_with_timeout_passthrough_without_deadline():
+    # Default path: no deadline → inline call, value returned verbatim.
+    assert fence_with_timeout(lambda: 41 + 1) == 42
+
+
+def test_fence_with_timeout_raises_after_deadline(tmp_path):
+    sink, prev = _capture(tmp_path)
+    try:
+        with pytest.raises(DeviceStallError, match="watchdog"):
+            fence_with_timeout(
+                lambda: time.sleep(5.0), timeout_s=0.05, seam="fence",
+                server="t",
+            )
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    evs = [e for e in _events(tmp_path / "ev.jsonl")
+           if e.get("name") == "device_stall"]
+    assert len(evs) == 1 and evs[0]["injected"] is False
+    assert evs[0]["seam"] == "fence"
+
+
+def test_fence_with_timeout_relays_wait_errors_and_values():
+    def boom():
+        raise KeyError("inner")
+
+    with pytest.raises(KeyError, match="inner"):
+        fence_with_timeout(boom, timeout_s=2.0)
+    assert fence_with_timeout(lambda: "ok", timeout_s=2.0) == "ok"
+
+
+# ----- the recoverable predicate -------------------------------------------
+
+
+def test_recoverable_predicate():
+    assert recoverable(TransientFault("x"))
+    assert recoverable(InjectedOom("RESOURCE_EXHAUSTED: y"))
+    assert recoverable(DeviceStallError("z"))
+    assert not recoverable(ValueError("user bug"))
+    assert not recoverable(AssertionError())
+
+    # Real XLA errors route by status marker, matched by type NAME so the
+    # predicate works without importing jaxlib.
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert recoverable(XlaRuntimeError("RESOURCE_EXHAUSTED: hbm oom"))
+    assert recoverable(XlaRuntimeError("UNAVAILABLE: transport dead"))
+    # A strict-mode transfer-guard trip must NOT be swallowed.
+    assert not recoverable(
+        XlaRuntimeError("Disallowed host-to-device transfer")
+    )
+
+
+# ----- env knob degrade contract -------------------------------------------
+
+
+def test_env_int_and_float_degrade_with_event(monkeypatch, tmp_path):
+    monkeypatch.setenv("KT_TEST_INT", "not-a-number")
+    monkeypatch.setenv("KT_TEST_FLOAT", "12.5")
+    sink, prev = _capture(tmp_path)
+    try:
+        assert resilience.env_int(
+            "KT_TEST_INT", 3, event="checkpoint_disabled", server="t"
+        ) == 3
+        assert resilience.env_float("KT_TEST_FLOAT", 0.0) == 12.5
+        assert resilience.env_int("KT_TEST_UNSET", 9) == 9
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    evs = [e for e in _events(tmp_path / "ev.jsonl")
+           if e.get("name") == "checkpoint_disabled"]
+    assert len(evs) == 1
+    assert evs[0]["reason"].startswith("bad_env:")
+
+
+def test_seams_cover_the_documented_surface():
+    # docs/resilience.md documents exactly these; a drifted set is a doc
+    # bug or a silent loss of chaos coverage.
+    assert SEAMS == ("decode_dispatch", "prefill", "admission_commit",
+                     "fence", "pool_alloc", "store_gather")

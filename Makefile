@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-watch chaos eval demo dryrun image clean deploy obs-check
+.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-watch chaos eval demo dryrun image clean deploy obs-check
 
 all: build
 
@@ -87,6 +87,20 @@ bench-smoke:
 	KATA_TPU_COMPILE_CACHE_DIR=$${KATA_TPU_COMPILE_CACHE_DIR:-.cache/xla-compile} \
 	  $(PY) bench.py --smoke
 
+# Latency-under-load sweep alone (ISSUE 8): the serving_load_* section —
+# open-loop Poisson arrivals at 0.5×/1.5×/3× measured capacity, TTFT +
+# inter-token p50/p99 per rate, fifo_batch vs slo_chunked admission —
+# with every other side section off, so the result line is the sweep.
+# CI's bench-smoke job runs the same sweep as part of the full smoke and
+# uploads the result lines + events JSONL as artifacts.
+bench-load:
+	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=bench_load_events.jsonl \
+	KATA_TPU_COMPILE_CACHE_DIR=$${KATA_TPU_COMPILE_CACHE_DIR:-.cache/xla-compile} \
+	KATA_TPU_BENCH_INT8=0 KATA_TPU_BENCH_SERVING=0 KATA_TPU_BENCH_SOFTCAP=0 \
+	KATA_TPU_BENCH_TRAIN=0 KATA_TPU_BENCH_PREFIX=0 KATA_TPU_BENCH_PAGED=0 \
+	KATA_TPU_BENCH_FAULTS=0 KATA_TPU_BENCH_SPEC=0 \
+	  $(PY) bench.py --smoke
+
 # Chaos gate (ISSUE 7): the serving test subset under a FIXED seeded
 # fault schedule injected through the same KATA_TPU_FAULTS env the
 # daemon's chaos knob rides. Every test must still pass — scheduled
@@ -96,18 +110,21 @@ bench-smoke:
 # is also transfer-guard-clean; the obs JSONL stream is the CI artifact.
 # Seam rounds are chosen past the small fixtures' natural counts for the
 # tiny tests and inside them for the serving matrices — the point is one
-# REPLAYABLE schedule, not maximal carnage.
+# REPLAYABLE schedule, not maximal carnage. The sched_tick entry (ISSUE 8)
+# fires at a chunked-prefill slice boundary in every scheduler-test server
+# that crosses it, so recovery × chunked-prefill replay (mid-chunk fault →
+# strict-FIFO requeue from the prompt) runs under BOTH strict modes.
 chaos:
 	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_events.jsonl \
-	KATA_TPU_FAULTS="decode_dispatch:5,fence:7:hang,prefill:3" \
+	KATA_TPU_FAULTS="decode_dispatch:5,fence:7:hang,prefill:3,sched_tick:2" \
 	KATA_TPU_FAULTS_SEED=13 \
 	  $(PY) -m pytest tests/test_recovery.py tests/test_serving.py \
-	    tests/test_serving_pipeline.py -q
+	    tests/test_serving_pipeline.py tests/test_scheduler.py -q
 	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_events_strict.jsonl \
-	KATA_TPU_FAULTS="decode_dispatch:5,fence:7:hang,prefill:3" \
+	KATA_TPU_FAULTS="decode_dispatch:5,fence:7:hang,prefill:3,sched_tick:2" \
 	KATA_TPU_FAULTS_SEED=13 KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_recovery.py tests/test_serving.py \
-	    tests/test_serving_pipeline.py -q
+	    tests/test_serving_pipeline.py tests/test_scheduler.py -q
 
 # Opportunistic TPU bench: probe the tunnel every few minutes and run the
 # full bench on the first healthy probe, banking a dated committed JSON

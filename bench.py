@@ -53,14 +53,19 @@ reference (``softcap_prefill_flash_speedup``), a shared-prefix serving
 A/B (``serving_prefix_*`` vs ``serving_prefix_cold_*`` — the same
 system-prefix burst through a prefix-KV-store server and cold, reporting
 the TTFT speedup and the fraction of prompt tokens whose prefill was
-reused; ISSUE 5), and a train-step MFU
+reused; ISSUE 5), a latency-under-load QPS sweep (ISSUE 8:
+``serving_load_*`` — open-loop Poisson arrivals at 0.5×/1.5×/3× measured
+capacity, TTFT + inter-token p50/p99 per rate, fifo_batch vs slo_chunked
+admission with the oversubscribed-rate ITL-p99 and tok/s ratios;
+``KATA_TPU_BENCH_LOAD=0`` skips it, ``make bench-load`` runs it alone),
+and a train-step MFU
 section — one Llama-3-style ~256M model, one optimizer step on a 1-device
 mesh, pallas-flash vs reference attention, reported against the chip's
 public peak bf16 FLOP/s (``train_mfu``, ``train_flash_speedup``) so the
 training path (flash fwd+bwd kernels, remat, GSPMD step) has chip
-evidence, not just the decode path. All five are crash-guarded side
+evidence, not just the decode path. All are crash-guarded side
 sections emitted AFTER the banked headline line, each with its own
-``KATA_TPU_BENCH_{INT8,SERVING,PREFIX,SOFTCAP,TRAIN}=0`` kill switch (the
+``KATA_TPU_BENCH_{INT8,SERVING,PREFIX,SOFTCAP,LOAD,TRAIN}=0`` kill switch (the
 supervisor flips all of them off on retries and in the CPU fallback); the
 optional ``KATA_TPU_BENCH_W8A8=1`` adds the int8×int8-dot decode variant
 inside the int8 section.
@@ -288,6 +293,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
             env["KATA_TPU_BENCH_PREFIX"] = "0"
             env["KATA_TPU_BENCH_PAGED"] = "0"
             env["KATA_TPU_BENCH_FAULTS"] = "0"
+            env["KATA_TPU_BENCH_LOAD"] = "0"
         attempts += 1
         stage_timeout = SMOKE_TIMEOUT_S if args.smoke else ATTEMPT_TIMEOUT_S
         line, hung = run_once(
@@ -328,6 +334,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
         env["KATA_TPU_BENCH_PREFIX"] = "0"
         env["KATA_TPU_BENCH_PAGED"] = "0"
         env["KATA_TPU_BENCH_FAULTS"] = "0"
+        env["KATA_TPU_BENCH_LOAD"] = "0"
         cmd = list(worker_cmd) + ["--smoke", "--fallback"]
         line, _hung = run_once(cmd, env, SMOKE_TIMEOUT_S, "cpu-fallback")
         if line is not None:
@@ -816,6 +823,10 @@ def worker(args: argparse.Namespace) -> None:
                         params, cfg, max_batch=BATCH,
                         max_len=PROMPT_LEN + 72 + 4, chunk=16,
                         prefill_buckets=(PROMPT_LEN,), speculative_k=4,
+                        # Explicit opt-in (ISSUE 8 satellite): spec is
+                        # demoted behind KATA_TPU_SPEC — the A/B measures
+                        # the path deliberately.
+                        spec_opt_in=True,
                         draft=draft,
                     )
 
@@ -1178,6 +1189,156 @@ def worker(args: argparse.Namespace) -> None:
             else:
                 os.environ["KATA_TPU_RECOVERY"] = prev_rec
 
+    def measure_load() -> dict:  # lint: allow(JX004) srv.step() returns host numpy tokens each round — inherently fenced
+        # Latency-under-load (ISSUE 8, ROADMAP item 4): an OPEN-LOOP
+        # Poisson arrival generator sweeps offered QPS and reports what a
+        # loaded deployment's users actually feel — TTFT and inter-token
+        # p50/p99 per rate, not batch tok/s. Long prompts (the admission
+        # theft being measured) arrive at 0.5× / 1.5× / 3× the measured
+        # closed-loop capacity, served through BOTH admission policies:
+        # fifo_batch (whole-prefill admission, the identity baseline) and
+        # slo_chunked (chunked prefill under a deadline, guest/scheduler
+        # .py). The A/B acceptance at the oversubscribed rate: chunked ITL
+        # p99 at or under the baseline's with aggregate tok/s within 10%.
+        # Runs in smoke too. SIDE measurement with the usual protections:
+        # after the banked headline, crash-guarded, KATA_TPU_BENCH_LOAD=0
+        # disables.
+        if os.environ.get("KATA_TPU_BENCH_LOAD", "1") == "0":
+            return {}
+        try:
+            from kata_xpu_device_plugin_tpu.guest.serving import (
+                GenerationServer,
+            )
+
+            load_prompt = 6 * PROMPT_LEN  # long: prefill >> one decode round
+            # STAGGERED budgets: equal ones would synchronize lane
+            # finishes, so every admission would run against an idle
+            # arena (live=0) and no in-flight request would ever feel the
+            # prefill theft the sweep exists to measure.
+            new_per_req = 48
+            budgets = [new_per_req + 8 * (i % 4) for i in range(64)]
+            srv_max_len = load_prompt + max(budgets)
+            srv_chunk = 4 if args.smoke else 16
+            n_req = 4 * BATCH
+            pchunk = max(8, load_prompt // 4)  # ~4 slices per admission
+            key = jax.random.PRNGKey(53)
+
+            def make_prompts(salt):
+                return [
+                    np.asarray(jax.random.randint(
+                        jax.random.fold_in(key, salt + i), (load_prompt,),
+                        0, cfg.vocab_size, dtype=jnp.int32,
+                    ))
+                    for i in range(n_req)
+                ]
+
+            def make_server(policy, slo_ms):
+                return GenerationServer(
+                    params, cfg, max_batch=BATCH, max_len=srv_max_len,
+                    chunk=srv_chunk, prefill_buckets=(load_prompt,),
+                    # Explicit args on BOTH sides: daemon-injected
+                    # KATA_TPU_SCHED_* / pool / prefix envs must not
+                    # contaminate the A/B.
+                    sched_policy=policy, prefill_chunk=pchunk,
+                    itl_slo_ms=slo_ms,
+                    prefix_cache_tokens=0, kv_pool_tokens=0,
+                )
+
+            def drive(srv, prompts, arrivals):  # jaxguard: hot  # lint: allow(JX004) srv.step() returns host numpy tokens each round — inherently fenced
+                # Open loop: requests arrive on the wall clock regardless
+                # of server progress (closed loops hide queueing delay —
+                # the whole point of the sweep).
+                rids = []
+                t0 = time.perf_counter()
+                i = 0
+                while i < len(prompts):
+                    now = time.perf_counter() - t0
+                    if arrivals[i] <= now:
+                        rids.append(srv.submit(prompts[i], budgets[i]))
+                        i += 1
+                        continue
+                    if not srv.step():
+                        time.sleep(min(0.002, arrivals[i] - now))
+                while srv.step():
+                    pass
+                dt_s = time.perf_counter() - t0
+                results = srv.run()
+                total = sum(len(results[r]) for r in rids if r in results)
+                return total, dt_s, srv.stats()
+
+            # Warm both executable families (the chunked side adds the
+            # fixed-width suffix-chunk executable) and calibrate: the
+            # closed-loop run measures capacity (offered-rate anchor) and
+            # the unloaded inter-token cadence (the SLO anchor).
+            warm = make_server("fifo_batch", 0.0)
+            t0 = time.perf_counter()
+            for i, p in enumerate(make_prompts(9000)):
+                warm.submit(p, budgets[i])
+            warm.run()
+            warm_dt = time.perf_counter() - t0
+            cap_rps = n_req / warm_dt
+            itl_clean = (warm.stats()["decode_token_s"] or {}).get(
+                "p50", 0.0)
+            # The deadline: 1.5× the unloaded chunk cadence — tight enough
+            # that a whole long-prompt prefill projects over it, honest
+            # enough that plain decode rounds meet it.
+            slo_ms = max(0.001, itl_clean * 1000.0 * 1.5)
+            warm_c = make_server("slo_chunked", slo_ms)
+            for i, p in enumerate(make_prompts(9100)):
+                warm_c.submit(p, budgets[i])
+            warm_c.run()
+
+            rng = np.random.default_rng(17)
+            out = {
+                "serving_load_requests": n_req,
+                "serving_load_prompt_len": load_prompt,
+                "serving_load_prefill_chunk": pchunk,
+                "serving_load_slo_ms": round(slo_ms, 3),
+                "serving_load_capacity_rps": round(cap_rps, 2),
+            }
+            top = {}
+            for j, mult in enumerate((0.5, 1.5, 3.0)):
+                rate = cap_rps * mult
+                # One arrival draw per rate, shared by both policies — the
+                # A/B must compare identical traffic.
+                arrivals = np.cumsum(rng.exponential(1.0 / rate, n_req))
+                out[f"serving_load_r{j}_offered_qps"] = round(rate, 2)
+                for tag, policy in (("fifo", "fifo_batch"),
+                                    ("slo", "slo_chunked")):
+                    srv = make_server(policy, slo_ms)
+                    total, dt_s, st = drive(
+                        srv, make_prompts(100 * j), arrivals
+                    )
+                    ttft = st["ttft_s"] or {}
+                    itl = st["decode_token_s"] or {}
+                    pre = f"serving_load_r{j}_{tag}"
+                    out.update({
+                        f"{pre}_tok_per_s": round(total / dt_s, 1),
+                        f"{pre}_ttft_p50_s": round(ttft.get("p50", 0.0), 4),
+                        f"{pre}_ttft_p99_s": round(ttft.get("p99", 0.0), 4),
+                        f"{pre}_itl_p50_s": round(itl.get("p50", 0.0), 5),
+                        f"{pre}_itl_p99_s": round(itl.get("p99", 0.0), 5),
+                    })
+                    if tag == "slo":
+                        out.update({
+                            f"{pre}_chunks": st["sched_chunks"],
+                            f"{pre}_defers": st["sched_defers"],
+                            f"{pre}_slo_violations": st["slo_violations"],
+                        })
+                    if j == 2:
+                        top[tag] = (total / dt_s, itl.get("p99", 0.0))
+            # The oversubscribed-rate acceptance ratios (ISSUE 8): ITL p99
+            # ratio <= 1 means chunking protected inter-token latency;
+            # tok/s ratio >= 0.9 means it cost < 10% aggregate throughput.
+            if top.get("fifo") and top["fifo"][1] > 0 and top["fifo"][0] > 0:
+                out["serving_load_itl_p99_ratio"] = round(
+                    top["slo"][1] / top["fifo"][1], 3)
+                out["serving_load_tok_per_s_ratio"] = round(
+                    top["slo"][0] / top["fifo"][0], 3)
+            return out
+        except Exception as exc:  # noqa: BLE001 — headline must survive
+            return {"load_error": f"{type(exc).__name__}: {exc}"[:200]}
+
     def measure_train() -> dict:
         # Train-step MFU (r5): the flash bwd kernels, remat, and the GSPMD
         # train step were inference-unmeasured claims until this section —
@@ -1337,6 +1498,10 @@ def worker(args: argparse.Namespace) -> None:
     faults_out = measure_faults()
     if faults_out:
         out.update(faults_out)
+        print(json.dumps(out), flush=True)
+    load_out = measure_load()
+    if load_out:
+        out.update(load_out)
         print(json.dumps(out), flush=True)
     softcap_out = measure_softcap_prefill()
     if softcap_out:

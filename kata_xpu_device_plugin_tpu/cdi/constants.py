@@ -83,6 +83,16 @@ ENV_CHECKPOINT_ROUNDS = "KATA_TPU_CHECKPOINT_ROUNDS"
 # (guest/resilience.py FaultInjector.from_env; malformed entries degrade).
 ENV_FAULT_SCHEDULE = "KATA_TPU_FAULTS"
 
+# SLO-aware admission scheduling handed to the guest (ISSUE 8):
+# guest.serving.GenerationServer reads these when the caller passes no
+# explicit scheduler args — policy ("fifo_batch" | "slo_chunked"; unknown
+# values degrade in-guest with a sched_disabled event), the chunked-
+# prefill slice size in tokens, and the inter-token-latency SLO in ms the
+# slo_chunked policy defers admissions against (guest/scheduler.py).
+ENV_SCHED_POLICY = "KATA_TPU_SCHED_POLICY"
+ENV_PREFILL_CHUNK = "KATA_TPU_PREFILL_CHUNK"
+ENV_ITL_SLO_MS = "KATA_TPU_ITL_SLO_MS"
+
 # Default location where containerd/CRI-O pick up CDI spec files
 # (ref pkg/device_plugin/device_plugin.go:20).
 DEFAULT_CDI_DIR = "/var/run/cdi"

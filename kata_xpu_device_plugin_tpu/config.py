@@ -131,6 +131,21 @@ class Config:
     # fault_schedule_error event — the knob can never crash a workload.
     faults: str = ""
 
+    # SLO-aware admission scheduling defaults (ISSUE 8): when set, the
+    # daemon injects KATA_TPU_SCHED_POLICY / KATA_TPU_PREFILL_CHUNK /
+    # KATA_TPU_ITL_SLO_MS into every TPU AllocateResponse so in-guest
+    # GenerationServers default their admission policy from the node's
+    # serving SLO instead of per-workload flags (guest/scheduler.py:
+    # "slo_chunked" slices admission prefills into prefill_chunk-token
+    # chunks interleaved with decode whenever projected inter-token
+    # latency exceeds itl_slo_ms; "fifo_batch" is today's behavior).
+    # Same delivery path as the compile/prefix/pool knobs; unknown or
+    # incompatible values degrade in-guest with a sched_disabled event.
+    # Empty/0 leaves the guest defaults.
+    sched_policy: str = ""
+    prefill_chunk: int = 0
+    itl_slo_ms: float = 0.0
+
     # Kubelet registration retry policy (ISSUE 7 satellite): attempts ×
     # exponential backoff (plus jitter) before a plugin gives up with a
     # registration_exhausted event. The old hardcoded 5 × 1 s ladder gave
@@ -151,6 +166,19 @@ class Config:
         if self.num_slices > 1 and not 0 <= self.slice_id < self.num_slices:
             raise ValueError(
                 f"slice-id {self.slice_id} out of range for {self.num_slices} slices"
+            )
+        if self.sched_policy not in ("", "fifo_batch", "slo_chunked"):
+            raise ValueError(
+                f"sched-policy must be fifo_batch or slo_chunked, got "
+                f"{self.sched_policy!r}"
+            )
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill-chunk must be >= 0, got {self.prefill_chunk}"
+            )
+        if self.itl_slo_ms < 0:
+            raise ValueError(
+                f"itl-slo-ms must be >= 0, got {self.itl_slo_ms}"
             )
         if self.register_attempts < 1:
             raise ValueError(

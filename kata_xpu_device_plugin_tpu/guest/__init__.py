@@ -5,11 +5,15 @@ from .distributed import initialize_from_env, resolve
 from .kv_arena import KVPool, PagedPrefixTier
 from .prefix_cache import PrefixStore, RadixIndex
 from .probe import probe_all_reduce, probe_compute, probe_devices, run_ladder
+from .scheduler import Scheduler, SLOChunkedScheduler, make_scheduler
 from .serving import GenerationServer, serve_batch
 
 __all__ = [
     "GenerationServer",
     "serve_batch",
+    "Scheduler",
+    "SLOChunkedScheduler",
+    "make_scheduler",
     "KVPool",
     "PagedPrefixTier",
     "PrefixStore",

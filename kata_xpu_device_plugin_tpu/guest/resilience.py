@@ -9,7 +9,8 @@ module supplies both halves:
 - :class:`FaultInjector` — a seeded, schedule-driven injector with named
   SEAMS wrapped around the serving loop's real failure points
   (``decode_dispatch``, ``prefill``, ``admission_commit``, ``fence``,
-  ``pool_alloc``, ``store_gather``). A schedule is a comma-separated
+  ``pool_alloc``, ``store_gather``, ``sched_tick``). A schedule is a
+  comma-separated
   ``<seam>:<round>[:<kind>]`` list (``KATA_TPU_FAULTS`` env), where
   ``round`` is the seam's 0-based invocation count and ``kind`` is one
   of ``raise-transient`` (default), ``raise-oom``, ``hang``. Each entry
@@ -62,6 +63,7 @@ SEAMS = (
     "fence",             # a blocking device->host wait (retire, lock-step)
     "pool_alloc",        # paged block allocation (OOM surface)
     "store_gather",      # prefix-store gather/materialize on a hit
+    "sched_tick",        # a chunked-prefill slice boundary (ISSUE 8)
 )
 
 KIND_TRANSIENT = "raise-transient"

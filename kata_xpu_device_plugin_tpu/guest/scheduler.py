@@ -1,0 +1,260 @@
+"""SLO-aware prefill/decode scheduling policies (ISSUE 8).
+
+PR 6 made admission a token-budget problem (the paged pool) and PR 7 made
+rounds survivable; what neither touched is WHEN prefill work runs. Today a
+long prompt's admission prefill executes as one forward between two decode
+rounds, so every in-flight request's inter-token latency absorbs the whole
+prompt — the head-of-line blocking FlexNPU (PAPERS.md) and Sarathi-style
+chunked prefill exist to remove. This module supplies the missing policy
+layer as pluggable objects :class:`GenerationServer` consults each round:
+
+- :class:`Scheduler` (``fifo_batch``) — the identity baseline: every
+  admission pass admits the full FIFO prefix in one (possibly batched)
+  prefill, exactly the pre-ISSUE-8 behavior. Zero overhead, zero new
+  decisions.
+- :class:`SLOChunkedScheduler` (``slo_chunked``) — deadline-driven
+  admission: when in-flight requests' PROJECTED inter-token latency
+  (estimated prefill time of the pending admission plus the observed
+  decode-round cadence, normalized per delivered token — the same unit
+  as the ``decode_token_s`` metric) would exceed ``KATA_TPU_ITL_SLO_MS``,
+  the
+  admission is sliced into ``KATA_TPU_PREFILL_CHUNK``-token chunks that
+  resume through the PR 5 ``prefill_suffix`` offset machinery, and the
+  serving loop interleaves AT MOST ONE chunk with each decode dispatch.
+  Decode rounds then stall for one chunk, not one prompt. With no decode
+  in flight (or no estimate yet — the first admissions bootstrap the
+  EWMAs) admission runs whole, so TTFT is never taxed when there is no
+  ITL to protect.
+
+The scheduler only decides WHEN prefill work happens and in what slice
+sizes — never what the forwards compute — so greedy outputs under
+``slo_chunked`` are bit-identical to ``fifo_batch`` (tested across
+paged/slotted × overlap × strict × prefix-hit in
+``tests/test_scheduler.py``). Chunking preserves strict FIFO by
+construction: a chunked admission is head-of-line — nothing admits past
+it while its chunks run — and a mid-chunk crash replays it from the
+prompt through the PR 7 strict-FIFO requeue.
+
+Policy selection rides the same env/daemon knob contract as the pool and
+prefix stores: ``KATA_TPU_SCHED_POLICY`` (injected node-wide via
+``config.sched_policy``) with malformed or incompatible values degrading
+to ``fifo_batch`` with a ``sched_disabled`` event, while explicit
+constructor arguments raise. jax-free at import: estimates are host
+floats, so host-side tests and the daemon can import this module.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import obs
+
+ENV_SCHED_POLICY = "KATA_TPU_SCHED_POLICY"
+ENV_PREFILL_CHUNK = "KATA_TPU_PREFILL_CHUNK"
+ENV_ITL_SLO_MS = "KATA_TPU_ITL_SLO_MS"
+
+POLICY_FIFO = "fifo_batch"
+POLICY_SLO = "slo_chunked"
+POLICIES = (POLICY_FIFO, POLICY_SLO)
+
+# A chunk should be several decode chunks' worth of work, small against a
+# production prompt; 128 splits a 1k-token system prompt into 8 slices.
+DEFAULT_PREFILL_CHUNK = 128
+# Interactive serving's common budget: ~20 tok/s perceived streaming rate.
+DEFAULT_ITL_SLO_MS = 50.0
+
+# EWMA weight for the prefill-rate / round-cadence estimates: heavy enough
+# to converge within a few observations, light enough that one outlier
+# round (a compile, a GC pause) does not flip the admission decision.
+_EWMA_ALPHA = 0.3
+
+
+@dataclass(frozen=True)
+class Directive:
+    """One admission decision. ``admit=True``: run the normal (full)
+    admission pass — the fifo_batch behavior. ``admit=False``: advance the
+    pending admission by one prefill chunk, then yield the round back to
+    decode (``defer_reason`` and the projection say why — they ride the
+    ``sched_defer`` event)."""
+
+    admit: bool
+    defer_reason: str = ""
+    projected_itl_ms: float = 0.0
+
+
+class Scheduler:
+    """The ``fifo_batch`` policy and the base every policy extends: admit
+    everything, every pass (today's behavior — the identity baseline the
+    bench A/B and the bit-identity tests compare against). Also owns the
+    bookkeeping every policy shares: the prefill-rate and round-cadence
+    EWMAs, the chunk/defer/violation counters ``stats()`` exposes, and the
+    queue-delay summary (submit → admission grant, the component of TTFT
+    the scheduler actually controls)."""
+
+    name = POLICY_FIFO
+
+    def __init__(self, *, chunk_tokens: int = 0,
+                 slo_ms: float = 0.0, decode_steps: int = 1,
+                 label: str = ""):
+        self.chunk_tokens = int(chunk_tokens)
+        self.slo_ms = float(slo_ms)
+        # The server's decode-chunk step count: rounds deliver this many
+        # tokens per lane, so PER-TOKEN latency (the unit ``slo_ms`` is
+        # in, matching the ``decode_token_s`` metric) is the round
+        # cadence divided by it. 1 = rounds ARE tokens (unit tests).
+        self.decode_steps = max(1, int(decode_steps))
+        self.label = label
+        self.chunks = 0          # chunked-prefill forwards run
+        self.defers = 0          # rounds that deferred admission to decode
+        self.slo_violations = 0  # observed rounds over the ITL SLO
+        self.queue_delay = obs.Rolling()
+        self._prefill_s_per_tok: Optional[float] = None
+        self._round_s: Optional[float] = None
+
+    # ----- observations (the serving loop feeds these) ---------------------
+
+    def note_prefill(self, tokens: int, dur_s: float) -> None:
+        """One prefill forward completed: fold its per-token cost into the
+        rate estimate (chunk forwards count too — they are the freshest
+        samples of exactly the work being projected)."""
+        if tokens <= 0 or dur_s <= 0:
+            return
+        per_tok = dur_s / tokens
+        if self._prefill_s_per_tok is None:
+            self._prefill_s_per_tok = per_tok
+        else:
+            self._prefill_s_per_tok += _EWMA_ALPHA * (
+                per_tok - self._prefill_s_per_tok
+            )
+
+    def note_round(self, dur_s: float) -> bool:
+        """One decode round retired at cadence ``dur_s``. Returns True when
+        the round violated the policy's ITL SLO (the serving loop emits the
+        ``slo_violation`` event — the base policy has no SLO and never
+        violates)."""
+        if dur_s <= 0:
+            return False
+        if self._round_s is None:
+            self._round_s = dur_s
+        else:
+            self._round_s += _EWMA_ALPHA * (dur_s - self._round_s)
+        return self._check_slo(dur_s)
+
+    def note_queue_delay(self, delay_s: float) -> None:
+        """A request left the queue (admission granted): record its
+        submit→grant wait."""
+        self.queue_delay.observe(max(0.0, float(delay_s)))
+
+    def _check_slo(self, dur_s: float) -> bool:
+        return False
+
+    # ----- the decision ----------------------------------------------------
+
+    def directive(self, *, live_lanes: int, pending_tokens: int,
+                  partial: bool = False) -> Directive:
+        """The per-pass admission decision. ``live_lanes``: requests
+        currently decoding (whose ITL a long prefill would stall);
+        ``pending_tokens``: the prefill tokens the pending admission still
+        needs (the queue head's padded cost, or a partial admission's
+        remaining suffix); ``partial=True``: a chunked admission is already
+        in progress (head-of-line — the decision is continue-whole vs
+        one-more-chunk, never skip)."""
+        return Directive(admit=True)
+
+    # ----- introspection ---------------------------------------------------
+
+    def projected_itl_s(self, pending_tokens: int) -> Optional[float]:
+        """The PER-TOKEN latency in-flight requests would see if
+        ``pending_tokens`` of prefill ran as one forward now: estimated
+        prefill time plus one decode-round cadence, normalized by the
+        round's step count — the same unit as the ``decode_token_s``
+        metric and ``slo_ms``. None until both estimates exist (the
+        bootstrap admissions measure them)."""
+        if self._prefill_s_per_tok is None or self._round_s is None:
+            return None
+        stall = pending_tokens * self._prefill_s_per_tok + self._round_s
+        return stall / self.decode_steps
+
+    def stats(self) -> dict:
+        """The always-present scheduler fields ``GenerationServer.stats()``
+        merges in (zeros under ``fifo_batch`` — no schema branch)."""
+        return {
+            "sched_policy": self.name,
+            "sched_chunks": self.chunks,
+            "sched_defers": self.defers,
+            "slo_violations": self.slo_violations,
+            "prefill_chunk_tokens": self.chunk_tokens,
+            "itl_slo_ms": self.slo_ms,
+            "sched_queue_delay_s": self.queue_delay.summary(),
+        }
+
+
+class SLOChunkedScheduler(Scheduler):
+    """``slo_chunked``: defer (chunk) the pending admission whenever the
+    projected ITL of running it whole would exceed the SLO and somebody is
+    decoding to feel it. See the module header for the policy argument."""
+
+    name = POLICY_SLO
+
+    def __init__(self, *, chunk_tokens: int = DEFAULT_PREFILL_CHUNK,
+                 slo_ms: float = DEFAULT_ITL_SLO_MS, decode_steps: int = 1,
+                 label: str = ""):
+        if chunk_tokens < 1:
+            raise ValueError(
+                f"prefill chunk must be >= 1 token, got {chunk_tokens}"
+            )
+        super().__init__(chunk_tokens=chunk_tokens, slo_ms=slo_ms,
+                         decode_steps=decode_steps, label=label)
+
+    def _check_slo(self, dur_s: float) -> bool:
+        # Per-token, like slo_ms itself: the round delivered decode_steps
+        # tokens per live lane, so the client-visible inter-token latency
+        # is the cadence over the steps (the ``decode_token_s`` metric).
+        if (dur_s / self.decode_steps) * 1000.0 > self.slo_ms:
+            self.slo_violations += 1
+            return True
+        return False
+
+    def directive(self, *, live_lanes: int, pending_tokens: int,
+                  partial: bool = False) -> Directive:
+        if live_lanes == 0:
+            # Nobody is decoding: there is no ITL to protect, and chunking
+            # would only tax this request's own TTFT.
+            return Directive(admit=True)
+        if not partial and pending_tokens <= self.chunk_tokens:
+            # The whole admission is one chunk's worth — slicing cannot
+            # shrink the stall, so take the cold/batched fast path.
+            return Directive(admit=True)
+        proj = self.projected_itl_s(pending_tokens)
+        if proj is None:
+            # Bootstrap: no estimates yet (the first admission and round
+            # measure them) — admitting whole is the only honest choice.
+            return Directive(admit=True)
+        proj_ms = proj * 1000.0
+        if proj_ms <= self.slo_ms:
+            return Directive(admit=True)
+        return Directive(
+            admit=False, defer_reason="projected_itl",
+            projected_itl_ms=round(proj_ms, 3),
+        )
+
+
+def make_scheduler(policy: str, *, chunk_tokens: int, slo_ms: float,
+                   decode_steps: int = 1, label: str = "") -> Scheduler:
+    """Instantiate a policy by knob value. Raises ``ValueError`` on an
+    unknown name — the CALLER owns the env-vs-explicit degrade contract
+    (``GenerationServer`` degrades env values with a ``sched_disabled``
+    event and raises on explicit arguments, like the pool/prefix knobs).
+    ``decode_steps`` is the server's decode-chunk step count — the
+    round→per-token normalizer that keeps ``slo_ms`` in the same unit as
+    the ``decode_token_s`` metric."""
+    if policy == POLICY_FIFO:
+        return Scheduler(decode_steps=decode_steps, label=label)
+    if policy == POLICY_SLO:
+        return SLOChunkedScheduler(
+            chunk_tokens=chunk_tokens, slo_ms=slo_ms,
+            decode_steps=decode_steps, label=label,
+        )
+    raise ValueError(
+        f"unknown scheduler policy {policy!r} (have {POLICIES})"
+    )

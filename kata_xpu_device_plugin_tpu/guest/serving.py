@@ -125,6 +125,24 @@ from .kv_arena import (
 )
 from .prefix_cache import PrefixHit, PrefixStore
 from .resilience import DeviceStallError, FaultInjector
+from .scheduler import (
+    DEFAULT_ITL_SLO_MS,
+    DEFAULT_PREFILL_CHUNK,
+    ENV_ITL_SLO_MS,
+    ENV_PREFILL_CHUNK,
+    ENV_SCHED_POLICY,
+    POLICIES,
+    POLICY_FIFO,
+    POLICY_SLO,
+    make_scheduler,
+)
+
+# Speculative serving opt-in (ISSUE 8 satellite): the measured spec A/B is
+# a net LOSS today (BENCH_TPU_20260731T140338Z: 64.8 tok/s at 0.178 draft
+# acceptance vs 206 tok/s plain), so ``speculative_k`` alone no longer
+# arms it — the caller must also opt in (``spec_opt_in=True`` or this env)
+# or the server degrades to plain decoding with a ``spec_disabled`` event.
+ENV_SPEC_OPT_IN = "KATA_TPU_SPEC"
 
 
 # Serving-stat gauges, created through obs.metrics' idempotent factory
@@ -153,6 +171,9 @@ _PROM_STATS = (
     ("quarantined", "Requests failed after K consecutive implicated rounds"),
     ("device_stalls", "Watchdog fence deadlines exceeded (real or injected)"),
     ("checkpoints", "Host KV checkpoints taken for crash recovery"),
+    ("sched_chunks", "Chunked-prefill slices run by the admission scheduler"),
+    ("sched_defers", "Admission passes deferred to decode under SLO pressure"),
+    ("slo_violations", "Decode rounds whose cadence exceeded the ITL SLO"),
 )
 
 
@@ -225,6 +246,32 @@ def _ctr_stalls():
     return obs.counter(
         "kata_tpu_serving_fence_stalls_total",
         "Watchdog fence deadlines exceeded (real or injected)",
+        ["server"],
+    )
+
+
+# Scheduler traffic counters (ISSUE 8): incremented at the moment of the
+# decision so rate() works between scrapes, like the pool/resilience ones.
+def _ctr_sched_chunks():
+    return obs.counter(
+        "kata_tpu_serving_prefill_chunks_total",
+        "Chunked-prefill slices run by the admission scheduler",
+        ["server"],
+    )
+
+
+def _ctr_sched_defers():
+    return obs.counter(
+        "kata_tpu_serving_admission_defers_total",
+        "Admission passes deferred to decode under projected-ITL pressure",
+        ["server"],
+    )
+
+
+def _ctr_slo_violations():
+    return obs.counter(
+        "kata_tpu_serving_itl_slo_violations_total",
+        "Decode rounds whose retire cadence exceeded the ITL SLO",
         ["server"],
     )
 
@@ -327,6 +374,29 @@ class _LanePlan:
 
     table: list
     n_shared: int
+
+
+@dataclass
+class _PartialPrefill:
+    """One CHUNKED admission in progress (ISSUE 8): the queue head's
+    prompt being prefilled in ``prefill_chunk``-token slices interleaved
+    with decode rounds. ``caches`` is the request's own standalone
+    ``[L, 1, max_len, ...]`` cache pytree (prefix-hit rows materialized
+    up front, each chunk's ``prefill_suffix`` resuming at ``offset``);
+    the admission commits to a lane — arena write, store insert, first
+    token — only when the final slice lands, so every shared invariant
+    (TTFT stamping, FIFO, none-vanish) goes through the same
+    ``_finish_admission`` epilogue as the unchunked paths. Strictly
+    head-of-line: while a partial exists nothing else admits or resumes,
+    and its request rides ``_admitting`` so a mid-chunk crash replays it
+    from the prompt (PR 7 strict-FIFO requeue)."""
+
+    req: _Request
+    hit: Optional[PrefixHit]
+    caches: Any
+    offset: int  # prompt rows already resident (prefix reuse + chunks)
+    reused: int  # prefix rows copied from the store (event bookkeeping)
+    chunks: int = 0  # chunk forwards run so far
 
 
 @dataclass
@@ -457,6 +527,28 @@ class GenerationServer:
     (``KATA_TPU_RECOVERY_BACKOFF_S``) seeds the bounded exponential
     retry backoff. ``KATA_TPU_RECOVERY=0`` disables supervision entirely
     (every exception unwinds, the pre-ISSUE-7 behavior).
+
+    SCHEDULING (ISSUE 8, ``docs/guest_guide.md`` "Scheduling & SLOs"):
+    ``sched_policy`` selects the admission policy object
+    (:mod:`.scheduler`) — ``"fifo_batch"`` (default; admit the whole FIFO
+    prefix every pass, today's behavior) or ``"slo_chunked"`` (slice
+    admission prefills into ``prefill_chunk``-token chunks resumed via
+    ``transformer.prefill_suffix`` and interleave at most one per decode
+    round whenever in-flight requests' projected inter-token latency
+    would exceed ``itl_slo_ms``). ``None`` reads the daemon-injectable
+    envs (``KATA_TPU_SCHED_POLICY`` / ``KATA_TPU_PREFILL_CHUNK`` /
+    ``KATA_TPU_ITL_SLO_MS``); malformed or incompatible env values
+    degrade to ``fifo_batch`` with a ``sched_disabled`` event while
+    explicit arguments raise. Greedy outputs under ``slo_chunked`` are
+    BIT-IDENTICAL to ``fifo_batch`` (chunking changes when prefill work
+    runs, never what it computes — tested across paged/slotted × overlap
+    × strict × prefix-hit), and chunked admissions are head-of-line so
+    FIFO and the crash-replay guarantees are preserved.
+
+    ``spec_opt_in`` (``KATA_TPU_SPEC=1``): speculative serving is opt-in
+    — ``speculative_k`` alone degrades to plain decoding with a
+    ``spec_disabled`` event (the measured A/B is a net loss at 0.178
+    draft acceptance; see the module constant).
     """
 
     def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
@@ -475,7 +567,11 @@ class GenerationServer:
                  fault_injector: Optional[FaultInjector] = None,
                  fence_timeout_s: Optional[float] = None,
                  quarantine_after: Optional[int] = None,
-                 recovery_backoff_s: Optional[float] = None):
+                 recovery_backoff_s: Optional[float] = None,
+                 sched_policy: Optional[str] = None,
+                 prefill_chunk: Optional[int] = None,
+                 itl_slo_ms: Optional[float] = None,
+                 spec_opt_in: Optional[bool] = None):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
@@ -514,6 +610,29 @@ class GenerationServer:
                     "(cfg.sliding_window > 0 or a windowed attn_windows "
                     "cycle)"
                 )
+        # Label + latency summaries FIRST: every env-degrade event below
+        # (spec opt-in, scheduler, pool, prefix) carries the server label.
+        self._label = f"server{next(GenerationServer._instance_ids)}"
+        self._ttft = obs.Rolling()
+        self._tok_lat = obs.Rolling()
+        # Speculative serving demoted behind an explicit opt-in (ISSUE 8
+        # satellite; see ENV_SPEC_OPT_IN): validation above still rejects
+        # malformed spec configs, but a VALID one only arms when opted in
+        # — otherwise the server degrades to plain decoding with an event,
+        # so the measured-net-loss path is not a reachable default.
+        if speculative_k:
+            opted = (
+                os.environ.get(ENV_SPEC_OPT_IN, "") == "1"
+                if spec_opt_in is None else bool(spec_opt_in)
+            )
+            if not opted:
+                obs.emit(
+                    "serving", "spec_disabled",
+                    server=self._label, reason="opt_in_required",
+                    speculative_k=speculative_k,
+                )
+                speculative_k = 0
+                draft = None
         self.speculative_k = speculative_k
         # Draft-model speculation (production shape for non-repetitive
         # text): the draft keeps its OWN full-length arena at the same
@@ -558,15 +677,90 @@ class GenerationServer:
         # Windowed rings get speculative_k margin slots (see the ring_kv
         # comment above); plain decode (k=0) keeps exactly window slots.
         self._ring_margin = speculative_k if ring_kv else 0
-        # Label + latency summaries early: the env-degrade events below
-        # (pool, prefix) carry the server label.
-        self._label = f"server{next(GenerationServer._instance_ids)}"
-        self._ttft = obs.Rolling()
-        self._tok_lat = obs.Rolling()
         # Labeled histogram children resolved ONCE: registry lookup +
         # .labels() on every prefill/chunk is pure hot-path overhead —
         # export_metrics(label=...) re-resolves on rename.
         self._bind_histograms()
+        # Admission scheduler (ISSUE 8): the policy object that owns the
+        # per-round dispatch plan — fifo_batch (identity baseline) admits
+        # whole every pass; slo_chunked slices admission prefills into
+        # KATA_TPU_PREFILL_CHUNK-token chunks and interleaves at most one
+        # per decode round when in-flight ITL is projected over
+        # KATA_TPU_ITL_SLO_MS. The env default degrades with a
+        # sched_disabled event (unknown policy, incompatible mode); an
+        # explicit argument raises — the pool/prefix knob contract.
+        explicit_sched = sched_policy is not None
+        if sched_policy is None:
+            raw = os.environ.get(ENV_SCHED_POLICY, "").strip()
+            sched_policy = raw or POLICY_FIFO
+            if sched_policy not in POLICIES:
+                obs.emit(
+                    "serving", "sched_disabled",
+                    server=self._label, reason=f"bad_env:{raw[:32]}",
+                )
+                sched_policy = POLICY_FIFO
+        elif sched_policy not in POLICIES:
+            raise ValueError(
+                f"unknown sched_policy {sched_policy!r} (have {POLICIES})"
+            )
+        if prefill_chunk is not None and int(prefill_chunk) < 1:
+            # Explicit nonsense raises UNCONDITIONALLY (whatever policy
+            # ends up selected, env-injected included) — the explicit-
+            # args-raise half of the knob contract.
+            raise ValueError(
+                f"prefill_chunk must be >= 1 token, got {prefill_chunk}"
+            )
+        chunk_tokens = (
+            resilience.env_int(ENV_PREFILL_CHUNK, DEFAULT_PREFILL_CHUNK,
+                               event="prefill_chunk_invalid",
+                               server=self._label)
+            if prefill_chunk is None else int(prefill_chunk)
+        )
+        if chunk_tokens < 1:
+            # A node-injected nonsense value (parseable but < 1 token)
+            # degrades to the default chunk — it must not disable a
+            # policy the guest explicitly asked for, nor crash it.
+            obs.emit(
+                "serving", "prefill_chunk_invalid",
+                server=self._label, reason=f"bad_env:{chunk_tokens}",
+            )
+            chunk_tokens = DEFAULT_PREFILL_CHUNK
+        slo_ms = (
+            resilience.env_float(ENV_ITL_SLO_MS, DEFAULT_ITL_SLO_MS,
+                                 event="itl_slo_invalid",
+                                 server=self._label)
+            if itl_slo_ms is None else float(itl_slo_ms)
+        )
+        if sched_policy == POLICY_SLO:
+            # Chunk resume rides the plain prefill_suffix branch: the
+            # ring/cycle folds re-layout rows per slot, and a draft arena
+            # has no chunk-resume mirror — same fallback set as the
+            # prefix store (docs/guest_guide.md "Scheduling & SLOs").
+            reason = None
+            if ring_kv:
+                reason = "ring_kv"
+            elif draft is not None or speculative_k:
+                reason = "speculative"
+            if reason is not None:
+                if explicit_sched:
+                    raise ValueError(
+                        "sched_policy='slo_chunked' is incompatible with "
+                        f"this server ({reason}) — see 'Scheduling & "
+                        "SLOs' in docs/guest_guide.md"
+                    )
+                obs.emit(
+                    "serving", "sched_disabled",
+                    server=self._label, reason=reason,
+                )
+                sched_policy = POLICY_FIFO
+        self._sched = make_scheduler(
+            sched_policy, chunk_tokens=chunk_tokens, slo_ms=slo_ms,
+            # The round→per-token normalizer: slo_ms is a PER-TOKEN
+            # deadline (the decode_token_s unit), rounds deliver ``chunk``
+            # tokens per lane.
+            decode_steps=chunk, label=self._label,
+        )
+        self._partial: Optional[_PartialPrefill] = None
         # Recovery supervisor (ISSUE 7). Every knob defaults through the
         # daemon env-injection path and degrades on malformed values —
         # node-wide chaos/cadence knobs must never crash a guest. With
@@ -844,6 +1038,9 @@ class GenerationServer:
         self._c_recover = _ctr_recoveries().labels(server=self._label)
         self._c_quarantine = _ctr_quarantined().labels(server=self._label)
         self._c_stall = _ctr_stalls().labels(server=self._label)
+        self._c_sched_chunk = _ctr_sched_chunks().labels(server=self._label)
+        self._c_sched_defer = _ctr_sched_defers().labels(server=self._label)
+        self._c_slo = _ctr_slo_violations().labels(server=self._label)
 
     def _pool_conflict(self, pool_tokens: int, ring_kv: bool, draft,
                        speculative_k: int, mesh,
@@ -1067,6 +1264,12 @@ class GenerationServer:
             "preempted_waiting": len(self._preempted) if self.paged else 0,
             "cow_copies": self._cow_copies,
         })
+        # Scheduler fields (ISSUE 8): ALWAYS present — fifo_batch reports
+        # policy name + zeros — so dashboards need no schema branch.
+        # sched_queue_delay_s is the submit→admission-grant summary (the
+        # TTFT component the scheduler controls); sched_chunks/defers and
+        # slo_violations mirror the _total prometheus counters.
+        out.update(self._sched.stats())
         # Resilience fields (ISSUE 7): ALWAYS present — zeros on a server
         # that never failed — so dashboards need no schema branch.
         out.update({
@@ -1219,7 +1422,7 @@ class GenerationServer:
             "serving.prefill",
             server=self._label, rid=req.rid, slot=b,
             prompt_len=true_len, padded_len=len(prompt), tokens=true_len,
-        ):
+        ) as sp:
             caches, last_logits, pos = prefill(
                 self.params, jnp.asarray(prompt)[None, :], self.cfg,
                 cache_len, return_logits=True, kv_quantized=self.kv_quant,
@@ -1236,6 +1439,7 @@ class GenerationServer:
                 )
             first = self._sample_first(last_logits)
         t_first = time.monotonic()  # the int() above fenced the forward
+        self._sched.note_prefill(len(prompt), sp.duration_s)
         self._inj.fire("admission_commit")
         if self.paged:
             self._paged_commit(b, req, caches, 0)
@@ -1326,7 +1530,7 @@ class GenerationServer:
             server=self._label, rid=req.rid, slot=b,
             prompt_len=n, reused=m, suffix_len=s_len,
             padded_len=len(suffix), tokens=s_len,
-        ):
+        ) as sp:
             self._inj.fire("store_gather")
             caches = self.prefix_store.materialize(hit, self.max_len)
             caches, last_logits, _pos = prefill_suffix(
@@ -1335,6 +1539,7 @@ class GenerationServer:
             )
             first = self._sample_first(last_logits)
         t_first = time.monotonic()  # the int() above fenced the forward
+        self._sched.note_prefill(len(suffix), sp.duration_s)
         self._inj.fire("admission_commit")
         if self.paged:
             self._paged_commit(b, req, caches, 0)
@@ -1394,7 +1599,7 @@ class GenerationServer:
             server=self._label, n=n, reused=m, padded_len=pad_len,
             tokens=int(true_lens.sum()),
             rids=[req.rid for req, _ in pairs], slots=list(slots),
-        ):
+        ) as sp:
             self._inj.fire("store_gather")
             caches = self.prefix_store.materialize(
                 pairs[0][1], self.max_len, n=n
@@ -1413,6 +1618,7 @@ class GenerationServer:
             else:
                 firsts = np.asarray(jnp.argmax(last_logits, axis=-1))  # jaxguard: allow(JG101) admission host read — sanctioned sync
         t_first = time.monotonic()  # the firsts transfer fenced the forward
+        self._sched.note_prefill(n * pad_len, sp.duration_s)
         self._inj.fire("admission_commit")
         if self.paged:
             self._paged_commit_batch(slots, [req for req, _ in pairs],
@@ -1453,7 +1659,7 @@ class GenerationServer:
             server=self._label, n=n, padded_len=pad_len,
             tokens=int(true_lens.sum()),
             rids=[r.rid for r in reqs], slots=list(slots),
-        ):
+        ) as sp:
             caches, last_logits, pos = prefill_batch(
                 self.params, jnp.asarray(prompts), self.cfg, self.max_len,
                 jnp.asarray(true_lens), kv_quantized=self.kv_quant,
@@ -1467,6 +1673,7 @@ class GenerationServer:
             else:
                 firsts = np.asarray(jnp.argmax(last_logits, axis=-1))  # jaxguard: allow(JG101) admission host read — sanctioned sync
         t_first = time.monotonic()  # the firsts transfer fenced the forward
+        self._sched.note_prefill(n * pad_len, sp.duration_s)
         self._inj.fire("admission_commit")
         if self.paged:
             self._paged_commit_batch(slots, reqs, caches)
@@ -1501,7 +1708,24 @@ class GenerationServer:
             self._admit_unguarded()
 
     def _admit_unguarded(self) -> None:
+        # Chunks already run THIS pass: the one-chunk-per-decode-round
+        # budget must hold across partials too (a partial completing and
+        # the next one starting in the same pass share the budget —
+        # without this, back-to-back long prompts would stall one round
+        # with two slices).
+        pass_chunks = 0
         while True:
+            # A CHUNKED admission in progress (ISSUE 8) is strictly
+            # head-of-line: advance it before anything else admits or
+            # resumes. Under SLO pressure it runs one chunk and yields the
+            # pass back to decode; otherwise it completes here and the
+            # loop continues to further admissions. Started work, so it
+            # advances through a drain too (like preempted resumes).
+            if self._partial is not None:
+                done, pass_chunks = self._advance_partial(pass_chunks)
+                if not done:
+                    return
+                continue
             free = [
                 b for b in range(self.max_batch) if self._slot_req[b] is None
             ]
@@ -1538,6 +1762,19 @@ class GenerationServer:
                 return
             if self._draining and not self._queue[0].replays:
                 return
+            # SLO-aware deferral (ISSUE 8): consult the policy BEFORE the
+            # admission pass. Under projected-ITL pressure the queue head
+            # starts a CHUNKED admission instead of a whole prefill —
+            # head-of-line, so FIFO is preserved by construction (nothing
+            # admits past it until its chunks complete above).
+            directive = self._sched.directive(
+                live_lanes=sum(r is not None for r in self._slot_req),
+                pending_tokens=self._cold_cost(self._queue[0]),
+            )
+            if not directive.admit:
+                if not self._start_partial():
+                    return  # paged reservation failed: head-of-line wait
+                continue  # the partial branch runs this pass's chunk
             # The admitted set this pass: the FIFO prefix that fits the
             # free lanes AND (paged) whose block reservations succeed —
             # the first request the pool cannot hold stops admission
@@ -1585,6 +1822,9 @@ class GenerationServer:
                     break
                 self._count_prefix(hit)
                 self._queue.popleft()
+                self._sched.note_queue_delay(
+                    time.monotonic() - req.t_submit
+                )
                 take.append((req, hit))
             if not take:
                 return
@@ -1643,6 +1883,172 @@ class GenerationServer:
                         self._fill_slot(next(it), req, bucket)
             self._admitting = []
             self._admit_current = []
+
+    # ----- chunked prefill (ISSUE 8) ---------------------------------------
+
+    def _cold_cost(self, req: _Request) -> int:
+        """The padded prefill tokens a whole cold admission of ``req``
+        would run — the scheduler's projection input. Deliberately the
+        COLD cost even when a prefix hit would shrink it: the lookup pins
+        state, so it runs only once the admission path is chosen, and an
+        overestimate merely chunks an admission whose first slice then
+        completes it."""
+        n = len(req.prompt)
+        bucket = next((k for k in self.prefill_buckets if k >= n), None)
+        return bucket or n
+
+    def _start_partial(self) -> bool:
+        """Begin a CHUNKED admission of the queue head: prefix lookup and
+        paged block reservation exactly like the normal pass (same unwind
+        rules), then park the request as the in-progress partial —
+        :meth:`_advance_partial` runs its chunk forwards. False when the
+        paged reservation failed (the head re-offers when the pool
+        drains; the lookup is fully unwound first)."""
+        req = self._queue[0]
+        self._admit_current = [req]
+        hit = self._prefix_lookup_raw(req)
+        try:
+            reserved = (not self.paged
+                        or self._reserve_lane_blocks(req, hit))
+        except BaseException:
+            if self.prefix_store is not None:
+                self.prefix_store.unlookup(hit)
+            raise
+        self._admit_current = []
+        if not reserved:
+            if self.prefix_store is not None:
+                self.prefix_store.unlookup(hit)
+            return False
+        self._count_prefix(hit)
+        self._queue.popleft()
+        self._sched.note_queue_delay(time.monotonic() - req.t_submit)
+        # In _admitting from this moment: in neither the queue nor a lane,
+        # so a mid-chunk crash must find it here to replay it (ISSUE 7).
+        self._admitting = [(req, hit)]
+        if hit is not None:
+            self._inj.fire("store_gather")
+            caches = self.prefix_store.materialize(hit, self.max_len)
+            offset = hit.length
+        else:
+            caches = init_kv_caches(
+                self.cfg, 1, self.max_len, quantized=self.kv_quant
+            )
+            offset = 0
+        self._partial = _PartialPrefill(
+            req=req, hit=hit, caches=caches, offset=offset, reused=offset
+        )
+        return True
+
+    def _advance_partial(self, ran: int = 0) -> tuple[bool, int]:
+        """Advance the in-progress chunked admission. While the policy
+        defers (projected ITL over the SLO) it runs AT MOST ONE chunk per
+        pass — ``ran`` carries chunks the pass already spent (a previous
+        partial's), so the per-round prefill budget holds across
+        back-to-back admissions; once the pressure clears (or the final
+        slice is reached) it runs the rest to completion. Returns
+        ``(completed, ran')``: completed=True when the admission landed in
+        a lane (the caller loops for more admissions), False when this
+        pass's chunk budget is spent."""
+        while True:
+            p = self._partial
+            remaining = len(p.req.prompt) - p.offset
+            d = self._sched.directive(
+                live_lanes=sum(r is not None for r in self._slot_req),
+                pending_tokens=remaining, partial=True,
+            )
+            if not d.admit:
+                if ran:
+                    return False, ran  # one chunk per decode dispatch
+                self._sched.defers += 1
+                self._c_sched_defer.inc()
+                obs.emit(
+                    "serving", "sched_defer",
+                    server=self._label, rid=p.req.rid, offset=p.offset,
+                    remaining=remaining, queued=len(self._queue),
+                    projected_itl_ms=d.projected_itl_ms,
+                    slo_ms=self._sched.slo_ms,
+                )
+            done = self._prefill_one_chunk(p)
+            ran += 1
+            if done:
+                return True, ran
+
+    def _prefill_one_chunk(self, p: _PartialPrefill) -> bool:
+        """One ``prefill_chunk``-token slice of a chunked admission: a
+        ``prefill_suffix`` forward at the partial's offset over its own
+        standalone caches (the PR 5 resume machinery — traced offset and
+        true_len, so ONE suffix executable of the chunk's width serves
+        every chunk at every offset). Intermediate slices fence before
+        returning (the round budget is WALL time — an unfenced dispatch
+        would just move the stall to the next decode fence); the final
+        slice samples the first token and lands the admission through the
+        shared commit + epilogue, bit-identical to the unchunked path
+        (tested). Slices are all width ``chunk_tokens`` (the final one
+        right-padded + true_len-masked) except near the arena end, where
+        padding would spill past ``max_len`` and the slice falls back to
+        exact width. True when the admission completed."""
+        req = p.req
+        n = len(req.prompt)
+        c = self._sched.chunk_tokens
+        take = min(c, n - p.offset)
+        width = c if p.offset + c <= self.max_len else take
+        suffix = req.prompt[p.offset:p.offset + take]
+        if width > take:
+            suffix = np.pad(suffix, (0, width - take))
+        last = p.offset + take >= n
+        # Blast-radius attribution: a fault in this chunk implicates only
+        # this request (stays set through the raise; _recover reads it).
+        self._admit_current = [req]
+        self._inj.fire("sched_tick")
+        self._inj.fire("prefill")
+        with obs.span(
+            "serving.prefill_chunk",
+            server=self._label, rid=req.rid, offset=p.offset,
+            chunk_len=take, padded_len=width, tokens=take,
+        ) as sp:
+            caches, last_logits, _pos = prefill_suffix(
+                self.params, jnp.asarray(suffix)[None, :], self.cfg,
+                p.caches, jnp.int32(p.offset), return_logits=True,
+                true_len=jnp.int32(take),
+            )
+            if last:
+                first = self._sample_first(last_logits)
+            else:
+                self._fence_wait(
+                    lambda: jax.block_until_ready(last_logits),
+                    seam="fence", inject=False,
+                )
+        p.caches = caches
+        p.offset += take
+        p.chunks += 1
+        self._sched.chunks += 1
+        self._c_sched_chunk.inc()
+        self._sched.note_prefill(width, sp.duration_s)
+        if not last:
+            self._admit_current = []
+            return False
+        t_first = time.monotonic()  # the sample's int() fenced the forward
+        self._inj.fire("admission_commit")
+        # Lane free by construction: one existed when the partial started
+        # and nothing fills lanes while it is head-of-line.
+        b = next(
+            i for i in range(self.max_batch) if self._slot_req[i] is None
+        )
+        if self.paged:
+            self._paged_commit(b, req, p.caches, 0)
+        else:
+            self.arena = _write_slot(self.arena, p.caches, b)
+        if self.prefix_store is not None:
+            # Same DEEPEN-on-completion contract as the suffix fill path:
+            # the caches now hold the whole prompt's KV.
+            self.prefix_store.insert(req.prompt, p.caches, 0)
+        self._partial = None
+        self._finish_admission(
+            b, req, first, n, t_first, hit=p.hit,
+            prefix_reused=p.reused, chunked=p.chunks,
+        )
+        self._admit_current = []
+        return True
 
     def _maybe_finish(self, b: int, new_tokens: list) -> None:
         req = self._slot_req[b]
@@ -2240,6 +2646,10 @@ class GenerationServer:
         self._slot_req = [None] * self.max_batch
         self._inflight = None
         self._fresh_rows.clear()
+        # A half-built chunked admission's caches are device state from
+        # the failed round — discard; its request is in the lost set (it
+        # rides _admitting) and replays from the prompt.
+        self._partial = None
         self._admitting = []
         self._admit_current = []
 
@@ -2308,6 +2718,21 @@ class GenerationServer:
         )
         self._drain_done = True
 
+    def _note_round(self, dur_s: float, busy: int) -> None:
+        """Feed one decode-round cadence to the scheduler's estimator; an
+        SLO-violating round (slo_chunked only) counts and events — the
+        measured ground truth the deadline-driven admission steers by."""
+        if self._sched.note_round(dur_s):
+            self._c_slo.inc()
+            obs.emit(
+                "serving", "slo_violation",
+                server=self._label, round_s=round(dur_s, 6),
+                # The per-token figure actually compared to slo_ms (the
+                # round cadence over its delivered steps).
+                itl_s=round(dur_s / self.chunk, 6),
+                slo_ms=self._sched.slo_ms, slots_busy=busy,
+            )
+
     def _fence_wait(self, wait, seam: str = "fence", inject: bool = True):
         """Route one blocking device→host wait through the watchdog
         fence (:func:`.resilience.fence_with_timeout`): the injector's
@@ -2354,8 +2779,10 @@ class GenerationServer:
         self._fresh_rows.clear()  # lock-step dispatch reads host rows
         active = [b for b in range(self.max_batch) if self._slot_req[b] is not None]
         if not active:
-            return bool(self._queue) or bool(
-                self.paged and self._preempted
+            return (
+                bool(self._queue)
+                or self._partial is not None
+                or bool(self.paged and self._preempted)
             )
 
         if self.speculative_k:
@@ -2408,6 +2835,7 @@ class GenerationServer:
         tok_lat = sp.duration_s / self.chunk
         self._tok_lat.observe(tok_lat)
         self._h_tok_lat.observe(tok_lat)
+        self._note_round(sp.duration_s, len(active))
         # np.array (not asarray): device arrays convert read-only, and
         # _fill_slot writes these rows in place on refill.
         self._last = np.array(last)  # jaxguard: allow(JG101) lock-step fence (writable host copy for refill)
@@ -2460,6 +2888,7 @@ class GenerationServer:
         return (
             self._inflight is not None
             or bool(self._queue)
+            or self._partial is not None
             or any(r is not None for r in self._slot_req)
             or bool(self.paged and self._preempted)
         )
@@ -2546,6 +2975,10 @@ class GenerationServer:
         tok_lat = round_s / self.chunk
         self._tok_lat.observe(tok_lat)
         self._h_tok_lat.observe(tok_lat)
+        # Retire cadence is the ITL ground truth under pipelining: an
+        # admission that stole host time between retires shows up here —
+        # exactly what the SLO projection must learn.
+        self._note_round(round_s, len(fl.slots))
         self._rounds += 1
         for b, req in fl.slots:
             if self._slot_req[b] is not req:

@@ -1134,6 +1134,16 @@ def prefill_suffix(params: Params, suffix: jax.Array, cfg: DecoderConfig,
     :func:`prefill`; for greedy decoding the resulting token stream is
     identical to the cold path (tested in ``tests/test_prefix_cache.py``).
 
+    CHAINABLE: because ``caches`` only needs rows ``[0, offset)`` resident
+    and the returned caches hold rows ``[0, offset + true_len)``, suffix
+    prefills COMPOSE — calling again at ``offset + true_len`` with the
+    next slice of the prompt resumes exactly where the last call stopped.
+    That is the chunked-prefill contract the SLO-aware admission scheduler
+    rides (``guest/scheduler.py``): a prompt split into fixed-width slices
+    re-enters here per slice, and the final caches/logits — hence the
+    greedy token stream — match the single-call prefill of the whole
+    prompt (tested in ``tests/test_scheduler.py``).
+
     ``offset`` and ``true_len`` are TRACED — one executable per suffix
     SHAPE (bucket), never per prefix length. ``true_len`` supports
     right-padded suffixes the same way :func:`prefill` does: logits are

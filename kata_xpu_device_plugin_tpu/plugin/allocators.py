@@ -47,6 +47,9 @@ class TpuAllocator:
         kv_pool_tokens: int = 0,
         checkpoint_rounds: int = 0,
         fault_schedule: str = "",
+        sched_policy: str = "",
+        prefill_chunk: int = 0,
+        itl_slo_ms: float = 0.0,
     ):
         self._inventory = inventory
         self._vendor = vendor
@@ -74,6 +77,14 @@ class TpuAllocator:
         # passes nothing explicit.
         self._checkpoint_rounds = int(checkpoint_rounds)
         self._fault_schedule = str(fault_schedule)
+        # SLO-aware admission scheduling (ISSUE 8, config.sched_policy /
+        # prefill_chunk / itl_slo_ms): same delivery path — in-guest
+        # servers read KATA_TPU_SCHED_POLICY / KATA_TPU_PREFILL_CHUNK /
+        # KATA_TPU_ITL_SLO_MS when the caller passes nothing explicit;
+        # unknown/incompatible values degrade in-guest with an event.
+        self._sched_policy = str(sched_policy)
+        self._prefill_chunk = int(prefill_chunk)
+        self._itl_slo_ms = float(itl_slo_ms)
         # Driver-level liveness check supplied by the manager
         # (``manager.tpu_chip_alive``: node_alive over the same
         # dev+driver-state pair health watches); bare existence would hand a
@@ -135,6 +146,12 @@ class TpuAllocator:
             resp.envs[C.ENV_CHECKPOINT_ROUNDS] = str(self._checkpoint_rounds)
         if self._fault_schedule:
             resp.envs[C.ENV_FAULT_SCHEDULE] = self._fault_schedule
+        if self._sched_policy:
+            resp.envs[C.ENV_SCHED_POLICY] = self._sched_policy
+        if self._prefill_chunk > 0:
+            resp.envs[C.ENV_PREFILL_CHUNK] = str(self._prefill_chunk)
+        if self._itl_slo_ms > 0:
+            resp.envs[C.ENV_ITL_SLO_MS] = str(self._itl_slo_ms)
         return resp
 
     def preferred(

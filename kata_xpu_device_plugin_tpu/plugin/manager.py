@@ -402,6 +402,9 @@ class PluginManager:
                 kv_pool_tokens=cfg.kv_pool_tokens,
                 checkpoint_rounds=cfg.checkpoint_rounds,
                 fault_schedule=cfg.faults,
+                sched_policy=cfg.sched_policy,
+                prefill_chunk=cfg.prefill_chunk,
+                itl_slo_ms=cfg.itl_slo_ms,
             ),
             socket_dir=cfg.kubelet_socket_dir,
             kubelet_socket=cfg.kubelet_socket,

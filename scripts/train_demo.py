@@ -88,7 +88,8 @@ served_params = quantize_decoder_params(
 # 5. serve: continuous batching + speculative decoding + int8 KV arena
 prompts = [corpus[i * 7 : i * 7 + 5 + i] for i in range(5)]
 outs = serve_batch(served_params, cfg, prompts, max_new_tokens=16,
-                   max_batch=2, max_len=64, speculative_k=3, kv_quant=True)
+                   max_batch=2, max_len=64, speculative_k=3,
+                   spec_opt_in=True, kv_quant=True)
 print(f"served {len(outs)} requests through 2 slots; "
       f"first output: {outs[0].tolist()}")
 

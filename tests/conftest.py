@@ -23,6 +23,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Speculative serving is opt-in (ISSUE 8 satellite: a net loss at the
+# measured draft acceptance, demoted behind KATA_TPU_SPEC=1 with a
+# spec_disabled degrade). The suite opts in globally so the still-supported
+# speculative path keeps its coverage; the tests that pin the DEFAULT
+# degrade behavior monkeypatch this env off explicitly.
+os.environ.setdefault("KATA_TPU_SPEC", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -231,6 +231,66 @@ def test_rolling_summary_quantiles():
     assert obs.Rolling().summary() == {"count": 0}
 
 
+# Percentile EDGES (ISSUE 8 satellite): the latency-under-load bench
+# section reports TTFT/ITL p50/p99 straight from these summaries, so the
+# estimator's boundary behavior is now a consumed contract, not an
+# implementation detail.
+
+
+def test_rolling_empty_window_summary_and_quantiles():
+    r = obs.Rolling()
+    # Empty: the summary is the {"count": 0} sentinel (no fake zeros a
+    # dashboard could mistake for a measured latency)...
+    assert r.summary() == {"count": 0}
+    # ...and the raw quantile helper answers 0.0 rather than raising.
+    assert r._quantile(0.5) == 0.0
+    assert r._quantile(0.99) == 0.0
+
+
+def test_rolling_single_sample_all_quantiles_collapse():
+    r = obs.Rolling()
+    r.observe(0.25)
+    s = r.summary()
+    assert s["count"] == 1
+    # One sample IS every order statistic.
+    assert (s["min"] == s["max"] == s["mean"]
+            == s["p50"] == s["p95"] == s["p99"] == 0.25)
+
+
+def test_rolling_exact_quantile_boundaries():
+    # Pin the nearest-rank rule on exactly-hit boundaries:
+    # idx = min(n-1, int(q*(n-1) + 0.5)) over the SORTED window.
+    r = obs.Rolling(keep=100)
+    for v in range(1, 101):  # 1..100 — value = rank + 1 at 0-based idx
+        r.observe(float(v))
+    s = r.summary()
+    # q*(n-1) lands exactly on 49.5 for p50 → rounds to idx 50 → value 51.
+    assert s["p50"] == 51.0
+    # p95: int(0.95*99 + 0.5) = int(94.55) = 94 → value 95.
+    assert s["p95"] == 95.0
+    # p99: int(0.99*99 + 0.5) = int(98.51) = 98 → value 99 (NOT the max —
+    # the rank rule never extrapolates past the window).
+    assert s["p99"] == 99.0
+    # Two samples: p50 rounds UP to the larger (idx min(1, int(1.0)) = 1).
+    r2 = obs.Rolling()
+    r2.observe(1.0)
+    r2.observe(2.0)
+    assert r2.summary()["p50"] == 2.0
+
+
+def test_rolling_window_eviction_keeps_cumulative_count():
+    # The reservoir is bounded (recent-window quantiles) while count/mean
+    # stay cumulative — the stats() contract serving documents.
+    r = obs.Rolling(keep=4)
+    for v in (100.0, 100.0, 1.0, 2.0, 3.0, 4.0):
+        r.observe(v)
+    s = r.summary()
+    assert s["count"] == 6          # cumulative
+    assert s["max"] == 100.0        # cumulative extrema survive eviction
+    assert s["p99"] == 4.0          # quantiles see only the kept window
+    assert s["p50"] == 3.0          # sorted window [1,2,3,4] → idx 2
+
+
 # ----- trainer emission ------------------------------------------------------
 
 

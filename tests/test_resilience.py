@@ -257,4 +257,4 @@ def test_seams_cover_the_documented_surface():
     # docs/resilience.md documents exactly these; a drifted set is a doc
     # bug or a silent loss of chaos coverage.
     assert SEAMS == ("decode_dispatch", "prefill", "admission_commit",
-                     "fence", "pool_alloc", "store_gather")
+                     "fence", "pool_alloc", "store_gather", "sched_tick")

@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-watch chaos eval demo dryrun image clean deploy obs-check
+.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-watch chaos tp eval demo dryrun image clean deploy obs-check
 
 all: build
 
@@ -85,6 +85,7 @@ bench:
 bench-smoke:
 	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=bench_smoke_events.jsonl \
 	KATA_TPU_COMPILE_CACHE_DIR=$${KATA_TPU_COMPILE_CACHE_DIR:-.cache/xla-compile} \
+	XLA_FLAGS="$${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
 	  $(PY) bench.py --smoke
 
 # Latency-under-load sweep alone (ISSUE 8): the serving_load_* section —
@@ -98,7 +99,7 @@ bench-load:
 	KATA_TPU_COMPILE_CACHE_DIR=$${KATA_TPU_COMPILE_CACHE_DIR:-.cache/xla-compile} \
 	KATA_TPU_BENCH_INT8=0 KATA_TPU_BENCH_SERVING=0 KATA_TPU_BENCH_SOFTCAP=0 \
 	KATA_TPU_BENCH_TRAIN=0 KATA_TPU_BENCH_PREFIX=0 KATA_TPU_BENCH_PAGED=0 \
-	KATA_TPU_BENCH_FAULTS=0 KATA_TPU_BENCH_SPEC=0 \
+	KATA_TPU_BENCH_FAULTS=0 KATA_TPU_BENCH_SPEC=0 KATA_TPU_BENCH_TP=0 \
 	  $(PY) bench.py --smoke
 
 # Chaos gate (ISSUE 7): the serving test subset under a FIXED seeded
@@ -125,6 +126,21 @@ chaos:
 	KATA_TPU_FAULTS_SEED=13 KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_recovery.py tests/test_serving.py \
 	    tests/test_serving_pipeline.py tests/test_scheduler.py -q
+
+# Tensor-parallel serving gate (ISSUE 9): the tp suite — topology-env →
+# guest-mesh round trip, the tp=N ≡ tp=1 greedy-identity matrix
+# (paged/slotted × overlap × prefix-hit), crash recovery over a sharded
+# pool, the raise-vs-degrade knob contract — on the virtual 8-device CPU
+# host, with and without KATA_TPU_STRICT=1 (the sharded decode window
+# must stay transfer-guard-clean too).
+tp:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=tp_events.jsonl \
+	  $(PY) -m pytest tests/test_tp_serving.py -q
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=tp_events_strict.jsonl \
+	KATA_TPU_STRICT=1 \
+	  $(PY) -m pytest tests/test_tp_serving.py -q
 
 # Opportunistic TPU bench: probe the tunnel every few minutes and run the
 # full bench on the first healthy probe, banking a dated committed JSON

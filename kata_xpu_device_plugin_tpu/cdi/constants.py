@@ -83,6 +83,15 @@ ENV_CHECKPOINT_ROUNDS = "KATA_TPU_CHECKPOINT_ROUNDS"
 # (guest/resilience.py FaultInjector.from_env; malformed entries degrade).
 ENV_FAULT_SCHEDULE = "KATA_TPU_FAULTS"
 
+# Tensor-parallel serving degree handed to the guest (ISSUE 9):
+# guest.serving.GenerationServer reads this when the caller passes no
+# explicit tp — the daemon's --serving-tp knob overrides the topology-
+# derived default (TPU_VISIBLE_CHIPS / TPU_ACCELERATOR_TYPE chip count)
+# so a node can pin single-chip serving (1) or a sub-slice degree.
+# Malformed or infeasible values degrade in-guest with a tp_disabled
+# event (guest/tp_serving.py).
+ENV_SERVING_TP = "KATA_TPU_TP"
+
 # SLO-aware admission scheduling handed to the guest (ISSUE 8):
 # guest.serving.GenerationServer reads these when the caller passes no
 # explicit scheduler args — policy ("fifo_batch" | "slo_chunked"; unknown

@@ -146,6 +146,17 @@ class Config:
     prefill_chunk: int = 0
     itl_slo_ms: float = 0.0
 
+    # Tensor-parallel serving degree (ISSUE 9): when > 0, the daemon
+    # injects KATA_TPU_TP into every TPU AllocateResponse so in-guest
+    # GenerationServers override their topology-derived default
+    # (guest/tp_serving.py meshes the granted TPU_VISIBLE_CHIPS slice by
+    # default) — pin 1 to force single-chip serving node-wide, or a
+    # sub-slice degree for guests that co-locate several servers on one
+    # allocation. Same delivery path as the compile/prefix/pool knobs;
+    # infeasible values degrade in-guest with a tp_disabled event.
+    # 0 leaves the guest default (mesh the whole granted slice).
+    serving_tp: int = 0
+
     # Kubelet registration retry policy (ISSUE 7 satellite): attempts ×
     # exponential backoff (plus jitter) before a plugin gives up with a
     # registration_exhausted event. The old hardcoded 5 × 1 s ladder gave
@@ -179,6 +190,10 @@ class Config:
         if self.itl_slo_ms < 0:
             raise ValueError(
                 f"itl-slo-ms must be >= 0, got {self.itl_slo_ms}"
+            )
+        if self.serving_tp < 0:
+            raise ValueError(
+                f"serving-tp must be >= 0, got {self.serving_tp}"
             )
         if self.register_attempts < 1:
             raise ValueError(

@@ -61,6 +61,26 @@ survives. ``ring_kv`` and draft-model servers fall back to cold admission
 (the ring/cycle folds re-layout prefix rows per slot and the draft arena
 would miss its own prefix — explicitly unsupported for now).
 
+TENSOR-PARALLEL SERVING (:mod:`.tp_serving`, ``tp=N``): one server runs
+this whole loop — overlap, paged arena, prefix cache, scheduler, crash
+recovery — over a 1×N ICI mesh built from the daemon-injected topology
+env (``KATA_TPU_TP`` override → ``TPU_VISIBLE_CHIPS`` →
+``TPU_ACCELERATOR_TYPE``). Params shard by the serving regex rules
+(``parallel.sharding.SERVING_RULES`` — embeddings replicated, attention
+heads and MLP column/row split over the ``model`` axis), the KV arena /
+paged pool / prefix store shard their head axis, and GSPMD inserts the
+tp collectives inside the SAME jitted prefill/decode executables — the
+host scheduling loop is untouched, its ``last``/``pos``/block-table
+inputs replicate into each dispatch with no resharding step on the
+decode hot path. Greedy outputs are BIT-IDENTICAL to ``tp=1`` (tested
+across paged/slotted × overlap × strict × prefix-hit and under seeded
+fault schedules): sharding a matmul's non-contraction axis computes the
+identical values, and the one psum per layer pair is the same fp32 sum
+— exact wherever the backend's matmul accumulation is tiling-invariant
+(the fp32 CI matrix; bf16 on XLA CPU retiles the accumulation per
+output width, which can flip greedy near-ties in the last rounding bit
+— see "Tensor-parallel serving" in docs/guest_guide.md).
+
 CRASH-TOLERANT SERVING (:mod:`.resilience`): a recovery SUPERVISOR wraps
 every scheduler round. A recoverable dispatch failure (injected fault,
 watchdog stall, transient XLA status — :func:`.resilience.recoverable`)
@@ -112,7 +132,7 @@ from ..models.transformer import (
     prefill_suffix,
     ring_caches_from_prefill,
 )
-from . import resilience
+from . import resilience, tp_serving
 from .kv_arena import (
     RESERVED_BLOCKS,
     SCRATCH_BLOCK,
@@ -174,7 +194,21 @@ _PROM_STATS = (
     ("sched_chunks", "Chunked-prefill slices run by the admission scheduler"),
     ("sched_defers", "Admission passes deferred to decode under SLO pressure"),
     ("slo_violations", "Decode rounds whose cadence exceeded the ITL SLO"),
+    ("tp_degree", "Tensor-parallel degree of the serving mesh (1 = unsharded)"),
 )
+
+
+# Per-shard paged-pool occupancy (ISSUE 9): one gauge per mesh shard so
+# dashboards see the sharded pool without a schema branch (shard 0 reports
+# 0.0 on tp=1 / slotted servers — same always-present contract as the
+# stats() field it mirrors).
+def _gauge_shard_occupancy():
+    return obs.gauge(
+        "kata_tpu_serving_kv_pool_shard_occupancy",
+        "Paged KV pool fill per tensor-parallel mesh shard "
+        "(0.0 at tp=1 or on slotted servers)",
+        ["server", "shard"],
+    )
 
 
 # Prefix-cache traffic counters (ISSUE 5): true Prometheus counters (the
@@ -549,6 +583,18 @@ class GenerationServer:
     — ``speculative_k`` alone degrades to plain decoding with a
     ``spec_disabled`` event (the measured A/B is a net loss at 0.178
     draft acceptance; see the module constant).
+
+    TENSOR PARALLELISM (ISSUE 9, ``docs/guest_guide.md`` "Tensor-parallel
+    serving"): ``tp=N`` serves over a 1×N ICI mesh
+    (:mod:`.tp_serving`) — params by the serving regex rules, KV
+    arena/pool/prefix-store head-sharded. ``None`` (default) resolves the
+    daemon-injected topology env (``KATA_TPU_TP`` → ``TPU_VISIBLE_CHIPS``
+    → ``TPU_ACCELERATOR_TYPE`` → 1); env-derived conflicts (``ring_kv``,
+    speculative, more chips than devices) DEGRADE to single-chip serving
+    with a ``tp_disabled`` event, while an explicit ``tp=`` argument
+    raises. Mutually exclusive with ``mesh=`` (which keeps its
+    training-layout sharding). Greedy outputs are bit-identical to
+    ``tp=1``.
     """
 
     def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
@@ -571,7 +617,8 @@ class GenerationServer:
                  sched_policy: Optional[str] = None,
                  prefill_chunk: Optional[int] = None,
                  itl_slo_ms: Optional[float] = None,
-                 spec_opt_in: Optional[bool] = None):
+                 spec_opt_in: Optional[bool] = None,
+                 tp: Optional[int] = None):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
@@ -834,6 +881,67 @@ class GenerationServer:
         self._drain_done = False
         self._drain_announced = False
         self._drain_reason = ""
+        # Tensor-parallel serving over the ICI slice (ISSUE 9,
+        # guest/tp_serving.py): ``tp=N`` shards params (SERVING_RULES —
+        # embeddings replicated, attention/MLP column/row over the model
+        # axis), the KV arena OR paged pool, the prefix store, and every
+        # decode/prefill executable over a 1×N mesh built from the first N
+        # devices. ``None`` resolves the daemon-injected topology env
+        # (KATA_TPU_TP override → TPU_VISIBLE_CHIPS → TPU_ACCELERATOR_TYPE
+        # → 1); env-derived conflicts DEGRADE to tp=1 with a ``tp_disabled``
+        # event while an explicit argument raises — the pool/prefix knob
+        # contract. ``mesh=`` keeps its training-layout sharding path for
+        # callers that bring their own mesh; the two are mutually
+        # exclusive.
+        explicit_tp = tp is not None
+        if tp is not None:
+            tp = int(tp)
+            if tp < 1:
+                raise ValueError(f"tp must be >= 1, got {tp}")
+            if mesh is not None:
+                raise ValueError(
+                    "pass tp= OR mesh=, not both — tp builds its own 1×N "
+                    "serving mesh (guest/tp_serving.py)"
+                )
+        elif mesh is None:
+            tp = tp_serving.tp_from_env(label=self._label)
+        else:
+            tp = 1
+        if tp > 1:
+            reason = None
+            if ring_kv:
+                # The ring/cycle folds re-layout rows per slot and the
+                # draft arena is a second cache the serving specs do not
+                # cover — same fallback set as the prefix store/pool
+                # (docs/guest_guide.md "Tensor-parallel serving").
+                reason = "ring_kv"
+            elif self.speculative_k or self.draft is not None:
+                reason = "speculative"
+            elif tp > jax.device_count():
+                reason = f"insufficient_devices:{jax.device_count()}"
+            if reason is not None:
+                if explicit_tp:
+                    raise ValueError(
+                        f"tp={tp} is incompatible with this server "
+                        f"({reason}) — see 'Tensor-parallel serving' in "
+                        "docs/guest_guide.md"
+                    )
+                obs.emit(
+                    "serving", "tp_disabled",
+                    server=self._label, reason=reason, tp=tp,
+                )
+                tp = 1
+        self._tp = tp
+        if tp > 1:
+            mesh = tp_serving.serving_mesh(tp)
+        elif mesh is not None:
+            from ..parallel.mesh import AXIS_MODEL
+
+            self._tp = mesh.shape.get(AXIS_MODEL, 1)
+        # tp-path params shard by the serving regex rules (embeddings
+        # replicated); an explicitly injected mesh keeps the training
+        # PARAM_RULES layout callers already rely on.
+        self._tp_serving_rules = tp > 1
         self._mesh = mesh
         # Paged KV pool (ISSUE 6): one block pool shared by all in-flight
         # requests replaces the fixed [max_batch, max_len] slot grid —
@@ -859,8 +967,7 @@ class GenerationServer:
                 kv_pool_tokens = 0
         if kv_pool_tokens > 0:
             reason = self._pool_conflict(
-                kv_pool_tokens, ring_kv, draft, speculative_k, mesh,
-                prefix_store,
+                kv_pool_tokens, ring_kv, draft, speculative_k, prefix_store,
             )
             if reason is not None:
                 if explicit_pool:
@@ -907,7 +1014,7 @@ class GenerationServer:
             self.arena = init_kv_caches(
                 cfg, max_batch, arena_len, quantized=kv_quant
             )
-        if mesh is not None and not self.paged:
+        if mesh is not None:
             self._shard_over(mesh)
         # Host-side slot state: which request occupies each slot, its
         # absolute position (next cache write index), and its last token.
@@ -1024,6 +1131,15 @@ class GenerationServer:
                     cfg, prefix_cache_tokens, self.prefill_buckets,
                     kv_quant=kv_quant, label=self._label,
                 )
+        if (self._mesh is not None and prefix_store is None
+                and isinstance(self.prefix_store, PrefixStore)):
+            # Shard the owned standalone store's arena like the serving
+            # arena (same [.., KV, D] head axis), so a prefix hit's gather
+            # → materialize → suffix prefill stays device-resident on the
+            # mesh with no resharding step. (A paged tier lives inside the
+            # already-placed pool; an INJECTED store keeps its caller's
+            # placement — it may back single-chip servers too.)
+            self._place_store(self._mesh)
 
     def _bind_histograms(self) -> None:
         self._h_ttft = _hist_ttft().labels(server=self._label)
@@ -1043,24 +1159,22 @@ class GenerationServer:
         self._c_slo = _ctr_slo_violations().labels(server=self._label)
 
     def _pool_conflict(self, pool_tokens: int, ring_kv: bool, draft,
-                       speculative_k: int, mesh,
-                       prefix_store) -> Optional[str]:
+                       speculative_k: int, prefix_store) -> Optional[str]:
         """Why this server cannot run paged — None when it can. The paged
         path shares the dense ragged-decode numerics but not the ring/
         cycle folds (block gather would re-layout the band), the draft
         arena (a second pool), speculative verification (multi-token
-        spans), mesh sharding (the pool is single-chip for now), or an
-        injected separate-arena PrefixStore (the pool-backed tier is the
-        prefix path here). Documented as the compatibility matrix in
-        docs/guest_guide.md."""
+        spans), or an injected separate-arena PrefixStore (the pool-backed
+        tier is the prefix path here). A mesh — tensor-parallel serving —
+        is NOT a conflict anymore (ISSUE 9): the pool arena shards its KV
+        head axis like the dense arena, so paged × tp composes. Documented
+        as the compatibility matrix in docs/guest_guide.md."""
         if self.kv_block < 1:
             return f"bad_block_size:{self.kv_block}"
         if ring_kv:
             return "ring_kv"
         if draft is not None or speculative_k:
             return "speculative"
-        if mesh is not None:
-            return "mesh"
         if prefix_store is not None:
             return "injected_prefix_store"
         usable = pool_tokens // self.kv_block - RESERVED_BLOCKS
@@ -1072,53 +1186,72 @@ class GenerationServer:
 
     def _shard_over(self, mesh) -> None:
         """Tensor-parallel serving: place params by their layout-aware
-        PartitionSpecs (``parallel.sharding.param_specs`` — wide dims over
-        the model axis; GSPMD inserts the tp collectives inside the same
-        jitted prefill/decode executables) and shard the KV arena's head
-        axis over model when the head count divides; otherwise the arena
-        replicates (correct, memory-heavier). All serving layouts shard:
-        the training layout, fused wqkv/w_gateup, int8 QTensors (q and
-        scale consistently), and live LoRA adapters — so the production
-        shape (tp × fused × int8) runs on a slice without merging."""
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
+        PartitionSpecs — the serving regex rules
+        (``parallel.sharding.SERVING_RULES``: embeddings replicated,
+        attention/MLP column/row over model) on the ``tp=`` path, the
+        training ``param_specs`` for an explicitly injected ``mesh=`` —
+        GSPMD then inserts the tp collectives inside the same jitted
+        prefill/decode executables. The KV arena (or paged pool) shards
+        its head axis over model when the head count divides; otherwise
+        it replicates (correct, memory-heavier). All serving layouts
+        shard: the training layout, fused wqkv/w_gateup, int8 QTensors
+        (q and scale consistently), and live LoRA adapters — so the
+        production shape (tp × fused × int8) runs on a slice without
+        merging."""
+        from ..parallel.sharding import shard_params, shard_serving_params
 
-        from ..parallel.mesh import AXIS_MODEL
-        from ..parallel.sharding import shard_params
-
-        self.params = shard_params(self.params, mesh)
+        place = (
+            shard_serving_params if self._tp_serving_rules else shard_params
+        )
+        self.params = place(self.params, mesh)
         if self.draft is not None:
             d_params, d_cfg = self.draft
-            self.draft = (shard_params(d_params, mesh), d_cfg)
+            self.draft = (place(d_params, mesh), d_cfg)
         self._place_arenas(mesh)
 
-    def _place_arenas(self, mesh) -> None:
-        """Device placement of the KV arena(s) for tensor-parallel
-        serving — split from :meth:`_shard_over` so crash recovery can
-        re-place a freshly rebuilt arena without re-sharding params."""
+    def _place_store(self, mesh) -> None:
+        """Shard the standalone prefix store's arena over the mesh (the
+        KV head axis when it divides — :func:`.tp_serving.kv_cache_spec`,
+        the same spec every other KV layout uses)."""
         from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
+
+        sh = NamedSharding(
+            mesh, tp_serving.kv_cache_spec(self.cfg, self._tp)
+        )
+        self.prefix_store.arena = jax.tree.map(
+            lambda c: jax.device_put(c, sh), self.prefix_store.arena
+        )
+
+    def _place_arenas(self, mesh) -> None:
+        """Device placement of the KV arena(s) — the dense slot grid OR
+        the paged block pool — for tensor-parallel serving. Split from
+        :meth:`_shard_over` so crash recovery can re-place a freshly
+        rebuilt arena/pool without re-sharding params. The divide-or-
+        replicate decision lives in ONE place
+        (:func:`.tp_serving.kv_heads_shardable`, via the spec helpers),
+        shared with the spill-restore uploads."""
+        from jax.sharding import NamedSharding
 
         from ..parallel.mesh import AXIS_MODEL
 
         tp = mesh.shape.get(AXIS_MODEL, 1)
-        kv_spec = (
-            P(None, None, None, AXIS_MODEL, None)
-            if self.cfg.n_kv_heads % tp == 0
-            else P()
-        )
-        sh = NamedSharding(mesh, kv_spec)
-        self.arena = jax.tree.map(
-            lambda c: jax.device_put(c, sh), self.arena
-        )
+        sh = NamedSharding(mesh, tp_serving.kv_cache_spec(self.cfg, tp))
+        if self.paged:
+            # The pool IS the arena ([L, 1, NT, KV, D] leaves — the same
+            # head-axis position as the slot grid), so paged × tp shards
+            # the one structure every lane's table points into.
+            self.kv_pool.arena = jax.tree.map(
+                lambda c: jax.device_put(c, sh), self.kv_pool.arena
+            )
+        else:
+            self.arena = jax.tree.map(
+                lambda c: jax.device_put(c, sh), self.arena
+            )
         if self.draft is not None:
             _d_params, d_cfg = self.draft
-            d_spec = (
-                P(None, None, None, AXIS_MODEL, None)
-                if d_cfg.n_kv_heads % tp == 0  # jaxguard: allow(JG101) d_cfg is the host-side DecoderConfig (attr-taint false positive); reachable from step only via crash recovery — a scheduling slow path
-                else P()
+            d_sh = NamedSharding(
+                mesh, tp_serving.kv_cache_spec(d_cfg, tp)  # jaxguard: allow(JG101) d_cfg is the host-side DecoderConfig (attr-taint false positive); reachable from step only via crash recovery — a scheduling slow path
             )
-            d_sh = NamedSharding(mesh, d_spec)
             self.draft_arena = jax.tree.map(
                 lambda c: jax.device_put(c, d_sh), self.draft_arena
             )
@@ -1264,6 +1397,14 @@ class GenerationServer:
             "preempted_waiting": len(self._preempted) if self.paged else 0,
             "cow_copies": self._cow_copies,
         })
+        # Tensor-parallel fields (ISSUE 9): ALWAYS present — tp_degree 1
+        # and shard occupancies 0.0 on unsharded servers — so dashboards
+        # need no schema branch (same contract as the pool/scheduler/
+        # resilience blocks around this one).
+        out.update({
+            "tp_degree": self._tp,
+            "kv_pool_shard_occupancy": self._pool_shard_occupancy(),
+        })
         # Scheduler fields (ISSUE 8): ALWAYS present — fifo_batch reports
         # policy name + zeros — so dashboards need no schema branch.
         # sched_queue_delay_s is the submit→admission-grant summary (the
@@ -1310,6 +1451,18 @@ class GenerationServer:
             )
         return out
 
+    def _pool_shard_occupancy(self) -> list[float]:
+        """Per-mesh-shard paged-pool fill, one entry per tp shard. The
+        pool shards its KV HEAD axis, so every block spans all shards
+        and each shard's fill equals the logical occupancy today; the
+        field is per-shard anyway so dashboards keep working unchanged
+        if a future layout shards blocks across the mesh. ALWAYS a
+        length-``max(1, tp)`` list — zeros at tp=1 and on slotted
+        servers (no schema branch)."""
+        if self._tp <= 1 or not self.paged or self.kv_pool is None:
+            return [0.0] * max(1, self._tp)
+        return [self.kv_pool.occupancy()] * self._tp
+
     def _kv_slot_utilization(self) -> float:
         busy = [b for b in range(self.max_batch) if self._slot_req[b] is not None]
         if not busy:
@@ -1343,6 +1496,18 @@ class GenerationServer:
         for name, gauge in _prom_gauges().items():
             gauge.labels(server=self._label).set_function(
                 lambda self=self, n=name: float(self.stats().get(n, 0.0))
+            )
+        # Per-shard pool occupancy (ISSUE 9): one labeled child per mesh
+        # shard — shard 0 exists on every server (0.0 unsharded), so the
+        # scrape schema never branches on the tp degree.
+        def _shard_occ(self=self, i=0) -> float:
+            occ = self._pool_shard_occupancy()
+            return float(occ[i]) if i < len(occ) else 0.0
+
+        shard_gauge = _gauge_shard_occupancy()
+        for i in range(max(1, self._tp)):
+            shard_gauge.labels(server=self._label, shard=str(i)).set_function(
+                partial(_shard_occ, self, i)
             )
         if port:
             from ..utils.metrics import serve
@@ -2245,7 +2410,7 @@ class GenerationServer:
         full = np.full(self._nb_max, SCRATCH_BLOCK, np.int32)
         full[:nb] = blocks
         self.kv_pool.arena = pool_scatter_rows(
-            self.kv_pool.arena, jax.tree.map(jnp.asarray, pre.kv),
+            self.kv_pool.arena, self._kv_host_upload(pre.kv, paged_rows=True),
             jnp.asarray(full), block_size=self.kv_block,
         )
         self._set_lane_table(b, blocks)
@@ -2622,6 +2787,12 @@ class GenerationServer:
                     self.kv_pool, self.cfg, self.prefill_buckets,
                     label=self._label,
                 )
+            if self._mesh is not None:
+                # Tensor-parallel paged serving: the rebuilt pool must be
+                # re-placed with the same head-axis sharding the failed
+                # one had, so checkpointed lanes restore with identical
+                # sharding (ISSUE 9 satellite).
+                self._place_arenas(self._mesh)
         else:
             if self._cycle:
                 self.arena = init_cycle_kv_caches(
@@ -2653,6 +2824,25 @@ class GenerationServer:
         self._admitting = []
         self._admit_current = []
 
+    def _kv_host_upload(self, host_tree, paged_rows: bool):
+        """Upload spilled/checkpointed host KV rows back to device. With
+        a live mesh (tensor-parallel serving, ISSUE 9) the rows are
+        placed with the SAME head-axis sharding the pool/arena carries —
+        the restore half of the sanctioned ``allow_transfer`` slow path
+        gathers per-shard and re-lands per-shard, so recovered state has
+        identical sharding and greedy replay stays bit-identical.
+        ``paged_rows``: the full-table spill layout ``[L, NT, KV, D]``
+        (head axis 2) vs the slotted snapshot ``[L, 1, S, KV, D]`` (head
+        axis 3)."""
+        if self._mesh is None:
+            return jax.tree.map(jnp.asarray, host_tree)
+        from jax.sharding import NamedSharding
+
+        sh = NamedSharding(self._mesh, tp_serving.kv_rows_spec(
+            self.cfg, self._tp, head_axis=2 if paged_rows else 3
+        ))
+        return jax.tree.map(lambda a: jax.device_put(a, sh), host_tree)
+
     def _restore_lane(self, b: int, entry: _CkptEntry) -> bool:
         """Re-land one checkpointed request into lane ``b`` of the fresh
         device state: KV rows verbatim (the spill/restore pair), emitted
@@ -2670,13 +2860,15 @@ class GenerationServer:
             full = np.full(self._nb_max, SCRATCH_BLOCK, np.int32)
             full[:nb] = blocks
             self.kv_pool.arena = pool_scatter_rows(
-                self.kv_pool.arena, jax.tree.map(jnp.asarray, entry.kv),
+                self.kv_pool.arena,
+                self._kv_host_upload(entry.kv, paged_rows=True),
                 jnp.asarray(full), block_size=self.kv_block,
             )
             self._set_lane_table(b, blocks)
         else:
             self.arena = _write_slot(
-                self.arena, jax.tree.map(jnp.asarray, entry.kv), b
+                self.arena, self._kv_host_upload(entry.kv, paged_rows=False),
+                b,
             )
         req.out = list(entry.out)
         self._slot_req[b] = req

@@ -1,0 +1,176 @@
+"""Tensor-parallel serving over the ICI slice (ISSUE 9).
+
+The plugin hands Kata guests whole ICI-connected slices and emits the
+libtpu topology env (``topology/slice.py`` → CDI containerEdits /
+AllocateResponse); this module is the GUEST half of that contract for
+serving: it turns the injected topology into a 1×N device mesh so one
+:class:`.serving.GenerationServer` shards its params, KV pool, prefix
+store, and decode/prefill executables across every chip of the
+allocation instead of serving from one.
+
+Resolution ladder for the tensor-parallel degree (``tp_from_env``):
+
+1. ``KATA_TPU_TP`` — the explicit override the daemon's ``--serving-tp``
+   knob injects into the AllocateResponse env (``config.serving_tp``).
+   ``0``/``1`` pins single-chip serving; malformed values DEGRADE to the
+   derived default with a ``tp_disabled`` event (a node-wide knob must
+   never crash a guest — the pool/prefix/scheduler env contract).
+2. ``TPU_VISIBLE_CHIPS`` — the per-allocation chip list: its length IS
+   the slice the guest was granted.
+3. ``TPU_ACCELERATOR_TYPE`` — the static slice topology: the host-local
+   chip count of the advertised type.
+4. Neither present (CPU tests, non-TPU hosts): 1.
+
+A derived degree larger than what JAX actually exposes degrades to 1
+with an ``insufficient_devices`` event rather than failing mesh
+construction — the env describes the allocation, the backend describes
+reality, and serving must come up on whatever is real. On CPU,
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` stands in for the
+chips (the tier-1/`make tp` test harness), so the whole
+daemon-env → guest-mesh round trip is testable without hardware.
+
+The mesh itself (``serving_mesh``) is the standard ``data×fsdp×model``
+mesh with both data axes collapsed to 1 — every parallel rule in
+:mod:`..parallel.sharding` (the ``SERVING_RULES`` regex set, the KV
+head-axis specs) applies unchanged, and on hardware
+``mesh_utils.create_device_mesh`` maps the ``model`` axis onto ICI
+neighbors. Host-side scheduling state (``last``/``pos``, block tables)
+rides each dispatch as plain uncommitted host arrays exactly as in
+single-chip serving: GSPMD replicates them into the executable without a
+resharding step in the decode hot path (strict mode's transfer guard and
+jaxguard keep it that way).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from .. import obs
+
+# The daemon-injectable override (cdi.constants.ENV_SERVING_TP rides the
+# same AllocateResponse path as the pool/prefix/scheduler knobs).
+ENV_TP = "KATA_TPU_TP"
+
+
+def _topology_chips(env) -> int:
+    """Chip count the injected topology env describes (1 when absent)."""
+    raw = env.get("TPU_VISIBLE_CHIPS", "").strip()
+    if raw:
+        return len([c for c in raw.split(",") if c.strip()]) or 1
+    accel = env.get("TPU_ACCELERATOR_TYPE", "").strip()
+    if accel:
+        from ..topology.slice import HostTopology
+
+        try:
+            return HostTopology.from_accelerator_type(accel).local_chips
+        except ValueError:
+            return 1
+    return 1
+
+
+def tp_from_env(env: Optional[dict] = None, *, label: str = "",
+                device_count: Optional[int] = None) -> int:
+    """Resolve the serving tensor-parallel degree from the daemon-injected
+    env (see the module header's ladder). Always returns ``>= 1``; every
+    degrade emits one ``serving/tp_disabled`` event with a reason."""
+    env = os.environ if env is None else env
+    raw = env.get(ENV_TP, "").strip()
+    tp = None
+    if raw:
+        try:
+            tp = int(raw)
+        except ValueError:
+            obs.emit(
+                "serving", "tp_disabled",
+                server=label, reason=f"bad_env:{raw[:32]}",
+            )
+            tp = None
+        else:
+            if tp < 0:
+                obs.emit(
+                    "serving", "tp_disabled",
+                    server=label, reason=f"bad_env:{raw[:32]}",
+                )
+                tp = None
+            elif tp == 0:
+                tp = 1  # explicit off
+    if tp is None:
+        tp = _topology_chips(env)
+    if tp > 1:
+        if device_count is None:
+            import jax
+
+            device_count = jax.device_count()
+        if tp > device_count:
+            obs.emit(
+                "serving", "tp_disabled",
+                server=label, tp=tp,
+                reason=f"insufficient_devices:{device_count}",
+            )
+            tp = 1
+    return max(1, tp)
+
+
+def serving_mesh(tp: int, devices: Optional[Sequence] = None):
+    """The 1×N serving mesh: ``data=1, fsdp=1, model=tp`` over the first
+    ``tp`` devices. All of :mod:`..parallel.sharding`'s rules apply
+    unchanged (the collapsed data axes are size-1 no-ops), and on real
+    slices ``mesh_utils`` places the ``model`` axis on ICI neighbors."""
+    import jax
+
+    from ..parallel.mesh import (
+        AXIS_DATA,
+        AXIS_FSDP,
+        AXIS_MODEL,
+        build_mesh,
+    )
+
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < tp:
+        raise ValueError(
+            f"tp={tp} needs {tp} devices, have {len(devices)}"
+        )
+    return build_mesh(
+        {AXIS_DATA: 1, AXIS_FSDP: 1, AXIS_MODEL: tp}, devices=devices[:tp]
+    )
+
+
+def kv_heads_shardable(cfg, tp: int) -> bool:
+    """The ONE divide-or-replicate decision for serving KV state: the
+    head axis shards over ``model`` only when the KV head count divides
+    the degree (splitting a GQA group across shards would break its
+    structure; replication is correct, memory-heavier). Every KV
+    placement — arena, pool, prefix store, spill-restore uploads — must
+    route through this predicate so the layouts cannot drift apart."""
+    return tp > 1 and cfg.n_kv_heads % tp == 0
+
+
+def kv_cache_spec(cfg, tp: int):
+    """PartitionSpec for every serving KV ARENA layout — the dense slot
+    arena ``[L, B, S, KV, D]``, the paged pool ``[L, 1, NT, KV, D]`` and
+    the prefix-store arena share the head axis at position 3 (int8
+    ``QTensor`` scales carry the same leading axes) — sharded over
+    ``model`` per :func:`kv_heads_shardable`."""
+    from ..compat.jaxapi import P
+    from ..parallel.mesh import AXIS_MODEL
+
+    if kv_heads_shardable(cfg, tp):
+        return P(None, None, None, AXIS_MODEL, None)
+    return P()
+
+
+def kv_rows_spec(cfg, tp: int, head_axis: int):
+    """PartitionSpec for host-spill ROW layouts (checkpoint/preemption
+    restore uploads) whose KV head axis sits at ``head_axis`` — the
+    paged full-table spill ``[L, NT, KV, D]`` (axis 2) and the slotted
+    snapshot ``[L, 1, S, KV, D]`` (axis 3). Same
+    :func:`kv_heads_shardable` decision as the arenas they restore
+    into, so a restore never forces a resharding."""
+    from ..compat.jaxapi import P
+    from ..parallel.mesh import AXIS_MODEL
+
+    if kv_heads_shardable(cfg, tp):
+        return P(*([None] * head_axis), AXIS_MODEL, None)
+    return P()

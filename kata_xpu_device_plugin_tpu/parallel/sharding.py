@@ -9,10 +9,12 @@ ICI via XLA, not an NCCL port).
 """
 from __future__ import annotations
 
+import re
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ..compat.jaxapi import (
@@ -141,6 +143,94 @@ def param_specs(params: Any) -> Any:
         return _layout_spec(param_spec(path), value)
 
     return node(params, "")
+
+
+# ----- tensor-parallel SERVING rules (ISSUE 9) ------------------------------
+#
+# Regex → PartitionSpec rules for the in-guest serving mesh (a 1×N slice:
+# data=fsdp=1, model=tp — guest.tp_serving.serving_mesh). Distinct from
+# PARAM_RULES, which is the TRAINING layout: serving replicates the
+# embedding table (decode reads one row per token — sharding vocab would
+# turn every embed lookup and every unembed matmul into a collective on
+# the latency-critical decode step; at serving batch sizes the replicated
+# table is the cheaper trade) and keeps the classic Megatron column/row
+# split for the per-layer weights, so each decode layer inserts exactly
+# one psum (after wo, after w_down) and no resharding in between.
+#
+# Matching is `re.search` over the dotted param path, first rule wins —
+# the `match_partition_rules` pytree-regex pattern. The rules below cover
+# every family in models/ (Gemma/Gemma-2/Gemma-3 post-norms + qk_norm,
+# Llama-3, Mistral, Qwen2 qkv biases, Mixtral MoE) in the training layout
+# AND the inference layouts: fused wqkv/w_gateup concatenate their parts'
+# out axes, which GSPMD splits at arbitrary boundaries without changing
+# values; int8 QTensors and LoRA adapters expand through `_layout_spec`
+# exactly as in the training rules.
+SERVING_RULES: tuple[tuple[str, P], ...] = (
+    # Norms and the tiny per-head QK-norms replicate (covers attn_norm,
+    # mlp_norm, post_attn_norm, post_mlp_norm, q_norm, k_norm, final_norm).
+    (r"norm$", P(None)),
+    # Embeddings REPLICATED (see the header note); the tied/untied
+    # unembedding reads the same table, so logits need no psum.
+    (r"^(embed|unembed)$", P(None, None)),
+    # Attention: column-parallel q/k/v (+ fused wqkv, + Qwen2 biases along
+    # the same out axis), row-parallel output projection.
+    (r"layers\.(wq|wk|wv|wqkv)$", P(None, None, AXIS_MODEL)),
+    (r"layers\.(bq|bk|bv|bqkv)$", P(None, AXIS_MODEL)),
+    (r"layers\.wo$", P(None, AXIS_MODEL, None)),
+    # MLP: column-parallel gate/up (+ fused w_gateup), row-parallel down.
+    (r"layers\.(w_gate|w_up|w_gateup)$", P(None, None, AXIS_MODEL)),
+    (r"layers\.w_down$", P(None, AXIS_MODEL, None)),
+    # MoE: experts over the model axis (ep replaces tp in the FFN); the
+    # tiny router replicates.
+    (r"layers\.router$", P(None, None, None)),
+    (r"layers\.moe_w_(gate|in|out)$", P(None, AXIS_MODEL, None, None)),
+)
+
+
+def match_partition_rules(rules, params: Any) -> Any:
+    """PartitionSpec pytree for ``params`` from ``(regex, spec)`` rules.
+
+    The regex-pytree pattern: each leaf's dotted path (``layers.wqkv``) is
+    matched with ``re.search`` against the rules in order, first match
+    wins; scalar / single-element leaves replicate unconditionally; a
+    path no rule covers raises (a silently replicated 7B weight matrix
+    would defeat the point of the mesh). Inference wrappers (int8
+    ``QTensor``, ``LoRAWeight``) expand through the same
+    :func:`_layout_spec` as the training rules, so one rule per WEIGHT
+    covers every serving layout of it."""
+
+    def spec_for(path: str, value: Any) -> P:
+        shape = getattr(value, "shape", None)
+        if shape is not None and (len(shape) == 0 or int(np.prod(shape)) == 1):
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, path):
+                return spec
+        raise ValueError(f"no serving partition rule matches param {path!r}")
+
+    def node(value: Any, path: str) -> Any:
+        if isinstance(value, dict):
+            return {
+                k: node(v, f"{path}.{k}" if path else k)
+                for k, v in value.items()
+            }
+        return _layout_spec(spec_for(path, value), value)
+
+    return node(params, "")
+
+
+def serving_param_specs(params: Any) -> Any:
+    """:data:`SERVING_RULES` applied to ``params`` (any serving layout)."""
+    return match_partition_rules(SERVING_RULES, params)
+
+
+def shard_serving_params(params: Any, mesh: Mesh) -> Any:
+    """Place a param tree onto the serving mesh by :data:`SERVING_RULES`
+    (embeddings replicated, attention/MLP column/row over ``model``)."""
+    shardings = tree_map(
+        lambda spec: NamedSharding(mesh, spec), serving_param_specs(params)
+    )
+    return jax.device_put(params, shardings)
 
 
 def param_shardings(params: Any, mesh: Mesh) -> Any:

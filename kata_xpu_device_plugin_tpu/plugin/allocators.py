@@ -50,6 +50,7 @@ class TpuAllocator:
         sched_policy: str = "",
         prefill_chunk: int = 0,
         itl_slo_ms: float = 0.0,
+        serving_tp: int = 0,
     ):
         self._inventory = inventory
         self._vendor = vendor
@@ -85,6 +86,11 @@ class TpuAllocator:
         self._sched_policy = str(sched_policy)
         self._prefill_chunk = int(prefill_chunk)
         self._itl_slo_ms = float(itl_slo_ms)
+        # Tensor-parallel serving override (ISSUE 9, config.serving_tp):
+        # same delivery path — in-guest servers mesh the granted slice by
+        # default (guest/tp_serving.py derives the degree from
+        # TPU_VISIBLE_CHIPS); KATA_TPU_TP pins it node-wide.
+        self._serving_tp = int(serving_tp)
         # Driver-level liveness check supplied by the manager
         # (``manager.tpu_chip_alive``: node_alive over the same
         # dev+driver-state pair health watches); bare existence would hand a
@@ -152,6 +158,21 @@ class TpuAllocator:
             resp.envs[C.ENV_PREFILL_CHUNK] = str(self._prefill_chunk)
         if self._itl_slo_ms > 0:
             resp.envs[C.ENV_ITL_SLO_MS] = str(self._itl_slo_ms)
+        if self._serving_tp > 0:
+            resp.envs[C.ENV_SERVING_TP] = str(self._serving_tp)
+            if self._serving_tp > len(chips):
+                # The override exceeds what this allocation can mesh: the
+                # guest will degrade to tp=1 with a tp_disabled event
+                # (guest/tp_serving.py clamps to real devices) — flag the
+                # misconfiguration host-side too so the operator sees it
+                # before reading guest event streams.
+                LOG.warning(
+                    "serving-tp exceeds the granted chip count; guest "
+                    "will degrade to single-chip serving",
+                    extra=log.kv(
+                        serving_tp=self._serving_tp, chips=len(chips)
+                    ),
+                )
         return resp
 
     def preferred(
@@ -169,6 +190,18 @@ class TpuAllocator:
             LOG.warning(
                 "no ICI-contiguous placement possible",
                 extra=log.kv(available=",".join(available), size=size),
+            )
+        elif size not in topo_mod.guest_meshable_counts(inv.topology):
+            # Consistency half of the daemon↔guest topology contract
+            # (ISSUE 9): a contiguous hint whose size the guest cannot
+            # mesh as a 1×N slice would hand out ICI neighbors the
+            # serving mesh then can't use — by construction
+            # (family.subslices keys ARE the meshable counts) this never
+            # fires; the log is the tripwire if a family table drifts.
+            LOG.warning(
+                "contiguous placement size is not a guest-meshable "
+                "sub-slice",
+                extra=log.kv(size=size),
             )
         return [str(c) for c in placement.chips]
 

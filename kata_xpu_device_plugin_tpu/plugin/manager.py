@@ -405,6 +405,7 @@ class PluginManager:
                 sched_policy=cfg.sched_policy,
                 prefill_chunk=cfg.prefill_chunk,
                 itl_slo_ms=cfg.itl_slo_ms,
+                serving_tp=cfg.serving_tp,
             ),
             socket_dir=cfg.kubelet_socket_dir,
             kubelet_socket=cfg.kubelet_socket,

@@ -1,7 +1,13 @@
 """ICI slice topology model + topology-aware preferred allocation (the TPU
 analogue of the reference's IOMMU-group co-allocation unit; implements what
 ``GetPreferredAllocation`` stubs out at generic_device_plugin.go:378-386)."""
-from .preferred import Placement, alignment_score, chip_ids_to_indexes, choose_chips
+from .preferred import (
+    Placement,
+    alignment_score,
+    chip_ids_to_indexes,
+    choose_chips,
+    guest_meshable_counts,
+)
 from .slice import (
     FAMILIES,
     HostTopology,
@@ -18,6 +24,7 @@ __all__ = [
     "alignment_score",
     "chip_ids_to_indexes",
     "choose_chips",
+    "guest_meshable_counts",
     "FAMILIES",
     "HostTopology",
     "TpuFamily",

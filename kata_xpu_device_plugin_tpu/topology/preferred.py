@@ -99,6 +99,21 @@ def choose_chips(
     return Placement(chips=tuple(chosen), contiguous=False)
 
 
+def guest_meshable_counts(topo: HostTopology) -> list[int]:
+    """Chip counts a guest can bring up as a 1×N tensor-parallel serving
+    mesh from the env this host emits — exactly the requestable sub-slice
+    sizes. The allocation-hint half of the daemon↔guest topology
+    contract (ISSUE 9): every sub-slice shape in ``family.subslices`` is
+    an axis-aligned ICI box, so the contiguous placements
+    :func:`choose_chips` prefers are precisely the allocations
+    ``guest.tp_serving`` can mesh with the ``model`` axis riding ICI
+    neighbors. Consistency is asserted in ``tests/test_tp_serving.py``:
+    every contiguous preferred placement's size appears here, and every
+    count here round-trips ``topology.runtime_env`` →
+    ``tp_serving.tp_from_env`` → ``tp_serving.serving_mesh``."""
+    return topo.valid_request_counts()
+
+
 def chip_ids_to_indexes(ids: Iterable[str]) -> list[int]:
     """Device-plugin device ids are strings; chips are host-local ints."""
     return [int(i) for i in ids]

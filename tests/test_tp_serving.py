@@ -1,0 +1,566 @@
+"""Tensor-parallel serving over the ICI slice (ISSUE 9).
+
+Three contracts under test, on the virtual 8-device CPU host:
+
+1. The daemon↔guest topology handoff: ``topology.runtime_env`` emission
+   → ``guest.tp_serving.tp_from_env`` → ``serving_mesh`` round-trips for
+   every family × sub-slice shape; preferred-allocation hints are
+   guest-meshable; the ``KATA_TPU_TP`` override rides the allocator env
+   path and malformed values degrade with a ``tp_disabled`` event.
+2. The serving regex partition rules cover every model family in
+   ``models/`` in every serving layout (training, fused, int8, LoRA).
+3. Bit-identity — the only oracle that matters: ``GenerationServer
+   (tp=N)`` greedy outputs equal ``tp=1`` across paged/slotted × overlap
+   × prefix-hit × kv_quant, under preemption spills, and under a seeded
+   fault schedule with checkpointed recovery (strict mode rides the
+   ``make tp`` second pass via ``KATA_TPU_STRICT=1``).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu import obs
+from kata_xpu_device_plugin_tpu.guest import tp_serving
+from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import init_params
+from kata_xpu_device_plugin_tpu.parallel.mesh import AXIS_MODEL
+from kata_xpu_device_plugin_tpu.parallel.sharding import (
+    SERVING_RULES,
+    match_partition_rules,
+    serving_param_specs,
+)
+from kata_xpu_device_plugin_tpu.topology import (
+    FAMILIES,
+    HostTopology,
+    choose_chips,
+    guest_meshable_counts,
+    runtime_env,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _prompts(cfg, lengths, seed=1, shared=0):
+    key = jax.random.PRNGKey(seed)
+    head = np.asarray(
+        jax.random.randint(key, (shared,), 0, cfg.vocab_size), np.int32
+    ) if shared else np.zeros((0,), np.int32)
+    out = []
+    for i, n in enumerate(lengths):
+        tail = np.asarray(jax.random.randint(
+            jax.random.fold_in(key, i), (n,), 0, cfg.vocab_size
+        ), np.int32)
+        out.append(np.concatenate([head, tail]))
+    return out
+
+
+def _serve(params, cfg, prompts, budgets=8, **kw):
+    srv = GenerationServer(params, cfg, **kw)
+    if isinstance(budgets, int):
+        budgets = [budgets] * len(prompts)
+    rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+    res = srv.run()
+    return [res[r] for r in rids], srv
+
+
+def _capture_events(tmp_path, fn, name="ev.jsonl"):
+    sink = obs.EventSink(str(tmp_path / name))
+    prev = obs.set_default_sink(sink)
+    try:
+        result = fn()
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    return result, obs.read_events(str(tmp_path / name))
+
+
+# ----- topology env → tp degree → mesh -------------------------------------
+
+
+def test_tp_from_env_ladder(monkeypatch):
+    # Nothing injected: single-chip.
+    assert tp_serving.tp_from_env(env={}) == 1
+    # TPU_VISIBLE_CHIPS length is the default degree.
+    assert tp_serving.tp_from_env(env={"TPU_VISIBLE_CHIPS": "0,1,2,3"}) == 4
+    # Accelerator type falls back to the host-local chip count.
+    assert tp_serving.tp_from_env(
+        env={"TPU_ACCELERATOR_TYPE": "v5litepod-8"}
+    ) == 8
+    # KATA_TPU_TP overrides the derived degree; 0/1 pins single-chip.
+    env = {"TPU_VISIBLE_CHIPS": "0,1,2,3", "KATA_TPU_TP": "2"}
+    assert tp_serving.tp_from_env(env=env) == 2
+    assert tp_serving.tp_from_env(
+        env={**env, "KATA_TPU_TP": "1"}
+    ) == 1
+    assert tp_serving.tp_from_env(
+        env={**env, "KATA_TPU_TP": "0"}
+    ) == 1
+
+
+def test_tp_from_env_malformed_and_infeasible_degrade(tmp_path):
+    # Malformed override: degrade to the DERIVED degree with an event.
+    got, events = _capture_events(
+        tmp_path,
+        lambda: tp_serving.tp_from_env(
+            env={"TPU_VISIBLE_CHIPS": "0,1", "KATA_TPU_TP": "lots"},
+            label="s1",
+        ),
+    )
+    assert got == 2
+    evs = [e for e in events if e.get("name") == "tp_disabled"]
+    assert len(evs) == 1 and evs[0]["reason"].startswith("bad_env")
+    # More chips promised than devices visible: degrade to 1 with an event.
+    got, events = _capture_events(
+        tmp_path,
+        lambda: tp_serving.tp_from_env(
+            env={"KATA_TPU_TP": "64"}, label="s1",
+        ),
+        name="ev2.jsonl",
+    )
+    assert got == 1
+    evs = [e for e in events if e.get("name") == "tp_disabled"]
+    assert len(evs) == 1
+    assert evs[0]["reason"].startswith("insufficient_devices")
+
+
+def test_topology_env_roundtrip_every_family_subslice():
+    """The daemon↔guest contract: for every family × requestable
+    sub-slice, the exact env block ``topology.runtime_env`` emits
+    resolves to the granted chip count and brings up a mesh of exactly
+    that size (CPU devices standing in for the chips)."""
+    for fam in FAMILIES.values():
+        for count in sorted(fam.subslices):
+            if count > jax.device_count():
+                continue
+            suffix = count * 2 if fam.suffix_counts_cores else count
+            topo = HostTopology.from_accelerator_type(
+                f"{fam.name}-{suffix}"
+            )
+            env = runtime_env(topo, visible_chips=list(range(count)))
+            tp = tp_serving.tp_from_env(env=env)
+            assert tp == count, (fam.name, count)
+            mesh = tp_serving.serving_mesh(tp)
+            assert mesh.shape[AXIS_MODEL] == count
+            assert mesh.devices.size == count
+
+
+def test_serving_mesh_shape_and_validation():
+    mesh = tp_serving.serving_mesh(4)
+    assert mesh.shape[AXIS_MODEL] == 4
+    assert mesh.devices.size == 4
+    with pytest.raises(ValueError, match="tp must be"):
+        tp_serving.serving_mesh(0)
+    with pytest.raises(ValueError, match="devices"):
+        tp_serving.serving_mesh(1 + jax.device_count())
+
+
+def test_preferred_hints_are_guest_meshable():
+    """Allocation-hint consistency (ISSUE 9): every ICI-contiguous
+    placement GetPreferredAllocation can prefer has a size the guest can
+    mesh, and every meshable count yields a contiguous placement on an
+    empty host."""
+    for fam in FAMILIES.values():
+        suffix = (
+            fam.chips_per_host * 2 if fam.suffix_counts_cores
+            else fam.chips_per_host
+        )
+        topo = HostTopology.from_accelerator_type(f"{fam.name}-{suffix}")
+        meshable = guest_meshable_counts(topo)
+        assert meshable == topo.valid_request_counts()
+        available = list(range(fam.chips_per_host))
+        for count in meshable:
+            placement = choose_chips(topo, available, count)
+            assert placement.contiguous, (fam.name, count)
+            assert len(placement.chips) == count
+            # The guest can mesh exactly this grant (device count
+            # permitting on the CPU stand-in host).
+            if count <= jax.device_count():
+                assert tp_serving.serving_mesh(count).devices.size == count
+
+
+def test_allocator_injects_tp_env_and_config_validates():
+    from kata_xpu_device_plugin_tpu.cdi import constants as C
+    from kata_xpu_device_plugin_tpu.config import Config
+    from kata_xpu_device_plugin_tpu.discovery.tpu import (
+        TpuChip,
+        TpuInventory,
+    )
+    from kata_xpu_device_plugin_tpu.plugin import TpuAllocator
+
+    inv = TpuInventory(
+        chips=(TpuChip(index=0, dev_path="/dev/accel0"),
+               TpuChip(index=1, dev_path="/dev/accel1")),
+        topology=HostTopology.from_accelerator_type("v5litepod-8"),
+        model_suffix="TPU_V5E",
+    )
+    alive = lambda _chip: True  # noqa: E731 — no real /dev in this test
+    wired = TpuAllocator(
+        lambda: inv, "google.com", "tpu", revalidate=alive, serving_tp=2,
+    ).allocate(["0", "1"])
+    assert wired.envs[C.ENV_SERVING_TP] == "2"
+    bare = TpuAllocator(
+        lambda: inv, "google.com", "tpu", revalidate=alive
+    ).allocate(["0"])
+    assert C.ENV_SERVING_TP not in bare.envs
+    assert Config(serving_tp=4).serving_tp == 4
+    assert Config().serving_tp == 0
+    with pytest.raises(ValueError, match="serving-tp"):
+        Config(serving_tp=-1)
+
+
+# ----- partition rules over every family / layout ---------------------------
+
+
+def test_serving_rules_cover_every_model_family():
+    from kata_xpu_device_plugin_tpu.models import (
+        gemma2_test_config,
+        gemma3_test_config,
+        mistral_test_config,
+        mixtral_test_config,
+        qwen2_test_config,
+    )
+
+    for make in (tiny_test_config, gemma2_test_config, gemma3_test_config,
+                 mistral_test_config, qwen2_test_config,
+                 mixtral_test_config):
+        cfg = make()
+        shapes = jax.eval_shape(
+            lambda cfg=cfg: init_params(jax.random.PRNGKey(0), cfg)
+        )
+        specs = serving_param_specs(shapes)  # raises on any uncovered path
+        flat = dict(_walk(specs))
+        # Embeddings replicated, attention/MLP wide axes over model.
+        assert AXIS_MODEL not in _axes(flat["embed"])
+        assert AXIS_MODEL in _axes(flat["layers.wq"])
+        if "layers.w_down" in flat:
+            assert AXIS_MODEL in _axes(flat["layers.w_down"])
+        if "layers.moe_w_out" in flat:
+            assert AXIS_MODEL in _axes(flat["layers.moe_w_out"])
+
+
+def _walk(tree, prefix=""):
+    for k, v in tree.items():
+        path = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            yield from _walk(v, path)
+        else:
+            yield path, v
+
+
+def _axes(spec):
+    import itertools
+
+    def flat(entry):
+        return entry if isinstance(entry, tuple) else (entry,)
+
+    try:
+        parts = tuple(spec)
+    except TypeError:  # QTensor/LoRA wrapper: collect every inner spec
+        parts = tuple(itertools.chain.from_iterable(tuple(s) for s in spec))
+    return set(itertools.chain.from_iterable(flat(p) for p in parts))
+
+
+def test_serving_rules_cover_inference_layouts(model):
+    from kata_xpu_device_plugin_tpu.ops.lora import apply_lora
+    from kata_xpu_device_plugin_tpu.ops.quant import quantize_decoder_params
+    from kata_xpu_device_plugin_tpu.models.transformer import (
+        fuse_decoder_params,
+    )
+
+    cfg, params = model
+    for name, p in {
+        "fused": fuse_decoder_params(params),
+        "fused_int8": quantize_decoder_params(fuse_decoder_params(params)),
+        "lora": apply_lora(params, jax.random.PRNGKey(7), rank=2),
+    }.items():
+        specs = serving_param_specs(p)  # raises on any uncovered path
+        assert specs is not None, name
+
+
+def test_match_partition_rules_unmatched_raises():
+    with pytest.raises(ValueError, match="no serving partition rule"):
+        match_partition_rules(
+            SERVING_RULES, {"layers": {"w_mystery": np.zeros((2, 4))}}
+        )
+    # Scalars replicate without needing a rule.
+    specs = match_partition_rules(SERVING_RULES, {"t": np.zeros(())})
+    assert tuple(specs["t"]) == ()
+
+
+# ----- bit-identity: tp=N ≡ tp=1 -------------------------------------------
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_identity_slotted(model, tp):
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 9, 6], seed=6)
+    ref, _ = _serve(params, cfg, prompts, max_batch=2, max_len=32)
+    out, srv = _serve(params, cfg, prompts, max_batch=2, max_len=32, tp=tp)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    assert srv.stats()["tp_degree"] == tp
+
+
+def test_tp_identity_lockstep_and_kv_quant(model):
+    cfg, params = model
+    prompts = _prompts(cfg, [5, 7], seed=9)
+    for kw in ({"overlap": False}, {"kv_quant": True}):
+        ref, _ = _serve(params, cfg, prompts, max_batch=2, max_len=32, **kw)
+        out, _ = _serve(
+            params, cfg, prompts, max_batch=2, max_len=32, tp=2, **kw
+        )
+        for r, o in zip(ref, out):
+            np.testing.assert_array_equal(o, r, err_msg=str(kw))
+
+
+def test_tp_identity_paged_pool(model, tmp_path):
+    """The flipped matrix row: paged × tp serves (head-sharded pool), no
+    kv_pool_disabled event, greedy identical to the single-chip pool."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 9, 6, 8], seed=12)
+    kw = dict(max_batch=2, max_len=32, prefill_buckets=(16,),
+              kv_pool_tokens=512)
+    ref, ref_srv = _serve(params, cfg, prompts, **kw)
+    assert ref_srv.paged
+
+    def run_tp():
+        return _serve(params, cfg, prompts, tp=2, **kw)
+
+    (out, srv), events = _capture_events(tmp_path, run_tp)
+    assert srv.paged and srv.kv_pool is not None
+    assert not [e for e in events if e.get("name") == "kv_pool_disabled"]
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    st = srv.stats()
+    assert st["tp_degree"] == 2
+    assert len(st["kv_pool_shard_occupancy"]) == 2
+
+
+def test_legacy_mesh_kwarg_now_composes_with_pool(model):
+    from kata_xpu_device_plugin_tpu.parallel import build_mesh
+
+    cfg, params = model
+    mesh = build_mesh({"data": 2, "fsdp": 2, "model": 2})
+    prompts = _prompts(cfg, [4, 7], seed=13)
+    kw = dict(max_batch=2, max_len=32, prefill_buckets=(16,),
+              kv_pool_tokens=512)
+    ref, _ = _serve(params, cfg, prompts, **kw)
+    out, srv = _serve(params, cfg, prompts, mesh=mesh, **kw)
+    assert srv.paged  # was kv_pool_disabled(reason="mesh") before ISSUE 9
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+
+def test_tp_identity_prefix_hits(model):
+    """Prefix-store reuse at tp=2 (standalone store AND pool tier) stays
+    bit-identical to tp=1, with the second wave actually hitting."""
+    cfg, params = model
+    shared = _prompts(cfg, [6, 9, 5, 8], seed=21, shared=16)
+    for extra in ({"prefix_cache_tokens": 256},
+                  {"prefix_cache_tokens": 256, "kv_pool_tokens": 512}):
+        kw = dict(max_batch=2, max_len=48, prefill_buckets=(16, 32), **extra)
+        ref, ref_srv = _serve(params, cfg, shared, **kw)
+        out, srv = _serve(params, cfg, shared, tp=2, **kw)
+        assert srv.stats()["prefix_hits"] >= 1, extra
+        assert srv.stats()["prefix_hits"] == ref_srv.stats()["prefix_hits"]
+        for r, o in zip(ref, out):
+            np.testing.assert_array_equal(o, r, err_msg=str(extra))
+
+
+def test_tp_identity_slo_chunked_scheduler(model):
+    cfg, params = model
+    prompts = _prompts(cfg, [14, 15, 13], seed=23)
+    kw = dict(max_batch=2, max_len=48, prefill_buckets=(16,),
+              sched_policy="slo_chunked", prefill_chunk=4, itl_slo_ms=0.001)
+    ref, _ = _serve(params, cfg, prompts, **kw)
+    out, srv = _serve(params, cfg, prompts, tp=2, **kw)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+
+def test_tp_preemption_spill_restore_identity(model):
+    """Pool pressure at tp=2: the youngest lane spills (per-shard gather
+    through the sanctioned slow path), requeues FIFO, and restores with
+    identical sharding — outputs equal the unpressured tp=1 run."""
+    cfg, params = model
+    prompts = _prompts(cfg, [12, 12, 12], seed=31)
+    base = dict(max_batch=3, max_len=32, prefill_buckets=(16,),
+                kv_block_size=8)
+    ref, _ = _serve(params, cfg, prompts, kv_pool_tokens=1024, **base)
+    tight = 16 * 5  # holds ~1.5 requests: forces preemption under growth
+    out, srv = _serve(params, cfg, prompts, tp=2, kv_pool_tokens=tight,
+                      **base)
+    assert srv.stats()["preemptions"] >= 1
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+
+def test_tp_crash_recovery_identity(model):
+    """The seeded-fault acceptance criterion: a transient decode fault at
+    tp=2 over a sharded paged pool — with host checkpoints riding the
+    per-shard allow_transfer gather — recovers to outputs bit-identical
+    to a fault-free tp=1 run."""
+    from kata_xpu_device_plugin_tpu.guest.resilience import (
+        FaultInjector,
+        FaultSpec,
+    )
+
+    cfg, params = model
+    prompts = _prompts(cfg, [6, 9, 5], seed=41)
+    kw = dict(max_batch=2, max_len=48, prefill_buckets=(16,),
+              kv_pool_tokens=512, checkpoint_rounds=2,
+              recovery_backoff_s=0.0)
+    ref, _ = _serve(params, cfg, prompts, budgets=12, **kw)
+    for schedule in ([FaultSpec("decode_dispatch", 2)],
+                     [FaultSpec("prefill", 1)]):
+        srv = GenerationServer(
+            params, cfg, tp=2,
+            fault_injector=FaultInjector(schedule, seed=13), **kw,
+        )
+        rids = [srv.submit(p, 12) for p in prompts]
+        res = srv.run()
+        assert srv.stats()["recoveries"] >= 1, schedule
+        assert srv.stats()["tp_degree"] == 2
+        for r, rid in zip(ref, rids):
+            np.testing.assert_array_equal(res[rid], r, err_msg=str(schedule))
+
+
+def test_tp_slotted_checkpoint_recovery_identity(model):
+    from kata_xpu_device_plugin_tpu.guest.resilience import (
+        FaultInjector,
+        FaultSpec,
+    )
+
+    cfg, params = model
+    prompts = _prompts(cfg, [6, 9], seed=43)
+    kw = dict(max_batch=2, max_len=32, checkpoint_rounds=1,
+              recovery_backoff_s=0.0)
+    ref, _ = _serve(params, cfg, prompts, budgets=10, **kw)
+    srv = GenerationServer(
+        params, cfg, tp=2,
+        fault_injector=FaultInjector([FaultSpec("decode_dispatch", 1)],
+                                     seed=7), **kw,
+    )
+    rids = [srv.submit(p, 10) for p in prompts]
+    res = srv.run()
+    assert srv.stats()["recoveries"] >= 1
+    for r, rid in zip(ref, rids):
+        np.testing.assert_array_equal(res[rid], r)
+
+
+# ----- knob contract: raise vs degrade -------------------------------------
+
+
+def test_tp_incompatible_modes_raise_on_explicit_arg(model):
+    from kata_xpu_device_plugin_tpu.models import mistral_test_config
+
+    cfg, params = model
+    with pytest.raises(ValueError, match="speculative"):
+        GenerationServer(params, cfg, max_batch=2, max_len=32, tp=2,
+                         speculative_k=2, spec_opt_in=True)
+    mcfg = mistral_test_config(dtype=jnp.float32)
+    mparams = init_params(jax.random.PRNGKey(4), mcfg, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="ring_kv"):
+        GenerationServer(mparams, mcfg, max_batch=2, max_len=64, tp=2,
+                         ring_kv=True)
+    with pytest.raises(ValueError, match="tp must be"):
+        GenerationServer(params, cfg, max_batch=2, max_len=32, tp=0)
+    with pytest.raises(ValueError, match="not both"):
+        GenerationServer(params, cfg, max_batch=2, max_len=32, tp=2,
+                         mesh=tp_serving.serving_mesh(2))
+
+
+def test_tp_env_incompatible_modes_degrade_with_event(model, monkeypatch,
+                                                      tmp_path):
+    from kata_xpu_device_plugin_tpu.models import mistral_test_config
+
+    cfg, params = model
+    mcfg = mistral_test_config(dtype=jnp.float32)
+    mparams = init_params(jax.random.PRNGKey(4), mcfg, dtype=jnp.float32)
+    monkeypatch.setenv("KATA_TPU_TP", "2")
+
+    srv, events = _capture_events(
+        tmp_path,
+        lambda: GenerationServer(mparams, mcfg, max_batch=2, max_len=64,
+                                 ring_kv=True),
+    )
+    assert srv._tp == 1 and srv._mesh is None
+    evs = [e for e in events if e.get("name") == "tp_disabled"]
+    assert len(evs) == 1 and evs[0]["reason"] == "ring_kv"
+
+    srv, events = _capture_events(
+        tmp_path,
+        lambda: GenerationServer(params, cfg, max_batch=2, max_len=32,
+                                 speculative_k=2, spec_opt_in=True),
+        name="ev2.jsonl",
+    )
+    assert srv._tp == 1 and srv._mesh is None
+    evs = [e for e in events if e.get("name") == "tp_disabled"]
+    assert len(evs) == 1 and evs[0]["reason"] == "speculative"
+    # The degraded server still serves correctly single-chip.
+    prompts = _prompts(cfg, [4, 6], seed=51)
+    ref, _ = _serve(params, cfg, prompts, max_batch=2, max_len=32)
+    monkeypatch.setenv("KATA_TPU_TP", "not-a-number")
+    out, srv = _serve(params, cfg, prompts, max_batch=2, max_len=32)
+    assert srv._tp == 1
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+
+def test_tp_env_default_builds_mesh(model, monkeypatch):
+    """A daemon-injected KATA_TPU_TP (no constructor arg) shards the
+    server — the node-wide knob actually reaches serving — and outputs
+    stay identical."""
+    cfg, params = model
+    prompts = _prompts(cfg, [5, 7], seed=61)
+    ref, _ = _serve(params, cfg, prompts, max_batch=2, max_len=32)
+    monkeypatch.setenv("KATA_TPU_TP", "2")
+    out, srv = _serve(params, cfg, prompts, max_batch=2, max_len=32)
+    assert srv._tp == 2 and srv._mesh is not None
+    assert srv._mesh.shape[AXIS_MODEL] == 2
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+
+# ----- stats / metrics schema ----------------------------------------------
+
+
+def test_tp_stats_schema_no_branch(model):
+    cfg, params = model
+    plain = GenerationServer(params, cfg, max_batch=2, max_len=32)
+    st = plain.stats()
+    assert st["tp_degree"] == 1
+    assert st["kv_pool_shard_occupancy"] == [0.0]
+    sharded = GenerationServer(params, cfg, max_batch=2, max_len=32, tp=2,
+                               prefill_buckets=(16,), kv_pool_tokens=512)
+    st = sharded.stats()
+    assert st["tp_degree"] == 2
+    assert len(st["kv_pool_shard_occupancy"]) == 2
+    # arena_bytes stays the real per-shard-summed figure (replicated KV
+    # under a non-dividing head count costs tp × the logical bytes).
+    assert st["arena_bytes"] > 0
+
+
+def test_tp_shard_gauges_exported(model):
+    from prometheus_client import REGISTRY, generate_latest
+
+    cfg, params = model
+    srv = GenerationServer(params, cfg, max_batch=2, max_len=32, tp=2,
+                           prefill_buckets=(16,), kv_pool_tokens=512)
+    lbl = srv.export_metrics()
+    (p,) = _prompts(cfg, [5], seed=71)
+    srv.submit(p, 6)
+    srv.run()
+    text = generate_latest(REGISTRY).decode()
+    assert f'kata_tpu_serving_tp_degree{{server="{lbl}"}} 2.0' in text
+    assert (f'kata_tpu_serving_kv_pool_shard_occupancy'
+            f'{{server="{lbl}",shard="0"}}') in text
+    assert (f'kata_tpu_serving_kv_pool_shard_occupancy'
+            f'{{server="{lbl}",shard="1"}}') in text

@@ -100,6 +100,7 @@ bench-load:
 	KATA_TPU_BENCH_INT8=0 KATA_TPU_BENCH_SERVING=0 KATA_TPU_BENCH_SOFTCAP=0 \
 	KATA_TPU_BENCH_TRAIN=0 KATA_TPU_BENCH_PREFIX=0 KATA_TPU_BENCH_PAGED=0 \
 	KATA_TPU_BENCH_FAULTS=0 KATA_TPU_BENCH_SPEC=0 KATA_TPU_BENCH_TP=0 \
+	KATA_TPU_BENCH_DEGRADED=0 \
 	  $(PY) bench.py --smoke
 
 # Chaos gate (ISSUE 7): the serving test subset under a FIXED seeded
@@ -126,6 +127,21 @@ chaos:
 	KATA_TPU_FAULTS_SEED=13 KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_recovery.py tests/test_serving.py \
 	    tests/test_serving_pipeline.py tests/test_scheduler.py -q
+	# Chip-loss schedule at tp=4 (ISSUE 10): the degraded-mode suite under
+	# the PERMANENT fault kinds — the tp=4 server must shrink to tp=2
+	# mid-run, finish the burst bit-identically, and the daemon half
+	# (quarantine events, allocation-journal reconcile) must stay green —
+	# with and without KATA_TPU_STRICT=1 (the shrink's re-shard path runs
+	# under allow_transfer and must stay transfer-guard-clean).
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_chiploss_events.jsonl \
+	KATA_TPU_FAULTS="decode_dispatch:3:chip_loss:1" KATA_TPU_FAULTS_SEED=13 \
+	  $(PY) -m pytest tests/test_degraded.py -q
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_chiploss_events_strict.jsonl \
+	KATA_TPU_FAULTS="decode_dispatch:3:chip_loss:1" KATA_TPU_FAULTS_SEED=13 \
+	KATA_TPU_STRICT=1 \
+	  $(PY) -m pytest tests/test_degraded.py -q
 
 # Tensor-parallel serving gate (ISSUE 9): the tp suite — topology-env →
 # guest-mesh round trip, the tp=N ≡ tp=1 greedy-identity matrix

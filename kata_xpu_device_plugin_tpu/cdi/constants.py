@@ -92,6 +92,14 @@ ENV_FAULT_SCHEDULE = "KATA_TPU_FAULTS"
 # event (guest/tp_serving.py).
 ENV_SERVING_TP = "KATA_TPU_TP"
 
+# Floor of the degraded-mode mesh-shrink ladder handed to the guest
+# (ISSUE 10): after a permanent chip fault the in-guest server halves
+# its tensor-parallel degree over the surviving chips but never below
+# this (guest/tp_serving.py shrink_ladder; docs/resilience.md "Degraded
+# mode"). Malformed values degrade in-guest with a tp_min_invalid event.
+# The guest-side kill switch KATA_TPU_DEGRADED=0 is env-only.
+ENV_SERVING_TP_MIN = "KATA_TPU_TP_MIN"
+
 # SLO-aware admission scheduling handed to the guest (ISSUE 8):
 # guest.serving.GenerationServer reads these when the caller passes no
 # explicit scheduler args — policy ("fifo_batch" | "slo_chunked"; unknown

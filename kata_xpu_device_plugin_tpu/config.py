@@ -157,6 +157,15 @@ class Config:
     # 0 leaves the guest default (mesh the whole granted slice).
     serving_tp: int = 0
 
+    # Degraded-mode shrink floor (ISSUE 10): when > 0, the daemon injects
+    # KATA_TPU_TP_MIN into every TPU AllocateResponse so in-guest
+    # GenerationServers stop their elastic mesh-shrink ladder (chip loss
+    # at tp=4 → 2 → 1) at this degree — below it the load fails loudly
+    # instead of continuing degraded. Same delivery path as the other
+    # serving knobs; malformed values degrade in-guest with a
+    # tp_min_invalid event. 0 leaves the guest default (shrink to 1).
+    serving_tp_min: int = 0
+
     # Kubelet registration retry policy (ISSUE 7 satellite): attempts ×
     # exponential backoff (plus jitter) before a plugin gives up with a
     # registration_exhausted event. The old hardcoded 5 × 1 s ladder gave
@@ -194,6 +203,15 @@ class Config:
         if self.serving_tp < 0:
             raise ValueError(
                 f"serving-tp must be >= 0, got {self.serving_tp}"
+            )
+        if self.serving_tp_min < 0:
+            raise ValueError(
+                f"serving-tp-min must be >= 0, got {self.serving_tp_min}"
+            )
+        if self.serving_tp and self.serving_tp_min > self.serving_tp:
+            raise ValueError(
+                f"serving-tp-min {self.serving_tp_min} exceeds serving-tp "
+                f"{self.serving_tp} — the shrink ladder could never start"
             )
         if self.register_attempts < 1:
             raise ValueError(

@@ -7,7 +7,7 @@ from .prefix_cache import PrefixStore, RadixIndex
 from .probe import probe_all_reduce, probe_compute, probe_devices, run_ladder
 from .scheduler import Scheduler, SLOChunkedScheduler, make_scheduler
 from .serving import GenerationServer, serve_batch
-from .tp_serving import serving_mesh, tp_from_env
+from .tp_serving import serving_mesh, shrink_ladder, tp_from_env
 
 __all__ = [
     "GenerationServer",
@@ -26,5 +26,6 @@ __all__ = [
     "probe_devices",
     "run_ladder",
     "serving_mesh",
+    "shrink_ladder",
     "tp_from_env",
 ]

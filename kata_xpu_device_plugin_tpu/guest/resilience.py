@@ -11,9 +11,11 @@ module supplies both halves:
   (``decode_dispatch``, ``prefill``, ``admission_commit``, ``fence``,
   ``pool_alloc``, ``store_gather``, ``sched_tick``). A schedule is a
   comma-separated
-  ``<seam>:<round>[:<kind>]`` list (``KATA_TPU_FAULTS`` env), where
-  ``round`` is the seam's 0-based invocation count and ``kind`` is one
-  of ``raise-transient`` (default), ``raise-oom``, ``hang``. Each entry
+  ``<seam>:<round>[:<kind>[:<device>]]`` list (``KATA_TPU_FAULTS`` env),
+  where ``round`` is the seam's 0-based invocation count and ``kind`` is
+  one of ``raise-transient`` (default), ``raise-oom``, ``hang``, or the
+  permanent kinds ``chip_loss`` (fourth field: the lost chip's
+  serving-mesh device index) and ``ici_error``. Each entry
   fires exactly once, so a chaos run is REPLAYABLE: the same schedule
   against the same workload produces the same fault sequence (tested),
   which is what lets the recovery supervisor's bit-identity claim be a
@@ -26,10 +28,15 @@ module supplies both halves:
   thread and a ``device_stall`` event + :class:`DeviceStallError` replace
   the infinite hang. With the deadline unset (the default) it calls the
   wait inline — zero threads, zero new syncs on the hot path.
-- :func:`recoverable` — the supervisor's catch predicate: injected
+- :func:`recoverable` / :func:`classify` — the supervisor's catch
+  predicate and its TRANSIENT-vs-PERMANENT split (ISSUE 10): injected
   faults, stalls, and XLA runtime errors whose status markers indicate a
-  transient device condition. Everything else (assertion errors, strict-
-  mode transfer-guard trips, user bugs) propagates unchanged.
+  transient device condition replay through the existing rebuild path;
+  permanent faults (``chip_loss:<device_index>``, ``ici_error``, and XLA
+  errors carrying a permanent-device marker) route to elastic mesh-shrink
+  recovery instead — a dead chip does not come back on retry. Everything
+  else (assertion errors, strict-mode transfer-guard trips, user bugs)
+  propagates unchanged.
 - :func:`wire_drain` — graceful-drain wiring: SIGTERM and/or a
   maintenance-notice file watch (``KATA_TPU_MAINTENANCE_FILE``, the
   host's TPU-maintenance signal surface) call the server's
@@ -69,7 +76,19 @@ SEAMS = (
 KIND_TRANSIENT = "raise-transient"
 KIND_OOM = "raise-oom"
 KIND_HANG = "hang"
-KINDS = (KIND_TRANSIENT, KIND_OOM, KIND_HANG)
+# Permanent fault kinds (ISSUE 10): the device does not come back on
+# retry. ``chip_loss`` optionally carries the lost chip's serving-mesh
+# device index as a FOURTH schedule field (``<seam>:<round>:chip_loss:1``,
+# default 0); ``ici_error`` models an interconnect failure — chips alive,
+# collectives untrustworthy.
+KIND_CHIP_LOSS = "chip_loss"
+KIND_ICI = "ici_error"
+KINDS = (KIND_TRANSIENT, KIND_OOM, KIND_HANG, KIND_CHIP_LOSS, KIND_ICI)
+PERMANENT_KINDS = (KIND_CHIP_LOSS, KIND_ICI)
+
+# classify() verdicts.
+TRANSIENT = "transient"
+PERMANENT = "permanent"
 
 ENV_FAULTS = "KATA_TPU_FAULTS"
 ENV_FAULTS_SEED = "KATA_TPU_FAULTS_SEED"
@@ -93,20 +112,41 @@ class DeviceStallError(TimeoutError):
     returns."""
 
 
+class ChipLossFault(RuntimeError):
+    """Injected PERMANENT chip failure: serving-mesh device
+    ``device_index`` is gone and will not come back on retry — the
+    supervisor must shrink the mesh over the survivors (or fail the load
+    loudly), never replay into the dead chip."""
+
+    def __init__(self, message: str, device_index: int = 0):
+        super().__init__(message)
+        self.device_index = int(device_index)
+
+
+class IciFault(RuntimeError):
+    """Injected PERMANENT ICI interconnect failure: the chips answer but
+    collectives across the mesh are untrustworthy — same elastic-shrink
+    recovery class as :class:`ChipLossFault`, with every chip surviving."""
+
+
 @dataclass(frozen=True)
 class FaultSpec:
     """One scheduled fault: fire ``kind`` at the ``round``-th invocation
-    (0-based, counted per seam) of ``seam``."""
+    (0-based, counted per seam) of ``seam``. ``device`` is meaningful for
+    ``chip_loss`` only: the serving-mesh index of the chip that dies."""
 
     seam: str
     round: int
     kind: str = KIND_TRANSIENT
+    device: int = 0
 
 
 def parse_schedule(raw: str) -> tuple[list[FaultSpec], list[str]]:
-    """Parse a ``<seam>:<round>[:<kind>],...`` schedule string into specs
-    plus the malformed entries (the caller decides whether to event or
-    raise on those — the env path degrades, the explicit path raises)."""
+    """Parse a ``<seam>:<round>[:<kind>[:<device>]],...`` schedule string
+    into specs plus the malformed entries (the caller decides whether to
+    event or raise on those — the env path degrades, the explicit path
+    raises). The fourth field is valid only for ``chip_loss`` (the lost
+    chip's serving-mesh device index, default 0)."""
     specs: list[FaultSpec] = []
     bad: list[str] = []
     for entry in raw.split(","):
@@ -114,13 +154,28 @@ def parse_schedule(raw: str) -> tuple[list[FaultSpec], list[str]]:
         if not entry:
             continue
         parts = entry.split(":")
-        if len(parts) not in (2, 3) or parts[0] not in SEAMS:
+        if len(parts) not in (2, 3, 4) or parts[0] not in SEAMS:
             bad.append(entry)
             continue
-        kind = parts[2] if len(parts) == 3 else KIND_TRANSIENT
+        kind = parts[2] if len(parts) >= 3 else KIND_TRANSIENT
         if kind not in KINDS:
             bad.append(entry)
             continue
+        device = 0
+        if len(parts) == 4:
+            # Only chip_loss carries a device index — a fourth field on
+            # any other kind is a malformed entry, not a silent ignore.
+            if kind != KIND_CHIP_LOSS:
+                bad.append(entry)
+                continue
+            try:
+                device = int(parts[3])
+            except ValueError:
+                bad.append(entry)
+                continue
+            if device < 0:
+                bad.append(entry)
+                continue
         try:
             rnd = int(parts[1])
         except ValueError:
@@ -129,7 +184,7 @@ def parse_schedule(raw: str) -> tuple[list[FaultSpec], list[str]]:
         if rnd < 0:
             bad.append(entry)
             continue
-        specs.append(FaultSpec(parts[0], rnd, kind))
+        specs.append(FaultSpec(parts[0], rnd, kind, device))
     return specs, bad
 
 
@@ -153,7 +208,7 @@ class FaultInjector:
     fired: list = field(default_factory=list)
 
     def __post_init__(self) -> None:
-        pending: dict[tuple[str, int], str] = {}
+        pending: dict[tuple[str, int], FaultSpec] = {}
         for spec in self.schedule:
             if spec.seam not in SEAMS:
                 raise ValueError(
@@ -163,7 +218,7 @@ class FaultInjector:
                 raise ValueError(
                     f"unknown fault kind {spec.kind!r} (have {KINDS})"
                 )
-            pending[(spec.seam, spec.round)] = spec.kind
+            pending[(spec.seam, spec.round)] = spec
         self._pending = pending
         self._counts: dict[str, int] = {}
         self._rng = random.Random(self.seed)
@@ -202,13 +257,16 @@ class FaultInjector:
             raise ValueError(f"unknown fault seam {seam!r}")
         n = self._counts.get(seam, 0)
         self._counts[seam] = n + 1
-        kind = self._pending.pop((seam, n), None)
-        if kind is None:
+        spec = self._pending.pop((seam, n), None)
+        if spec is None:
             return
+        kind = spec.kind
         self.fired.append((seam, n, kind))
+        extra = {"device": spec.device} if kind == KIND_CHIP_LOSS else {}
         obs.emit(
             "serving", "fault_injected",
             server=self.label, seam=seam, round=n, fault_kind=kind,
+            **extra,
         )
         if kind == KIND_TRANSIENT:
             raise TransientFault(f"injected transient fault at {seam}#{n}")
@@ -216,6 +274,16 @@ class FaultInjector:
             raise InjectedOom(
                 f"RESOURCE_EXHAUSTED: injected allocation failure at "
                 f"{seam}#{n}"
+            )
+        if kind == KIND_CHIP_LOSS:
+            raise ChipLossFault(
+                f"injected permanent chip loss at {seam}#{n} "
+                f"(mesh device {spec.device})",
+                device_index=spec.device,
+            )
+        if kind == KIND_ICI:
+            raise IciFault(
+                f"injected permanent ICI interconnect failure at {seam}#{n}"
             )
         # hang: a simulated stall — the watchdog deadline is short-
         # circuited deterministically (an optional real hang_s delay keeps
@@ -329,19 +397,49 @@ _TRANSIENT_MARKERS = (
     "DEADLINE_EXCEEDED",
 )
 
+# Markers of a PERMANENT device condition (ISSUE 10): retrying the same
+# mesh cannot succeed — the supervisor must shrink over the survivors.
+# Checked BEFORE the transient set (a halted chip's message may also
+# carry UNAVAILABLE). Heuristic by necessity: the TPU runtime has no
+# structured "chip N died" status, these are the phrases its chip-loss
+# and ICI failure paths are observed to emit.
+_PERMANENT_MARKERS = (
+    "device halted",
+    "chip has been lost",
+    "ici failure",
+    "interconnect failure",
+)
+
+
+def classify(exc: BaseException) -> Optional[str]:
+    """The supervisor's fault triage (ISSUE 10): :data:`TRANSIENT` routes
+    through the existing rebuild-and-replay recovery, :data:`PERMANENT`
+    (a dead chip, a broken interconnect) through elastic mesh-shrink —
+    replaying into a dead chip can only fail again. ``None`` means not
+    ours to catch: the exception propagates unchanged (user bugs, shape
+    errors, strict-mode guard trips). XLA errors are matched by type NAME
+    so a jax-free host process can import this module."""
+    if isinstance(exc, (ChipLossFault, IciFault)):
+        return PERMANENT
+    if isinstance(exc, (TransientFault, InjectedOom, DeviceStallError)):
+        return TRANSIENT
+    if type(exc).__name__ == "XlaRuntimeError":
+        msg = str(exc)
+        low = msg.lower()
+        if any(marker in low for marker in _PERMANENT_MARKERS):
+            return PERMANENT
+        if any(marker in msg for marker in _TRANSIENT_MARKERS):
+            return TRANSIENT
+    return None
+
 
 def recoverable(exc: BaseException) -> bool:
     """Should the recovery supervisor catch this and rebuild, rather than
-    let it unwind the server? Injected faults and watchdog stalls always;
-    real XLA runtime errors only when their status marker says transient
-    (matched by type NAME so a jax-free host process can import this
-    module)."""
-    if isinstance(exc, (TransientFault, InjectedOom, DeviceStallError)):
-        return True
-    if type(exc).__name__ == "XlaRuntimeError":
-        msg = str(exc)
-        return any(marker in msg for marker in _TRANSIENT_MARKERS)
-    return False
+    let it unwind the server? Injected faults and watchdog stalls always
+    (transient replay or permanent mesh-shrink — :func:`classify` picks
+    the path); real XLA runtime errors only when a status marker says the
+    device, not the program, failed."""
+    return classify(exc) is not None
 
 
 def env_int(name: str, default: int, *, event: str = "",

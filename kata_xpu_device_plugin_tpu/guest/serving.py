@@ -195,6 +195,10 @@ _PROM_STATS = (
     ("sched_defers", "Admission passes deferred to decode under SLO pressure"),
     ("slo_violations", "Decode rounds whose cadence exceeded the ITL SLO"),
     ("tp_degree", "Tensor-parallel degree of the serving mesh (1 = unsharded)"),
+    ("tp_degraded", "Serving below the configured tensor-parallel degree "
+                    "after a permanent chip fault (0/1)"),
+    ("tp_shrinks", "Elastic mesh-shrink recoveries performed (chip loss / "
+                   "ICI failure survived degraded)"),
 )
 
 
@@ -595,6 +599,21 @@ class GenerationServer:
     raises. Mutually exclusive with ``mesh=`` (which keeps its
     training-layout sharding). Greedy outputs are bit-identical to
     ``tp=1``.
+
+    DEGRADED MODE (ISSUE 10, ``docs/resilience.md`` "Degraded mode"):
+    chip loss is a survivable event at ``tp > 1``. A PERMANENT fault
+    (``chip_loss:<device>`` / ``ici_error`` schedule kinds, or an XLA
+    error carrying a permanent-device marker) makes the supervisor
+    SHRINK the mesh instead of retrying: halve the degree over the
+    surviving chips (tp=4 → 2 → 1, floored at ``tp_min`` /
+    ``KATA_TPU_TP_MIN``), re-shard params from a host donor copy
+    retained at construction, rebuild the KV state on the smaller mesh,
+    restore checkpointed lanes under the new sharding, and replay the
+    rest strict-FIFO — recovered greedy outputs stay bit-identical to a
+    fault-free run (tp-invariance). ``degraded=False`` /
+    ``KATA_TPU_DEGRADED=0`` kills the path (and skips the donor copy);
+    with no feasible rung left the load fails loudly into
+    :meth:`failures` (reason ``chip_lost``) — none vanish.
     """
 
     def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
@@ -618,7 +637,9 @@ class GenerationServer:
                  prefill_chunk: Optional[int] = None,
                  itl_slo_ms: Optional[float] = None,
                  spec_opt_in: Optional[bool] = None,
-                 tp: Optional[int] = None):
+                 tp: Optional[int] = None,
+                 tp_min: Optional[int] = None,
+                 degraded: Optional[bool] = None):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
@@ -943,6 +964,41 @@ class GenerationServer:
         # PARAM_RULES layout callers already rely on.
         self._tp_serving_rules = tp > 1
         self._mesh = mesh
+        # Degraded-mode chip-loss tolerance (ISSUE 10, docs/resilience.md
+        # "Degraded mode"): a PERMANENT fault (chip_loss / ici_error —
+        # resilience.classify) cannot be retried away, so the supervisor
+        # SHRINKS the mesh instead: re-resolve a feasible degree over the
+        # survivors (halving ladder, floored at tp_min), re-shard params
+        # from the host donor copy retained here, rebuild the KV state on
+        # the smaller mesh, and let the standard restore/replay machinery
+        # finish the in-flight load — greedy outputs stay bit-identical
+        # because tp never changes the computed values (PR 9 invariance).
+        # KATA_TPU_DEGRADED=0 (or degraded=False) kills the whole path
+        # (and skips the donor copy's host RAM cost); tp_min floors the
+        # ladder (KATA_TPU_TP_MIN, daemon-injectable). Only the tp= path
+        # shrinks — an injected mesh= keeps its caller-owned layout.
+        self._tp_initial = self._tp
+        self._tp_shrinks = 0
+        self._tp_devices = (
+            list(mesh.devices.flat) if self._tp_serving_rules else []
+        )
+        self._degraded_ok = (
+            tp_serving.degraded_enabled() if degraded is None
+            else bool(degraded)
+        )
+        if tp_min is not None:
+            tp_min = int(tp_min)
+            if tp_min < 1:
+                raise ValueError(f"tp_min must be >= 1, got {tp_min}")
+            self._tp_min = tp_min
+        else:
+            self._tp_min = tp_serving.tp_min_from_env(label=self._label)
+        self._params_host = None
+        if self._tp_serving_rules and self._degraded_ok:
+            from ..parallel.sharding import host_param_copy
+
+            self._params_host = host_param_copy(params)
+        self._kv_replicated_warned: set[int] = set()
         # Paged KV pool (ISSUE 6): one block pool shared by all in-flight
         # requests replaces the fixed [max_batch, max_len] slot grid —
         # admission becomes token-budget continuous batching with
@@ -1140,6 +1196,14 @@ class GenerationServer:
             # already-placed pool; an INJECTED store keeps its caller's
             # placement — it may back single-chip servers too.)
             self._place_store(self._mesh)
+        # Degraded-mode store bookkeeping (ISSUE 10): a mesh shrink
+        # rebuilds an OWNED standalone store empty (its shards on the dead
+        # chip are gone) but must only DISABLE an injected one — other
+        # servers may share it.
+        self._prefix_injected = (
+            prefix_store is not None and self.prefix_store is prefix_store
+        )
+        self._prefix_capacity = int(prefix_cache_tokens or 0)
 
     def _bind_histograms(self) -> None:
         self._h_ttft = _hist_ttft().labels(server=self._label)
@@ -1236,6 +1300,25 @@ class GenerationServer:
 
         tp = mesh.shape.get(AXIS_MODEL, 1)
         sh = NamedSharding(mesh, tp_serving.kv_cache_spec(self.cfg, tp))
+        if (tp > 1 and not tp_serving.kv_heads_shardable(self.cfg, tp)
+                and tp not in self._kv_replicated_warned):
+            # The paged×tp memory cliff's worst edge made LOUD (ISSUE 10
+            # satellite; ROADMAP item 3b): when n_kv_heads does not
+            # divide tp the KV spec replicates the whole pool/arena onto
+            # every shard — correct, but real HBM is tp × the logical
+            # figure. One warning event per (server, degree) with the
+            # measured extra bytes, instead of the silent replication.
+            self._kv_replicated_warned.add(tp)
+            logical = sum(
+                leaf.nbytes for leaf in jax.tree_util.tree_leaves(
+                    self.kv_pool.arena if self.paged else self.arena
+                )
+            )
+            obs.emit(
+                "serving", "kv_replicated",
+                server=self._label, tp=tp, n_kv_heads=self.cfg.n_kv_heads,
+                extra_bytes=(tp - 1) * logical,
+            )
         if self.paged:
             # The pool IS the arena ([L, 1, NT, KV, D] leaves — the same
             # head-axis position as the slot grid), so paged × tp shards
@@ -1403,6 +1486,10 @@ class GenerationServer:
         # resilience blocks around this one).
         out.update({
             "tp_degree": self._tp,
+            # Degraded mode (ISSUE 10): ALWAYS present — 0/0 on servers
+            # that never lost a chip — same no-schema-branch contract.
+            "tp_degraded": int(self._tp < self._tp_initial),
+            "tp_shrinks": self._tp_shrinks,
             "kv_pool_shard_occupancy": self._pool_shard_occupancy(),
         })
         # Scheduler fields (ISSUE 8): ALWAYS present — fifo_batch reports
@@ -2646,6 +2733,17 @@ class GenerationServer:
         if isinstance(exc, DeviceStallError):
             self._stalls += 1
             self._c_stall.inc()
+        # Permanent faults (ISSUE 10): a dead chip or broken interconnect
+        # cannot be retried away — shrink the mesh over the survivors
+        # FIRST, then let the standard restore/replay path below run
+        # against the degraded mesh (checkpointed host KV re-uploads
+        # under the NEW sharding via _kv_host_upload). When no feasible
+        # degraded configuration exists (single chip, KATA_TPU_DEGRADED=0,
+        # the tp_min floor, an injected mesh=), the load fails LOUDLY:
+        # every unfinished rid lands in failures() — none vanish.
+        if resilience.classify(exc) == resilience.PERMANENT:
+            if not self._degrade_mesh(exc):
+                return self._fail_all(err)
         # The implicated set: who loses progress to this round. A fault
         # inside a fill path is attributed to the requests of THAT fill
         # (_admit_current) — their batch-mates just requeue without an
@@ -2685,17 +2783,11 @@ class GenerationServer:
                 if req.rid in blamed and req.rid not in lost:
                     self._queue.remove(req)
                     lost[req.rid] = req
-        # Release prefix pins. A standalone store's arena survives (decode
-        # never donates it); a pool-backed tier is rebuilt with the pool.
-        if (self.prefix_store is not None
-                and not isinstance(self.prefix_store, PagedPrefixTier)):
-            for handle in self._slot_prefix:
-                if handle is not None:
-                    self.prefix_store.release(handle)
-            for _req, hit in self._admitting:
-                if hit is not None:
-                    self.prefix_store.cancel(hit)
-        self._slot_prefix = [None] * self.max_batch
+        # Release prefix pins. A standalone store's arena survives a
+        # transient recovery (decode never donates it); a pool-backed
+        # tier is rebuilt with the pool. No-op after a mesh shrink — the
+        # degrade path already released against the OLD store.
+        self._release_prefix_state()
         quarantined = 0
         survivors: list[_Request] = []
         for rid in sorted(lost):
@@ -2730,6 +2822,23 @@ class GenerationServer:
         except BaseException as exc2:
             if not (self._supervised and resilience.recoverable(exc2)):
                 raise
+            # A PERMANENT fault during the restore itself (another chip
+            # died while we were re-uploading): shrink AGAIN before the
+            # reset, or the replay below would land on the dead mesh.
+            # With no rung left, fail the load loudly — requeue the
+            # survivors not yet in a lane first so _fail_all sees every
+            # one of them (none vanish).
+            if resilience.classify(exc2) == resilience.PERMANENT:
+                if not self._degrade_mesh(exc2):
+                    lane_rids = {
+                        r.rid for r in self._slot_req if r is not None
+                    }
+                    self._queue.extendleft(reversed(
+                        [r for r in survivors if r.rid not in lane_rids]
+                    ))
+                    return self._fail_all(
+                        f"{type(exc2).__name__}: {exc2}"[:200]
+                    )
             # A recoverable fault inside the restore itself (pool_alloc
             # seam, a transient error mid-scatter): the half-restored
             # device state is untrustworthy — reset once more and replay
@@ -2766,6 +2875,167 @@ class GenerationServer:
             or any(r is not None for r in self._slot_req)
             or bool(self.paged and self._preempted)
         )
+
+    def _release_prefix_state(self) -> None:
+        """Release every prefix pin and cancel mid-admission lookups
+        against the CURRENT standalone store (a pool tier dies and is
+        rebuilt with its pool), then strip the hits from ``_admitting``
+        so later unwind code cannot release them twice — or against a
+        replacement store after a mesh shrink."""
+        if (self.prefix_store is not None
+                and not isinstance(self.prefix_store, PagedPrefixTier)):
+            for handle in self._slot_prefix:
+                if handle is not None:
+                    self.prefix_store.release(handle)
+            for _req, hit in self._admitting:
+                if hit is not None:
+                    self.prefix_store.cancel(hit)
+        self._slot_prefix = [None] * self.max_batch
+        self._admitting = [(r, None) for r, _h in self._admitting]
+
+    def _degrade_mesh(self, exc: BaseException) -> bool:
+        """Elastic mesh-shrink recovery (ISSUE 10): re-resolve a feasible
+        tensor-parallel degree over the chips that survived a permanent
+        fault (``tp_serving.shrink_ladder`` — tp=4 → 2 → 1, floored at
+        ``tp_min``), rebuild the serving mesh over the survivors,
+        re-shard params from the host donor copy retained at
+        construction, and swap/rebuild the prefix store. The caller's
+        normal recovery pass then rebuilds the pool/arena on the new mesh
+        (``_reset_device_state`` → ``_place_arenas``) and restores
+        checkpointed lanes through ``_kv_host_upload`` under the NEW
+        sharding — so recovered greedy outputs stay bit-identical to a
+        fault-free run (tp-invariance, PR 9). False when no degraded
+        configuration exists; the caller fails the load loudly."""
+        permanent_reason = (
+            f"chip_loss:{exc.device_index}"
+            if isinstance(exc, resilience.ChipLossFault) else "ici_error"
+        )
+        if (self._tp <= 1 or not self._tp_serving_rules
+                or not self._degraded_ok or self._params_host is None):
+            why = (
+                "degraded_disabled" if not self._degraded_ok
+                else "single_chip" if self._tp <= 1
+                else "mesh_injected"
+            )
+            obs.emit(
+                "serving", "chip_loss_fatal",
+                server=self._label, reason=permanent_reason, tp=self._tp,
+                why=why,
+            )
+            return False
+        if isinstance(exc, resilience.ChipLossFault):
+            i = exc.device_index
+            if not 0 <= i < len(self._tp_devices):
+                i = 0  # index outside the mesh: one chip is gone all the same
+            survivors = self._tp_devices[:i] + self._tp_devices[i + 1:]
+        else:
+            # ICI fault: every chip answers but collectives over the full
+            # ring are untrustworthy — shrink one rung onto fewer chips.
+            survivors = list(self._tp_devices)
+        new_tp = tp_serving.shrink_ladder(
+            self._tp, len(survivors), self._tp_min
+        )
+        if new_tp is None:
+            obs.emit(
+                "serving", "chip_loss_fatal",
+                server=self._label, reason=permanent_reason, tp=self._tp,
+                why=f"tp_min_floor:{self._tp_min}",
+                survivors=len(survivors),
+            )
+            return False
+        old_tp = self._tp
+        self._release_prefix_state()
+        if self.prefix_store is not None and not isinstance(
+                self.prefix_store, PagedPrefixTier):
+            # The standalone store's arena lived on the OLD mesh — its
+            # shards on the dead chip are gone, so unlike transient
+            # recovery it cannot survive. An OWNED store rebuilds empty
+            # (cold cache, warms again from traffic); an INJECTED one may
+            # back other servers and is disabled here instead.
+            if self._prefix_injected:
+                obs.emit(
+                    "serving", "prefix_store_disabled",
+                    server=self._label, reason="tp_degraded",
+                )
+                self.prefix_store = None
+            else:
+                self.prefix_store = PrefixStore(
+                    self.cfg, self._prefix_capacity, self.prefill_buckets,
+                    kv_quant=self.kv_quant, label=self._label,
+                )
+        self._tp = new_tp
+        with jaxapi.allow_transfer(
+                "degraded-mode mesh shrink: param re-shard from the host "
+                "donor copy"):
+            if new_tp > 1:
+                self._mesh = tp_serving.serving_mesh(
+                    new_tp, devices=survivors
+                )
+                self._tp_devices = survivors[:new_tp]
+                from ..parallel.sharding import shard_serving_params
+
+                self.params = shard_serving_params(
+                    self._params_host, self._mesh
+                )
+            else:
+                self._mesh = None
+                self._tp_devices = []
+                self.params = jax.tree.map(jnp.asarray, self._params_host)
+            if (self._mesh is not None and not self._prefix_injected
+                    and isinstance(self.prefix_store, PrefixStore)):
+                self._place_store(self._mesh)
+        self._tp_shrinks += 1
+        obs.emit(
+            "serving", "tp_degraded",
+            server=self._label, reason=permanent_reason, old_tp=old_tp,
+            tp=new_tp, survivors=len(survivors), tp_min=self._tp_min,
+        )
+        return True
+
+    def _fail_all(self, err: str) -> bool:
+        """Terminal path for an unrecoverable permanent fault: no
+        degraded mesh exists, so no retry can serve the in-flight load.
+        Every unfinished request — lanes, the in-flight chunk's pins,
+        mid-admission work, preempted spills, the whole queue — fails
+        LOUDLY into :meth:`failures` (reason ``chip_lost``); banked
+        results survive. The none-vanish invariant holds: every submitted
+        rid still ends in exactly one of results/failures. Device state
+        is rebuilt so fresh submits can still be served (on real hardware
+        the runtime decides whether the surviving configuration comes
+        back up)."""
+        lost: dict[int, _Request] = {}
+        for b in range(self.max_batch):
+            req = self._slot_req[b]
+            if req is not None and not req.done:
+                lost[req.rid] = req
+        if self._inflight is not None:
+            for _b, req in self._inflight.slots:
+                if not req.done:
+                    lost[req.rid] = req
+        for req, _hit in self._admitting:
+            if not req.done:
+                lost[req.rid] = req
+        if self.paged:
+            while self._preempted:
+                pre = self._preempted.popleft()
+                if not pre.req.done:
+                    lost[pre.req.rid] = pre.req
+        while self._queue:
+            req = self._queue.popleft()
+            if not req.done:
+                lost[req.rid] = req
+        self._release_prefix_state()
+        self._reset_device_state()
+        self._ckpt = {}
+        for rid in sorted(lost):
+            self._fail_request(lost[rid], reason="chip_lost", error=err)
+        obs.emit(
+            "serving", "recovery",
+            server=self._label, error=err, restored=0, requeued=0,
+            quarantined=0, failed=len(lost), streak=self._fail_streak,
+            backoff_s=0.0,
+        )
+        return False
 
     def _reset_device_state(self) -> None:
         """Fresh pool/arena + cleared device-coupled host mirrors. After

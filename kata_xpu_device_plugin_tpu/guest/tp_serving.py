@@ -51,6 +51,55 @@ from .. import obs
 # same AllocateResponse path as the pool/prefix/scheduler knobs).
 ENV_TP = "KATA_TPU_TP"
 
+# Degraded-mode knobs (ISSUE 10, docs/resilience.md "Degraded mode"):
+# the floor of the elastic mesh-shrink ladder a permanent chip fault
+# walks (daemon-injectable, cdi.constants.ENV_SERVING_TP_MIN), and the
+# guest-side kill switch that disables mesh shrink entirely (a chip loss
+# then fails the in-flight load loudly instead of continuing degraded).
+ENV_TP_MIN = "KATA_TPU_TP_MIN"
+ENV_DEGRADED = "KATA_TPU_DEGRADED"
+
+
+def degraded_enabled(env: Optional[dict] = None) -> bool:
+    """Is elastic mesh-shrink recovery allowed? ``KATA_TPU_DEGRADED=0``
+    is the kill switch — any other value (including unset) enables it."""
+    env = os.environ if env is None else env
+    return env.get(ENV_DEGRADED, "1") != "0"
+
+
+def tp_min_from_env(*, label: str = "") -> int:
+    """The shrink ladder's floor from the daemon-injected env (default 1
+    — degrade all the way to single-chip serving before giving up).
+    Rides :func:`.resilience.env_int`'s degrade contract: a malformed
+    node-wide knob falls back with one ``tp_min_invalid`` event, never a
+    crash."""
+    from . import resilience
+
+    return max(1, resilience.env_int(
+        ENV_TP_MIN, 1, event="tp_min_invalid", server=label
+    ))
+
+
+def shrink_ladder(tp: int, survivors: int,
+                  tp_min: int = 1) -> Optional[int]:
+    """The next feasible degraded tensor-parallel degree after a
+    permanent fault at degree ``tp``: HALVE until the rung both fits the
+    surviving chip count and stays at or above the ``tp_min`` floor
+    (tp=4 → 2 → 1). Halving keeps every rung a valid 1×N sub-mesh of the
+    original allocation (the same power-of-two sub-slice shapes
+    ``topology.preferred`` hands out — see ``degraded_fallbacks``), and
+    divisibility-dependent layouts (KV head sharding) re-resolve per rung
+    through :func:`kv_heads_shardable`. ``None`` when no rung survives:
+    the caller fails the load loudly instead of retrying into a dead
+    mesh."""
+    floor = max(1, int(tp_min))
+    t = tp // 2
+    while t >= floor:
+        if t <= survivors:
+            return t
+        t //= 2
+    return None
+
 
 def _topology_chips(env) -> int:
     """Chip count the injected topology env describes (1 when absent)."""

@@ -233,6 +233,16 @@ def shard_serving_params(params: Any, mesh: Mesh) -> Any:
     return jax.device_put(params, shardings)
 
 
+def host_param_copy(params: Any) -> Any:
+    """A full HOST copy of a (possibly sharded) param tree — the donor
+    copy elastic mesh-shrink recovery (ISSUE 10) re-shards from after a
+    chip loss: the dead chip's parameter shards are unrecoverable, so the
+    degraded mesh must be fed from state that never lived on the device.
+    One deliberate device→host gather per leaf at construction time (off
+    every hot path); costs host RAM equal to the param bytes."""
+    return jax.tree.map(np.asarray, params)
+
+
 def param_shardings(params: Any, mesh: Mesh) -> Any:
     return tree_map(
         lambda spec: NamedSharding(mesh, spec), param_specs(params)

@@ -3,10 +3,16 @@ watching, and the discovery→CDI→serve orchestration (counterpart of the
 reference's ``pkg/device_plugin``)."""
 from .allocators import TpuAllocator, VfioAllocator
 from .health import HealthWatcher
-from .manager import PluginManager, build_tpu_spec, build_vfio_spec
+from .manager import (
+    AllocationJournal,
+    PluginManager,
+    build_tpu_spec,
+    build_vfio_spec,
+)
 from .server import AllocationError, DevicePluginServer, DeviceState, WatchedDevice
 
 __all__ = [
+    "AllocationJournal",
     "TpuAllocator",
     "VfioAllocator",
     "HealthWatcher",

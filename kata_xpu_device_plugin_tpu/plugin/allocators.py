@@ -51,6 +51,7 @@ class TpuAllocator:
         prefill_chunk: int = 0,
         itl_slo_ms: float = 0.0,
         serving_tp: int = 0,
+        serving_tp_min: int = 0,
     ):
         self._inventory = inventory
         self._vendor = vendor
@@ -91,6 +92,10 @@ class TpuAllocator:
         # default (guest/tp_serving.py derives the degree from
         # TPU_VISIBLE_CHIPS); KATA_TPU_TP pins it node-wide.
         self._serving_tp = int(serving_tp)
+        # Degraded-mode shrink floor (ISSUE 10, config.serving_tp_min):
+        # same delivery path — in-guest servers stop the chip-loss
+        # mesh-shrink ladder at this degree (guest/tp_serving.py).
+        self._serving_tp_min = int(serving_tp_min)
         # Driver-level liveness check supplied by the manager
         # (``manager.tpu_chip_alive``: node_alive over the same
         # dev+driver-state pair health watches); bare existence would hand a
@@ -158,6 +163,8 @@ class TpuAllocator:
             resp.envs[C.ENV_PREFILL_CHUNK] = str(self._prefill_chunk)
         if self._itl_slo_ms > 0:
             resp.envs[C.ENV_ITL_SLO_MS] = str(self._itl_slo_ms)
+        if self._serving_tp_min > 0:
+            resp.envs[C.ENV_SERVING_TP_MIN] = str(self._serving_tp_min)
         if self._serving_tp > 0:
             resp.envs[C.ENV_SERVING_TP] = str(self._serving_tp)
             if self._serving_tp > len(chips):

@@ -161,9 +161,17 @@ class HealthWatcher(threading.Thread):
             plugins = list(self._plugins)
         for plugin in plugins:
             if plugin.stopped:
+                # A stopped plugin no longer serves or watches anything:
+                # its gauge must not keep reporting the last live count.
+                metrics.chips_quarantined.labels(
+                    resource=plugin.resource_name
+                ).set(0)
                 continue
+            unhealthy = 0
             for dev in plugin.state.snapshot():
                 if not dev.watch_paths:
+                    if dev.health == glue.UNHEALTHY:
+                        unhealthy += 1
                     continue
                 # Existence of the dev+driver-state pair decides steady-state
                 # health WITHOUT open()ing anything: probing a healthy,
@@ -179,16 +187,33 @@ class HealthWatcher(threading.Thread):
                 if alive and dev.health == glue.UNHEALTHY:
                     alive = all(node_alive(p) for p in dev.watch_paths)
                 health = glue.HEALTHY if alive else glue.UNHEALTHY
+                if health == glue.UNHEALTHY:
+                    unhealthy += 1
                 if plugin.state.set_health(dev.id, health):
                     metrics.health_transitions_total.labels(
                         resource=plugin.resource_name, to=health
                     ).inc()
+                    # Per-chip quarantine contract (ISSUE 10): one event
+                    # per flip, so the guest-side tp_degraded stream and
+                    # the daemon-side quarantine stream can be joined on
+                    # the same chip-loss incident. Re-admission (the
+                    # open-probe recovery classifier above) events too —
+                    # a flap is visible as the pair, not silence.
+                    obs.emit(
+                        "plugin",
+                        "chip_quarantined" if health == glue.UNHEALTHY
+                        else "chip_readmitted",
+                        resource=plugin.resource_name, device=dev.id,
+                    )
                     LOG.info(
                         "device health changed",
                         extra=log.kv(
                             resource=plugin.resource_name, device=dev.id, health=health
                         ),
                     )
+            metrics.chips_quarantined.labels(
+                resource=plugin.resource_name
+            ).set(unhealthy)
             # Kubelet restart wipes the plugin-socket dir (ref :444-453).
             if plugin.serving and not os.path.exists(plugin.socket_path):
                 self._try_restart(plugin)

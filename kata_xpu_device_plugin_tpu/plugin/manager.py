@@ -9,11 +9,13 @@ shutdown path, per-kind CDI spec files, and the TPU-native spec content
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import threading
-from typing import Optional
+import time
+from typing import Optional, Sequence
 
-from .. import cdi
+from .. import cdi, obs
 from ..cdi import constants as C
 from ..config import Config
 from ..discovery import pciids
@@ -183,6 +185,137 @@ def vfio_watched_devices(
     ]
 
 
+# ----- allocation-state journal (ISSUE 10) ---------------------------------
+
+
+class AllocationJournal:
+    """Crash-consistent record of device→allocation assignments.
+
+    The kubelet owns allocation truth but never replays it to a
+    restarting plugin (v1beta1 has no ListAllocations), so the reference
+    plugin restarts BLIND: allocations made before the restart are
+    invisible, and a chip that died while the daemon was down is only
+    noticed when a pod crashes on it. This journal closes that hole with
+    the reconcile-from-observed-state loop the Kubernetes Network Driver
+    Model argues for (PAPERS.md): every Allocate checkpoints its
+    device→group assignment to disk (atomic tmp+rename), and a
+    restarting daemon reconciles the journal against the devices it
+    actually observes — ``alloc_reconciled`` for groups whose devices
+    all still exist, ``alloc_orphaned`` (entry dropped, gauge set) for
+    groups referencing vanished chips.
+
+    Entries are keyed by device id: a chip belongs to at most one live
+    allocation (the kubelet only re-hands-out freed devices), so the
+    journal is bounded by chip count and a re-allocation of a device
+    supersedes its old entry. A missing or corrupt file degrades to an
+    empty journal — observed state is the authority, the journal is the
+    hint."""
+
+    def __init__(self, path: str):
+        self.path = path
+        # Allocate handlers run on the gRPC thread pool: record() calls
+        # arrive concurrently, and an unguarded dict would race json.dump
+        # mid-write (and two writers would fight over the same tmp file).
+        self._lock = threading.Lock()
+        self._devices: dict[str, dict] = {}
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+            devices = data.get("devices", {})
+            if isinstance(devices, dict):
+                self._devices = {
+                    str(k): v for k, v in devices.items()
+                    if isinstance(v, dict) and v.get("group")
+                }
+        except FileNotFoundError:
+            pass
+        except (OSError, ValueError) as e:
+            LOG.warning(
+                "allocation journal unreadable — starting empty",
+                extra=log.kv(path=path, err=str(e)),
+            )
+
+    def _save_locked(self) -> None:
+        tmp = self.path + ".tmp"
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"version": 1, "devices": self._devices}, fh)
+            os.replace(tmp, self.path)
+        except OSError as e:
+            # A read-only state dir must not fail Allocate — the journal
+            # is a restart hint, never the allocation's source of truth.
+            LOG.warning(
+                "allocation journal write failed",
+                extra=log.kv(path=self.path, err=str(e)),
+            )
+
+    def record(self, resource: str, device_ids: Sequence[str]) -> None:
+        """Checkpoint one granted allocation (called from the Allocate
+        handler via ``on_allocate``): each device maps to the full group
+        it was granted with, superseding any stale entry."""
+        group = sorted(str(i) for i in device_ids)
+        entry = {"resource": resource, "group": group, "ts": time.time()}
+        with self._lock:
+            for dev_id in group:
+                self._devices[dev_id] = dict(entry)
+            self._save_locked()
+
+    def allocations(self, resource: str) -> list[tuple[str, ...]]:
+        """Distinct journaled device groups for ``resource``."""
+        with self._lock:
+            return sorted({
+                tuple(ent["group"]) for ent in self._devices.values()
+                if ent.get("resource") == resource
+            })
+
+    def reconcile(self, resource: str,
+                  observed_ids: set[str]) -> tuple[int, int]:
+        """Startup reconcile against the OBSERVED device set: emit one
+        ``alloc_reconciled`` event per journaled group whose devices all
+        still exist and one ``alloc_orphaned`` per group with vanished
+        devices (entry dropped). Never touches device HEALTH — health is
+        the watcher's job from live probes; reconcile only restores the
+        assignment map, so a restart causes zero spurious Unhealthy
+        flaps in the ListAndWatch stream (tested). Returns
+        ``(reconciled, orphaned)`` group counts."""
+        reconciled = orphaned = 0
+        for group in self.allocations(resource):
+            missing = [d for d in group if d not in observed_ids]
+            if missing:
+                orphaned += 1
+                with self._lock:
+                    for dev_id in group:
+                        ent = self._devices.get(dev_id)
+                        if ent and tuple(ent["group"]) == group:
+                            del self._devices[dev_id]
+                obs.emit(
+                    "plugin", "alloc_orphaned",
+                    resource=resource, devices=",".join(group),
+                    missing=",".join(missing),
+                )
+                LOG.warning(
+                    "journaled allocation references vanished devices",
+                    extra=log.kv(
+                        resource=resource, devices=",".join(group),
+                        missing=",".join(missing),
+                    ),
+                )
+            else:
+                reconciled += 1
+                obs.emit(
+                    "plugin", "alloc_reconciled",
+                    resource=resource, devices=",".join(group),
+                )
+        if orphaned:
+            with self._lock:
+                self._save_locked()
+        metrics.alloc_orphaned.labels(resource=resource).set(orphaned)
+        return reconciled, orphaned
+
+
 # ----- manager -------------------------------------------------------------
 
 
@@ -203,6 +336,13 @@ class PluginManager:
         self._watcher: Optional[HealthWatcher] = None
         self._stop = threading.Event()
         self._rescan_thread: Optional[threading.Thread] = None
+        # Allocation-state journal (ISSUE 10): lives in the same state
+        # dir as the persisted worker identity; "" disables (the daemon
+        # then restarts blind, the reference behavior).
+        self._journal: Optional[AllocationJournal] = (
+            AllocationJournal(os.path.join(cfg.state_dir, "allocations.json"))
+            if cfg.state_dir and not state_readonly else None
+        )
 
     # -- inventory providers (allocators call these on every Allocate) ------
 
@@ -381,6 +521,18 @@ class PluginManager:
         )
         self.write_specs()
 
+        # Reconcile the allocation journal against the devices this scan
+        # actually OBSERVED — before any plugin serves, so the event
+        # stream orders restart state ahead of new traffic. Reconcile
+        # never touches health (zero spurious Unhealthy flaps in the
+        # ListAndWatch stream); vanished devices surface as
+        # alloc_orphaned events + the gauge, not as health churn.
+        if self._journal is not None:
+            self._journal.reconcile(
+                cfg.tpu_resource_name,
+                {str(c.index) for c in tpu_inv.chips},
+            )
+
         if self._stop.is_set():
             return
         # The TPU plugin always runs — a 0-chip node advertises an empty list
@@ -406,6 +558,13 @@ class PluginManager:
                 prefill_chunk=cfg.prefill_chunk,
                 itl_slo_ms=cfg.itl_slo_ms,
                 serving_tp=cfg.serving_tp,
+                serving_tp_min=cfg.serving_tp_min,
+            ),
+            # Journal every grant at the moment it happens (the Allocate
+            # handler's on_allocate hook) — the restart reconcile's input.
+            on_allocate=(
+                (lambda ids: self._journal.record(cfg.tpu_resource_name, ids))
+                if self._journal is not None else None
             ),
             socket_dir=cfg.kubelet_socket_dir,
             kubelet_socket=cfg.kubelet_socket,

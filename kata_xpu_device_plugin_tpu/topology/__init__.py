@@ -6,6 +6,7 @@ from .preferred import (
     alignment_score,
     chip_ids_to_indexes,
     choose_chips,
+    degraded_fallbacks,
     guest_meshable_counts,
 )
 from .slice import (
@@ -24,6 +25,7 @@ __all__ = [
     "alignment_score",
     "chip_ids_to_indexes",
     "choose_chips",
+    "degraded_fallbacks",
     "guest_meshable_counts",
     "FAMILIES",
     "HostTopology",

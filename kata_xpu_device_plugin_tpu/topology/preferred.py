@@ -114,6 +114,27 @@ def guest_meshable_counts(topo: HostTopology) -> list[int]:
     return topo.valid_request_counts()
 
 
+def degraded_fallbacks(topo: HostTopology, count: int) -> list[int]:
+    """The tensor-parallel degrees a guest can land on when chips of a
+    ``count``-chip allocation die — the host-side half of the
+    degraded-mode contract (ISSUE 10). The guest's elastic shrink walks
+    a HALVING ladder (``guest.tp_serving.shrink_ladder``: tp=4 → 2 → 1),
+    and every rung must be a size this host could itself have allocated
+    as an ICI-contiguous sub-slice, or a ``tp_degraded`` event would
+    name a degree the family table cannot interpret. Returned
+    descending; consistency with :func:`guest_meshable_counts` is
+    asserted in ``tests/test_degraded.py`` (the tripwire if a family
+    table drifts)."""
+    meshable = set(guest_meshable_counts(topo))
+    out = []
+    t = count // 2
+    while t >= 1:
+        if t == 1 or t in meshable:
+            out.append(t)
+        t //= 2
+    return out
+
+
 def chip_ids_to_indexes(ids: Iterable[str]) -> list[int]:
     """Device-plugin device ids are strings; chips are host-local ints."""
     return [int(i) for i in ids]

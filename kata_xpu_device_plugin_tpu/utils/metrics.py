@@ -53,6 +53,22 @@ plugin_restarts_total = obs_metrics.counter(
     ["resource", "ok"],
 )
 
+# Chip-loss tolerance (ISSUE 10): how many chips the health watcher is
+# currently holding out of allocation, and how many journaled allocations
+# the startup reconcile found referencing vanished devices.
+chips_quarantined = obs_metrics.gauge(
+    f"{NS}_chips_quarantined",
+    "Devices currently Unhealthy — quarantined from allocation by the "
+    "health watcher",
+    ["resource"],
+)
+alloc_orphaned = obs_metrics.gauge(
+    f"{NS}_alloc_orphaned",
+    "Journaled allocations whose devices were missing at the last "
+    "daemon-restart reconcile (entries dropped, event emitted)",
+    ["resource"],
+)
+
 # gRPC handler latency (ISSUE 2): one histogram, labeled by method —
 # Allocate / GetPreferredAllocation / ListAndWatch_update share it.
 grpc_handler_seconds = obs_metrics.histogram(

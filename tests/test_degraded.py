@@ -1,0 +1,704 @@
+"""Chip-loss tolerance end to end (ISSUE 10).
+
+Two halves under test. Guest: a PERMANENT fault (``chip_loss:<device>``
+/ ``ici_error`` schedule kinds) makes the recovery supervisor SHRINK the
+serving mesh over the survivors (tp=4 → 2 → 1, floored at ``tp_min``),
+re-shard params from the host donor copy, rebuild/restore KV state under
+the new sharding, and finish the burst with greedy outputs BIT-IDENTICAL
+to a fault-free run — tp-invariance (PR 9) makes that assertable on the
+forced-8-device CPU host. With no feasible rung (tp=1, kill switch,
+``tp_min`` floor) the load fails LOUDLY into ``failures()`` — none
+vanish. Daemon: per-chip health flips emit quarantine/readmit events,
+and the allocation-state journal reconciles device→group assignments
+across a daemon restart against OBSERVED devices — with zero spurious
+Unhealthy flaps.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu import obs
+from kata_xpu_device_plugin_tpu.guest import resilience, tp_serving
+from kata_xpu_device_plugin_tpu.guest.resilience import (
+    KINDS,
+    ChipLossFault,
+    FaultInjector,
+    FaultSpec,
+    IciFault,
+    parse_schedule,
+)
+from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import init_params
+from kata_xpu_device_plugin_tpu.topology import (
+    HostTopology,
+    degraded_fallbacks,
+    guest_meshable_counts,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _prompts(cfg, lengths, seed=2):
+    key = jax.random.PRNGKey(seed)
+    return [
+        np.asarray(
+            jax.random.randint(jax.random.fold_in(key, i), (n,), 0,
+                               cfg.vocab_size),
+            np.int32,
+        )
+        for i, n in enumerate(lengths)
+    ]
+
+
+def _server(params, cfg, injector=None, **kw):
+    # Explicit injector / tp_min on every server: the chaos gate replays
+    # this suite under an env KATA_TPU_FAULTS schedule, and ambient knobs
+    # must not flip a clean baseline into a faulted run.
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("recovery_backoff_s", 0.0)
+    kw.setdefault("tp_min", 1)
+    return GenerationServer(
+        params, cfg,
+        fault_injector=injector if injector is not None else FaultInjector(),
+        **kw,
+    )
+
+
+def _serve(params, cfg, prompts, budgets=8, injector=None, **kw):
+    srv = _server(params, cfg, injector=injector, **kw)
+    if isinstance(budgets, int):
+        budgets = [budgets] * len(prompts)
+    rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+    res = srv.run()
+    return rids, res, srv
+
+
+def _capture_events(tmp_path, fn, name="ev.jsonl"):
+    sink = obs.EventSink(str(tmp_path / name))
+    prev = obs.set_default_sink(sink)
+    try:
+        result = fn()
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    return result, obs.read_events(str(tmp_path / name))
+
+
+# ----- schedule grammar: the permanent kinds -------------------------------
+
+
+def test_parse_schedule_permanent_kinds():
+    specs, bad = parse_schedule(
+        "decode_dispatch:3:chip_loss:1,fence:0:ici_error,prefill:2:chip_loss"
+    )
+    assert bad == []
+    assert specs == [
+        FaultSpec("decode_dispatch", 3, "chip_loss", 1),
+        FaultSpec("fence", 0, "ici_error"),
+        FaultSpec("prefill", 2, "chip_loss", 0),
+    ]
+
+
+def test_parse_schedule_malformed_permanent_entries_degrade():
+    # A fourth field on a non-chip_loss kind, a non-integer or negative
+    # device index — each malformed INDIVIDUALLY, valid entries survive.
+    specs, bad = parse_schedule(
+        "fence:0:ici_error:1,decode_dispatch:1:chip_loss:x,"
+        "decode_dispatch:1:chip_loss:-2,decode_dispatch:0:chip_loss:3"
+    )
+    assert specs == [FaultSpec("decode_dispatch", 0, "chip_loss", 3)]
+    assert sorted(bad) == sorted([
+        "fence:0:ici_error:1", "decode_dispatch:1:chip_loss:x",
+        "decode_dispatch:1:chip_loss:-2",
+    ])
+
+
+def test_injector_fires_permanent_kinds_with_device():
+    inj = FaultInjector([FaultSpec("decode_dispatch", 0, "chip_loss", 2),
+                         FaultSpec("fence", 0, "ici_error")])
+    with pytest.raises(ChipLossFault) as ei:
+        inj.fire("decode_dispatch")
+    assert ei.value.device_index == 2
+    with pytest.raises(IciFault):
+        inj.fire("fence")
+    assert [f[2] for f in inj.fired] == ["chip_loss", "ici_error"]
+
+
+def test_classify_splits_transient_and_permanent():
+    assert resilience.classify(ChipLossFault("x", 1)) == resilience.PERMANENT
+    assert resilience.classify(IciFault("x")) == resilience.PERMANENT
+    assert resilience.classify(
+        resilience.TransientFault("x")) == resilience.TRANSIENT
+    assert resilience.classify(ValueError("user bug")) is None
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    # Permanent markers win even when a transient marker rides along.
+    assert resilience.classify(
+        XlaRuntimeError("UNAVAILABLE: device halted")
+    ) == resilience.PERMANENT
+    assert resilience.classify(
+        XlaRuntimeError("UNAVAILABLE: transport dead")
+    ) == resilience.TRANSIENT
+    # recoverable() covers both classes, and still rejects user bugs.
+    assert resilience.recoverable(ChipLossFault("x"))
+    assert not resilience.recoverable(AssertionError())
+
+
+def test_kinds_doc_pin():
+    # docs/resilience.md's grammar table documents exactly these kinds; a
+    # drifted tuple is a doc bug or silent loss of chaos coverage.
+    assert KINDS == ("raise-transient", "raise-oom", "hang",
+                     "chip_loss", "ici_error")
+
+
+# ----- shrink ladder -------------------------------------------------------
+
+
+def test_shrink_ladder():
+    # tp=4, one chip dead (3 survivors): 4→2.
+    assert tp_serving.shrink_ladder(4, 3) == 2
+    # tp=2, one dead: 2→1; tp=1 has nowhere to go.
+    assert tp_serving.shrink_ladder(2, 1) == 1
+    assert tp_serving.shrink_ladder(1, 0) is None
+    # tp_min floors the ladder.
+    assert tp_serving.shrink_ladder(4, 3, tp_min=2) == 2
+    assert tp_serving.shrink_ladder(4, 3, tp_min=4) is None
+    assert tp_serving.shrink_ladder(2, 1, tp_min=2) is None
+    # ICI fault: all chips survive, still one rung down.
+    assert tp_serving.shrink_ladder(4, 4) == 2
+    # Degenerate survivor counts skip rungs that cannot fit.
+    assert tp_serving.shrink_ladder(8, 1) == 1
+
+
+def test_degraded_fallbacks_are_guest_meshable():
+    # The host-side half of the degraded-mode contract: every rung the
+    # guest ladder can land on is a size the family table can interpret.
+    topo = HostTopology.from_accelerator_type("v5litepod-8")
+    meshable = set(guest_meshable_counts(topo))
+    for count in meshable:
+        for rung in degraded_fallbacks(topo, count):
+            assert rung == 1 or rung in meshable
+    assert degraded_fallbacks(topo, 4) == [2, 1]
+    assert degraded_fallbacks(topo, 8) == [4, 2, 1]
+
+
+def test_tp_min_env_ladder(monkeypatch, tmp_path):
+    monkeypatch.delenv(tp_serving.ENV_TP_MIN, raising=False)
+    assert tp_serving.tp_min_from_env() == 1
+    monkeypatch.setenv(tp_serving.ENV_TP_MIN, "2")
+    assert tp_serving.tp_min_from_env() == 2
+    monkeypatch.setenv(tp_serving.ENV_TP_MIN, "garbage")
+    got, events = _capture_events(
+        tmp_path, lambda: tp_serving.tp_min_from_env(label="s1")
+    )
+    assert got == 1
+    evs = [e for e in events if e.get("name") == "tp_min_invalid"]
+    assert len(evs) == 1 and evs[0]["reason"].startswith("bad_env")
+    monkeypatch.delenv(tp_serving.ENV_DEGRADED, raising=False)
+    assert tp_serving.degraded_enabled()
+    monkeypatch.setenv(tp_serving.ENV_DEGRADED, "0")
+    assert not tp_serving.degraded_enabled()
+
+
+# ----- the headline: chip loss survives bit-identically --------------------
+
+
+@pytest.mark.parametrize("overlap", [False, True])
+@pytest.mark.parametrize("paged", [False, True])
+def test_chip_loss_tp4_shrinks_to_tp2_bit_identical(model, paged, overlap,
+                                                    tmp_path):
+    """The acceptance criterion: a seeded chip_loss at tp=4 on the
+    forced-8-device CPU host shrinks the server to tp=2, completes every
+    in-flight and queued request, and greedy outputs are bit-identical
+    to the fault-free run — paged and slotted, overlap and lockstep."""
+    if jax.device_count() < 4:
+        pytest.skip("needs the forced 8-device CPU host")
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 8, 6, 3])
+    kw = dict(tp=4, overlap=overlap)
+    if paged:
+        kw.update(kv_pool_tokens=4 * 32, kv_block_size=8)
+    _, refres, _ = _serve(params, cfg, prompts, **kw)
+    (rids, res, srv), events = _capture_events(
+        tmp_path,
+        lambda: _serve(
+            params, cfg, prompts,
+            injector=FaultInjector(
+                [FaultSpec("decode_dispatch", 2, "chip_loss", 1)], seed=3
+            ),
+            **kw,
+        ),
+    )
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(res[r], refres[i])
+    assert srv.failures() == {}
+    st = srv.stats()
+    assert st["tp_degree"] == 2
+    assert st["tp_degraded"] == 1 and st["tp_shrinks"] == 1
+    assert st["recoveries"] >= 1
+    degraded = [e for e in events if e.get("name") == "tp_degraded"]
+    assert len(degraded) == 1
+    assert degraded[0]["old_tp"] == 4 and degraded[0]["tp"] == 2
+    assert degraded[0]["reason"] == "chip_loss:1"
+    assert degraded[0]["survivors"] == 3
+
+
+def test_chip_loss_with_checkpoint_restore_under_new_sharding(model):
+    """Checkpointed lanes restore through _kv_host_upload under the NEW
+    (shrunk) sharding — recovered outputs stay bit-identical, and the
+    restore path actually engages (restored lanes, not just replays)."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 8, 6, 3])
+    _, refres, _ = _serve(params, cfg, prompts, budgets=12, tp=4)
+    rids, res, srv = _serve(
+        params, cfg, prompts, budgets=12, tp=4, checkpoint_rounds=1,
+        injector=FaultInjector(
+            [FaultSpec("decode_dispatch", 2, "chip_loss", 0)]
+        ),
+    )
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(res[r], refres[i])
+    assert srv.stats()["tp_degree"] == 2
+    assert srv.stats()["checkpoints"] >= 1
+
+
+def test_chip_loss_tp2_shrinks_to_single_chip(model):
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 8, 6, 3])
+    _, refres, _ = _serve(params, cfg, prompts)
+    rids, res, srv = _serve(
+        params, cfg, prompts, tp=2,
+        injector=FaultInjector([FaultSpec("decode_dispatch", 1, "chip_loss")]),
+    )
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(res[r], refres[i])
+    assert srv.stats()["tp_degree"] == 1
+    assert srv.stats()["tp_degraded"] == 1
+    assert srv.failures() == {}
+
+
+def test_ici_error_shrinks_one_rung(model):
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 8, 6, 3])
+    _, refres, _ = _serve(params, cfg, prompts)
+    rids, res, srv = _serve(
+        params, cfg, prompts, tp=4,
+        injector=FaultInjector([FaultSpec("decode_dispatch", 2, "ici_error")]),
+    )
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(res[r], refres[i])
+    assert srv.stats()["tp_degree"] == 2
+
+
+def test_env_schedule_chip_loss_tp4(model, monkeypatch):
+    """The daemon chaos path end-to-end: KATA_TPU_FAULTS carries the
+    permanent kind (with device index) into the default injector, and
+    the env-built server survives it degraded — the `make chaos`
+    chip-loss gate's shape."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 8, 6, 3])
+    _, refres, _ = _serve(params, cfg, prompts)
+    monkeypatch.setenv("KATA_TPU_FAULTS", "decode_dispatch:3:chip_loss:1")
+    monkeypatch.setenv("KATA_TPU_FAULTS_SEED", "13")
+    srv = GenerationServer(params, cfg, max_batch=2, max_len=32, chunk=4,
+                           recovery_backoff_s=0.0, tp=4, tp_min=1)
+    rids = [srv.submit(p, 8) for p in prompts]
+    res = srv.run()
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(res[r], refres[i])
+    assert srv.stats()["tp_degree"] == 2 and srv.failures() == {}
+
+
+def test_chip_loss_during_restore_shrinks_again(model):
+    """A SECOND permanent fault arriving while the first shrink's
+    checkpoint restore re-uploads (the pool_alloc seam inside
+    _restore_lane — crossing 4 with this workload) must shrink the mesh
+    AGAIN before the replay, not replay onto the dead rung: tp=4 → 2 →
+    1, outputs still bit-identical, nothing lost."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 8, 6, 3])
+    kw = dict(overlap=False, checkpoint_rounds=1,
+              kv_pool_tokens=4 * 32, kv_block_size=8)
+    _, refres, _ = _serve(params, cfg, prompts, budgets=12, **kw)
+    sched = [FaultSpec("decode_dispatch", 2, "chip_loss", 1),
+             FaultSpec("pool_alloc", 4, "chip_loss", 0)]
+    rids, res, srv = _serve(params, cfg, prompts, budgets=12, tp=4,
+                            injector=FaultInjector(sched, seed=3), **kw)
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(res[r], refres[i])
+    assert srv.failures() == {}
+    assert srv.stats()["tp_degree"] == 1
+    assert srv.stats()["tp_shrinks"] == 2
+
+
+def test_chip_loss_during_restore_with_floor_fails_all(model):
+    """Same restore-phase second fault, but the tp_min floor forbids the
+    second shrink: the load fails loudly and every rid — including the
+    survivors that were mid-restore — ends in results or failures."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 8, 6, 3])
+    sched = [FaultSpec("decode_dispatch", 2, "chip_loss", 1),
+             FaultSpec("pool_alloc", 4, "chip_loss", 0)]
+    rids, res, srv = _serve(
+        params, cfg, prompts, budgets=12, tp=4, tp_min=2,
+        overlap=False, checkpoint_rounds=1,
+        kv_pool_tokens=4 * 32, kv_block_size=8,
+        injector=FaultInjector(sched, seed=3),
+    )
+    _assert_none_vanish(rids, res, srv.failures())
+    assert srv.failures()
+    assert srv.stats()["tp_degree"] == 2  # the first shrink held
+
+
+# ----- no feasible rung: fail loudly, none vanish --------------------------
+
+
+def _assert_none_vanish(rids, res, fails):
+    assert set(rids) == set(res) | set(fails)
+    assert not set(res) & set(fails)
+
+
+def test_chip_loss_at_tp1_fails_all_loudly(model, tmp_path):
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 8, 6, 3])
+    (rids, res, srv), events = _capture_events(
+        tmp_path,
+        lambda: _serve(
+            params, cfg, prompts,
+            injector=FaultInjector(
+                [FaultSpec("decode_dispatch", 1, "chip_loss")]
+            ),
+        ),
+    )
+    fails = srv.failures()
+    _assert_none_vanish(rids, res, fails)
+    assert fails and all("ChipLossFault" in v for v in fails.values())
+    fatal = [e for e in events if e.get("name") == "chip_loss_fatal"]
+    assert len(fatal) == 1 and fatal[0]["why"] == "single_chip"
+    failed = [e for e in events if e.get("name") == "request_failed"]
+    assert {e["reason"] for e in failed} == {"chip_lost"}
+
+
+def test_tp_min_floor_fails_all(model, tmp_path):
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 8, 6, 3])
+    (rids, res, srv), events = _capture_events(
+        tmp_path,
+        lambda: _serve(
+            params, cfg, prompts, tp=4, tp_min=4,
+            injector=FaultInjector(
+                [FaultSpec("decode_dispatch", 2, "chip_loss", 1)]
+            ),
+        ),
+    )
+    _assert_none_vanish(rids, res, srv.failures())
+    assert srv.failures()
+    assert srv.stats()["tp_degree"] == 4  # never shrank
+    fatal = [e for e in events if e.get("name") == "chip_loss_fatal"]
+    assert len(fatal) == 1 and fatal[0]["why"] == "tp_min_floor:4"
+
+
+def test_degraded_kill_switch_fails_all(model, tmp_path):
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 8, 6, 3])
+    (rids, res, srv), events = _capture_events(
+        tmp_path,
+        lambda: _serve(
+            params, cfg, prompts, tp=4, degraded=False,
+            injector=FaultInjector(
+                [FaultSpec("decode_dispatch", 2, "chip_loss", 1)]
+            ),
+        ),
+    )
+    _assert_none_vanish(rids, res, srv.failures())
+    assert srv.failures()
+    fatal = [e for e in events if e.get("name") == "chip_loss_fatal"]
+    assert len(fatal) == 1 and fatal[0]["why"] == "degraded_disabled"
+
+
+def test_explicit_bad_tp_min_raises(model):
+    cfg, params = model
+    with pytest.raises(ValueError, match="tp_min"):
+        _server(params, cfg, tp_min=0)
+
+
+def test_transient_faults_keep_the_mesh(model):
+    """The classify() split's other half: a transient fault at tp=4
+    recovers WITHOUT shrinking (the pre-ISSUE-10 path, unchanged)."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 8, 6, 3])
+    _, refres, _ = _serve(params, cfg, prompts)
+    rids, res, srv = _serve(
+        params, cfg, prompts, tp=4,
+        injector=FaultInjector([FaultSpec("decode_dispatch", 2)]),
+    )
+    for i, r in enumerate(rids):
+        np.testing.assert_array_equal(res[r], refres[i])
+    st = srv.stats()
+    assert st["tp_degree"] == 4 and st["tp_shrinks"] == 0
+    assert st["recoveries"] == 1
+
+
+# ----- drain at tp>1 with a chip loss mid-drain ----------------------------
+
+
+def test_drain_tp4_paged_chip_loss_mid_drain(model):
+    """Graceful drain over a sharded paged pool with a chip_loss arriving
+    MID-DRAIN: started work finishes degraded (bit-identically), the
+    never-started tail fails as drained, and every rid ends in exactly
+    one of results/failures."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 5, 6, 7])
+    _, refres, _ = _serve(params, cfg, prompts, budgets=16)
+    srv = _server(
+        params, cfg, tp=4, overlap=False,
+        kv_pool_tokens=4 * 32, kv_block_size=8,
+        injector=FaultInjector([FaultSpec("decode_dispatch", 2,
+                                          "chip_loss", 1)]),
+    )
+    rids = [srv.submit(p, 16) for p in prompts]
+    for _ in range(2):  # decode crossings 0 and 1 — clean rounds
+        srv.step()
+    srv.request_drain(reason="test")
+    res = srv.run()  # crossing 2 loses the chip during the drain
+    fails = srv.failures()
+    _assert_none_vanish(rids, res, fails)
+    assert srv.stats()["tp_degree"] == 2  # shrank mid-drain
+    assert sorted(res) == rids[:2]  # the started lanes completed
+    for rid in res:
+        np.testing.assert_array_equal(res[rid], refres[rids.index(rid)])
+    assert all(v.startswith("drained") for v in fails.values())
+
+
+def test_drain_maintenance_file_tp2(model, tmp_path):
+    """The production drain trigger composes with a sharded server: the
+    maintenance-notice file flips a tp=2 paged server into draining."""
+    cfg, params = model
+    srv = _server(params, cfg, tp=2, kv_pool_tokens=4 * 32, kv_block_size=8)
+    notice = tmp_path / "maintenance"
+    wiring = resilience.wire_drain(
+        srv, sigterm=False, maintenance_file=str(notice), poll_s=0.01
+    )
+    try:
+        assert wiring.poll_once() is False
+        notice.write_text("scheduled")
+        assert wiring.poll_once() is True
+        assert srv.stats()["draining"]
+    finally:
+        wiring.stop()
+
+
+# ----- kv_replicated warning (satellite) -----------------------------------
+
+
+def test_kv_replicated_warns_once_with_extra_bytes(model, tmp_path):
+    """n_kv_heads (2) does not divide tp=4: the pool replicates onto
+    every shard — one kv_replicated event with the measured extra bytes,
+    emitted once per (server, degree) even across recovery rebuilds."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 6])
+    (rids, res, srv), events = _capture_events(
+        tmp_path,
+        lambda: _serve(
+            params, cfg, prompts, tp=4,
+            kv_pool_tokens=4 * 32, kv_block_size=8,
+            injector=FaultInjector([FaultSpec("decode_dispatch", 1)]),
+        ),
+    )
+    assert sorted(res) == rids
+    assert srv.stats()["recoveries"] == 1  # the rebuild re-placed the pool
+    evs = [e for e in events if e.get("name") == "kv_replicated"]
+    assert len(evs) == 1
+    assert evs[0]["tp"] == 4 and evs[0]["n_kv_heads"] == cfg.n_kv_heads
+    assert evs[0]["extra_bytes"] > 0
+
+
+def test_kv_shardable_degree_does_not_warn(model, tmp_path):
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 6])
+    (_rids, _res, _srv), events = _capture_events(
+        tmp_path, lambda: _serve(params, cfg, prompts, tp=2)
+    )
+    assert not [e for e in events if e.get("name") == "kv_replicated"]
+
+
+# ----- stats / knob plumbing -----------------------------------------------
+
+
+def test_stats_schema_always_has_degraded_fields(model):
+    cfg, params = model
+    srv = _server(params, cfg)
+    st = srv.stats()
+    assert st["tp_degraded"] == 0 and st["tp_shrinks"] == 0
+    assert st["tp_degree"] == 1
+
+
+def test_allocator_injects_tp_min_env_and_config_validates():
+    from kata_xpu_device_plugin_tpu.cdi import constants as C
+    from kata_xpu_device_plugin_tpu.config import Config
+    from kata_xpu_device_plugin_tpu.discovery.tpu import (
+        TpuChip,
+        TpuInventory,
+    )
+    from kata_xpu_device_plugin_tpu.plugin import TpuAllocator
+
+    inv = TpuInventory(
+        chips=(TpuChip(index=0, dev_path="/dev/accel0"),
+               TpuChip(index=1, dev_path="/dev/accel1")),
+        topology=HostTopology.from_accelerator_type("v5litepod-8"),
+        model_suffix="TPU_V5E",
+    )
+    alive = lambda _chip: True  # noqa: E731 — no real /dev in this test
+    wired = TpuAllocator(
+        lambda: inv, "google.com", "tpu", revalidate=alive,
+        serving_tp=2, serving_tp_min=2,
+    ).allocate(["0", "1"])
+    assert wired.envs[C.ENV_SERVING_TP_MIN] == "2"
+    bare = TpuAllocator(
+        lambda: inv, "google.com", "tpu", revalidate=alive
+    ).allocate(["0"])
+    assert C.ENV_SERVING_TP_MIN not in bare.envs
+    assert Config(serving_tp_min=2).serving_tp_min == 2
+    assert Config().serving_tp_min == 0
+    with pytest.raises(ValueError, match="serving-tp-min"):
+        Config(serving_tp_min=-1)
+    with pytest.raises(ValueError, match="serving-tp-min"):
+        Config(serving_tp=2, serving_tp_min=4)
+
+
+# ----- daemon half: allocation journal + health quarantine -----------------
+
+
+def test_allocation_journal_roundtrip(tmp_path):
+    from kata_xpu_device_plugin_tpu.plugin import AllocationJournal
+
+    path = str(tmp_path / "state" / "allocations.json")
+    j = AllocationJournal(path)
+    j.record("google.com/tpu", ["1", "0"])
+    j.record("google.com/tpu", ["2", "3"])
+    # Reload from disk: groups survive, ids normalized/sorted.
+    j2 = AllocationJournal(path)
+    assert j2.allocations("google.com/tpu") == [("0", "1"), ("2", "3")]
+    # A re-allocation of a freed device supersedes its old entry.
+    j2.record("google.com/tpu", ["0"])
+    assert AllocationJournal(path).allocations("google.com/tpu") == [
+        ("0",), ("0", "1"), ("2", "3")
+    ]
+
+
+def test_journal_reconcile_emits_and_drops_orphans(tmp_path):
+    from kata_xpu_device_plugin_tpu.plugin import AllocationJournal
+
+    path = str(tmp_path / "allocations.json")
+    j = AllocationJournal(path)
+    j.record("google.com/tpu", ["0", "1"])
+    j.record("google.com/tpu", ["2", "3"])
+    fresh = AllocationJournal(path)
+
+    def run():
+        return fresh.reconcile("google.com/tpu", {"0", "1", "2"})
+
+    (rec, orp), events = _capture_events(tmp_path, run)
+    assert (rec, orp) == (1, 1)
+    ok = [e for e in events if e.get("name") == "alloc_reconciled"]
+    bad = [e for e in events if e.get("name") == "alloc_orphaned"]
+    assert len(ok) == 1 and ok[0]["devices"] == "0,1"
+    assert len(bad) == 1 and bad[0]["devices"] == "2,3"
+    assert bad[0]["missing"] == "3"
+    # Orphaned entries dropped and the drop persisted.
+    assert AllocationJournal(path).allocations("google.com/tpu") == [
+        ("0", "1")
+    ]
+
+
+def test_journal_corrupt_or_missing_file_degrades(tmp_path):
+    from kata_xpu_device_plugin_tpu.plugin import AllocationJournal
+
+    missing = AllocationJournal(str(tmp_path / "nope.json"))
+    assert missing.allocations("google.com/tpu") == []
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    j = AllocationJournal(str(bad))
+    assert j.allocations("google.com/tpu") == []
+    # And it recovers to a writable journal.
+    j.record("google.com/tpu", ["0"])
+    assert AllocationJournal(str(bad)).allocations("google.com/tpu") == [
+        ("0",)
+    ]
+
+
+def test_journal_reconcile_never_touches_health(tmp_path):
+    """The zero-spurious-flaps half of the acceptance criterion: a
+    restart reconcile with a populated journal emits allocation events
+    only — no health transition, no subscriber wake-up in the
+    ListAndWatch path."""
+    from kata_xpu_device_plugin_tpu.plugin import AllocationJournal
+    from kata_xpu_device_plugin_tpu.plugin.api import glue
+    from kata_xpu_device_plugin_tpu.plugin.server import (
+        DeviceState,
+        WatchedDevice,
+    )
+
+    state = DeviceState([WatchedDevice(id=str(i)) for i in range(4)])
+    q = state.subscribe()
+    path = str(tmp_path / "allocations.json")
+    j = AllocationJournal(path)
+    j.record("google.com/tpu", ["0", "1"])
+    j.record("google.com/tpu", ["3"])
+    fresh = AllocationJournal(path)
+    fresh.reconcile("google.com/tpu", {"0", "1", "2"})  # 3 vanished
+    assert q.qsize() == 0  # no ListAndWatch wake-up
+    assert all(d.health == glue.HEALTHY for d in state.snapshot())
+
+
+def test_health_flip_emits_quarantine_and_readmit_events(tmp_path):
+    """Per-chip health quarantine (ISSUE 10): an Unhealthy flip emits
+    chip_quarantined, recovery emits chip_readmitted — joinable against
+    the guest's tp_degraded stream on the same incident."""
+    from kata_xpu_device_plugin_tpu.plugin import HealthWatcher
+    from kata_xpu_device_plugin_tpu.plugin.api import glue
+    from kata_xpu_device_plugin_tpu.plugin.server import (
+        DeviceState,
+        WatchedDevice,
+    )
+
+    dev = tmp_path / "accel0"
+    dev.write_text("")  # regular file: existence is the health signal
+
+    class _Plugin:
+        resource_name = "google.com/tpu"
+        stopped = False
+        serving = True
+        socket_dir = str(tmp_path)
+        socket_path = str(tmp_path / "sock")
+        state = DeviceState([
+            WatchedDevice(id="0", watch_paths=(str(dev),))
+        ])
+
+    plugin = _Plugin()
+    (tmp_path / "sock").write_text("")  # no restart path in this test
+    watcher = HealthWatcher([plugin], use_inotify=False)
+
+    def run():
+        watcher.evaluate()          # healthy, no flip
+        dev.unlink()
+        watcher.evaluate()          # flip to UNHEALTHY
+        dev.write_text("")
+        watcher.evaluate()          # flip back
+
+    _, events = _capture_events(tmp_path, run)
+    quarantined = [e for e in events if e.get("name") == "chip_quarantined"]
+    readmitted = [e for e in events if e.get("name") == "chip_readmitted"]
+    assert len(quarantined) == 1 and quarantined[0]["device"] == "0"
+    assert len(readmitted) == 1 and readmitted[0]["device"] == "0"
+    assert plugin.state.get("0").health == glue.HEALTHY

@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-watch chaos tp eval demo dryrun image clean deploy obs-check
+.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp eval demo dryrun image clean deploy obs-check
 
 all: build
 
@@ -100,8 +100,16 @@ bench-load:
 	KATA_TPU_BENCH_INT8=0 KATA_TPU_BENCH_SERVING=0 KATA_TPU_BENCH_SOFTCAP=0 \
 	KATA_TPU_BENCH_TRAIN=0 KATA_TPU_BENCH_PREFIX=0 KATA_TPU_BENCH_PAGED=0 \
 	KATA_TPU_BENCH_FAULTS=0 KATA_TPU_BENCH_SPEC=0 KATA_TPU_BENCH_TP=0 \
-	KATA_TPU_BENCH_DEGRADED=0 \
+	KATA_TPU_BENCH_DEGRADED=0 KATA_TPU_BENCH_OBS=0 \
 	  $(PY) bench.py --smoke
+
+# Bench-bank trend (ISSUE 11 satellite): compare the two newest
+# BENCH_TPU_*.json banks, print per-metric deltas, flag >10% headline
+# regressions (exit 1). decode tok/s/chip sat at 1303.8 across the whole
+# bank unnoticed — this makes the trajectory visible. CI runs it
+# non-blocking: a bench regression is a flag to read, not a merge gate.
+bench-trend:
+	$(PY) -m tools.bench_trend
 
 # Chaos gate (ISSUE 7): the serving test subset under a FIXED seeded
 # fault schedule injected through the same KATA_TPU_FAULTS env the
@@ -117,12 +125,15 @@ bench-load:
 # that crosses it, so recovery × chunked-prefill replay (mid-chunk fault →
 # strict-FIFO requeue from the prompt) runs under BOTH strict modes.
 chaos:
+	rm -rf chaos_flight_dumps
 	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_events.jsonl \
+	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
 	KATA_TPU_FAULTS="decode_dispatch:5,fence:7:hang,prefill:3,sched_tick:2" \
 	KATA_TPU_FAULTS_SEED=13 \
 	  $(PY) -m pytest tests/test_recovery.py tests/test_serving.py \
 	    tests/test_serving_pipeline.py tests/test_scheduler.py -q
 	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_events_strict.jsonl \
+	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
 	KATA_TPU_FAULTS="decode_dispatch:5,fence:7:hang,prefill:3,sched_tick:2" \
 	KATA_TPU_FAULTS_SEED=13 KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_recovery.py tests/test_serving.py \
@@ -135,10 +146,12 @@ chaos:
 	# under allow_transfer and must stay transfer-guard-clean).
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_chiploss_events.jsonl \
+	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
 	KATA_TPU_FAULTS="decode_dispatch:3:chip_loss:1" KATA_TPU_FAULTS_SEED=13 \
 	  $(PY) -m pytest tests/test_degraded.py -q
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_chiploss_events_strict.jsonl \
+	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
 	KATA_TPU_FAULTS="decode_dispatch:3:chip_loss:1" KATA_TPU_FAULTS_SEED=13 \
 	KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_degraded.py -q

@@ -296,6 +296,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
             env["KATA_TPU_BENCH_LOAD"] = "0"
             env["KATA_TPU_BENCH_TP"] = "0"
             env["KATA_TPU_BENCH_DEGRADED"] = "0"
+            env["KATA_TPU_BENCH_OBS"] = "0"
         attempts += 1
         stage_timeout = SMOKE_TIMEOUT_S if args.smoke else ATTEMPT_TIMEOUT_S
         line, hung = run_once(
@@ -339,6 +340,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
         env["KATA_TPU_BENCH_LOAD"] = "0"
         env["KATA_TPU_BENCH_TP"] = "0"
         env["KATA_TPU_BENCH_DEGRADED"] = "0"
+        env["KATA_TPU_BENCH_OBS"] = "0"
         cmd = list(worker_cmd) + ["--smoke", "--fallback"]
         line, _hung = run_once(cmd, env, SMOKE_TIMEOUT_S, "cpu-fallback")
         if line is not None:
@@ -1612,6 +1614,157 @@ def worker(args: argparse.Namespace) -> None:
                 else:
                     os.environ[k] = v
 
+    def measure_obs() -> dict:  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
+        # Telemetry-overhead A/B (ISSUE 11): the same burst served three
+        # ways — (a) the PRODUCTION DEFAULT: request lifecycle ledger +
+        # always-armed flight-recorder ring, JSONL sink off
+        # (serving_obs_*); (b) everything disarmed, recorder included
+        # (serving_obs_off_*); (c) the full opt-in KATATPU_OBS JSONL
+        # sink (serving_obs_sink_*). What this pins: the always-on
+        # tier's cost is noise (serving_obs_overhead_ratio ~1.0,
+        # acceptance <= 1% tok/s — the ring is a dict append per event
+        # at scheduling cadence), greedy outputs are BIT-IDENTICAL
+        # tracing on/off (serving_obs_token_match == 1.0 — telemetry
+        # must never touch numerics), and phase attribution is complete
+        # (serving_obs_trace_coverage ~1.0: request_trace phases sum to
+        # request wall time — read from the RING, proving the flight
+        # recorder captures lifecycle traces with the sink off). The
+        # sink side's ratio is context: per-line flushed file I/O is
+        # the documented opt-in cost, visible at smoke-tiny round
+        # times. SIDE measurement with the usual protections: after the
+        # banked headline, crash-guarded, KATA_TPU_BENCH_OBS=0 disables
+        # (off on retries/fallback).
+        if os.environ.get("KATA_TPU_BENCH_OBS", "1") == "0":
+            return {}
+        try:
+            import tempfile
+
+            from kata_xpu_device_plugin_tpu.guest.serving import (
+                GenerationServer,
+            )
+            from kata_xpu_device_plugin_tpu.obs import flight as obs_flight
+
+            srv_chunk = 8 if args.smoke else 16
+            new_per_req = 64
+            rng = jax.random.PRNGKey(53)
+            len_step = max(1, PROMPT_LEN // 8)
+
+            def make_server():
+                return GenerationServer(
+                    params, cfg, max_batch=BATCH,
+                    max_len=PROMPT_LEN + 72, chunk=srv_chunk,
+                    prefill_buckets=(PROMPT_LEN,),
+                    # Explicit offs: daemon-injected pool/prefix envs
+                    # must not contaminate the A/B.
+                    prefix_cache_tokens=0, kv_pool_tokens=0,
+                )
+
+            def reqs(srv, salt=0):
+                out_r = []
+                for i in range(2 * BATCH):
+                    n = PROMPT_LEN - (i % 4) * len_step
+                    p = jax.random.randint(
+                        jax.random.fold_in(rng, salt + i), (n,), 0,
+                        cfg.vocab_size, dtype=jnp.int32,
+                    )
+                    out_r.append(srv.submit(np.asarray(p), new_per_req))
+                return out_r
+
+            warm = make_server()
+            reqs(warm, salt=7000)
+            warm.run()
+
+            tmpdir = tempfile.mkdtemp(prefix="bench_obs_")
+
+            def one_trial(mode: str, trial: int):  # jaxguard: hot  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
+                # Same salt on every side and across trials: the A/B's
+                # whole claim is identical work, identical outputs.
+                # mode: "ring" (recorder armed, sink off — the
+                # production default), "off" (everything disarmed),
+                # "sink" (full JSONL stream).
+                rec = (
+                    obs_flight.FlightRecorder(capacity=4096)
+                    if mode != "off" else None
+                )
+                sink = (
+                    obs.EventSink(os.path.join(
+                        tmpdir, f"events_{trial}.jsonl"
+                    )) if mode == "sink" else None
+                )
+                prev_rec = obs_flight.set_default_recorder(rec)
+                prev_sink = obs.set_default_sink(sink)
+                try:
+                    srv = make_server()
+                    rids = reqs(srv, salt=0)
+                    t0 = time.perf_counter()
+                    results = srv.run()
+                    dt_s = time.perf_counter() - t0
+                finally:
+                    obs.set_default_sink(prev_sink)
+                    obs_flight.set_default_recorder(prev_rec)
+                    if sink is not None:
+                        sink.close()
+                total = sum(len(results[r]) for r in rids)
+                return (total, dt_s, results, rec)
+
+            # INTERLEAVED trials (ring/off/sink per round, best-of-4 per
+            # side): host drift — thermal, page cache, a background
+            # compile — then lands on every side equally instead of
+            # biasing whichever side ran last.
+            best: dict = {}
+            for trial in range(4):
+                for mode in ("ring", "off", "sink"):
+                    r = one_trial(mode, trial)
+                    if mode not in best or r[1] < best[mode][1]:
+                        best[mode] = r
+            ring_total, ring_s, ring_results, ring_rec = best["ring"]
+            off_total, off_s, off_results, _r = best["off"]
+            sink_total, sink_s, sink_results, _r2 = best["sink"]
+
+            def outputs_equal(a, b):
+                return float(
+                    set(a) == set(b)
+                    and all(np.array_equal(a[r], b[r]) for r in a)
+                )
+
+            match = min(
+                outputs_equal(ring_results, off_results),
+                outputs_equal(sink_results, off_results),
+            )
+            traces = [
+                e for e in (ring_rec.snapshot() if ring_rec else [])
+                if e.get("name") == "request_trace"
+            ]
+            coverage = (
+                sum(
+                    e["attributed_s"] / e["wall_s"]
+                    for e in traces if e.get("wall_s")
+                ) / len(traces)
+            ) if traces else 0.0
+            ring_rate = ring_total / ring_s
+            off_rate = off_total / off_s
+            sink_rate = sink_total / sink_s
+            return {
+                "serving_obs_tok_per_s": round(ring_rate, 1),
+                "serving_obs_off_tok_per_s": round(off_rate, 1),
+                # >= 0.99 is the acceptance bar (<= 1% tok/s overhead
+                # for the always-armed tier); interleaved best-of-4 on
+                # every side keeps scheduler noise out.
+                "serving_obs_overhead_ratio": round(
+                    ring_rate / off_rate, 3) if off_rate else 0.0,
+                # Context: the opt-in JSONL stream's cost (per-line
+                # flushed writes — expected to be visible at smoke-tiny
+                # round times, amortized on hardware).
+                "serving_obs_sink_tok_per_s": round(sink_rate, 1),
+                "serving_obs_sink_ratio": round(
+                    sink_rate / off_rate, 3) if off_rate else 0.0,
+                "serving_obs_token_match": match,
+                "serving_obs_traces": len(traces),
+                "serving_obs_trace_coverage": round(coverage, 4),
+            }
+        except Exception as exc:  # noqa: BLE001 — headline must survive
+            return {"obs_error": f"{type(exc).__name__}: {exc}"[:200]}
+
     def measure_train() -> dict:
         # Train-step MFU (r5): the flash bwd kernels, remat, and the GSPMD
         # train step were inference-unmeasured claims until this section —
@@ -1786,6 +1939,10 @@ def worker(args: argparse.Namespace) -> None:
     degraded_out = measure_degraded()
     if degraded_out:
         out.update(degraded_out)
+        print(json.dumps(out), flush=True)
+    obs_out = measure_obs()
+    if obs_out:
+        out.update(obs_out)
         print(json.dumps(out), flush=True)
     softcap_out = measure_softcap_prefill()
     if softcap_out:

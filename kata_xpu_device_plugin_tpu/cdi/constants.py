@@ -100,6 +100,16 @@ ENV_SERVING_TP = "KATA_TPU_TP"
 # The guest-side kill switch KATA_TPU_DEGRADED=0 is env-only.
 ENV_SERVING_TP_MIN = "KATA_TPU_TP_MIN"
 
+# Per-allocation trace context handed to the guest (ISSUE 11): the
+# daemon's Allocate handler stamps the trace id of its own
+# ``plugin.Allocate`` span into this env, so in-guest
+# GenerationServers adopt it as their trace id — guest spans and
+# lifecycle events (``request_trace``, ``recovery``, ``tp_degraded``,
+# flight-recorder dumps) then join the daemon's allocation trace end
+# to end (docs/architecture.md "Daemon → guest trace context").
+# --no-trace-context disables the stamp; guests then mint their own.
+ENV_TRACE_CTX = "KATA_TPU_TRACE_CTX"
+
 # SLO-aware admission scheduling handed to the guest (ISSUE 8):
 # guest.serving.GenerationServer reads these when the caller passes no
 # explicit scheduler args — policy ("fifo_batch" | "slo_chunked"; unknown

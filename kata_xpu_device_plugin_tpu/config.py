@@ -166,6 +166,16 @@ class Config:
     # tp_min_invalid event. 0 leaves the guest default (shrink to 1).
     serving_tp_min: int = 0
 
+    # Per-allocation trace context (ISSUE 11): when enabled (default),
+    # every TPU Allocate stamps the trace id of its own plugin.Allocate
+    # span into KATA_TPU_TRACE_CTX in the AllocateResponse env, so
+    # in-guest GenerationServers join their spans/events — request
+    # lifecycle traces, recovery/degraded events, flight-recorder dumps
+    # — to the daemon's allocation trace (docs/architecture.md
+    # "Daemon → guest trace context"). --no-trace-context disables the
+    # stamp; guests then mint their own trace ids.
+    trace_context: bool = True
+
     # Kubelet registration retry policy (ISSUE 7 satellite): attempts ×
     # exponential backoff (plus jitter) before a plugin gives up with a
     # registration_exhausted event. The old hardcoded 5 × 1 s ladder gave

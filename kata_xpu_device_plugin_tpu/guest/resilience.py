@@ -204,6 +204,10 @@ class FaultInjector:
     schedule: Iterable[FaultSpec] = ()
     seed: int = 0
     label: str = ""
+    # Allocation trace id (ISSUE 11): attached to every fault_injected /
+    # device_stall event so a chaos run's injections join the request
+    # traces and flight-recorder dumps of the same incident.
+    trace: str = ""
     hang_s: float = 0.0  # optional real delay before an injected stall
     fired: list = field(default_factory=list)
 
@@ -224,7 +228,7 @@ class FaultInjector:
         self._rng = random.Random(self.seed)
 
     @classmethod
-    def from_env(cls, label: str = "") -> "FaultInjector":
+    def from_env(cls, label: str = "", trace: str = "") -> "FaultInjector":
         """The injector the serving loop builds by default: schedule from
         ``KATA_TPU_FAULTS`` (the env the daemon's ``--faults`` chaos knob
         injects), seed from ``KATA_TPU_FAULTS_SEED``. Malformed entries
@@ -241,7 +245,7 @@ class FaultInjector:
             seed = int(os.environ.get(ENV_FAULTS_SEED, "0") or 0)
         except ValueError:
             seed = 0
-        return cls(schedule=specs, seed=seed, label=label)
+        return cls(schedule=specs, seed=seed, label=label, trace=trace)
 
     @property
     def armed(self) -> bool:
@@ -263,6 +267,8 @@ class FaultInjector:
         kind = spec.kind
         self.fired.append((seam, n, kind))
         extra = {"device": spec.device} if kind == KIND_CHIP_LOSS else {}
+        if self.trace:
+            extra["trace"] = self.trace
         obs.emit(
             "serving", "fault_injected",
             server=self.label, seam=seam, round=n, fault_kind=kind,
@@ -294,6 +300,7 @@ class FaultInjector:
         obs.emit(
             "serving", "device_stall",
             server=self.label, seam=seam, injected=True,
+            **({"trace": self.trace} if self.trace else {}),
         )
         raise DeviceStallError(f"injected device stall at {seam}#{n}")
 
@@ -351,6 +358,7 @@ def fence_with_timeout(
     seam: str = "fence",
     injector: Optional[FaultInjector] = None,
     server: str = "",
+    trace: str = "",
 ) -> object:
     """Run a blocking device wait (``wait`` is a zero-arg callable — a
     ``DeviceFence.wait`` / ``block_until_ready`` / host-transfer closure)
@@ -377,6 +385,7 @@ def fence_with_timeout(
             "serving", "device_stall",
             server=server, seam=seam, timeout_s=round(float(timeout_s), 3),
             injected=False,
+            **({"trace": trace} if trace else {}),
         )
         raise DeviceStallError(
             f"device fence {seam!r} exceeded {timeout_s}s watchdog deadline"
@@ -443,10 +452,11 @@ def recoverable(exc: BaseException) -> bool:
 
 
 def env_int(name: str, default: int, *, event: str = "",
-            server: str = "") -> int:
+            server: str = "", trace: str = "") -> int:
     """Integer env knob with the repo's degrade contract: a malformed
     node-injected value falls back to ``default`` with one ``event``
-    (reason ``bad_env:<raw>``) instead of crashing the guest."""
+    (reason ``bad_env:<raw>``) instead of crashing the guest. ``trace``
+    joins the degrade event to the allocation trace (ISSUE 11)."""
     raw = os.environ.get(name, "")
     if not raw:
         return default
@@ -455,12 +465,13 @@ def env_int(name: str, default: int, *, event: str = "",
     except ValueError:
         if event:
             obs.emit("serving", event, server=server,
-                     reason=f"bad_env:{raw[:32]}")
+                     reason=f"bad_env:{raw[:32]}",
+                     **({"trace": trace} if trace else {}))
         return default
 
 
 def env_float(name: str, default: float, *, event: str = "",
-              server: str = "") -> float:
+              server: str = "", trace: str = "") -> float:
     """Float sibling of :func:`env_int` (same degrade contract)."""
     raw = os.environ.get(name, "")
     if not raw:
@@ -470,7 +481,8 @@ def env_float(name: str, default: float, *, event: str = "",
     except ValueError:
         if event:
             obs.emit("serving", event, server=server,
-                     reason=f"bad_env:{raw[:32]}")
+                     reason=f"bad_env:{raw[:32]}",
+                     **({"trace": trace} if trace else {}))
         return default
 
 

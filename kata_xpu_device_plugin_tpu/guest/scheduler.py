@@ -145,6 +145,17 @@ class Scheduler:
         submit→grant wait."""
         self.queue_delay.observe(max(0.0, float(delay_s)))
 
+    def reset_estimates(self) -> None:
+        """Drop the prefill-rate and round-cadence EWMAs. Called by the
+        serving loop after a degraded-mode mesh shrink (ISSUE 11): the
+        estimates were measured on the OLD mesh, and a shrunken mesh is
+        slower — stale values would mis-project the first post-recovery
+        admissions, either thrashing chunked admission or missing the
+        SLO. Re-bootstrapping keeps the projection honest (the first
+        degraded admission and round re-measure)."""
+        self._prefill_s_per_tok = None
+        self._round_s = None
+
     def _check_slo(self, dur_s: float) -> bool:
         return False
 

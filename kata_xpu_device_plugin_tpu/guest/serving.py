@@ -164,6 +164,35 @@ from .scheduler import (
 # or the server degrades to plain decoding with a ``spec_disabled`` event.
 ENV_SPEC_OPT_IN = "KATA_TPU_SPEC"
 
+# Per-allocation trace context (ISSUE 11): the daemon's Allocate handler
+# stamps its span's trace id into this env (cdi.constants.ENV_TRACE_CTX,
+# config.trace_context — the same constants → allocators → manager path
+# as every other knob). A server ADOPTS it as its trace id, so every
+# serving span/event — request lifecycle traces, recovery/degraded
+# events, flight-recorder dumps — joins the daemon's allocation trace
+# end to end. Unset (direct runs, tests): the server mints its own, so
+# a process's workloads still share one join key per server.
+ENV_TRACE_CTX = "KATA_TPU_TRACE_CTX"
+
+# Request lifecycle phases (ISSUE 11): every submitted request is in
+# exactly ONE of these states at any moment, and the per-request ledger
+# accrues wall time into the current phase at each state transition —
+# so the emitted ``request_trace`` event's phases sum to the request's
+# wall clock by construction (transitions are stamped at the same
+# honest fence points the latency metrics already use: the first-token
+# fence, the retire cadence, the spill/restore completions).
+PHASE_QUEUE = "queue"                # submit → admission grant
+PHASE_PREFILL = "prefill"            # admission grant → first-token fence
+#                                      (chunked slices + their deferrals)
+PHASE_DECODE = "decode"              # decoding rounds at full tp
+PHASE_DECODE_DEGRADED = "decode_degraded"  # decoding on a shrunken mesh
+PHASE_PREEMPTED = "preempted"        # KV spilled, waiting FIFO for the pool
+PHASE_RECOVERY = "recovery"          # crash recovery: restore wait + replay
+PHASES = (
+    PHASE_QUEUE, PHASE_PREFILL, PHASE_DECODE, PHASE_DECODE_DEGRADED,
+    PHASE_PREEMPTED, PHASE_RECOVERY,
+)
+
 
 # Serving-stat gauges, created through obs.metrics' idempotent factory
 # (a reload or second import path returns the SAME collectors instead of
@@ -199,7 +228,21 @@ _PROM_STATS = (
                     "after a permanent chip fault (0/1)"),
     ("tp_shrinks", "Elastic mesh-shrink recoveries performed (chip loss / "
                    "ICI failure survived degraded)"),
+    ("request_traces", "Request lifecycle traces emitted (one request_trace "
+                       "event per retired/failed request)"),
 )
+
+
+# Per-request lifecycle phase times (ISSUE 11): observed once per retired
+# or failed request, one labeled child per phase — the aggregate a fleet
+# router can load-balance on (where does THIS server's latency go).
+def _hist_phase():
+    return obs.histogram(
+        "kata_tpu_serving_request_phase_seconds",
+        "Per-request lifecycle phase time attributed at retire "
+        "(queue/prefill/decode/decode_degraded/preempted/recovery)",
+        ["server", "phase"],
+    )
 
 
 # Per-shard paged-pool occupancy (ISSUE 9): one gauge per mesh shard so
@@ -366,6 +409,13 @@ class _Request:
     # Times this request was requeued for a from-the-prompt replay by
     # crash recovery — its re-admission ttft event is labeled with it.
     replays: int = 0
+    # Lifecycle ledger (ISSUE 11): accrued seconds per PHASES entry, the
+    # current phase, and the monotonic stamp it was entered at. ``state``
+    # is None once the ledger closed (request_trace emitted) — a second
+    # finish/fail can never double-emit or double-accrue.
+    phases: dict = field(default_factory=dict)
+    state: Optional[str] = PHASE_QUEUE
+    t_state: float = 0.0
 
 
 @dataclass
@@ -681,8 +731,21 @@ class GenerationServer:
         # Label + latency summaries FIRST: every env-degrade event below
         # (spec opt-in, scheduler, pool, prefix) carries the server label.
         self._label = f"server{next(GenerationServer._instance_ids)}"
+        # Trace context (ISSUE 11): adopt the daemon-injected
+        # per-allocation trace id, or mint one — every serving span and
+        # event this server emits carries it (self._emit), so guest
+        # telemetry joins the daemon's allocation trace end to end.
+        self._trace = (
+            os.environ.get(ENV_TRACE_CTX, "").strip() or obs.new_trace()
+        )
         self._ttft = obs.Rolling()
         self._tok_lat = obs.Rolling()
+        # Request lifecycle ledger aggregates (ISSUE 11): per-phase
+        # Rolling summaries observed once per retired/failed request
+        # (only phases the request actually spent time in — a request
+        # that never preempted must not drag the preempted p50 to 0).
+        self._phase_roll = {p: obs.Rolling() for p in PHASES}
+        self._traces_emitted = 0
         # Speculative serving demoted behind an explicit opt-in (ISSUE 8
         # satellite; see ENV_SPEC_OPT_IN): validation above still rejects
         # malformed spec configs, but a VALID one only arms when opted in
@@ -694,9 +757,8 @@ class GenerationServer:
                 if spec_opt_in is None else bool(spec_opt_in)
             )
             if not opted:
-                obs.emit(
-                    "serving", "spec_disabled",
-                    server=self._label, reason="opt_in_required",
+                self._emit(
+                    "spec_disabled", reason="opt_in_required",
                     speculative_k=speculative_k,
                 )
                 speculative_k = 0
@@ -762,9 +824,8 @@ class GenerationServer:
             raw = os.environ.get(ENV_SCHED_POLICY, "").strip()
             sched_policy = raw or POLICY_FIFO
             if sched_policy not in POLICIES:
-                obs.emit(
-                    "serving", "sched_disabled",
-                    server=self._label, reason=f"bad_env:{raw[:32]}",
+                self._emit(
+                    "sched_disabled", reason=f"bad_env:{raw[:32]}",
                 )
                 sched_policy = POLICY_FIFO
         elif sched_policy not in POLICIES:
@@ -788,9 +849,8 @@ class GenerationServer:
             # A node-injected nonsense value (parseable but < 1 token)
             # degrades to the default chunk — it must not disable a
             # policy the guest explicitly asked for, nor crash it.
-            obs.emit(
-                "serving", "prefill_chunk_invalid",
-                server=self._label, reason=f"bad_env:{chunk_tokens}",
+            self._emit(
+                "prefill_chunk_invalid", reason=f"bad_env:{chunk_tokens}",
             )
             chunk_tokens = DEFAULT_PREFILL_CHUNK
         slo_ms = (
@@ -816,9 +876,8 @@ class GenerationServer:
                         f"this server ({reason}) — see 'Scheduling & "
                         "SLOs' in docs/guest_guide.md"
                     )
-                obs.emit(
-                    "serving", "sched_disabled",
-                    server=self._label, reason=reason,
+                self._emit(
+                    "sched_disabled", reason=reason,
                 )
                 sched_policy = POLICY_FIFO
         self._sched = make_scheduler(
@@ -837,7 +896,9 @@ class GenerationServer:
         # wrapper calls through inline, and no checkpoint gathers run.
         self._inj = (
             fault_injector if fault_injector is not None
-            else FaultInjector.from_env(label=self._label)
+            else FaultInjector.from_env(
+                label=self._label, trace=self._trace
+            )
         )
         self._fence_timeout_s = (
             resilience.env_float(
@@ -878,9 +939,8 @@ class GenerationServer:
                     "speculative/draft serving — recovery falls back to "
                     "full replay there (docs/resilience.md)"
                 )
-            obs.emit(
-                "serving", "checkpoint_disabled",
-                server=self._label, reason="speculative",
+            self._emit(
+                "checkpoint_disabled", reason="speculative",
             )
             ckpt = 0
         self._ckpt_every = max(0, ckpt)
@@ -925,7 +985,9 @@ class GenerationServer:
                     "serving mesh (guest/tp_serving.py)"
                 )
         elif mesh is None:
-            tp = tp_serving.tp_from_env(label=self._label)
+            tp = tp_serving.tp_from_env(
+                label=self._label, trace=self._trace
+            )
         else:
             tp = 1
         if tp > 1:
@@ -947,9 +1009,8 @@ class GenerationServer:
                         f"({reason}) — see 'Tensor-parallel serving' in "
                         "docs/guest_guide.md"
                     )
-                obs.emit(
-                    "serving", "tp_disabled",
-                    server=self._label, reason=reason, tp=tp,
+                self._emit(
+                    "tp_disabled", reason=reason, tp=tp,
                 )
                 tp = 1
         self._tp = tp
@@ -992,7 +1053,9 @@ class GenerationServer:
                 raise ValueError(f"tp_min must be >= 1, got {tp_min}")
             self._tp_min = tp_min
         else:
-            self._tp_min = tp_serving.tp_min_from_env(label=self._label)
+            self._tp_min = tp_serving.tp_min_from_env(
+                label=self._label, trace=self._trace
+            )
         self._params_host = None
         if self._tp_serving_rules and self._degraded_ok:
             from ..parallel.sharding import host_param_copy
@@ -1016,9 +1079,8 @@ class GenerationServer:
                 # A malformed NODE-WIDE env must degrade to the fixed-slot
                 # path with an event, never crash a guest that did not opt
                 # in (mirrors KATA_TPU_PREFIX_CACHE_TOKENS).
-                obs.emit(
-                    "serving", "kv_pool_disabled",
-                    server=self._label, reason=f"bad_env:{raw[:32]}",
+                self._emit(
+                    "kv_pool_disabled", reason=f"bad_env:{raw[:32]}",
                 )
                 kv_pool_tokens = 0
         if kv_pool_tokens > 0:
@@ -1034,9 +1096,8 @@ class GenerationServer:
                     )
                 # Node-injected default on an incompatible server: degrade
                 # to the fixed-slot path, say so on the event stream.
-                obs.emit(
-                    "serving", "kv_pool_disabled",
-                    server=self._label, reason=reason,
+                self._emit(
+                    "kv_pool_disabled", reason=reason,
                 )
             else:
                 self.paged = True
@@ -1125,9 +1186,8 @@ class GenerationServer:
                 # A malformed NODE-WIDE env (e.g. "16k") must degrade like
                 # every other implicit prefix-cache fallback, never crash
                 # a guest server that did not opt in.
-                obs.emit(
-                    "serving", "prefix_store_disabled",
-                    server=self._label, reason=f"bad_env:{raw[:32]}",
+                self._emit(
+                    "prefix_store_disabled", reason=f"bad_env:{raw[:32]}",
                 )
                 prefix_cache_tokens = 0
         self.prefix_store: Optional[PrefixStore] = None
@@ -1137,9 +1197,8 @@ class GenerationServer:
                 # refusing the server: the ring/cycle folds re-layout prefix
                 # rows per slot, and a draft server's second arena would
                 # miss its own prefix KV. Documented in docs/guest_guide.md.
-                obs.emit(
-                    "serving", "prefix_store_disabled",
-                    server=self._label,
+                self._emit(
+                    "prefix_store_disabled",
                     reason="ring_kv" if ring_kv else "draft",
                 )
             elif not self.prefill_buckets:
@@ -1153,9 +1212,8 @@ class GenerationServer:
                 # node-wide knob must never crash a guest server that was
                 # valid without it — degrade like the other implicit
                 # fallbacks and say so on the event stream.
-                obs.emit(
-                    "serving", "prefix_store_disabled",
-                    server=self._label, reason="no_prefill_buckets",
+                self._emit(
+                    "prefix_store_disabled", reason="no_prefill_buckets",
                 )
             elif self.paged:
                 # The radix prefix store becomes the shared-prefix TIER of
@@ -1205,9 +1263,87 @@ class GenerationServer:
         )
         self._prefix_capacity = int(prefix_cache_tokens or 0)
 
+    def _emit(self, name: str, **fields) -> None:
+        """One emitter for every serving event: attaches the server label
+        and the allocation TRACE id (ISSUE 11) so postmortem consumers —
+        the flight recorder's dumps in particular — can join any event
+        back to the daemon's Allocate span and to the request traces of
+        the same incident. Fields win on collision."""
+        obs.emit(
+            "serving", name,
+            **{"server": self._label, "trace": self._trace, **fields},
+        )
+
+    # ----- request lifecycle ledger (ISSUE 11) -----------------------------
+
+    def _ledger_to(self, req: _Request, state: Optional[str],
+                   now: Optional[float] = None) -> None:
+        """Move ``req`` to lifecycle phase ``state``, accruing the time
+        since the previous transition into the phase it is leaving.
+        ``now`` lets callers stamp at an honest fence point they already
+        hold (the first-token fence). ``state=None`` closes the ledger
+        (final accrual; :meth:`_finish_trace` emits). No-op on a closed
+        ledger — a request can never accrue time twice."""
+        if req.state is None:
+            return
+        if now is None:
+            now = time.monotonic()
+        dt = now - req.t_state
+        if dt > 0:
+            req.phases[req.state] = req.phases.get(req.state, 0.0) + dt
+        req.state = state
+        req.t_state = now
+
+    def _decode_state(self) -> str:
+        """Decode time is attributed per-round to the CURRENT mesh state:
+        rounds on a shrunken mesh land in ``decode_degraded`` so the
+        ledger answers "how much of this request's latency was the
+        incident" directly."""
+        return (
+            PHASE_DECODE_DEGRADED if self._tp < self._tp_initial
+            else PHASE_DECODE
+        )
+
+    def _finish_trace(self, req: _Request, outcome: str,
+                      reason: str = "") -> None:
+        """Close a request's lifecycle ledger and emit its one
+        ``request_trace`` event. INVARIANT: the six phase fields sum to
+        ``wall_s`` (submit → this stamp) by construction — every moment
+        of the request's life was in exactly one phase — so latency
+        attribution is complete, not sampled (tested within 5% across
+        the serving matrix; the slack is float rounding only). Observes
+        the per-phase Rolling/histogram aggregates for phases the
+        request actually spent time in."""
+        if req.state is None:
+            return
+        now = time.monotonic()
+        self._ledger_to(req, None, now)
+        wall = max(now - req.t_submit, 0.0)
+        fields = {}
+        for p in PHASES:
+            v = req.phases.get(p, 0.0)
+            fields[f"{p}_s"] = round(v, 6)
+            if v > 0:
+                self._phase_roll[p].observe(v)
+                self._h_phase[p].observe(v)
+        if reason:
+            fields["reason"] = reason
+        self._traces_emitted += 1
+        self._emit(
+            "request_trace", rid=req.rid, outcome=outcome,
+            wall_s=round(wall, 6),
+            attributed_s=round(sum(req.phases.values()), 6),
+            tokens=len(req.out), prompt_len=len(req.prompt),
+            replays=req.replays, **fields,
+        )
+
     def _bind_histograms(self) -> None:
         self._h_ttft = _hist_ttft().labels(server=self._label)
         self._h_tok_lat = _hist_decode_token().labels(server=self._label)
+        self._h_phase = {
+            p: _hist_phase().labels(server=self._label, phase=p)
+            for p in PHASES
+        }
         self._c_prefix_hits = _ctr_prefix_hits().labels(server=self._label)
         self._c_prefix_misses = _ctr_prefix_misses().labels(server=self._label)
         self._c_prefix_reused = _ctr_prefix_tokens_reused().labels(
@@ -1314,9 +1450,8 @@ class GenerationServer:
                     self.kv_pool.arena if self.paged else self.arena
                 )
             )
-            obs.emit(
-                "serving", "kv_replicated",
-                server=self._label, tp=tp, n_kv_heads=self.cfg.n_kv_heads,
+            self._emit(
+                "kv_replicated", tp=tp, n_kv_heads=self.cfg.n_kv_heads,
                 extra_bytes=(tp - 1) * logical,
             )
         if self.paged:
@@ -1358,9 +1493,9 @@ class GenerationServer:
             )
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(
-            _Request(rid, prompt, max_new_tokens, t_submit=time.monotonic())
-        )
+        req = _Request(rid, prompt, max_new_tokens, t_submit=time.monotonic())
+        req.t_state = req.t_submit  # ledger: the queue phase starts here
+        self._queue.append(req)
         return rid
 
     def run(self) -> dict[int, np.ndarray]:
@@ -1491,6 +1626,19 @@ class GenerationServer:
             "tp_degraded": int(self._tp < self._tp_initial),
             "tp_shrinks": self._tp_shrinks,
             "kv_pool_shard_occupancy": self._pool_shard_occupancy(),
+        })
+        # Request lifecycle ledger (ISSUE 11): ALWAYS present — the trace
+        # id every event of this server carries, the request_trace count,
+        # and per-phase Rolling summaries ({"count": 0} for phases no
+        # retired request has spent time in — no schema branch). The
+        # future fleet router load-balances on these (where does latency
+        # go on THIS replica: queue? prefill? degraded decode?).
+        out.update({
+            "trace": self._trace,
+            "request_traces": self._traces_emitted,
+            "request_phase_s": {
+                p: self._phase_roll[p].summary() for p in PHASES
+            },
         })
         # Scheduler fields (ISSUE 8): ALWAYS present — fifo_batch reports
         # policy name + zeros — so dashboards need no schema branch.
@@ -1625,6 +1773,10 @@ class GenerationServer:
         req.out.append(first)
         self._prefills += 1
         self._emitted += 1  # the prefill forward emits the first token
+        # Ledger: the first-token fence closes the prefill (or recovery-
+        # replay) phase — t_first is the same honest post-fence stamp
+        # TTFT uses, so attribution and TTFT cannot drift apart.
+        self._ledger_to(req, self._decode_state(), now=t_first)
         ttft = t_first - req.t_submit
         self._ttft.observe(ttft)
         self._h_ttft.observe(ttft)
@@ -1634,9 +1786,8 @@ class GenerationServer:
             # first-admission consumers (FIFO-order tests, dashboards
             # separating clean TTFT from recovery tail) can filter.
             event_fields = {**event_fields, "replay": req.replays}
-        obs.emit(
-            "serving", "ttft",
-            server=self._label, rid=req.rid, ttft_s=round(ttft, 6),
+        self._emit(
+            "ttft", rid=req.rid, ttft_s=round(ttft, 6),
             prompt_len=len(req.prompt), queued=len(self._queue),
             **event_fields,
         )
@@ -1672,7 +1823,7 @@ class GenerationServer:
         # which depends on the whole prefill forward.
         with obs.span(
             "serving.prefill",
-            server=self._label, rid=req.rid, slot=b,
+            trace_id=self._trace, server=self._label, rid=req.rid, slot=b,
             prompt_len=true_len, padded_len=len(prompt), tokens=true_len,
         ) as sp:
             caches, last_logits, pos = prefill(
@@ -1779,7 +1930,7 @@ class GenerationServer:
         # which depends on the gather and the whole suffix forward.
         with obs.span(
             "serving.prefill_suffix",
-            server=self._label, rid=req.rid, slot=b,
+            trace_id=self._trace, server=self._label, rid=req.rid, slot=b,
             prompt_len=n, reused=m, suffix_len=s_len,
             padded_len=len(suffix), tokens=s_len,
         ) as sp:
@@ -1848,7 +1999,7 @@ class GenerationServer:
         # every row's suffix forward.
         with obs.span(
             "serving.prefill_suffix_batch",
-            server=self._label, n=n, reused=m, padded_len=pad_len,
+            trace_id=self._trace, server=self._label, n=n, reused=m, padded_len=pad_len,
             tokens=int(true_lens.sum()),
             rids=[req.rid for req, _ in pairs], slots=list(slots),
         ) as sp:
@@ -1908,7 +2059,7 @@ class GenerationServer:
         # full prefill forward.
         with obs.span(
             "serving.prefill_batch",
-            server=self._label, n=n, padded_len=pad_len,
+            trace_id=self._trace, server=self._label, n=n, padded_len=pad_len,
             tokens=int(true_lens.sum()),
             rids=[r.rid for r in reqs], slots=list(slots),
         ) as sp:
@@ -2077,6 +2228,11 @@ class GenerationServer:
                 self._sched.note_queue_delay(
                     time.monotonic() - req.t_submit
                 )
+                if req.state != PHASE_RECOVERY:
+                    # Ledger: admission granted. A crash-recovery replay
+                    # stays in its recovery phase through the re-prefill
+                    # (the replay IS the recovery cost).
+                    self._ledger_to(req, PHASE_PREFILL)
                 take.append((req, hit))
             if not take:
                 return
@@ -2174,6 +2330,10 @@ class GenerationServer:
         self._count_prefix(hit)
         self._queue.popleft()
         self._sched.note_queue_delay(time.monotonic() - req.t_submit)
+        if req.state != PHASE_RECOVERY:
+            # Ledger: chunked admission granted — the whole chunked fill
+            # (slices AND the deferred rounds between them) is prefill.
+            self._ledger_to(req, PHASE_PREFILL)
         # In _admitting from this moment: in neither the queue nor a lane,
         # so a mid-chunk crash must find it here to replay it (ISSUE 7).
         self._admitting = [(req, hit)]
@@ -2213,9 +2373,8 @@ class GenerationServer:
                     return False, ran  # one chunk per decode dispatch
                 self._sched.defers += 1
                 self._c_sched_defer.inc()
-                obs.emit(
-                    "serving", "sched_defer",
-                    server=self._label, rid=p.req.rid, offset=p.offset,
+                self._emit(
+                    "sched_defer", rid=p.req.rid, offset=p.offset,
                     remaining=remaining, queued=len(self._queue),
                     projected_itl_ms=d.projected_itl_ms,
                     slo_ms=self._sched.slo_ms,
@@ -2255,7 +2414,7 @@ class GenerationServer:
         self._inj.fire("prefill")
         with obs.span(
             "serving.prefill_chunk",
-            server=self._label, rid=req.rid, offset=p.offset,
+            trace_id=self._trace, server=self._label, rid=req.rid, offset=p.offset,
             chunk_len=take, padded_len=width, tokens=take,
         ) as sp:
             caches, last_logits, _pos = prefill_suffix(
@@ -2313,6 +2472,7 @@ class GenerationServer:
             req.out = req.out[: req.max_new_tokens]
             self._results[req.rid] = np.asarray(req.out, np.int32)
             req.done = True
+            self._finish_trace(req, outcome="completed")
             self._slot_req[b] = None
             handle = self._slot_prefix[b]
             if handle is not None:
@@ -2476,9 +2636,9 @@ class GenerationServer:
         self._slot_req[b] = None
         self._preemptions += 1
         self._c_preempt.inc()
-        obs.emit(
-            "serving", "kv_preempt",
-            server=self._label, rid=req.rid, pos=int(self._pos[b]),
+        self._ledger_to(req, PHASE_PREEMPTED)  # spilled: decode stops here
+        self._emit(
+            "kv_preempt", rid=req.rid, pos=int(self._pos[b]),
             reason=reason, waiting=len(self._preempted),
             queued=len(self._queue),
         )
@@ -2510,9 +2670,9 @@ class GenerationServer:
         # scatter must still find the request in _preempted (the lost-set
         # source for spilled work) or it would vanish from recovery.
         self._preempted.popleft()
-        obs.emit(
-            "serving", "kv_resume",
-            server=self._label, rid=pre.req.rid, pos=pre.pos,
+        self._ledger_to(pre.req, self._decode_state())  # restored: decoding
+        self._emit(
+            "kv_resume", rid=pre.req.rid, pos=pre.pos,
             waiting=len(self._preempted), queued=len(self._queue),
         )
         return True
@@ -2585,9 +2745,8 @@ class GenerationServer:
             # Deferred from request_drain (async-signal-safe there): the
             # loop announces the drain from its own thread.
             self._drain_announced = True
-            obs.emit(
-                "serving", "drain_begin",
-                server=self._label, reason=self._drain_reason,
+            self._emit(
+                "drain_begin", reason=self._drain_reason,
                 queued=len(self._queue),
                 slots_busy=sum(r is not None for r in self._slot_req),
             )
@@ -2600,6 +2759,17 @@ class GenerationServer:
             self._note_progress()
         except BaseException as exc:
             if not (self._supervised and resilience.recoverable(exc)):
+                # Terminal for the serving loop ("not ours to catch":
+                # user bugs, strict-mode guard trips, disabled recovery).
+                # Record the incident on the stream AND the always-armed
+                # flight-recorder ring before unwinding — the ring dumps
+                # its postmortem on this event (obs/flight.py).
+                self._emit(
+                    "fatal_error",
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                    queued=len(self._queue),
+                    slots_busy=sum(r is not None for r in self._slot_req),
+                )
                 raise
             alive = self._recover(exc)
         if self._draining and not self._drain_done and self._drain_idle():
@@ -2696,9 +2866,8 @@ class GenerationServer:
         self._ckpt = entries
         self._ckpt_round = self._rounds
         self._checkpoints += 1
-        obs.emit(
-            "serving", "checkpoint",
-            server=self._label, round=self._rounds, lanes=len(entries),
+        self._emit(
+            "checkpoint", round=self._rounds, lanes=len(entries),
             tokens=tokens,
         )
 
@@ -2709,11 +2878,11 @@ class GenerationServer:
         dropped, never retried again."""
         req.done = True
         self._failures[req.rid] = error or reason
-        obs.emit(
-            "serving", "request_failed",
-            server=self._label, rid=req.rid, reason=reason,
+        self._emit(
+            "request_failed", rid=req.rid, reason=reason,
             error=(error or reason)[:200], emitted=len(req.out),
         )
+        self._finish_trace(req, outcome="failed", reason=reason)
 
     def _recover(self, exc: BaseException) -> bool:
         """Rebuild after a failed round. The device state is rebuilt from
@@ -2801,6 +2970,10 @@ class GenerationServer:
                 self._c_quarantine.inc()
                 quarantined += 1
             else:
+                # Ledger: from here until the request is back in a lane
+                # (checkpoint restore or replay first token) its time is
+                # the recovery phase — the incident's attributed cost.
+                self._ledger_to(req, PHASE_RECOVERY)
                 survivors.append(req)
         self._reset_device_state()
         # Restore checkpointed survivors into fresh lanes; everything
@@ -2851,6 +3024,9 @@ class GenerationServer:
                 if req.rid not in counted:
                     req.replays += 1
                 req.out = []
+                # Ledger: a lane restored before the restore-phase fault
+                # moved to decode — it is recovery work again now.
+                self._ledger_to(req, PHASE_RECOVERY)
             replay = list(survivors)
         if replay:
             self._queue.extendleft(reversed(replay))
@@ -2862,9 +3038,8 @@ class GenerationServer:
         if self._backoff_s > 0:
             backoff = min(self._backoff_s * (2 ** (self._fail_streak - 1)),
                           5.0)
-        obs.emit(
-            "serving", "recovery",
-            server=self._label, error=err, restored=restored,
+        self._emit(
+            "recovery", error=err, restored=restored,
             requeued=len(replay), quarantined=quarantined,
             streak=self._fail_streak, backoff_s=round(backoff, 4),
         )
@@ -2917,9 +3092,8 @@ class GenerationServer:
                 else "single_chip" if self._tp <= 1
                 else "mesh_injected"
             )
-            obs.emit(
-                "serving", "chip_loss_fatal",
-                server=self._label, reason=permanent_reason, tp=self._tp,
+            self._emit(
+                "chip_loss_fatal", reason=permanent_reason, tp=self._tp,
                 why=why,
             )
             return False
@@ -2936,9 +3110,8 @@ class GenerationServer:
             self._tp, len(survivors), self._tp_min
         )
         if new_tp is None:
-            obs.emit(
-                "serving", "chip_loss_fatal",
-                server=self._label, reason=permanent_reason, tp=self._tp,
+            self._emit(
+                "chip_loss_fatal", reason=permanent_reason, tp=self._tp,
                 why=f"tp_min_floor:{self._tp_min}",
                 survivors=len(survivors),
             )
@@ -2953,9 +3126,8 @@ class GenerationServer:
             # (cold cache, warms again from traffic); an INJECTED one may
             # back other servers and is disabled here instead.
             if self._prefix_injected:
-                obs.emit(
-                    "serving", "prefix_store_disabled",
-                    server=self._label, reason="tp_degraded",
+                self._emit(
+                    "prefix_store_disabled", reason="tp_degraded",
                 )
                 self.prefix_store = None
             else:
@@ -2985,9 +3157,13 @@ class GenerationServer:
                     and isinstance(self.prefix_store, PrefixStore)):
                 self._place_store(self._mesh)
         self._tp_shrinks += 1
-        obs.emit(
-            "serving", "tp_degraded",
-            server=self._label, reason=permanent_reason, old_tp=old_tp,
+        # The scheduler's prefill-rate / round-cadence EWMAs were measured
+        # on the OLD mesh — the shrunken one is slower, and stale
+        # estimates would mis-project the first post-recovery admissions.
+        # Re-bootstrap them on degraded-mesh observations.
+        self._sched.reset_estimates()
+        self._emit(
+            "tp_degraded", reason=permanent_reason, old_tp=old_tp,
             tp=new_tp, survivors=len(survivors), tp_min=self._tp_min,
         )
         return True
@@ -3029,9 +3205,8 @@ class GenerationServer:
         self._ckpt = {}
         for rid in sorted(lost):
             self._fail_request(lost[rid], reason="chip_lost", error=err)
-        obs.emit(
-            "serving", "recovery",
-            server=self._label, error=err, restored=0, requeued=0,
+        self._emit(
+            "recovery", error=err, restored=0, requeued=0,
             quarantined=0, failed=len(lost), streak=self._fail_streak,
             backoff_s=0.0,
         )
@@ -3146,6 +3321,7 @@ class GenerationServer:
         self._pos[b] = entry.pos
         self._last[b] = entry.last
         self._fresh_rows.add(b)
+        self._ledger_to(req, self._decode_state())  # restored: decoding
         return True
 
     def _finish_drain(self) -> None:
@@ -3168,14 +3344,12 @@ class GenerationServer:
                                      f"({self._drain_reason})")
             failed += 1
         self._ckpt = {}
-        obs.emit(
-            "serving", "checkpoint",
-            server=self._label, round=self._rounds, lanes=0, tokens=0,
+        self._emit(
+            "checkpoint", round=self._rounds, lanes=0, tokens=0,
             final=True,
         )
-        obs.emit(
-            "serving", "drain",
-            server=self._label, reason=self._drain_reason,
+        self._emit(
+            "drain", reason=self._drain_reason,
             completed=len(self._results), failed=failed,
         )
         self._drain_done = True
@@ -3186,9 +3360,8 @@ class GenerationServer:
         measured ground truth the deadline-driven admission steers by."""
         if self._sched.note_round(dur_s):
             self._c_slo.inc()
-            obs.emit(
-                "serving", "slo_violation",
-                server=self._label, round_s=round(dur_s, 6),
+            self._emit(
+                "slo_violation", round_s=round(dur_s, 6),
                 # The per-token figure actually compared to slo_ms (the
                 # round cadence over its delivered steps).
                 itl_s=round(dur_s / self.chunk, 6),
@@ -3207,6 +3380,7 @@ class GenerationServer:
         return resilience.fence_with_timeout(
             wait, timeout_s=self._fence_timeout_s, seam=seam,
             injector=self._inj if inject else None, server=self._label,
+            trace=self._trace,
         )
 
     def _dispatch_decode(self, last, pos, sub):
@@ -3254,7 +3428,7 @@ class GenerationServer:
             before = self._emitted
             with obs.span(
                 "serving.verify_round",
-                server=self._label, slots_busy=len(active),
+                trace_id=self._trace, server=self._label, slots_busy=len(active),
                 queued=len(self._queue),
             ) as sp:
                 alive = self._step_speculative(active)
@@ -3263,9 +3437,8 @@ class GenerationServer:
                 tok_lat = sp.duration_s / (accepted / len(active))
                 self._tok_lat.observe(tok_lat)
                 self._h_tok_lat.observe(tok_lat)
-                obs.emit(
-                    "serving", "spec_round",
-                    server=self._label, accepted=accepted,
+                self._emit(
+                    "spec_round", accepted=accepted,
                     offered=self.speculative_k * len(active),
                     dur_s=round(sp.duration_s, 6),
                 )
@@ -3283,7 +3456,7 @@ class GenerationServer:
         # on the chunk's tokens is a device→host transfer, i.e. the fence.
         with obs.span(
             "serving.decode_chunk",
-            server=self._label, tokens=len(active) * self.chunk,
+            trace_id=self._trace, server=self._label, tokens=len(active) * self.chunk,
             slots_busy=len(active), queued=len(self._queue),
             batch_occupancy=round(len(active) / self.max_batch, 4),
         ) as sp:
@@ -3396,7 +3569,7 @@ class GenerationServer:
         # rate from that instead.
         sp = obs.start_span(
             "serving.decode_chunk",
-            server=self._label, chunk_tokens=len(active) * self.chunk,
+            trace_id=self._trace, server=self._label, chunk_tokens=len(active) * self.chunk,
             slots_busy=len(active), queued=len(self._queue),
             batch_occupancy=round(len(active) / self.max_batch, 4),
             overlapped=True,

@@ -67,7 +67,7 @@ def degraded_enabled(env: Optional[dict] = None) -> bool:
     return env.get(ENV_DEGRADED, "1") != "0"
 
 
-def tp_min_from_env(*, label: str = "") -> int:
+def tp_min_from_env(*, label: str = "", trace: str = "") -> int:
     """The shrink ladder's floor from the daemon-injected env (default 1
     — degrade all the way to single-chip serving before giving up).
     Rides :func:`.resilience.env_int`'s degrade contract: a malformed
@@ -76,7 +76,7 @@ def tp_min_from_env(*, label: str = "") -> int:
     from . import resilience
 
     return max(1, resilience.env_int(
-        ENV_TP_MIN, 1, event="tp_min_invalid", server=label
+        ENV_TP_MIN, 1, event="tp_min_invalid", server=label, trace=trace
     ))
 
 
@@ -118,11 +118,14 @@ def _topology_chips(env) -> int:
 
 
 def tp_from_env(env: Optional[dict] = None, *, label: str = "",
-                device_count: Optional[int] = None) -> int:
+                device_count: Optional[int] = None,
+                trace: str = "") -> int:
     """Resolve the serving tensor-parallel degree from the daemon-injected
     env (see the module header's ladder). Always returns ``>= 1``; every
-    degrade emits one ``serving/tp_disabled`` event with a reason."""
+    degrade emits one ``serving/tp_disabled`` event with a reason
+    (``trace`` joins it to the allocation trace, ISSUE 11)."""
     env = os.environ if env is None else env
+    t_extra = {"trace": trace} if trace else {}
     raw = env.get(ENV_TP, "").strip()
     tp = None
     if raw:
@@ -131,14 +134,14 @@ def tp_from_env(env: Optional[dict] = None, *, label: str = "",
         except ValueError:
             obs.emit(
                 "serving", "tp_disabled",
-                server=label, reason=f"bad_env:{raw[:32]}",
+                server=label, reason=f"bad_env:{raw[:32]}", **t_extra,
             )
             tp = None
         else:
             if tp < 0:
                 obs.emit(
                     "serving", "tp_disabled",
-                    server=label, reason=f"bad_env:{raw[:32]}",
+                    server=label, reason=f"bad_env:{raw[:32]}", **t_extra,
                 )
                 tp = None
             elif tp == 0:
@@ -154,7 +157,7 @@ def tp_from_env(env: Optional[dict] = None, *, label: str = "",
             obs.emit(
                 "serving", "tp_disabled",
                 server=label, tp=tp,
-                reason=f"insufficient_devices:{device_count}",
+                reason=f"insufficient_devices:{device_count}", **t_extra,
             )
             tp = 1
     return max(1, tp)

@@ -16,6 +16,11 @@ plugin gRPC — one pipeline for the signals production traffic needs:
 - :mod:`.events`   — a JSONL event sink (``KATATPU_OBS=1`` +
   ``KATATPU_OBS_FILE``) every span and metric event streams into;
   ``bench.py`` parses it back into per-phase breakdowns.
+- :mod:`.flight`   — the crash FLIGHT RECORDER (ISSUE 11): a bounded
+  in-memory ring of the most recent events, armed even when the JSONL
+  sink is off, dumped to a postmortem JSONL on terminal events
+  (``chip_loss_fatal``, ``fatal_error``, ``registration_exhausted``, a
+  failed drain). ``KATATPU_FLIGHT=0`` disarms.
 - :mod:`.profiler` — optional ``jax.profiler`` start/stop around N
   configurable steps.
 
@@ -35,6 +40,10 @@ from .events import (
     read_events,
     set_default_sink,
     summarize_phases,
+)
+from .flight import (
+    FlightRecorder,
+    set_default_recorder,
 )
 from .metrics import (
     DEFAULT_REGISTRY,
@@ -67,6 +76,8 @@ __all__ = [
     "read_events",
     "set_default_sink",
     "summarize_phases",
+    "FlightRecorder",
+    "set_default_recorder",
     "DEFAULT_REGISTRY",
     "MetricsRegistry",
     "Rolling",

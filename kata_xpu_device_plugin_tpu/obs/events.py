@@ -25,6 +25,8 @@ import threading
 import time
 from typing import IO, Iterable, Optional
 
+from . import flight
+
 _ENV_ENABLE = ("KATATPU_OBS", "KATA_TPU_OBS")
 _ENV_FILE = ("KATATPU_OBS_FILE", "KATA_TPU_OBS_FILE")
 _DEFAULT_FILE = "katatpu_events.jsonl"
@@ -152,11 +154,27 @@ def default_sink() -> Optional[EventSink]:
 
 
 def emit(kind: str, name: str, **fields) -> Optional[dict]:
-    """Emit to the default sink; no-op (returns None) when disabled."""
+    """Emit to the default sink; returns None when the sink is disabled.
+
+    The crash FLIGHT RECORDER (:mod:`.flight`) sees every event emitted
+    here regardless of the sink switch — its bounded in-memory ring is
+    always armed (``KATATPU_FLIGHT=0`` disarms), so a terminal event
+    (``chip_loss_fatal``, ``registration_exhausted``, a failed drain)
+    can dump the recent past even when nobody enabled ``KATATPU_OBS``
+    before the incident."""
     sink = default_sink()
-    if sink is None:
-        return None
-    return sink.emit(kind, name, **fields)
+    event: Optional[dict] = None
+    if sink is not None:
+        event = sink.emit(kind, name, **fields)
+    rec = flight.recorder()
+    if rec is not None:
+        if event is None:
+            event = {"ts": round(time.time(), 6), "kind": kind, "name": name}
+            event.update(fields)
+            rec.record(event)
+            return None  # sink disabled: keep the old return contract
+        rec.record(event)
+    return event
 
 
 # -- consumers ---------------------------------------------------------------

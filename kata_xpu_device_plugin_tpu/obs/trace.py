@@ -261,12 +261,17 @@ def timer(name: str, metric: Any = None, fence: Any = None, **attrs):
             observe(sp.duration_s)
 
 
-def start_span(name: str, **attrs) -> Span:
+def start_span(
+    name: str, trace_id: Optional[str] = None, **attrs
+) -> Span:
     """Open a DETACHED span: timing starts now, but the span is NOT pushed
     onto the ambient contextvar stack — spans opened while it is in flight
     do not become its children, and closing it (from any later call frame)
     cannot unwind someone else's parent. It still records the ambient span
     at open time as its parent, so the trace tree stays joined.
+    ``trace_id`` pins the span to an existing trace (e.g. the
+    daemon-injected per-allocation trace context — see
+    "Daemon → guest trace context" in docs/architecture.md).
 
     This is the API for overlapped regions — work dispatched in one call
     frame and fenced in another (the pipelined serving round)::
@@ -277,7 +282,7 @@ def start_span(name: str, **attrs) -> Span:
         ...                         # host schedules while device computes
         sp.end(fence=toks)          # dur_s: dispatch → results ready
     """
-    sp = Span(name, parent=_current.get(), **attrs)
+    sp = Span(name, parent=_current.get(), trace_id=trace_id, **attrs)
     sp._t0 = time.perf_counter()  # open without touching the contextvar
     return sp
 

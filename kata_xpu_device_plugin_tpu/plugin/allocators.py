@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 from typing import Callable, Optional, Sequence
 
+from .. import obs
 from .. import topology as topo_mod
 from ..cdi import constants as C
 from ..cdi import qualified_name
@@ -52,6 +53,7 @@ class TpuAllocator:
         itl_slo_ms: float = 0.0,
         serving_tp: int = 0,
         serving_tp_min: int = 0,
+        trace_context: bool = True,
     ):
         self._inventory = inventory
         self._vendor = vendor
@@ -96,6 +98,11 @@ class TpuAllocator:
         # same delivery path — in-guest servers stop the chip-loss
         # mesh-shrink ladder at this degree (guest/tp_serving.py).
         self._serving_tp_min = int(serving_tp_min)
+        # Per-allocation trace context (ISSUE 11, config.trace_context):
+        # each Allocate stamps its own span's trace id (or a fresh one
+        # when no span is open) into KATA_TPU_TRACE_CTX, so the guest's
+        # serving telemetry joins the daemon's allocation trace.
+        self._trace_context = bool(trace_context)
         # Driver-level liveness check supplied by the manager
         # (``manager.tpu_chip_alive``: node_alive over the same
         # dev+driver-state pair health watches); bare existence would hand a
@@ -145,6 +152,17 @@ class TpuAllocator:
                 resp.envs[C.LIBTPU_ENV] = C.LIBTPU_CONTAINER_PATH
         resp.envs[C.ENV_CDI_VENDOR_CLASS] = self._resource
         resp.envs[C.ENV_TPU_VISIBLE_CHIPS] = ",".join(str(c.index) for c in chips)
+        if self._trace_context:
+            # The daemon→guest trace-context handoff (ISSUE 11): inside
+            # the gRPC handler this is the plugin.Allocate span's trace
+            # id, so everything the guest emits under it — request
+            # lifecycle traces, recovery/degraded events, flight-recorder
+            # dumps — joins the allocation's trace; a direct (test) call
+            # with no open span mints a fresh id, which still gives every
+            # workload of the allocation one shared join key.
+            resp.envs[C.ENV_TRACE_CTX] = (
+                obs.current_trace_id() or obs.new_trace()
+            )
         if self._compile_cache_dir:
             resp.envs[C.ENV_COMPILE_CACHE_DIR] = self._compile_cache_dir
         if self._prefix_cache_tokens > 0:

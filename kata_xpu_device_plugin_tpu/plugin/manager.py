@@ -559,6 +559,7 @@ class PluginManager:
                 itl_slo_ms=cfg.itl_slo_ms,
                 serving_tp=cfg.serving_tp,
                 serving_tp_min=cfg.serving_tp_min,
+                trace_context=cfg.trace_context,
             ),
             # Journal every grant at the moment it happens (the Allocate
             # handler's on_allocate hook) — the restart reconcile's input.

@@ -53,3 +53,30 @@ def _bound_process_accumulation():
     yield
     jax.clear_caches()
     gc.collect()
+
+
+@pytest.fixture(autouse=True)
+def _flight_dumps_to_tmp(tmp_path):
+    """Keep the always-armed crash flight recorder (ISSUE 11) from
+    littering the working directory: tests that exercise terminal events
+    (chip_loss_fatal, failed drains, non-recoverable faults) dump into
+    the test's tmp dir instead. An EXTERNALLY pinned KATATPU_FLIGHT_DIR
+    (the chaos CI gate sets one so the dumps upload as artifacts) wins —
+    the fixture only fills the default. Each test also gets a fresh ring
+    so one test's events can never leak into another's postmortem.
+
+    The env var is managed by hand, NOT via the monkeypatch fixture: an
+    autouse dependency on monkeypatch would instantiate it before every
+    test-local fixture, flipping finalization order so test patches of
+    os-level functions outlive the fixtures (e.g. tmp-tree rmtree in
+    test_plugin) that must run unpatched."""
+    from kata_xpu_device_plugin_tpu.obs import flight
+
+    prev = os.environ.get(flight.ENV_DIR)
+    if not prev:
+        os.environ[flight.ENV_DIR] = str(tmp_path / "flight")
+    flight.configure_from_env(force=True)
+    yield
+    if not prev:
+        os.environ.pop(flight.ENV_DIR, None)
+    flight.configure_from_env(force=True)

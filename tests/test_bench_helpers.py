@@ -58,3 +58,78 @@ def test_spec_tables_cover_same_generations():
     """A generation added to one table but not the other would make the
     decode roofline and the train MFU disagree about what chip this is."""
     assert set(bench.HBM_GBPS) == set(bench.MXU_TFLOPS)
+
+
+# ----- bench-trend (ISSUE 11 satellite) --------------------------------------
+
+
+def _bank(path, stamp, **fields):
+    import json
+
+    d = {"metric": "decode", "unit": "tok/s", "note": "x",
+         "attempts": 1, "_all_lines": ["{}"],
+         "phases": {"decode": {"count": 1}}}
+    d.update(fields)
+    p = path / f"BENCH_TPU_{stamp}.json"
+    p.write_text(json.dumps(d))
+    return p
+
+
+def test_bench_trend_flags_headline_regression(tmp_path, capsys):
+    from tools import bench_trend
+
+    _bank(tmp_path, "20260101T000000Z", value=1000.0,
+          serving_tok_per_s=200.0, decode_s=0.5)
+    _bank(tmp_path, "20260102T000000Z", value=800.0,  # -20%: regression
+          serving_tok_per_s=205.0, decode_s=0.4)
+    rc = bench_trend.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "regression" in out and "value" in out
+    # Context metrics (decode_s) are reported but never flagged.
+    assert "1 regression(s)" in out
+
+
+def test_bench_trend_flat_and_clean(tmp_path, capsys):
+    from tools import bench_trend
+
+    _bank(tmp_path, "20260101T000000Z", value=1303.8, e2e_tok_per_s=1100.0)
+    _bank(tmp_path, "20260102T000000Z", value=1303.8, e2e_tok_per_s=1150.0)
+    rc = bench_trend.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    # The whole point of the tool: a bit-identical headline is marked
+    # flat — the number nobody has moved — and is not a failure.
+    assert rc == 0 and "flat" in out
+
+
+def test_bench_trend_newest_two_and_sparse_banks(tmp_path, capsys):
+    from tools import bench_trend
+
+    _bank(tmp_path, "20260101T000000Z", value=1.0)
+    _bank(tmp_path, "20260102T000000Z", value=2000.0, int8_tok_per_s=5.0)
+    _bank(tmp_path, "20260103T000000Z", value=2000.0)  # int8 vanished
+    rc = bench_trend.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # Only the two NEWEST compare; fields present in one bank only are
+    # skipped rather than crashing the comparison.
+    assert "int8_tok_per_s" not in out
+    assert "20260102" in out and "20260103" in out
+
+
+def test_bench_trend_single_bank_is_not_a_failure(tmp_path, capsys):
+    from tools import bench_trend
+
+    _bank(tmp_path, "20260101T000000Z", value=1.0)
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+    assert "nothing to compare" in capsys.readouterr().err
+
+
+def test_bench_trend_numeric_metrics_filter():
+    from tools import bench_trend
+
+    rows = bench_trend.numeric_metrics({
+        "value": 1.0, "note": "s", "_all_lines": [1], "attempts": 3,
+        "phases": {"a": 1}, "ok": True, "serving_s": 2.5,
+    })
+    assert rows == {"value": 1.0, "serving_s": 2.5}

@@ -676,3 +676,234 @@ def test_fit_drives_profiler(tmp_path, fake_profiler, sink):
     fit(init_state, step, loader, steps=3, key=jax.random.PRNGKey(1),
         profiler=hook)
     assert fake_profiler == [("start", str(tmp_path / "p")), ("stop",)]
+
+
+# ----- JSONL sink thread safety (ISSUE 11 satellite) -------------------------
+
+
+def test_event_sink_concurrent_emits_no_interleaving(tmp_path):
+    """Concurrent emitters through ONE sink — the overlap scheduler, the
+    recovery supervisor, and a drain signal path all share the process
+    default — must produce a parseable stream: every line one complete
+    JSON object, nothing interleaved or torn, nothing lost."""
+    import threading
+
+    path = str(tmp_path / "concurrent.jsonl")
+    n_threads, n_each = 8, 200
+    with obs.EventSink(path) as s:
+        barrier = threading.Barrier(n_threads)
+
+        def pound(tid):
+            barrier.wait()  # maximal contention: all start together
+            for i in range(n_each):
+                s.emit(
+                    "serving", "stress",
+                    thread=tid, i=i,
+                    # A long-ish payload widens the torn-write window a
+                    # non-atomic writer would expose.
+                    pad="x" * 64,
+                )
+
+        threads = [
+            threading.Thread(target=pound, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    # Parse back STRICTLY (read_events skips bad lines — that leniency
+    # would hide exactly the corruption this test exists to catch).
+    with open(path, encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    events = [json.loads(line) for line in lines]  # raises on any tear
+    assert len(events) == n_threads * n_each
+    seen = {(e["thread"], e["i"]) for e in events}
+    assert len(seen) == n_threads * n_each  # none lost, none duplicated
+
+
+# ----- event-schema drift gate (ISSUE 11 satellite) --------------------------
+
+
+def _emitted_event_names():
+    """Every event NAME the package can emit, collected statically:
+    literal second arguments of ``*.emit(kind, name, ...)`` calls (kind
+    ``"span"`` excluded — span names are the span catalog, not events),
+    literal first arguments of the serving ``self._emit(name, ...)``
+    wrapper, and literal ``event=`` keywords (the env-knob degrade
+    events routed through ``resilience.env_int``/``env_float``)."""
+    import ast
+    import pathlib
+
+    import kata_xpu_device_plugin_tpu
+
+    pkg_root = pathlib.Path(kata_xpu_device_plugin_tpu.__file__).parent
+    names: set[str] = set()
+    for p in pkg_root.rglob("*.py"):
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            attr = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None
+            )
+            args = node.args
+            if attr == "emit":
+                if (
+                    len(args) >= 2
+                    and all(
+                        isinstance(a, ast.Constant)
+                        and isinstance(a.value, str)
+                        for a in args[:2]
+                    )
+                    and args[0].value != "span"
+                ):
+                    names.add(args[1].value)
+            elif attr == "_emit":
+                if (
+                    args
+                    and isinstance(args[0], ast.Constant)
+                    and isinstance(args[0].value, str)
+                ):
+                    names.add(args[0].value)
+            for kw in node.keywords:
+                if (
+                    kw.arg == "event"
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                ):
+                    names.add(kw.value.value)
+    return names
+
+
+def test_every_emitted_event_name_is_documented():
+    """The event-schema drift gate (the PR 7 seam-doc pin pattern,
+    applied to events): every event name the package can emit must
+    appear in docs/observability.md — an event consumers cannot look up
+    is telemetry debt. Adding an event means documenting it; this test
+    is the tripwire."""
+    import pathlib
+
+    import kata_xpu_device_plugin_tpu
+
+    doc = (
+        pathlib.Path(kata_xpu_device_plugin_tpu.__file__).parent.parent
+        / "docs" / "observability.md"
+    ).read_text(encoding="utf-8")
+    names = _emitted_event_names()
+    assert len(names) >= 30  # the collector found the real surface
+    undocumented = sorted(n for n in names if n not in doc)
+    assert not undocumented, (
+        f"event names emitted but absent from docs/observability.md: "
+        f"{undocumented} — document them (schema drift gate, ISSUE 11)"
+    )
+
+
+# ----- flight recorder (ISSUE 11) --------------------------------------------
+
+
+@pytest.fixture
+def flight_mod():
+    from kata_xpu_device_plugin_tpu.obs import flight
+
+    return flight
+
+
+def test_flight_ring_armed_with_sink_off(flight_mod):
+    """The recorder's whole reason to exist: events are captured even
+    when the JSONL sink is disabled — the incident nobody enabled
+    KATATPU_OBS for is the one that matters."""
+    rec = flight_mod.FlightRecorder(capacity=16)
+    prev_rec = flight_mod.set_default_recorder(rec)
+    prev_sink = obs.set_default_sink(None)
+    try:
+        assert obs.emit("serving", "ttft", rid=1) is None  # sink off
+        assert obs.emit("serving", "recovery", error="x") is None
+    finally:
+        obs.set_default_sink(prev_sink)
+        flight_mod.set_default_recorder(prev_rec)
+    names = [e["name"] for e in rec.snapshot()]
+    assert names == ["ttft", "recovery"]
+    assert all("ts" in e for e in rec.snapshot())
+
+
+def test_flight_ring_bounded(flight_mod):
+    rec = flight_mod.FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record({"kind": "serving", "name": "tick", "i": i})
+    snap = rec.snapshot()
+    assert len(snap) == 4
+    assert [e["i"] for e in snap] == [6, 7, 8, 9]  # most recent survive
+
+
+def test_flight_dump_on_terminal_event(flight_mod, tmp_path, monkeypatch):
+    monkeypatch.setenv(flight_mod.ENV_DIR, str(tmp_path / "dumps"))
+    rec = flight_mod.FlightRecorder(capacity=8)
+    prev = flight_mod.set_default_recorder(rec)
+    try:
+        obs.emit("serving", "ttft", rid=0, trace="feedface")
+        obs.emit(
+            "serving", "chip_loss_fatal",
+            server="s1", trace="feedface", why="single_chip",
+        )
+    finally:
+        flight_mod.set_default_recorder(prev)
+    assert len(rec.dumps) == 1
+    dump = obs.read_events(rec.dumps[0])
+    assert dump[-1]["name"] == "chip_loss_fatal"
+    # The postmortem joins: the fatal event AND the preceding context
+    # carry the trace id.
+    assert dump[-1]["trace"] == "feedface"
+    assert dump[0]["name"] == "ttft"
+
+
+def test_flight_clean_stream_never_dumps(flight_mod):
+    rec = flight_mod.FlightRecorder(capacity=8)
+    for name in ("ttft", "checkpoint", "recovery", "kv_preempt"):
+        rec.record({"kind": "serving", "name": name})
+    # A CLEAN drain (failed == 0) is not an incident.
+    rec.record({"kind": "serving", "name": "drain", "failed": 0})
+    assert rec.dumps == []
+
+
+def test_flight_failed_drain_dumps(flight_mod, tmp_path, monkeypatch):
+    monkeypatch.setenv(flight_mod.ENV_DIR, str(tmp_path / "dumps"))
+    rec = flight_mod.FlightRecorder(capacity=8)
+    rec.record({"kind": "serving", "name": "drain", "failed": 3})
+    assert len(rec.dumps) == 1
+
+
+def test_flight_kill_switch_and_capacity_env(flight_mod, monkeypatch):
+    monkeypatch.setenv(flight_mod.ENV_ENABLE, "0")
+    assert flight_mod.configure_from_env(force=True) is None
+    # Emitting with the recorder disarmed (and sink off) is a no-op.
+    prev_sink = obs.set_default_sink(None)
+    try:
+        assert obs.emit("serving", "chip_loss_fatal", server="x") is None
+    finally:
+        obs.set_default_sink(prev_sink)
+    monkeypatch.delenv(flight_mod.ENV_ENABLE)
+    monkeypatch.setenv(flight_mod.ENV_RING, "7")
+    rec = flight_mod.configure_from_env(force=True)
+    assert rec is not None and rec.capacity == 7
+    monkeypatch.delenv(flight_mod.ENV_RING)
+    flight_mod.configure_from_env(force=True)
+
+
+def test_flight_records_span_events(flight_mod):
+    """Spans flow through events.emit, so the ring holds them too — the
+    postmortem's timeline is spans AND events, like the JSONL stream."""
+    rec = flight_mod.FlightRecorder(capacity=8)
+    prev = flight_mod.set_default_recorder(rec)
+    prev_sink = obs.set_default_sink(None)
+    try:
+        with obs.span("plugin.Allocate", resource="google.com/tpu"):
+            pass
+    finally:
+        obs.set_default_sink(prev_sink)
+        flight_mod.set_default_recorder(prev)
+    snap = rec.snapshot()
+    assert len(snap) == 1 and snap[0]["kind"] == "span"
+    assert snap[0]["name"] == "plugin.Allocate" and snap[0]["trace"]

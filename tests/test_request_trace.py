@@ -1,0 +1,401 @@
+"""Request lifecycle tracing, trace-context propagation, and the crash
+flight recorder (ISSUE 11).
+
+Oracle — ATTRIBUTION IS COMPLETE: every submitted request ends with
+exactly one ``request_trace`` event whose six phase fields sum to the
+request's wall clock (the ledger is a state machine — every moment of a
+request's life is in exactly one phase), across the serving matrix
+(paged/slotted × overlap × chunked × preemption × recovery). Telemetry
+must also be INVISIBLE in the output: greedy tokens are bit-identical
+with the sink+recorder armed and disarmed. The daemon→guest half:
+``Allocate`` stamps ``KATA_TPU_TRACE_CTX``, the server adopts it, and
+every serving event (the PR 10 recovery/degrade/fatal vocabulary
+included — the satellite) carries the allocation trace id, which is
+what makes a flight-recorder postmortem joinable end to end.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu import obs
+from kata_xpu_device_plugin_tpu.guest.resilience import (
+    FaultInjector,
+    FaultSpec,
+)
+from kata_xpu_device_plugin_tpu.guest.serving import (
+    PHASES,
+    GenerationServer,
+)
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import init_params
+from kata_xpu_device_plugin_tpu.obs import flight
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _prompts(cfg, lengths, seed=2):
+    key = jax.random.PRNGKey(seed)
+    return [
+        np.asarray(
+            jax.random.randint(jax.random.fold_in(key, i), (n,), 0,
+                               cfg.vocab_size),
+            np.int32,
+        )
+        for i, n in enumerate(lengths)
+    ]
+
+
+def _serve(params, cfg, prompts, budgets=8, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("recovery_backoff_s", 0.0)
+    srv = GenerationServer(params, cfg, **kw)
+    if isinstance(budgets, int):
+        budgets = [budgets] * len(prompts)
+    rids = [srv.submit(p, m) for p, m in zip(prompts, budgets)]
+    res = srv.run()
+    return rids, res, srv
+
+
+def _events(path):
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def _run_with_sink(tmp_path, fn):
+    sink = obs.EventSink(str(tmp_path / "ev.jsonl"))
+    prev = obs.set_default_sink(sink)
+    try:
+        out = fn()
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    return out, _events(tmp_path / "ev.jsonl")
+
+
+# ----- the attribution matrix (tentpole b) ----------------------------------
+
+
+MATRIX = {
+    "slotted_lockstep": dict(overlap=False),
+    "slotted_overlap": dict(overlap=True),
+    "paged_overlap": dict(kv_pool_tokens=4 * 32, kv_block_size=8),
+    "chunked": dict(prefill_buckets=(16,), sched_policy="slo_chunked",
+                    prefill_chunk=4, itl_slo_ms=0.0),
+    "preemption": dict(max_batch=4, kv_pool_tokens=32 + 3 * 8,
+                       kv_block_size=8),
+    "recovery": dict(checkpoint_rounds=2),
+}
+
+
+@pytest.mark.parametrize("case", sorted(MATRIX))
+def test_phase_attribution_sums_to_wall(model, tmp_path, case):
+    """The acceptance invariant: one request_trace per rid, phases sum
+    to wall time within 5% (the slack is 6-decimal rounding — the
+    ledger is exact by construction), across the serving matrix."""
+    cfg, params = model
+    kw = dict(MATRIX[case])
+    if case == "preemption":
+        prompts = _prompts(cfg, [4, 9, 6, 12, 3, 7, 5, 8])
+        budgets = 14
+    elif case == "chunked":
+        # The test_scheduler workload: long mixed prompts + ragged
+        # budgets so deferral (slo_ms=0) actually chunks admissions
+        # once the bootstrap estimates exist.
+        prompts = _prompts(cfg, [14, 9, 12, 7, 15, 11])
+        budgets = [6, 12, 9, 5, 11, 7]
+    else:
+        prompts = _prompts(cfg, [4, 7, 5, 6])
+        budgets = 8
+    if case == "recovery":
+        kw["fault_injector"] = FaultInjector(
+            schedule=[FaultSpec("decode_dispatch", 2)]
+        )
+
+    (rids, res, srv), evs = _run_with_sink(
+        tmp_path, lambda: _serve(params, cfg, prompts, budgets, **kw)
+    )
+    assert set(res) == set(rids)
+    traces = [e for e in evs if e.get("name") == "request_trace"]
+    assert sorted(e["rid"] for e in traces) == sorted(rids)  # exactly one
+    for e in traces:
+        assert e["outcome"] == "completed"
+        assert e["wall_s"] > 0
+        total = sum(e[f"{p}_s"] for p in PHASES)
+        assert abs(total - e["wall_s"]) <= 0.05 * e["wall_s"] + 1e-4, (
+            case, e)
+        assert abs(e["attributed_s"] - total) <= 1e-3
+        assert e["tokens"] == len(res[e["rid"]])
+        # Decode happened for every completed request, at full tp here.
+        assert e["decode_s"] > 0 and e["decode_degraded_s"] == 0.0
+        # Everything joins the server's trace id.
+        assert e["trace"] == srv.stats()["trace"]
+    st = srv.stats()
+    assert st["request_traces"] == len(traces)
+    if case == "preemption":
+        assert st["preemptions"] >= 1
+        assert any(e["preempted_s"] > 0 for e in traces)
+    if case == "recovery":
+        assert st["recoveries"] >= 1
+        assert any(e["recovery_s"] > 0 for e in traces)
+        assert any(e["replays"] > 0 or e["recovery_s"] > 0 for e in traces)
+    if case == "chunked":
+        assert st["sched_chunks"] >= 1
+        # Chunked slices (and their deferrals) are prefill phase.
+        assert all(e["prefill_s"] > 0 for e in traces)
+
+
+def test_queue_phase_dominates_under_pressure(model, tmp_path):
+    """Sanity of the numbers themselves: with 2 lanes and 6 requests,
+    late submitters spend real time queued — their queue_s must be a
+    visible fraction of wall, and early requests' queue_s near zero."""
+    cfg, params = model
+    prompts = _prompts(cfg, [6, 6, 6, 6, 6, 6])
+    (rids, res, srv), evs = _run_with_sink(
+        tmp_path, lambda: _serve(params, cfg, prompts, 10)
+    )
+    traces = sorted(
+        (e for e in evs if e.get("name") == "request_trace"),
+        key=lambda e: e["rid"],
+    )
+    assert traces[-1]["queue_s"] > traces[0]["queue_s"]
+    assert traces[-1]["queue_s"] > 0
+
+
+def test_greedy_outputs_bit_identical_tracing_on_off(model, tmp_path):
+    """Telemetry must never touch numerics: the same burst with the
+    JSONL sink + flight recorder armed and with both disarmed produces
+    bit-identical greedy tokens (the ledger itself is always on — it is
+    host arithmetic outside every traced computation)."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 9, 6, 12], seed=5)
+
+    prev_rec = flight.set_default_recorder(flight.FlightRecorder())
+    try:
+        (rids_on, res_on, _s), _evs = _run_with_sink(
+            tmp_path, lambda: _serve(params, cfg, prompts, 10)
+        )
+    finally:
+        flight.set_default_recorder(prev_rec)
+
+    prev_sink = obs.set_default_sink(None)
+    prev_rec = flight.set_default_recorder(None)
+    try:
+        rids_off, res_off, _s2 = _serve(params, cfg, prompts, 10)
+    finally:
+        obs.set_default_sink(prev_sink)
+        flight.set_default_recorder(prev_rec)
+
+    for a, b in zip(rids_on, rids_off):
+        np.testing.assert_array_equal(res_on[a], res_off[b])
+
+
+# ----- trace-context propagation (tentpole a) -------------------------------
+
+
+def test_server_adopts_daemon_trace_ctx(model, tmp_path, monkeypatch):
+    cfg, params = model
+    monkeypatch.setenv("KATA_TPU_TRACE_CTX", "deadbeefcafe0123")
+    prompts = _prompts(cfg, [4, 6])
+    (rids, res, srv), evs = _run_with_sink(
+        tmp_path, lambda: _serve(params, cfg, prompts, 6)
+    )
+    assert srv.stats()["trace"] == "deadbeefcafe0123"
+    serving_evs = [e for e in evs if e.get("kind") == "serving"]
+    assert serving_evs and all(
+        e.get("trace") == "deadbeefcafe0123" for e in serving_evs
+    )
+    # Spans join the same trace: the guest's prefill/decode spans carry
+    # the daemon's allocation trace id end to end.
+    spans = [e for e in evs if e.get("kind") == "span"
+             and e.get("name", "").startswith("serving.")]
+    assert spans and all(e["trace"] == "deadbeefcafe0123" for e in spans)
+
+
+def test_server_mints_trace_without_env(model, tmp_path, monkeypatch):
+    cfg, params = model
+    monkeypatch.delenv("KATA_TPU_TRACE_CTX", raising=False)
+    srv_a = GenerationServer(params, cfg, max_batch=1, max_len=32)
+    srv_b = GenerationServer(params, cfg, max_batch=1, max_len=32)
+    ta, tb = srv_a.stats()["trace"], srv_b.stats()["trace"]
+    assert ta and tb and ta != tb  # per-server join keys, never shared
+
+
+def test_allocator_injects_trace_ctx_env():
+    from kata_xpu_device_plugin_tpu.cdi import constants as C
+    from kata_xpu_device_plugin_tpu.config import Config
+    from kata_xpu_device_plugin_tpu.discovery.tpu import (
+        TpuChip,
+        TpuInventory,
+    )
+    from kata_xpu_device_plugin_tpu.plugin import TpuAllocator
+    from kata_xpu_device_plugin_tpu.topology.slice import HostTopology
+
+    inv = TpuInventory(
+        chips=(TpuChip(index=0, dev_path="/dev/accel0"),
+               TpuChip(index=1, dev_path="/dev/accel1")),
+        topology=HostTopology.from_accelerator_type("v5litepod-8"),
+        model_suffix="TPU_V5E",
+    )
+    alive = lambda _chip: True  # noqa: E731 — no real /dev in this test
+    alloc = TpuAllocator(lambda: inv, "google.com", "tpu", revalidate=alive)
+    # Inside a gRPC handler span the stamped id IS the span's trace id —
+    # the daemon-side half of the end-to-end join.
+    with obs.span("plugin.Allocate", resource="google.com/tpu") as sp:
+        resp = alloc.allocate(["0", "1"])
+    assert resp.envs[C.ENV_TRACE_CTX] == sp.trace_id
+    # Outside any span: a fresh id per allocation, still a join key.
+    a = alloc.allocate(["0"]).envs[C.ENV_TRACE_CTX]
+    b = alloc.allocate(["1"]).envs[C.ENV_TRACE_CTX]
+    assert a and b and a != b
+    # The daemon knob: --no-trace-context removes the stamp entirely.
+    off = TpuAllocator(lambda: inv, "google.com", "tpu", revalidate=alive,
+                       trace_context=False).allocate(["0"])
+    assert C.ENV_TRACE_CTX not in off.envs
+    assert Config(trace_context=False).trace_context is False
+    assert Config().trace_context is True
+
+
+# ----- satellite: recovery/degrade/fatal events carry trace ids -------------
+
+
+def test_recovery_vocabulary_carries_trace(model, tmp_path):
+    """The PR 10 incident vocabulary — fault_injected, recovery,
+    request_failed — joins the allocation trace (the satellite: today
+    only spans attached trace ids)."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 6])
+    inj = FaultInjector(schedule=[FaultSpec("decode_dispatch", 1)])
+    (rids, res, srv), evs = _run_with_sink(
+        tmp_path,
+        lambda: _serve(params, cfg, prompts, 8, fault_injector=inj),
+    )
+    trace = srv.stats()["trace"]
+    recov = [e for e in evs if e.get("name") == "recovery"]
+    assert recov and all(e["trace"] == trace for e in recov)
+    # The injected injector has no trace of its own; the recovery event
+    # stream still joins through the server's emits. An env-built
+    # injector adopts the server trace:
+    srv2 = GenerationServer(params, cfg, max_batch=1, max_len=32)
+    assert srv2._inj.trace == srv2.stats()["trace"]
+
+
+def test_chip_loss_fatal_carries_trace_and_dumps_flight(
+        model, tmp_path, monkeypatch):
+    """The acceptance path end to end: a chip loss with no degraded rung
+    (tp=1) emits chip_loss_fatal + request_failed — all carrying the
+    allocation trace — and the always-armed flight recorder dumps a
+    postmortem JSONL containing the fatal event's trace id."""
+    cfg, params = model
+    dump_dir = tmp_path / "dumps"
+    monkeypatch.setenv(flight.ENV_DIR, str(dump_dir))
+    monkeypatch.setenv("KATA_TPU_TRACE_CTX", "a11ocfeedc0ffee1")
+    rec = flight.FlightRecorder(capacity=64)
+    prev_rec = flight.set_default_recorder(rec)
+    try:
+        inj = FaultInjector(
+            schedule=[FaultSpec("decode_dispatch", 1, "chip_loss", 0)]
+        )
+        (rids, res, srv), evs = _run_with_sink(
+            tmp_path,
+            lambda: _serve(params, cfg, _prompts(cfg, [4, 6]), 8,
+                           fault_injector=inj),
+        )
+    finally:
+        flight.set_default_recorder(prev_rec)
+    fatal = [e for e in evs if e.get("name") == "chip_loss_fatal"]
+    failed = [e for e in evs if e.get("name") == "request_failed"]
+    assert fatal and fatal[0]["trace"] == "a11ocfeedc0ffee1"
+    assert failed and all(
+        e["trace"] == "a11ocfeedc0ffee1" for e in failed
+    )
+    # Failed requests still close their ledgers (outcome=failed).
+    traces = [e for e in evs if e.get("name") == "request_trace"]
+    assert {e["rid"] for e in traces} == set(rids)
+    assert all(e["outcome"] == "failed" for e in traces)
+    assert srv.failures()
+    # The flight dump: produced, in the configured dir, joinable.
+    assert rec.dumps and os.path.dirname(rec.dumps[0]) == str(dump_dir)
+    dump = _events(rec.dumps[0])
+    dumped_fatal = [e for e in dump if e.get("name") == "chip_loss_fatal"]
+    assert dumped_fatal and dumped_fatal[0]["trace"] == "a11ocfeedc0ffee1"
+
+
+def test_clean_run_produces_no_flight_dump(model, tmp_path):
+    cfg, params = model
+    rec = flight.FlightRecorder(capacity=64)
+    prev_rec = flight.set_default_recorder(rec)
+    try:
+        (rids, res, srv), _evs = _run_with_sink(
+            tmp_path, lambda: _serve(params, cfg, _prompts(cfg, [4, 6]), 6)
+        )
+    finally:
+        flight.set_default_recorder(prev_rec)
+    assert set(res) == set(rids)
+    assert rec.dumps == []
+    assert rec.snapshot()  # armed: the run's events are in the ring
+
+
+def test_fatal_error_event_on_nonrecoverable(model, tmp_path, monkeypatch):
+    """A non-recoverable exception unwinds the loop but leaves evidence:
+    one serving/fatal_error event — the flight recorder's guest-side
+    trigger for 'the supervisor could not help'."""
+    cfg, params = model
+    monkeypatch.setenv("KATA_TPU_RECOVERY", "0")
+    rec = flight.FlightRecorder(capacity=32)
+    prev_rec = flight.set_default_recorder(rec)
+
+    def run():
+        inj = FaultInjector(schedule=[FaultSpec("decode_dispatch", 1)])
+        with pytest.raises(Exception):
+            _serve(params, cfg, _prompts(cfg, [4]), 8, fault_injector=inj)
+
+    try:
+        _out, evs = _run_with_sink(tmp_path, run)
+    finally:
+        flight.set_default_recorder(prev_rec)
+    fatal = [e for e in evs if e.get("name") == "fatal_error"]
+    assert len(fatal) == 1 and "TransientFault" in fatal[0]["error"]
+    assert rec.dumps  # the ring dumped on it
+
+
+# ----- stats schema + scheduler estimate reset ------------------------------
+
+
+def test_stats_request_phase_schema(model):
+    cfg, params = model
+    srv = GenerationServer(params, cfg, max_batch=1, max_len=32)
+    st = srv.stats()
+    assert st["request_traces"] == 0
+    assert set(st["request_phase_s"]) == set(PHASES)
+    assert all(v == {"count": 0} for v in st["request_phase_s"].values())
+    srv.submit(np.arange(4, dtype=np.int32) % cfg.vocab_size, 4)
+    srv.run()
+    st = srv.stats()
+    assert st["request_traces"] == 1
+    assert st["request_phase_s"]["decode"]["count"] == 1
+    assert st["request_phase_s"]["preempted"] == {"count": 0}
+
+
+def test_scheduler_reset_estimates():
+    from kata_xpu_device_plugin_tpu.guest.scheduler import (
+        SLOChunkedScheduler,
+    )
+
+    s = SLOChunkedScheduler(chunk_tokens=4, slo_ms=50.0, decode_steps=2)
+    s.note_prefill(16, 0.08)
+    s.note_round(0.02)
+    assert s.projected_itl_s(32) is not None
+    s.reset_estimates()  # post-shrink: old-mesh timings are stale
+    assert s.projected_itl_s(32) is None
+    assert s.directive(live_lanes=2, pending_tokens=64).admit  # bootstrap

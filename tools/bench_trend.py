@@ -1,0 +1,165 @@
+"""Bench-bank trend comparison (ISSUE 11 satellite).
+
+The TPU bench banks one dated ``BENCH_TPU_<utcstamp>.json`` per healthy
+round (``scripts/bench_when_healthy.py``), but nothing ever LOOKED at
+two of them side by side — decode tok/s/chip sat at 1303.8 across the
+entire bank without anyone noticing, because each file is only ever
+read alone. This tool makes the trajectory visible: it loads the two
+newest banks, prints the per-metric delta for every numeric field they
+share, marks headline metrics that moved more than the threshold, and
+exits non-zero on a headline REGRESSION so CI can surface it (the CI
+step is non-blocking — a bench regression is a flag to read, not a
+merge gate; the numbers come from shared hardware).
+
+Usage::
+
+    python -m tools.bench_trend [--dir .] [--threshold 0.10] [--json]
+
+Conventions:
+
+- Banks sort by filename — the UTC stamp in ``BENCH_TPU_<stamp>.json``
+  is lexicographically ordered.
+- HEADLINE metrics are throughputs (higher is better); a drop beyond
+  the threshold is a regression. All other shared numeric fields are
+  reported as context, never flagged.
+- A headline metric whose value is bit-identical across both banks is
+  marked ``flat`` — the "nobody is moving this number" signal this tool
+  exists to raise.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Optional
+
+# Throughput headlines (higher is better): a >threshold drop flags.
+HEADLINE_METRICS = (
+    "value",                 # the banked headline (decode tok/s/chip)
+    "e2e_tok_per_s",
+    "prefill_tok_per_s",
+    "int8_tok_per_s",
+    "serving_tok_per_s",
+)
+
+DEFAULT_THRESHOLD = 0.10  # 10%
+
+# Non-measurement fields a bank carries that must not enter the table.
+_SKIP = {"attempts", "ts"}
+
+
+def find_banks(directory: str = ".") -> list[str]:
+    """All bench banks in ``directory``, oldest → newest (the filename
+    stamp is the order)."""
+    return sorted(glob.glob(os.path.join(directory, "BENCH_TPU_*.json")))
+
+
+def numeric_metrics(bank: dict) -> dict[str, float]:
+    """The flat numeric fields of one bank line (nested dicts like
+    ``phases``, strings, lists, and bookkeeping fields are skipped)."""
+    out: dict[str, float] = {}
+    for k, v in bank.items():
+        if k.startswith("_") or k in _SKIP:
+            continue
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[k] = float(v)
+    return out
+
+
+def compare(old: dict, new: dict,
+            threshold: float = DEFAULT_THRESHOLD) -> list[dict]:
+    """Per-metric rows for the fields both banks carry: old/new values,
+    relative delta, and a status — ``regression`` (headline, dropped
+    beyond threshold), ``improved`` (headline, rose beyond threshold),
+    ``flat`` (headline, bit-identical), or ``""`` (context)."""
+    om, nm = numeric_metrics(old), numeric_metrics(new)
+    rows: list[dict] = []
+    for k in sorted(set(om) & set(nm)):
+        a, b = om[k], nm[k]
+        delta = (b - a) / a if a else (0.0 if b == a else float("inf"))
+        status = ""
+        if k in HEADLINE_METRICS:
+            if b == a:
+                status = "flat"
+            elif delta < -threshold:
+                status = "regression"
+            elif delta > threshold:
+                status = "improved"
+        rows.append({
+            "metric": k,
+            "old": a,
+            "new": b,
+            "delta_pct": round(delta * 100.0, 2),
+            "status": status,
+        })
+    # Headlines first (bank order), then context alphabetically.
+    order = {m: i for i, m in enumerate(HEADLINE_METRICS)}
+    rows.sort(key=lambda r: (order.get(r["metric"], len(order)),
+                             r["metric"]))
+    return rows
+
+
+def render(rows: list[dict], old_path: str, new_path: str) -> str:
+    lines = [
+        f"bench trend: {os.path.basename(old_path)} -> "
+        f"{os.path.basename(new_path)}",
+        f"{'metric':<38} {'old':>12} {'new':>12} {'delta':>9}  status",
+    ]
+    for r in rows:
+        lines.append(
+            f"{r['metric']:<38} {r['old']:>12.4g} {r['new']:>12.4g} "
+            f"{r['delta_pct']:>+8.2f}%  {r['status']}"
+        )
+    n_reg = sum(r["status"] == "regression" for r in rows)
+    n_flat = sum(r["status"] == "flat" for r in rows)
+    lines.append(
+        f"headline: {n_reg} regression(s), {n_flat} flat "
+        f"(of {sum(r['metric'] in HEADLINE_METRICS for r in rows)} present)"
+    )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_TPU_*.json banks")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="headline regression threshold (fraction, "
+                         "default 0.10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison as one JSON object instead "
+                         "of the table")
+    args = ap.parse_args(argv)
+
+    banks = find_banks(args.dir)
+    if len(banks) < 2:
+        print(
+            f"bench-trend: need two BENCH_TPU_*.json banks in "
+            f"{args.dir!r}, found {len(banks)} — nothing to compare",
+            file=sys.stderr,
+        )
+        return 0  # an empty bank is not a failure
+    old_path, new_path = banks[-2], banks[-1]
+    with open(old_path, encoding="utf-8") as fh:
+        old = json.load(fh)
+    with open(new_path, encoding="utf-8") as fh:
+        new = json.load(fh)
+    rows = compare(old, new, threshold=args.threshold)
+    if args.json:
+        print(json.dumps({
+            "old": os.path.basename(old_path),
+            "new": os.path.basename(new_path),
+            "threshold": args.threshold,
+            "rows": rows,
+        }, indent=2))
+    else:
+        print(render(rows, old_path, new_path))
+    return 1 if any(r["status"] == "regression" for r in rows) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

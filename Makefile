@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp eval demo dryrun image clean deploy obs-check
+.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn eval eval-kv demo dryrun image clean deploy obs-check
 
 all: build
 
@@ -170,6 +170,28 @@ tp:
 	KATATPU_OBS=1 KATATPU_OBS_FILE=tp_events_strict.jsonl \
 	KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_tp_serving.py -q
+
+# Paged-native decode-attention gate (ISSUE 12): the kernel suite —
+# interpret-mode oracle vs xla_reference across ragged/boundary blocks,
+# the int8 fused-dequant bit-match, tp=2/4 shard_map identity on the
+# virtual 8-device host, and the serving bit-identity matrix re-run with
+# the kernel selected — with and without KATA_TPU_STRICT=1 (the kernel
+# dispatch window must stay transfer-guard-clean too).
+decode-attn:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=decode_attn_events.jsonl \
+	  $(PY) -m pytest tests/test_decode_attn_paged.py -q
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=decode_attn_events_strict.jsonl \
+	KATA_TPU_STRICT=1 \
+	  $(PY) -m pytest tests/test_decode_attn_paged.py -q
+
+# int8-KV promotion gate (ISSUE 12): pooled greedy agreement + first-
+# decode-step logit drift vs the bf16 oracle on a fixed prompt set —
+# the quality check behind the GenerationServer int8-KV default (exit 1
+# on a failing gate; KATA_TPU_KV_QUANT=bf16 is the node-wide opt-out).
+eval-kv:
+	JAX_PLATFORMS=cpu $(PY) -m tools.eval_quality --cpu
 
 # Opportunistic TPU bench: probe the tunnel every few minutes and run the
 # full bench on the first healthy probe, banking a dated committed JSON

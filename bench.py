@@ -292,6 +292,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
             env["KATA_TPU_BENCH_TRAIN"] = "0"
             env["KATA_TPU_BENCH_PREFIX"] = "0"
             env["KATA_TPU_BENCH_PAGED"] = "0"
+            env["KATA_TPU_BENCH_DECODE_ATTN"] = "0"
             env["KATA_TPU_BENCH_FAULTS"] = "0"
             env["KATA_TPU_BENCH_LOAD"] = "0"
             env["KATA_TPU_BENCH_TP"] = "0"
@@ -336,6 +337,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
         env["KATA_TPU_BENCH_TRAIN"] = "0"
         env["KATA_TPU_BENCH_PREFIX"] = "0"
         env["KATA_TPU_BENCH_PAGED"] = "0"
+        env["KATA_TPU_BENCH_DECODE_ATTN"] = "0"
         env["KATA_TPU_BENCH_FAULTS"] = "0"
         env["KATA_TPU_BENCH_LOAD"] = "0"
         env["KATA_TPU_BENCH_TP"] = "0"
@@ -916,6 +918,11 @@ def worker(args: argparse.Namespace) -> None:
                     # otherwise the baseline would grow its own store and
                     # the A/B would compare prefix against prefix.
                     prefix_cache_tokens=0,
+                    # Explicit int8 (the ISSUE 12 server default), pinned
+                    # on BOTH sides so the injected store below always
+                    # matches the arena dtype regardless of any
+                    # KATA_TPU_KV_QUANT env this process inherited.
+                    kv_quant=True,
                 )
 
             def timed(store, salt):  # jaxguard: hot  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
@@ -951,7 +958,8 @@ def worker(args: argparse.Namespace) -> None:
             # the HIT path — lookups happen before inserts within one
             # admission pass, so a single pass would warm only cold.
             store = PrefixStore(cfg, capacity_tokens=4 * shared_len,
-                                buckets=buckets, label="bench")
+                                buckets=buckets, label="bench",
+                                kv_quant=True)
             warm = make_server(store)
             warm.submit(make_prompts(1, salt=900)[0], new_per_req)
             warm.run()
@@ -1093,6 +1101,105 @@ def worker(args: argparse.Namespace) -> None:
             }
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"paged_error": f"{type(exc).__name__}: {exc}"[:200]}
+
+    def measure_decode_attn() -> dict:  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
+        # Paged-native decode-attention kernel A/B (ISSUE 12): the same
+        # paged burst served through the split-K pallas kernel
+        # (decode_attn="pallas_paged" — block tables walked in place,
+        # int8 dequant fused in-kernel) and through the legacy
+        # gather-back-to-dense XLA path, bf16 AND int8 KV. The pool holds
+        # every lane fully resident so the A/B times attention, not
+        # allocation pressure. On TPU this is the ROADMAP item-1 number
+        # (the >2× decode target's direct evidence); on CPU smoke the
+        # kernel runs interpret mode — harness validation, the speedup
+        # there is meaningless and expected < 1. SIDE measurement with
+        # the usual protections: after the banked headline, crash-
+        # guarded, KATA_TPU_BENCH_DECODE_ATTN=0 disables (the
+        # supervisor's retry kill switch).
+        if os.environ.get("KATA_TPU_BENCH_DECODE_ATTN", "1") == "0":
+            return {}
+        try:
+            from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+
+            srv_max_len = PROMPT_LEN + 72
+            new_per_req = 64
+            n_req = 2 * BATCH
+            pool_tokens = 2 * BATCH * srv_max_len + 64
+            kv_block = 16  # the kernel's KV tile — banked with the result
+            rng = jax.random.PRNGKey(53)
+            len_step = max(1, PROMPT_LEN // 8)
+
+            def make_server(backend, kv_quant):
+                return GenerationServer(
+                    params, cfg, max_batch=BATCH, max_len=srv_max_len,
+                    chunk=8 if args.smoke else 16,
+                    prefill_buckets=(PROMPT_LEN,),
+                    # Explicit args on BOTH sides: node-injected pool/
+                    # prefix/kv-quant envs must not flip either config.
+                    kv_pool_tokens=pool_tokens, prefix_cache_tokens=0,
+                    kv_block_size=kv_block,
+                    kv_quant=kv_quant, decode_attn=backend,
+                )
+
+            def reqs(srv, count, salt=0):
+                out = []
+                for i in range(count):
+                    n = PROMPT_LEN - (i % 4) * len_step  # mixed, one bucket
+                    p = jax.random.randint(
+                        jax.random.fold_in(rng, salt + i), (n,), 0,
+                        cfg.vocab_size, dtype=jnp.int32,
+                    )
+                    out.append(srv.submit(np.asarray(p), new_per_req))
+                return out
+
+            def timed(backend, kvq, salt):  # jaxguard: hot  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
+                # ONE server per variant, reused across the warm pass and
+                # all trials: the kernel callable is a per-server closure
+                # and a STATIC jit argument (its identity is the cache
+                # key), so a fresh server per trial — the other sections'
+                # pattern — would recompile _serve_decode inside every
+                # pallas-side timed window while the reference side
+                # (decode_kernel_fn=None, identity-stable) stayed warm,
+                # biasing the speedup low by a full compile per trial.
+                srv = make_server(backend, kvq)
+                reqs(srv, n_req, salt=salt + 9000)
+                srv.run()  # warm: compiles THIS server's executables
+                best = None
+                for trial in range(3):
+                    rids = reqs(srv, n_req, salt=salt + 100 * trial)
+                    t0 = time.perf_counter()
+                    results = srv.run()
+                    dt_s = time.perf_counter() - t0
+                    total = sum(len(results[r]) for r in rids)
+                    if best is None or dt_s < best[1]:
+                        best = (total, dt_s, srv.stats())
+                return best
+
+            k_total, k_dt, k_st = timed("pallas_paged", False, salt=0)
+            r_total, r_dt, _ = timed("xla_reference", False, salt=500)
+            kq_total, kq_dt, _ = timed("pallas_paged", True, salt=1000)
+            rq_total, rq_dt, _ = timed("xla_reference", True, salt=1500)
+            return {
+                # bf16 KV: kernel vs gather path.
+                "serving_decode_attn_tok_per_s": round(k_total / k_dt, 1),
+                "serving_decode_attn_reference_tok_per_s": round(
+                    r_total / r_dt, 1),
+                "serving_decode_attn_speedup": round(
+                    (k_total / k_dt) / (r_total / r_dt), 3),
+                # int8 KV: fused in-kernel dequant vs gather + XLA dequant.
+                "serving_decode_attn_int8_tok_per_s": round(
+                    kq_total / kq_dt, 1),
+                "serving_decode_attn_int8_reference_tok_per_s": round(
+                    rq_total / rq_dt, 1),
+                "serving_decode_attn_int8_speedup": round(
+                    (kq_total / kq_dt) / (rq_total / rq_dt), 3),
+                # The backend the kernel side actually engaged (interpret-
+                # mode smoke still reports pallas_paged) + the KV tile.
+                "serving_decode_attn_backend": k_st["decode_backend"],
+                "serving_decode_attn_block_size": kv_block,
+            }
+        except Exception as exc:  # noqa: BLE001 — headline must survive
+            return {"decode_attn_error": f"{type(exc).__name__}: {exc}"[:200]}
 
     def measure_faults() -> dict:  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
         # Fault-recovery smoke A/B (ISSUE 7): the same burst served once
@@ -1923,6 +2030,10 @@ def worker(args: argparse.Namespace) -> None:
     paged_out = measure_paged()
     if paged_out:
         out.update(paged_out)
+        print(json.dumps(out), flush=True)
+    decode_attn_out = measure_decode_attn()
+    if decode_attn_out:
+        out.update(decode_attn_out)
         print(json.dumps(out), flush=True)
     faults_out = measure_faults()
     if faults_out:

@@ -71,6 +71,15 @@ ENV_PREFIX_CACHE_TOKENS = "KATA_TPU_PREFIX_CACHE_TOKENS"
 # over one shared block pool (guest/kv_arena.py) sized per node.
 ENV_KV_POOL_TOKENS = "KATA_TPU_KV_POOL_TOKENS"
 
+# KV-cache quantization default handed to the guest (ISSUE 12):
+# guest.serving.GenerationServer defaults to the int8 KV arena (the
+# measured-1.7×-faster path, quality-gated by tools/eval_quality.py);
+# the daemon's --kv-quant knob injects "bf16" to opt a node out (or
+# "int8" to pin the default explicitly). Malformed values degrade
+# in-guest with a kv_quant_invalid event; an explicit kv_quant= server
+# argument always wins.
+ENV_KV_QUANT = "KATA_TPU_KV_QUANT"
+
 # Recovery-checkpoint cadence handed to the guest (ISSUE 7):
 # guest.serving.GenerationServer snapshots live-lane KV to host every N
 # rounds when the caller passes no checkpoint_rounds, so the daemon's

@@ -114,6 +114,17 @@ class Config:
     # default (fixed slots unless the server opts in via kv_pool_tokens=).
     kv_pool_tokens: int = 0
 
+    # KV-cache quantization default (ISSUE 12): when set ("int8" |
+    # "bf16"), the daemon injects KATA_TPU_KV_QUANT into every TPU
+    # AllocateResponse so in-guest GenerationServers resolve their KV
+    # arena dtype from the node's policy. The guest default is int8 (the
+    # measured-1.7×-faster arena, quality-gated by tools/eval_quality.py
+    # — `make eval-kv`); "bf16" is the node-wide opt-out for models the
+    # gate rejects. Same delivery path as the compile/prefix/pool knobs;
+    # malformed guest-side values degrade with a kv_quant_invalid event.
+    # Empty leaves the guest default.
+    kv_quant: str = ""
+
     # Crash-tolerant serving defaults (ISSUE 7): when > 0, the daemon
     # injects KATA_TPU_CHECKPOINT_ROUNDS into every TPU AllocateResponse
     # so in-guest GenerationServers snapshot live-lane KV to host every N
@@ -201,6 +212,10 @@ class Config:
             raise ValueError(
                 f"sched-policy must be fifo_batch or slo_chunked, got "
                 f"{self.sched_policy!r}"
+            )
+        if self.kv_quant not in ("", "int8", "bf16"):
+            raise ValueError(
+                f"kv-quant must be int8 or bf16, got {self.kv_quant!r}"
             )
         if self.prefill_chunk < 0:
             raise ValueError(

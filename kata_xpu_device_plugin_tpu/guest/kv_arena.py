@@ -205,6 +205,18 @@ class KVPool:
     :meth:`unref` returns a block to the free list when its last holder
     lets go — so a tier segment and three lanes can all reference one
     physical block and it is recycled exactly once.
+
+    **Kernel alignment contract (ISSUE 12).** Physical block ``t``
+    occupies pool rows ``t * block_size .. (t+1) * block_size`` — the
+    layout the paged-native decode kernel's index maps ride: its KV tile
+    IS one pool block, DMA'd straight from the block table
+    (:func:`..ops.decode_attn.pallas_paged_decode_attention`). On TPU
+    hardware the tile must satisfy the sublane quantum
+    (:func:`..ops.decode_attn.supports_paged_decode`: ``block_size`` a
+    multiple of 8 and ``head_dim`` lane-aligned); an unaligned pool
+    still serves correctly — the server's backend resolution falls back
+    to the XLA gather path with an ``unsupported_shape`` reason on its
+    ``decode_attn_backend`` event — it just forfeits the kernel.
     """
 
     def __init__(self, cfg: DecoderConfig, pool_tokens: int,

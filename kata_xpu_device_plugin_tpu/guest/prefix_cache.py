@@ -281,7 +281,7 @@ class PrefixStore:
     """
 
     def __init__(self, cfg: DecoderConfig, capacity_tokens: int,
-                 buckets: tuple, *, kv_quant: bool = False,
+                 buckets: tuple, *, kv_quant: Optional[bool] = None,
                  dtype=None, label: str = "") -> None:
         buckets = tuple(sorted(buckets))
         if not buckets:
@@ -294,6 +294,15 @@ class PrefixStore:
                 f"capacity_tokens={capacity_tokens} cannot hold even the "
                 f"smallest bucket ({buckets[0]})"
             )
+        # kv_quant=None follows the SAME int8-by-default resolution as
+        # GenerationServer (serving.resolve_kv_quant — explicit arg >
+        # KATA_TPU_KV_QUANT env > int8), so a default-constructed store
+        # injected into a default server matches its arena dtype instead
+        # of tripping the mismatch check (ISSUE 12). Call-time import:
+        # serving imports this module at its top.
+        from .serving import resolve_kv_quant
+
+        kv_quant = resolve_kv_quant(kv_quant)
         self.cfg, self.buckets = cfg, buckets
         self.capacity_tokens = int(capacity_tokens)
         self.kv_quant = bool(kv_quant)

@@ -132,6 +132,7 @@ from ..models.transformer import (
     prefill_suffix,
     ring_caches_from_prefill,
 )
+from ..ops import attention
 from . import resilience, tp_serving
 from .kv_arena import (
     RESERVED_BLOCKS,
@@ -173,6 +174,54 @@ ENV_SPEC_OPT_IN = "KATA_TPU_SPEC"
 # end to end. Unset (direct runs, tests): the server mints its own, so
 # a process's workloads still share one join key per server.
 ENV_TRACE_CTX = "KATA_TPU_TRACE_CTX"
+
+# int8 KV by default (ISSUE 12): the measured-1.7×-faster int8 KV cache
+# is the server default, gated by the tools/eval_quality.py quality check
+# (greedy-token match + logit drift vs bf16 — `make eval-kv` must pass
+# before a release flips or keeps this). KATA_TPU_KV_QUANT is the
+# daemon-injectable opt-out (cdi.constants.ENV_KV_QUANT, config.kv_quant
+# — the standard constants → allocators → manager path): "bf16" restores
+# the unquantized arena node-wide, "int8" pins the default explicitly,
+# anything else degrades to the default with a kv_quant_invalid event.
+# An explicit kv_quant= argument always wins.
+ENV_KV_QUANT = "KATA_TPU_KV_QUANT"
+DEFAULT_KV_QUANT = "int8"
+
+
+def resolve_kv_quant(kv_quant, emit=None) -> bool:
+    """The ONE int8-by-default resolution (ISSUE 12): explicit argument >
+    ``KATA_TPU_KV_QUANT`` env ("int8" | "bf16") > the int8 default. Both
+    :class:`GenerationServer` and a default-constructed
+    :class:`.prefix_cache.PrefixStore` route through this, so an
+    injected store and its server resolve the SAME dtype by default
+    (their mismatch check stays for explicitly divergent pairs). A
+    malformed env value degrades to the default; ``emit`` (the server's
+    ``_emit``) reports it once as ``kv_quant_invalid`` — store-side
+    resolution passes no emitter, so one server emits one event."""
+    if kv_quant is not None:
+        return bool(kv_quant)
+    raw = os.environ.get(ENV_KV_QUANT, "").strip().lower()
+    if raw and raw not in ("int8", "bf16"):
+        if emit is not None:
+            emit("kv_quant_invalid", reason=f"bad_env:{raw[:32]}")
+        raw = ""
+    return (raw or DEFAULT_KV_QUANT) == "int8"
+
+# Decode-attention backend override (ISSUE 12): the serving decode step
+# runs the paged-native split-K pallas kernel on TPU
+# (ops/decode_attn.pallas_paged_decode_attention — block tables walked in
+# place, int8 dequant fused in-kernel, shard_map'd over the tp mesh) and
+# the XLA gather path elsewhere. This env forces either side
+# ("pallas_paged" runs the kernel in interpret mode off-TPU — the CPU
+# serving-matrix harness); malformed values degrade to the automatic
+# choice with a decode_attn_invalid event. The resolved backend is
+# emitted once per server (decode_attn_backend event), always present in
+# stats()["decode_backend"], and scraped as a labeled gauge. The name
+# constants live with the dispatch decision (ops/attention.py) so label
+# and dispatch cannot drift.
+ENV_DECODE_ATTN = attention.DECODE_ATTN_ENV
+BACKEND_PAGED = attention.BACKEND_PAGED
+BACKEND_REFERENCE = attention.BACKEND_REFERENCE
 
 # Request lifecycle phases (ISSUE 11): every submitted request is in
 # exactly ONE of these states at any moment, and the per-request ledger
@@ -255,6 +304,20 @@ def _gauge_shard_occupancy():
         "Paged KV pool fill per tensor-parallel mesh shard "
         "(0.0 at tp=1 or on slotted servers)",
         ["server", "shard"],
+    )
+
+
+# Decode-attention backend (ISSUE 12): a labeled 0/1 gauge rather than a
+# _PROM_STATS entry — the backend is a NAME, and the always-present
+# stats()["decode_backend"] string cannot ride the numeric scrape loop.
+# One child per known backend, 1 on the active one, so dashboards can
+# alert on "fleet fraction running the kernel" without schema branches.
+def _gauge_decode_backend():
+    return obs.gauge(
+        "kata_tpu_serving_decode_attn_backend",
+        "Active decode-attention backend (1 on the server's backend "
+        "label, 0 on the others; pallas_paged | xla_reference)",
+        ["server", "backend"],
     )
 
 
@@ -543,12 +606,13 @@ def _merge_rows(dev_vals, host_vals, fresh):
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "do_sample", "top_k",
                                    "top_p", "ring", "block_size",
-                                   "paged_len"),
+                                   "paged_len", "decode_kernel_fn"),
          donate_argnums=(1,))
 def _serve_decode(params, caches, tok, pos, cfg, steps: int, do_sample: bool,
                   top_k: int, temperature, key, top_p: float = 0.0,
                   ring: bool = False, block_tables=None,
-                  block_size: int = 0, paged_len: int = 0):
+                  block_size: int = 0, paged_len: int = 0,
+                  decode_kernel_fn=None):
     """The server's one decode executable: a fixed-``steps`` ragged chunk
     with the KV arena DONATED — without donation XLA must copy every arena
     tensor each chunk (the first in-scan cache write would otherwise alias
@@ -557,12 +621,18 @@ def _serve_decode(params, caches, tok, pos, cfg, steps: int, do_sample: bool,
     ``window``-slot pair, or the window-cycle tuple layout (see
     ``GenerationServer(ring_kv=True)``). ``block_tables`` (+ static
     ``block_size``/``paged_len``): the arena is the shared paged block
-    pool and each row decodes through its table (``kv_pool_tokens``)."""
+    pool and each row decodes through its table (``kv_pool_tokens``).
+    ``decode_kernel_fn`` (STATIC, resolved once per server — ISSUE 12):
+    the paged-native pallas decode-attention callable the transformer's
+    ragged branches dispatch through; None keeps the XLA gather path.
+    Its identity is part of the executable cache key, so a backend
+    change can never reuse a stale executable."""
     return _decode_scan(params, caches, tok, pos, cfg, steps, None,
                         do_sample, top_k, temperature, key,
                         return_state=True, top_p=top_p, ring=ring,
                         block_tables=block_tables, block_size=block_size,
-                        paged_len=paged_len)
+                        paged_len=paged_len,
+                        decode_kernel_fn=decode_kernel_fn)
 
 
 class GenerationServer:
@@ -650,6 +720,28 @@ class GenerationServer:
     training-layout sharding). Greedy outputs are bit-identical to
     ``tp=1``.
 
+    KV QUANTIZATION (ISSUE 12): ``kv_quant=None`` (default) resolves
+    int8 KV — the measured-1.7×-faster arena, quality-gated by
+    ``tools/eval_quality.py`` (``make eval-kv``) — unless the
+    daemon-injected ``KATA_TPU_KV_QUANT`` env says ``bf16`` (the
+    node-wide opt-out; malformed values degrade to the default with a
+    ``kv_quant_invalid`` event). An explicit ``True``/``False`` always
+    wins.
+
+    DECODE-ATTENTION BACKEND (ISSUE 12, ``docs/guest_guide.md`` "Decode
+    attention backends"): the decode step's attention runs the
+    paged-native split-K pallas kernel
+    (:func:`..ops.decode_attn.pallas_paged_decode_attention`) on TPU —
+    block tables walked in place (no ``_paged_view`` gather), int8
+    dequant fused in-kernel, ``shard_map``'d over the tp mesh — and the
+    XLA gather path elsewhere. ``decode_attn`` forces either side
+    (``"pallas_paged"`` off-TPU runs interpret mode — the CPU test
+    harness); ``None`` reads ``KATA_TPU_DECODE_ATTN`` then picks
+    automatically. Explicit incompatible choices raise; env-injected
+    ones degrade with the reason on the once-per-server
+    ``decode_attn_backend`` event. Greedy outputs are bit-identical to
+    the XLA path across the serving matrix (tested).
+
     DEGRADED MODE (ISSUE 10, ``docs/resilience.md`` "Degraded mode"):
     chip loss is a survivable event at ``tp > 1``. A PERMANENT fault
     (``chip_loss:<device>`` / ``ici_error`` schedule kinds, or an XLA
@@ -670,7 +762,8 @@ class GenerationServer:
                  max_len: int = 512, eos_id: Optional[int] = None,
                  chunk: int = 8, temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, seed: int = 0, mesh: Any = None,
-                 kv_quant: bool = False, prefill_buckets: tuple = (),
+                 kv_quant: Optional[bool] = None,
+                 prefill_buckets: tuple = (),
                  speculative_k: int = 0, ring_kv: bool = False,
                  draft: Optional[tuple] = None, overlap: bool = True,
                  strict: Optional[bool] = None,
@@ -689,7 +782,8 @@ class GenerationServer:
                  spec_opt_in: Optional[bool] = None,
                  tp: Optional[int] = None,
                  tp_min: Optional[int] = None,
-                 degraded: Optional[bool] = None):
+                 degraded: Optional[bool] = None,
+                 decode_attn: Optional[str] = None):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
@@ -780,6 +874,14 @@ class GenerationServer:
         self.max_batch, self.max_len = max_batch, max_len
         self.eos_id, self.chunk = eos_id, chunk
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
+        # int8 KV is the DEFAULT (ISSUE 12; the measured-1.7×-faster path,
+        # quality-gated by tools/eval_quality.py): an explicit kv_quant=
+        # argument wins; otherwise the daemon-injectable KATA_TPU_KV_QUANT
+        # env selects "int8"/"bf16", a malformed value degrading to the
+        # default with one kv_quant_invalid event (node-wide knobs never
+        # crash a guest — the standard env contract). resolve_kv_quant is
+        # shared with PrefixStore's default, so injected stores agree.
+        kv_quant = resolve_kv_quant(kv_quant, emit=self._emit)
         self.kv_quant = kv_quant
         # The one sample-vs-greedy decision (transformer._sampling_args):
         # also validates top_k/top_p-without-temperature.
@@ -1131,6 +1233,19 @@ class GenerationServer:
             self.arena = init_kv_caches(
                 cfg, max_batch, arena_len, quantized=kv_quant
             )
+        # Decode-attention backend (ISSUE 12): resolve ONCE per server —
+        # explicit arg > KATA_TPU_DECODE_ATTN env > automatic (the kernel
+        # on TPU, the XLA gather path elsewhere) — then build the kernel
+        # callable for the current mesh. The resolved name is emitted on
+        # the first decode dispatch, lives in stats()["decode_backend"],
+        # and is a STATIC argument of _serve_decode so the executable
+        # cache can never serve a stale backend.
+        self._decode_attn, self._decode_attn_reason, self._decode_interpret = (
+            self._resolve_decode_attn(decode_attn)
+        )
+        self._decode_kernel = None
+        self._decode_attn_emitted = False
+        self._build_decode_kernel(None)
         if mesh is not None:
             self._shard_over(mesh)
         # Host-side slot state: which request occupies each slot, its
@@ -1384,6 +1499,120 @@ class GenerationServer:
             return f"pool_too_small:{pool_tokens}"
         return None
 
+    def _decode_attn_conflict(self) -> Optional[str]:
+        """Why this server structurally cannot run the paged-native
+        decode kernel — None when it can. The kernel is single-token
+        ragged attention over the pool (or the pool-layout re-view of
+        the slotted arena): ring/cycle folds re-layout rows per slot,
+        speculative verification decodes multi-token spans, and sliding
+        windows / the Gemma-2 softcap are masks it does not model.
+        Backend-independent — shape/tiling limits are the separate
+        :meth:`_decode_attn_shape_conflict` (they depend on interpret
+        mode)."""
+        if self.ring_kv:
+            return "ring_kv"
+        if self.speculative_k or self.draft is not None:
+            return "speculative"
+        if any(w > 0 for w in self.cfg.window_cycle):
+            return "sliding_window"
+        if self.cfg.attn_logits_softcap:
+            return "logits_softcap"
+        return None
+
+    def _decode_attn_shape_conflict(self, interpret: bool) -> Optional[str]:
+        """Tile/shape gate: the KV tile (the pool block — the kv_arena
+        alignment contract — or :func:`..ops.attention.dense_decode_tile`
+        of the slotted arena) and head_dim must satisfy
+        :func:`..ops.decode_attn.supports_paged_decode` for the target
+        backend (interpret mode has no tiling constraints)."""
+        from ..ops.decode_attn import supports_paged_decode
+
+        tile = (
+            self.kv_block if self.paged
+            else attention.dense_decode_tile(self.max_len)
+        )
+        if not supports_paged_decode(self.cfg.head_dim, tile,
+                                     interpret=interpret):
+            return (
+                f"unsupported_shape:head_dim={self.cfg.head_dim}"
+                f",kv_tile={tile}"
+            )
+        return None
+
+    def _resolve_decode_attn(self, choice: Optional[str]):
+        """Resolve the decode-attention backend: ``(name, reason,
+        interpret)``. Explicit argument > env > auto, with the standard
+        knob contract — an explicit incompatible choice raises, an
+        env-injected one degrades to the automatic pick with an event
+        (the reason also rides the decode_attn_backend event). Forcing
+        the kernel off-TPU runs it in pallas interpret mode (the CPU
+        serving-matrix harness); the automatic pick never interprets —
+        interpret mode is far slower than XLA."""
+        explicit = choice is not None
+        if choice is None:
+            raw = os.environ.get(ENV_DECODE_ATTN, "").strip()
+            if raw and raw not in attention.DECODE_ATTN_BACKENDS:
+                self._emit(
+                    "decode_attn_invalid", reason=f"bad_env:{raw[:32]}",
+                )
+                raw = ""
+            choice = raw or None
+        elif choice not in attention.DECODE_ATTN_BACKENDS:
+            raise ValueError(
+                f"unknown decode_attn {choice!r} "
+                f"(have {attention.DECODE_ATTN_BACKENDS})"
+            )
+        if choice == BACKEND_REFERENCE:
+            return BACKEND_REFERENCE, "forced", False
+        if choice == BACKEND_PAGED:
+            interpret = not attention.on_tpu()
+            reason = (
+                self._decode_attn_conflict()
+                or self._decode_attn_shape_conflict(interpret)
+            )
+            if reason is not None:
+                if explicit:
+                    raise ValueError(
+                        f"decode_attn={BACKEND_PAGED!r} is incompatible "
+                        f"with this server ({reason}) — see 'Decode "
+                        "attention backends' in docs/guest_guide.md"
+                    )
+                return BACKEND_REFERENCE, reason, False
+            return BACKEND_PAGED, "", interpret
+        # Automatic: the kernel on TPU where supported, XLA elsewhere.
+        # Structural conflicts outrank the platform reason (they hold on
+        # every backend and are the actionable part of the event).
+        reason = self._decode_attn_conflict()
+        if reason is not None:
+            return BACKEND_REFERENCE, reason, False
+        if not attention.on_tpu():
+            return BACKEND_REFERENCE, "cpu_backend", False
+        reason = self._decode_attn_shape_conflict(False)
+        if reason is not None:
+            return BACKEND_REFERENCE, reason, False
+        return BACKEND_PAGED, "", False
+
+    def _build_decode_kernel(self, mesh) -> None:
+        """(Re)build the static decode-attention kernel callable for the
+        CURRENT mesh — called at construction and again from
+        :meth:`_place_arenas` whenever the arena moves (tp serving, crash
+        rebuild, degraded mesh shrink: a smaller mesh needs a fresh
+        shard_map wrapper, and the fn's identity being the executable
+        cache key makes the recompile explicit rather than a stale
+        reuse)."""
+        if self._decode_attn != BACKEND_PAGED:
+            self._decode_kernel = None
+            return
+        from ..parallel.mesh import AXIS_MODEL
+
+        tp = mesh.shape.get(AXIS_MODEL, 1) if mesh is not None else 1
+        self._decode_kernel = attention.make_decode_attn_fn(
+            self.cfg, paged=self.paged, block_size=self.kv_block,
+            paged_len=self.max_len, arena_len=self.max_len,
+            quantized=self.kv_quant, mesh=mesh if tp > 1 else None,
+            tp=tp, interpret=self._decode_interpret,
+        )
+
     def _shard_over(self, mesh) -> None:
         """Tensor-parallel serving: place params by their layout-aware
         PartitionSpecs — the serving regex rules
@@ -1473,6 +1702,12 @@ class GenerationServer:
             self.draft_arena = jax.tree.map(
                 lambda c: jax.device_put(c, d_sh), self.draft_arena
             )
+        # The decode kernel wrapper is mesh-specific (ISSUE 12): rebuild
+        # it wherever the arena lands — including the degraded shrink's
+        # smaller mesh (attribute-guarded: __init__ places the arena
+        # before the backend is resolved).
+        if getattr(self, "_decode_attn", None) is not None:
+            self._build_decode_kernel(mesh)
 
     # ----- public API ------------------------------------------------------
 
@@ -1627,6 +1862,14 @@ class GenerationServer:
             "tp_shrinks": self._tp_shrinks,
             "kv_pool_shard_occupancy": self._pool_shard_occupancy(),
         })
+        # Decode-attention backend (ISSUE 12): ALWAYS present — the
+        # resolved backend name plus the fallback reason ("" when the
+        # kernel is active) — mirrored by the once-per-server
+        # decode_attn_backend event and the labeled scrape gauge.
+        out.update({
+            "decode_backend": self._decode_attn,
+            "decode_backend_reason": self._decode_attn_reason,
+        })
         # Request lifecycle ledger (ISSUE 11): ALWAYS present — the trace
         # id every event of this server carries, the request_trace count,
         # and per-phase Rolling summaries ({"count": 0} for phases no
@@ -1744,6 +1987,20 @@ class GenerationServer:
             shard_gauge.labels(server=self._label, shard=str(i)).set_function(
                 partial(_shard_occ, self, i)
             )
+        # Decode-attention backend (ISSUE 12): 1 on the active backend's
+        # label, 0 on the others — every known backend gets a child so
+        # the scrape schema never branches on the selection. Reads the
+        # resolved field directly (like _shard_occ): a stats() snapshot
+        # per scrape would rebuild every Rolling summary just to compare
+        # one immutable string.
+        def _backend_active(self=self, be: str = "") -> float:
+            return float(self._decode_attn == be)
+
+        backend_gauge = _gauge_decode_backend()
+        for be in attention.DECODE_ATTN_BACKENDS:
+            backend_gauge.labels(
+                server=self._label, backend=be
+            ).set_function(partial(_backend_active, self, be))
         if port:
             from ..utils.metrics import serve
 
@@ -3391,6 +3648,24 @@ class GenerationServer:
         servers through the dense arena. Returns ``(toks, last, pos)``
         futures; the donated arena's successor is stored back."""
         self._inj.fire("decode_dispatch")
+        if not self._decode_attn_emitted:
+            # One decode_attn_backend event per server, at the first
+            # decode (ISSUE 12): the resolved backend plus the reason
+            # whenever the kernel was not selected — the event-stream
+            # mirror of stats()["decode_backend"].
+            self._decode_attn_emitted = True
+            self._emit(
+                "decode_attn_backend", backend=self._decode_attn,
+                reason=self._decode_attn_reason, paged=self.paged,
+                # The kernel's actual KV tile: the pool block when paged,
+                # the derived dense tile when slotted (the alignment
+                # contract the guest guide documents for this event).
+                block_size=(
+                    self.kv_block if self.paged
+                    else attention.dense_decode_tile(self.max_len)
+                ),
+                kv_quant="int8" if self.kv_quant else "bf16",
+            )
         if self.paged:
             toks, caches, new_last, new_pos = _serve_decode(
                 self.params, self.kv_pool.arena, last, pos, self.cfg,
@@ -3398,6 +3673,7 @@ class GenerationServer:
                 sub, top_p=self.top_p, ring=False,
                 block_tables=jnp.asarray(self._bt_host),
                 block_size=self.kv_block, paged_len=self.max_len,
+                decode_kernel_fn=self._decode_kernel,
             )
             self.kv_pool.arena = caches
         else:
@@ -3405,6 +3681,7 @@ class GenerationServer:
                 self.params, self.arena, last, pos, self.cfg, self.chunk,
                 self._do_sample, self.top_k, self._temp_dev, sub,
                 top_p=self.top_p, ring=self.ring_kv,
+                decode_kernel_fn=self._decode_kernel,
             )
             self.arena = caches
         return toks, new_last, new_pos

@@ -463,13 +463,18 @@ def _layer(
     block_tables: Optional[jax.Array] = None,
     block_size: int = 0,
     paged_len: int = 0,
+    decode_kernel_fn=None,
 ):
     """One decoder block. x: [B, S, D]. Returns (x, new_kv, aux) where aux
     is the layer's MoE load-balancing loss (0.0 for dense layers).
     ``ring=True``: the cache is a ``sliding_window``-slot ring buffer
     (slot = position % window) instead of a max_len array. ``window``
     overrides ``cfg.sliding_window`` for THIS layer (the per-layer
-    attn_windows cycle)."""
+    attn_windows cycle). ``decode_kernel_fn`` (STATIC — resolved once by
+    the server, see ``ops.attention.make_decode_attn_fn``) routes the
+    single-token ragged decode branches (paged AND slotted) through the
+    paged-native pallas kernel instead of the gather + XLA path; None
+    keeps the XLA path."""
     B, S, _ = x.shape
     eff_window = cfg.sliding_window if window is None else window
     # Sliding window rides as a kwarg only when configured, so custom
@@ -620,15 +625,26 @@ def _layer(
             block_tables == PAGED_SCRATCH_BLOCK, PAGED_ZERO_BLOCK,
             block_tables,
         )
-        view_idx = (
-            (view_tables * bs)[:, :, None]
-            + jnp.arange(bs)[None, None, :]
-        ).reshape(B, -1)[:, :paged_len]
-        attn_out = attn_fn(
-            q, dequantize_kv(_paged_view(ck, view_idx), x.dtype),
-            dequantize_kv(_paged_view(cv, view_idx), x.dtype),
-            causal=True, q_offset=cache_offset, **wkw,
-        )
+        if decode_kernel_fn is not None:
+            # Paged-NATIVE kernel (ISSUE 12): each lane's program walks
+            # its block table in place — the dense [B, paged_len] view
+            # below (a full copy of every live lane's KV through HBM,
+            # every layer, every step) never materializes. int8 pools
+            # dequantize in-kernel. The mask semantics are the gather
+            # path's exactly (unmapped→ZERO rows, every column > pos
+            # replaced before softmax), so greedy tokens match.
+            attn_out = decode_kernel_fn(q, ck, cv, view_tables,
+                                        cache_offset)
+        else:
+            view_idx = (
+                (view_tables * bs)[:, :, None]
+                + jnp.arange(bs)[None, None, :]
+            ).reshape(B, -1)[:, :paged_len]
+            attn_out = attn_fn(
+                q, dequantize_kv(_paged_view(ck, view_idx), x.dtype),
+                dequantize_kv(_paged_view(cv, view_idx), x.dtype),
+                causal=True, q_offset=cache_offset, **wkw,
+            )
         new_cache = (ck, cv)
     elif kv_cache is not None and jnp.ndim(cache_offset) == 1:
         # Ragged decode ([B] offsets): each batch row writes its S k/v
@@ -641,10 +657,18 @@ def _layer(
         rows = jnp.arange(B)
         ck = _cache_write_rows(ck, k, rows, cache_offset)
         cv = _cache_write_rows(cv, v, rows, cache_offset)
-        attn_out = attn_fn(
-            q, dequantize_kv(ck, x.dtype), dequantize_kv(cv, x.dtype),
-            causal=True, q_offset=cache_offset, **wkw,
-        )
+        if decode_kernel_fn is not None and S == 1:
+            # Slotted single-token decode through the SAME paged-native
+            # kernel: the dense arena re-views zero-copy as a pool with
+            # identity tables (ops.attention.make_decode_attn_fn,
+            # paged=False). Multi-token spans (speculative verification)
+            # keep the XLA path — the kernel is single-token.
+            attn_out = decode_kernel_fn(q, ck, cv, None, cache_offset)
+        else:
+            attn_out = attn_fn(
+                q, dequantize_kv(ck, x.dtype), dequantize_kv(cv, x.dtype),
+                causal=True, q_offset=cache_offset, **wkw,
+            )
         new_cache = (ck, cv)
     elif kv_cache is not None:
         # Decode: write new k/v at cache_offset, attend to the whole cache
@@ -722,6 +746,7 @@ def forward(
     block_tables: Optional[jax.Array] = None,
     block_size: int = 0,
     paged_len: int = 0,
+    decode_kernel_fn=None,
 ):
     """Full forward. tokens: [B, S] int32 → logits [B, S, vocab].
 
@@ -795,7 +820,7 @@ def forward(
             prefill=prefill, moe_mesh=moe_mesh, ring=ring and w > 0,
             window=w, rope_theta=theta, rope_linear=linear,
             block_tables=block_tables, block_size=block_size,
-            paged_len=paged_len,
+            paged_len=paged_len, decode_kernel_fn=decode_kernel_fn,
         )
 
     def body(carry, group_and_cache):
@@ -1238,14 +1263,16 @@ def prefill_batch(params: Params, prompts: jax.Array, cfg: DecoderConfig,
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn", "do_sample",
                                    "top_k", "top_p", "return_state", "ring",
-                                   "block_size", "paged_len"))
+                                   "block_size", "paged_len",
+                                   "decode_kernel_fn"))
 def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
                  cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn],
                  do_sample: bool, top_k: int, temperature, key: jax.Array,
                  return_state: bool = False, ring: bool = False,
                  top_p: float = 0.0,
                  block_tables: Optional[jax.Array] = None,
-                 block_size: int = 0, paged_len: int = 0):
+                 block_size: int = 0, paged_len: int = 0,
+                 decode_kernel_fn=None):
     if attn_fn is None:
         from ..ops.attention import flash_attention
 
@@ -1261,7 +1288,7 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
             params, tok[:, None], cfg, attn_fn=attn_fn, positions=positions,
             kv_caches=caches, cache_offset=pos, ring=ring,
             block_tables=block_tables, block_size=block_size,
-            paged_len=paged_len,
+            paged_len=paged_len, decode_kernel_fn=decode_kernel_fn,
         )
         nxt = _next_token(logits[:, -1, :], step_key, do_sample, temperature,
                           top_k, top_p)
@@ -1276,7 +1303,7 @@ def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
            cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn] = None,
            temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
            key: Optional[jax.Array] = None, return_state: bool = False,
-           ring: bool = False):
+           ring: bool = False, decode_kernel_fn=None):
     """Decode ``steps`` tokens after ``tok`` as one lax.scan — no per-token
     dispatch overhead. Returns [B, steps] (with ``return_state=True``:
     ``(tokens, caches, last_token, pos)`` so a server can continue later).
@@ -1315,7 +1342,8 @@ def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,
     do_sample, key = _sampling_args(temperature, top_k, key, top_p)
     return _decode_scan(params, caches, tok, pos, cfg, steps, attn_fn,
                         do_sample, top_k, jnp.float32(temperature), key,
-                        return_state=return_state, ring=ring, top_p=top_p)
+                        return_state=return_state, ring=ring, top_p=top_p,
+                        decode_kernel_fn=decode_kernel_fn)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "max_len", "attn_fn",

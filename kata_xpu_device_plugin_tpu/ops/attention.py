@@ -179,6 +179,150 @@ def decode_eligible(sq: int, sk: int, d: int, causal: bool, q_offset) -> bool:
     )
 
 
+# ----- paged-native decode backend (ISSUE 12) -------------------------------
+#
+# The serving decode step's attention backend: the paged-native split-K
+# pallas kernel (ops/decode_attn.pallas_paged_decode_attention) or the
+# legacy gather-back-to-dense XLA path. Selection is resolved ONCE per
+# GenerationServer (never per trace — a per-trace env read would let a
+# toggled variable silently mix cached executables, the ops.quant._W8A8
+# lesson) and threaded down as the static ``decode_kernel_fn`` argument of
+# transformer.forward, so the executable cache key carries the decision.
+DECODE_ATTN_ENV = "KATA_TPU_DECODE_ATTN"
+BACKEND_PAGED = "pallas_paged"
+BACKEND_REFERENCE = "xla_reference"
+DECODE_ATTN_BACKENDS = (BACKEND_PAGED, BACKEND_REFERENCE)
+
+
+def dense_decode_tile(arena_len: int) -> int:
+    """KV tile for running the SLOTTED (dense ragged) arena through the
+    paged-native kernel: the ``[B, S, KV, D]`` arena reshapes zero-copy to
+    the pool layout ``[1, B·S, KV, D]`` (row ``b·S + s`` is exactly lane
+    b's position s), with a synthetic block table ``table[b, j] = b·(S/t)
+    + j`` — so one kernel serves both arena models. The tile must divide
+    the arena length; 0 means no supported tile (the dispatch falls back
+    to the XLA path)."""
+    for t in (128, 64, 32, 16, 8):
+        if arena_len % t == 0:
+            return t
+    return 0
+
+
+def make_decode_attn_fn(
+    cfg,
+    *,
+    paged: bool,
+    block_size: int = 0,
+    paged_len: int = 0,
+    arena_len: int = 0,
+    quantized: bool = False,
+    mesh=None,
+    tp: int = 1,
+    interpret: bool = False,
+):
+    """Build the serving decode-attention kernel callable
+    ``fn(q, ck, cv, tables, pos) -> [B, 1, H, D]`` the transformer's
+    ragged decode branches dispatch through (static ``decode_kernel_fn``).
+
+    ``paged=True``: ``ck``/``cv`` are the layer's ``[1, NT, KV, D]`` pool
+    slice (bf16 or int8 QTensor) and ``tables`` the lanes' view tables;
+    the kernel's KV tile is the pool's ``block_size`` (the alignment
+    contract ``guest.kv_arena.KVPool`` documents). ``paged=False``: the
+    slotted arena rides the SAME kernel through the zero-copy pool-layout
+    reshape + synthetic tables of :func:`dense_decode_tile` (``tables``
+    is ignored — pass None).
+
+    ``mesh``/``tp``: tensor-parallel serving wraps the pallas call in
+    ``shard_map`` with the serving KV-head specs
+    (:func:`..parallel.sharding.decode_attn_specs`) — explicit specs are
+    what let a custom call partition over the model axis instead of
+    replicating; the kv-replicated layout (n_kv_heads % tp != 0) runs
+    fully replicated inside the same wrapper.
+
+    Raises on configs the kernel cannot model (sliding windows, the
+    Gemma-2 attention-logit softcap, unsupported tiles) — eligibility
+    lives with the caller (``GenerationServer._resolve_decode_attn``),
+    this builder only refuses to build something silently wrong."""
+    from .decode_attn import (
+        pallas_paged_decode_attention,
+        supports_paged_decode,
+    )
+
+    if any(w > 0 for w in cfg.window_cycle):
+        raise ValueError(
+            "the paged-native decode kernel has no sliding-window mask — "
+            "windowed configs stay on the XLA path"
+        )
+    if cfg.attn_logits_softcap:
+        raise ValueError(
+            "the paged-native decode kernel does not model the attention-"
+            "logit softcap — capped configs stay on the XLA path"
+        )
+    if paged:
+        bs, plen = int(block_size), int(paged_len)
+    else:
+        bs, plen = dense_decode_tile(int(arena_len)), int(arena_len)
+    if not supports_paged_decode(cfg.head_dim, bs, interpret=interpret):
+        raise ValueError(
+            f"paged decode kernel unsupported shape: head_dim="
+            f"{cfg.head_dim}, kv_tile={bs} (interpret={interpret})"
+        )
+
+    def pool_form(q, ck, cv, tables, pos):
+        if not paged:
+            # Zero-copy re-view of the slotted arena as a pool: row
+            # b·S + s IS lane b's position s, tables are the identity
+            # mapping over each lane's own rows.
+            B, S = q.shape[0], plen
+            nb_row = S // bs
+
+            def reshape(a):
+                return a.reshape((1, B * S) + a.shape[2:])
+
+            tables = (
+                jnp.arange(B, dtype=jnp.int32)[:, None] * nb_row
+                + jnp.arange(nb_row, dtype=jnp.int32)[None, :]
+            )
+            ck = jax.tree.map(reshape, ck)
+            cv = jax.tree.map(reshape, cv)
+        return pallas_paged_decode_attention(
+            q, ck, cv, tables, pos, block_size=bs, paged_len=plen,
+            interpret=interpret,
+        )
+
+    if mesh is None or tp <= 1:
+        return pool_form
+
+    from ..compat.jaxapi import P, shard_map
+    from ..parallel.sharding import decode_attn_specs
+
+    q_spec, kv_spec, out_spec = decode_attn_specs(cfg, tp, quantized)
+    if paged:
+        return shard_map(
+            pool_form,
+            mesh=mesh,
+            in_specs=(q_spec, kv_spec, kv_spec, P(None, None), P(None)),
+            out_specs=out_spec,
+            check_vma=False,  # no collectives: outputs are shard-local
+        )
+
+    # Slotted: the synthetic tables are built INSIDE the shard (they are
+    # not an operand), so the wrapped signature drops them.
+    sharded = shard_map(
+        lambda q, ck, cv, pos: pool_form(q, ck, cv, None, pos),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, P(None)),
+        out_specs=out_spec,
+        check_vma=False,
+    )
+
+    def slotted(q, ck, cv, tables, pos):
+        del tables
+        return sharded(q, ck, cv, pos)
+
+    return slotted
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,

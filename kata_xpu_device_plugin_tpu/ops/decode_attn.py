@@ -1,29 +1,50 @@
 """Pallas TPU fused decode attention (single-token GQA attention into a KV
-cache).
+cache) — the lockstep whole-cache kernel AND the paged-native split-K
+kernel (ISSUE 12).
 
-The decode hot loop is bandwidth-bound, but XLA lowers one decode-attention
-step to ~8 small ops (dot, scale, mask, max, exp, sum, div, dot) per layer —
-at B=8 each op touches a few hundred KB, so the step pays ~8 op-dispatch
-latencies per layer for ~0.07 ms of actual HBM traffic (measured on v5e:
-0.57 ms/step of attention against a 0.02 ms roofline; see BASELINE.md). This
-kernel fuses the whole thing into ONE pallas program per layer and, because
-the causal frontier is the scalar-prefetched ``pos``, it skips cache blocks
-past the valid prefix entirely — XLA's version must always read the padded
-``max_len`` cache, this one reads only ``pos+1`` entries.
-
-Numerics: logits/softmax/accumulator in fp32 (the dots take bf16 inputs with
-``preferred_element_type=fp32`` — MXU-native), identical structure to
-:mod:`.flash`'s online softmax so the two kernels stay oracle-compatible
-with :func:`.attention.reference_attention`.
-
-Measured verdict (v5e, Gemma-2B, B=8, 128-step decode scan): the kernel
-LOSES to the XLA path end-to-end — 1068 vs 1281 tok/s — because the scan
-launches it once per layer per step (2304 launches) and per-launch overhead
-outweighs the fused-op and cache-tail savings at these shapes. It therefore
+**Lockstep kernel** (:func:`pallas_decode_attention`): the decode hot loop
+is bandwidth-bound, but XLA lowers one decode-attention step to ~8 small
+ops (dot, scale, mask, max, exp, sum, div, dot) per layer — at B=8 each op
+touches a few hundred KB, so the step pays ~8 op-dispatch latencies per
+layer for ~0.07 ms of actual HBM traffic (measured on v5e: 0.57 ms/step of
+attention against a 0.02 ms roofline; see BASELINE.md). This kernel fuses
+the whole thing into ONE pallas program per layer and, because the causal
+frontier is the scalar-prefetched ``pos``, it skips cache blocks past the
+valid prefix entirely. Measured verdict (v5e, Gemma-2B, B=8, 128-step
+scan): it LOSES to the XLA path end-to-end — 1068 vs 1281 tok/s — because
+per-launch overhead outweighs the fused-op savings at these shapes; it
 ships OFF by default (``KATA_TPU_DECODE_KERNEL=1`` opts in, see
-:func:`.attention.decode_eligible`) and stays numerics-verified in tests;
-the win it was built for (dispatch overhead) is real but XLA's scan-internal
-fusion already prices it lower.
+:func:`.attention.decode_eligible`) and stays numerics-verified in tests.
+
+**Paged-native split-K kernel** (:func:`pallas_paged_decode_attention`):
+the serving decode path. Instead of the ``_paged_view`` gather that
+rebuilds a dense ``[B, max_len]`` operand out of the block pool every
+step (``models/transformer.py`` paged branch — a full copy of every live
+lane's KV through HBM per layer per step), each program walks the lane's
+**block table directly** via scalar prefetch: grid ``(batch lane, KV
+head, KV-length split)``, where split ``ki`` DMAs physical pool block
+``table[b, ki]`` in place and folds it into a flash-decode-style running
+max/sum/accumulator carry (the split-K partial-softmax reduction — the
+same online softmax as :mod:`.flash`, carried across splits in VMEM
+scratch). Ragged per-lane positions ride the prefetched ``pos`` vector:
+splits past a lane's causal frontier clamp their index map to the
+frontier block, so the unwritten tail is never even DMA'd — per-lane
+traffic scales with ``pos[b]``, not ``max_len``. int8 ``QTensor`` pools
+dequantize IN KERNEL (payload+scale blocks ride together; the int8·scale
+multiply runs in fp32 registers exactly like
+:func:`..ops.quant.dequantize_kv`, value-identical), so the quantized
+pool never materializes a bf16 copy in HBM — cache read traffic is the
+int8 bytes plus scales. Tensor parallelism composes via ``shard_map`` +
+the serving KV-head specs (:func:`..parallel.sharding.decode_attn_specs`
+— a pallas call has no SPMD partitioning rule, so the wrapper is what
+lets it partition instead of replicating); see
+:func:`.attention.make_decode_attn_fn`.
+
+Numerics: logits/softmax/accumulator in fp32 (the dots take bf16 inputs
+with ``preferred_element_type=fp32`` — MXU-native), identical structure
+to :mod:`.flash`'s online softmax so the kernels stay oracle-compatible
+with :func:`.attention.reference_attention` (greedy tokens match the XLA
+path across the serving matrix; tested in tests/test_decode_attn_paged.py).
 """
 from __future__ import annotations
 
@@ -39,6 +60,7 @@ from jax.experimental import pallas as pl  # lint: allow(JX002) pallas-only API
 from jax.experimental.pallas import tpu as pltpu  # lint: allow(JX002) pallas-only API
 
 from ..compat.jaxapi import pallas_tpu_compiler_params
+from .quant import QTensor
 
 NEG_INF = -1e30
 
@@ -168,4 +190,186 @@ def pallas_decode_attention(
         ),
         interpret=interpret,
     )(jnp.asarray(pos, jnp.int32).reshape(1), q, k, v)
+    return out
+
+
+# ----- paged-native split-K decode attention (ISSUE 12) ---------------------
+
+
+def supports_paged_decode(d: int, block_size: int,
+                          interpret: bool = False) -> bool:
+    """Shape gate for the paged-native kernel. The KV tile IS one pool
+    block (``guest.kv_arena.KVPool`` physical block ``t`` occupies pool
+    rows ``t*bs .. (t+1)*bs`` — the layout contract the index map rides),
+    so on hardware the block size must satisfy the TPU sublane quantum
+    (8 rows; Mosaic sub-tiles bf16/int8 within it) and head_dim the lane
+    width. Interpret mode (the CPU test/serving-matrix path) has no
+    tiling constraints — any positive shape runs."""
+    if interpret:
+        return d >= 1 and block_size >= 1
+    return (d % 128 == 0 or d == 64) and block_size >= 8 and block_size % 8 == 0
+
+
+def _paged_decode_kernel(
+    pos_ref,  # scalar prefetch: [B] int32 — per-lane last valid position
+    tbl_ref,  # scalar prefetch: [B, NB] int32 — physical block tables
+    q_ref,  # [1, 1, G, D] block of [B, 1, H, D]
+    *refs,  # k, v (each payload [, scale]) blocks, o block, 3 scratches
+    scale: float,
+    block_k: int,
+    grid_k: int,
+    quantized: bool,
+):
+    if quantized:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+    pos = pos_ref[b]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Whole-split skip above the lane's causal frontier (the index maps
+    # clamp the physical block at the frontier too, so skipped splits are
+    # never DMA'd — per-lane decode traffic scales with pos[b], not the
+    # table width).
+    @pl.when(ki * block_k <= pos)
+    def _compute():
+        q = q_ref[0, 0]  # [G, D] native dtype
+        if quantized:
+            # Fused int8 dequant: value-identical to quant.dequantize_kv
+            # (int8→fp32, ·fp32 scale, cast to the activation dtype) but
+            # in registers — the bf16 pool copy never exists in HBM.
+            k = (k_ref[0, :, 0, :].astype(jnp.float32)
+                 * ks_ref[0, :, 0, :]).astype(q.dtype)  # [BK, D]
+            v = (v_ref[0, :, 0, :].astype(jnp.float32)
+                 * vs_ref[0, :, 0, :]).astype(q.dtype)
+        else:
+            k = k_ref[0, :, 0, :]  # [BK, D]
+            v = v_ref[0, :, 0, :]
+        logits = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [G, BK] fp32
+        k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logits = jnp.where(k_pos <= pos, logits, NEG_INF)
+
+        # Split-K partial-softmax reduction: running max/denominator/
+        # accumulator carried across splits in VMEM scratch (flash-decode
+        # style; structurally identical to .flash's online softmax).
+        m_prev = m_scr[:, 0:1]
+        l_prev = l_scr[:, 0:1]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[...] = jnp.broadcast_to(
+            l_prev * corr + jnp.sum(p, axis=-1, keepdims=True), l_scr.shape
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        acc_scr[...] = acc_scr[...] * corr + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == grid_k - 1)
+    def _finalize():
+        denom = l_scr[:, 0:1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[0, 0] = (acc_scr[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "paged_len", "interpret")
+)
+def pallas_paged_decode_attention(
+    q: jax.Array,  # [B, 1, H, D]
+    k,  # [1, NT, KV, D] pool slice — jax.Array or int8 QTensor
+    v,
+    tables: jax.Array,  # [B, NB] int32 physical block ids (SCRATCH→ZERO'd)
+    pos: jax.Array,  # [B] int32: per-lane last valid position (ragged)
+    *,
+    block_size: int,
+    paged_len: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Paged-native ragged decode attention: each lane attends its block-
+    table view of the shared pool IN PLACE — no ``_paged_view`` gather
+    back to a dense ``[B, paged_len]`` operand. ``tables`` must already
+    have SCRATCH entries remapped to the ZERO block (the transformer's
+    ``view_tables``), so unmapped splits read the zeros the dense path
+    would read; every position ``> pos[b]`` is masked before softmax
+    regardless, which is the same bit-identity argument the gather path
+    makes. Dead lanes (stale ``pos``) clamp their index maps into the
+    table and produce garbage no caller reads — exactly the dense
+    contract."""
+    quantized = isinstance(k, QTensor)
+    B, Sq, H, D = q.shape
+    kq = k.q if quantized else k
+    NT, KV = kq.shape[1], kq.shape[2]
+    assert Sq == 1, "paged decode kernel is single-token"
+    assert H % KV == 0, (H, KV)
+    G = H // KV
+    NB = tables.shape[1]
+    bs = block_size
+    assert NT % bs == 0, (NT, bs)
+    # Splits actually visible through the view (the gather path truncates
+    # its view at paged_len; here the causal mask covers the tail of the
+    # last partial block — see the bit-identity note above).
+    grid_k = min(NB, -(-paged_len // bs))
+    grid = (B, KV, grid_k)
+    kernel = functools.partial(
+        _paged_decode_kernel, scale=float(1.0 / (D**0.5)), block_k=bs,
+        grid_k=grid_k, quantized=quantized,
+    )
+
+    def q_index(b, h, ki, pos_ref, tbl_ref):
+        del ki, pos_ref, tbl_ref
+        return (b, 0, h, 0)
+
+    def kv_index(b, h, ki, pos_ref, tbl_ref):
+        # Clamp at the lane's causal frontier: splits past pos[b] map to
+        # the frontier block, whose copy pallas elides (same index as the
+        # previous grid step) — the unwritten tail is never fetched. The
+        # second clamp bounds a dead lane's stale pos inside the table.
+        blk = jnp.minimum(jnp.minimum(ki, pos_ref[b] // bs), NB - 1)
+        return (0, tbl_ref[b, blk], h, 0)
+
+    in_specs = [pl.BlockSpec((1, 1, G, D), q_index)]
+    operands = [q]
+    for c in (k, v):
+        in_specs.append(pl.BlockSpec((1, bs, 1, D), kv_index))
+        if quantized:
+            operands.extend([c.q, c.scale])
+            in_specs.append(pl.BlockSpec((1, bs, 1, 1), kv_index))
+        else:
+            operands.append(c)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, G, D), q_index),
+            scratch_shapes=[
+                pltpu.VMEM((G, 128), jnp.float32),
+                pltpu.VMEM((G, 128), jnp.float32),
+                pltpu.VMEM((G, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, 1, H, D), q.dtype),
+        compiler_params=pallas_tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        jnp.asarray(pos, jnp.int32).reshape(B),
+        jnp.asarray(tables, jnp.int32),
+        *operands,
+    )
     return out

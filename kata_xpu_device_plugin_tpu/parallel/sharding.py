@@ -187,6 +187,37 @@ SERVING_RULES: tuple[tuple[str, P], ...] = (
 )
 
 
+def decode_attn_specs(cfg, tp: int, quantized: bool):
+    """``shard_map`` PartitionSpecs for the paged-native decode kernel
+    (ISSUE 12): ``(q_spec, kv_spec, out_spec)`` over the serving mesh's
+    ``model`` axis. A pallas call has no SPMD partitioning rule (the
+    SNIPPETS [1] lesson: the XLA path shards automatically, a custom call
+    needs explicit specs), so the serving dispatch wraps the kernel in
+    ``shard_map`` with these.
+
+    The divide-or-replicate decision IS
+    ``guest.tp_serving.kv_heads_shardable`` (the ONE predicate every KV
+    placement routes through — n_kv_heads must divide tp or the GQA group
+    structure breaks; imported at call time so the layouts cannot
+    drift): when it divides, q ``[B, 1, H, D]`` and the pool
+    slice ``[1, NT, KV, D]`` both shard their head axis (position 2) over
+    ``model`` — each shard runs the kernel on its own KV groups, no
+    collectives. When it does not (the kv-replicated layout), every spec
+    replicates: each device runs the full kernel on the full operands —
+    correct, memory-heavier, exactly the dense arena's replication trade.
+    int8 ``QTensor`` pools expand leaf-wise (payload and per-vector scale
+    share the head axis), like :func:`_layout_spec` everywhere else."""
+    from ..guest.tp_serving import kv_heads_shardable
+    from ..ops.quant import QTensor
+
+    if kv_heads_shardable(cfg, tp):
+        head = P(None, None, AXIS_MODEL, None)
+    else:
+        head = P(None, None, None, None)
+    kv = QTensor(q=head, scale=head) if quantized else head
+    return head, kv, head
+
+
 def match_partition_rules(rules, params: Any) -> Any:
     """PartitionSpec pytree for ``params`` from ``(regex, spec)`` rules.
 

@@ -46,6 +46,7 @@ class TpuAllocator:
         compile_cache_dir: str = "",
         prefix_cache_tokens: int = 0,
         kv_pool_tokens: int = 0,
+        kv_quant: str = "",
         checkpoint_rounds: int = 0,
         fault_schedule: str = "",
         sched_policy: str = "",
@@ -74,6 +75,10 @@ class TpuAllocator:
         # same delivery path — in-guest GenerationServers read
         # KATA_TPU_KV_POOL_TOKENS when no explicit kv_pool_tokens is passed.
         self._kv_pool_tokens = int(kv_pool_tokens)
+        # KV-arena quantization policy (ISSUE 12, config.kv_quant): same
+        # delivery path — the guest default is int8 (eval_quality-gated);
+        # "bf16" opts the node out, "int8" pins it explicitly.
+        self._kv_quant = str(kv_quant)
         # Crash-tolerance knobs (ISSUE 7, config.checkpoint_rounds /
         # config.faults): recovery-checkpoint cadence and the chaos
         # fault schedule, same delivery path — in-guest servers read
@@ -171,6 +176,8 @@ class TpuAllocator:
             )
         if self._kv_pool_tokens > 0:
             resp.envs[C.ENV_KV_POOL_TOKENS] = str(self._kv_pool_tokens)
+        if self._kv_quant:
+            resp.envs[C.ENV_KV_QUANT] = self._kv_quant
         if self._checkpoint_rounds > 0:
             resp.envs[C.ENV_CHECKPOINT_ROUNDS] = str(self._checkpoint_rounds)
         if self._fault_schedule:

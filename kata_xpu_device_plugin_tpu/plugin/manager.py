@@ -552,6 +552,7 @@ class PluginManager:
                 compile_cache_dir=cfg.compile_cache_dir,
                 prefix_cache_tokens=cfg.prefix_cache_tokens,
                 kv_pool_tokens=cfg.kv_pool_tokens,
+                kv_quant=cfg.kv_quant,
                 checkpoint_rounds=cfg.checkpoint_rounds,
                 fault_schedule=cfg.faults,
                 sched_policy=cfg.sched_policy,

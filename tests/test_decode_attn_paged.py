@@ -1,0 +1,430 @@
+"""Paged-native split-K decode attention (ISSUE 12).
+
+Three layers of coverage, all CPU interpret mode:
+
+- KERNEL ORACLE: ``pallas_paged_decode_attention`` against the dense
+  gather + ``reference_attention`` oracle across ragged lengths and
+  boundary blocks (pos at a block edge, pos 0, a partial last block,
+  unmapped-ZERO table tails), and the int8 fused-dequant bit-match
+  against dequantize-then-attend.
+- TP COMPOSITION: the ``make_decode_attn_fn`` shard_map wrapper on the
+  forced-8-device host — tp=2 (KV heads shard) and tp=4 (kv-replicated
+  layout) identical to tp=1.
+- SERVING MATRIX: the existing bit-identity matrix re-run with the
+  kernel selected (``decode_attn="pallas_paged"``) — paged × slotted ×
+  int8/bf16 × prefix-hit × preemption — greedy tokens equal to the
+  ``xla_reference`` path's, plus the backend observability contract
+  (once-per-server event, always-present stats field, raise-vs-degrade
+  knob semantics).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import init_params
+from kata_xpu_device_plugin_tpu.ops.attention import (
+    BACKEND_PAGED,
+    BACKEND_REFERENCE,
+    dense_decode_tile,
+    make_decode_attn_fn,
+    reference_attention,
+)
+from kata_xpu_device_plugin_tpu.ops.decode_attn import (
+    pallas_paged_decode_attention,
+    supports_paged_decode,
+)
+from kata_xpu_device_plugin_tpu.ops.quant import (
+    dequantize_kv,
+    quantize_kv,
+)
+
+
+# ----- kernel-level oracle ---------------------------------------------------
+
+
+def _pool_case(seed=0, B=3, H=8, KV=2, D=16, bs=4, NB=6, paged_len=22,
+               dtype=jnp.float32):
+    """A pool + tables + ragged positions covering the boundary cases:
+    pos at a block edge (bs*3-1), pos 0, pos in the partial last block
+    (paged_len-1 with paged_len % bs != 0), unmapped tails at ZERO."""
+    num_blocks = 2 + B * NB
+    NT = num_blocks * bs
+    key = jax.random.PRNGKey(seed)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, 1, H, D), dtype)
+    pool_k = jax.random.normal(kk, (1, NT, KV, D), dtype)
+    pool_v = jax.random.normal(kv_, (1, NT, KV, D), dtype)
+    pool_k = pool_k.at[0, :bs].set(0.0)  # the ZERO block really is zero
+    pool_v = pool_v.at[0, :bs].set(0.0)
+    pos = jnp.asarray([0, bs * 3 - 1, paged_len - 1][:B], jnp.int32)
+    tables = np.zeros((B, NB), np.int32)  # unmapped tail = ZERO block
+    for b in range(B):
+        for j in range(int(pos[b]) // bs + 1):
+            tables[b, j] = 2 + b * NB + j
+    return q, pool_k, pool_v, jnp.asarray(tables), pos, bs, paged_len
+
+
+def _oracle(q, pool_k, pool_v, tables, pos, bs, paged_len):
+    """The gather path the transformer's paged branch runs: dense view
+    through the tables, then the XLA reference with ragged q_offset."""
+    B = q.shape[0]
+    idx = (tables * bs)[:, :, None] + jnp.arange(bs)[None, None, :]
+    idx = idx.reshape(B, -1)[:, :paged_len]
+    return reference_attention(
+        q, pool_k[0][idx], pool_v[0][idx], causal=True, q_offset=pos,
+    )
+
+
+def test_paged_kernel_matches_reference_ragged_boundaries():
+    q, pk, pv, tables, pos, bs, plen = _pool_case()
+    out = pallas_paged_decode_attention(
+        q, pk, pv, tables, pos, block_size=bs, paged_len=plen,
+        interpret=True,
+    )
+    ref = _oracle(q, pk, pv, tables, pos, bs, plen)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+def test_paged_kernel_unmapped_rows_read_zero():
+    # A lane whose table is ALL zero-block entries (a dead lane after the
+    # SCRATCH→ZERO remap) must attend pure zeros — same output the dense
+    # path computes from a fresh arena, finite (no NaN from the empty-
+    # softmax denominator guard).
+    q, pk, pv, tables, pos, bs, plen = _pool_case()
+    tables = tables.at[0].set(0)
+    out = pallas_paged_decode_attention(
+        q, pk, pv, tables, pos, block_size=bs, paged_len=plen,
+        interpret=True,
+    )
+    ref = _oracle(q, pk, pv, tables, pos, bs, plen)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+def test_paged_kernel_dead_lane_stale_pos_clamps():
+    # Dead lanes carry stale, ever-growing positions (the serving scan
+    # advances every lane); the index map must clamp inside the table
+    # and the output stay finite (it is discarded, never read).
+    q, pk, pv, tables, pos, bs, plen = _pool_case()
+    pos = pos.at[0].set(10_000)
+    out = pallas_paged_decode_attention(
+        q, pk, pv, tables, pos, block_size=bs, paged_len=plen,
+        interpret=True,
+    )
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_paged_kernel_int8_dequant_bitmatch():
+    """The fused in-kernel dequant is VALUE-IDENTICAL to dequantize-then-
+    attend: same int8→fp32 cast, same fp32 scale multiply, same cast to
+    the activation dtype — so the two orderings are bit-equal."""
+    q, pk, pv, tables, pos, bs, plen = _pool_case()
+    qt_k, qt_v = quantize_kv(pk), quantize_kv(pv)
+    fused = pallas_paged_decode_attention(
+        q, qt_k, qt_v, tables, pos, block_size=bs, paged_len=plen,
+        interpret=True,
+    )
+    deq = pallas_paged_decode_attention(
+        q, dequantize_kv(qt_k, q.dtype), dequantize_kv(qt_v, q.dtype),
+        tables, pos, block_size=bs, paged_len=plen, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(deq))
+
+
+def test_supports_paged_decode_contract():
+    # Interpret mode (CPU tests) has no tiling constraints.
+    assert supports_paged_decode(16, 4, interpret=True)
+    assert not supports_paged_decode(16, 0, interpret=True)
+    # Hardware: head_dim lane-aligned, tile on the sublane quantum (the
+    # kv_arena block-size alignment contract).
+    assert supports_paged_decode(128, 16)
+    assert supports_paged_decode(64, 8)
+    assert not supports_paged_decode(16, 16)   # head_dim unaligned
+    assert not supports_paged_decode(128, 12)  # tile off the quantum
+    assert not supports_paged_decode(128, 4)   # tile below it
+
+
+def test_dense_decode_tile_selection():
+    assert dense_decode_tile(256) == 128
+    assert dense_decode_tile(48) == 16
+    assert dense_decode_tile(24) == 8
+    assert dense_decode_tile(22) == 0  # no divisor — XLA fallback
+
+
+# ----- tp composition (shard_map over the forced-8-device host) -------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_test_config(dtype=jnp.float32)  # n_kv_heads=2
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+@pytest.mark.parametrize("tp", [2, 4])
+def test_kernel_shard_map_identity(model, tp, quantized):
+    """tp=2: KV heads divide — the pool shards its head axis. tp=4: they
+    do not — the kv-replicated layout runs fully replicated inside the
+    same wrapper. Both must be bit-identical to the unwrapped kernel."""
+    from kata_xpu_device_plugin_tpu.guest.tp_serving import serving_mesh
+
+    cfg, _ = model
+    B, bs, NB, plen = 2, 4, 6, 24
+    NT = (2 + B * NB) * bs
+    key = jax.random.PRNGKey(3)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, 1, cfg.n_heads, cfg.head_dim), jnp.float32)
+    pk = jax.random.normal(kk, (1, NT, cfg.n_kv_heads, cfg.head_dim),
+                           jnp.float32)
+    pv = jax.random.normal(kv_, (1, NT, cfg.n_kv_heads, cfg.head_dim),
+                           jnp.float32)
+    if quantized:
+        pk, pv = quantize_kv(pk), quantize_kv(pv)
+    tables = jnp.asarray(
+        [[2 + b * NB + j for j in range(NB)] for b in range(B)], jnp.int32
+    )
+    pos = jnp.asarray([plen - 1, bs * 2], jnp.int32)
+
+    base = make_decode_attn_fn(
+        cfg, paged=True, block_size=bs, paged_len=plen,
+        quantized=quantized, interpret=True,
+    )
+    sharded = make_decode_attn_fn(
+        cfg, paged=True, block_size=bs, paged_len=plen,
+        quantized=quantized, mesh=serving_mesh(tp), tp=tp, interpret=True,
+    )
+    ref = base(q, pk, pv, tables, pos)
+    out = sharded(q, pk, pv, tables, pos)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_make_decode_attn_fn_refuses_unmodeled_masks(model):
+    cfg, _ = model
+    from dataclasses import replace
+
+    with pytest.raises(ValueError, match="sliding-window"):
+        make_decode_attn_fn(
+            replace(cfg, sliding_window=8), paged=True, block_size=4,
+            paged_len=16, interpret=True,
+        )
+    with pytest.raises(ValueError, match="softcap"):
+        make_decode_attn_fn(
+            replace(cfg, attn_logits_softcap=50.0), paged=True,
+            block_size=4, paged_len=16, interpret=True,
+        )
+
+
+# ----- serving matrix with the kernel selected ------------------------------
+
+
+def _prompts(cfg, lengths, seed=1):
+    key = jax.random.PRNGKey(seed)
+    return [
+        np.asarray(
+            jax.random.randint(jax.random.fold_in(key, i), (n,), 0,
+                               cfg.vocab_size),
+            np.int32,
+        )
+        for i, n in enumerate(lengths)
+    ]
+
+
+def _serve(params, cfg, prompts, budgets=8, **kw):
+    srv = GenerationServer(params, cfg, **kw)
+    if isinstance(budgets, int):
+        budgets = [budgets] * len(prompts)
+    rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+    res = srv.run()
+    return [res[r] for r in rids], srv
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+@pytest.mark.parametrize("paged", [True, False])
+def test_serving_kernel_greedy_identical_to_reference(model, paged, kv_quant):
+    """The acceptance matrix's core: the SAME traffic (mixed lengths,
+    queue pressure) through the kernel backend and the XLA gather
+    backend, paged and slotted arenas, bf16 and int8 pools — greedy
+    outputs bit-identical."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 9, 6, 12, 3, 7])
+    common = dict(max_batch=3, max_len=32, chunk=4, kv_quant=kv_quant)
+    if paged:
+        common.update(kv_pool_tokens=3 * 32 + 16, kv_block_size=8)
+    ref, ref_srv = _serve(params, cfg, prompts,
+                          decode_attn=BACKEND_REFERENCE, **common)
+    out, srv = _serve(params, cfg, prompts, decode_attn=BACKEND_PAGED,
+                      **common)
+    assert srv.paged == paged
+    assert srv.stats()["decode_backend"] == BACKEND_PAGED
+    assert ref_srv.stats()["decode_backend"] == BACKEND_REFERENCE
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+
+def test_serving_kernel_with_prefix_hits(model):
+    """Kernel × the shared-prefix tier: hit admissions reference tier
+    blocks read-only from their lane tables — the kernel reads them in
+    place — and outputs equal the reference backend's."""
+    cfg, params = model
+    base = np.arange(16, dtype=np.int32)
+    prompts = [np.concatenate([base, p]) for p in
+               _prompts(cfg, [4, 6, 3, 5, 7, 4], seed=5)]
+    common = dict(max_batch=3, max_len=40, chunk=4,
+                  prefill_buckets=(8, 16, 24),
+                  kv_pool_tokens=3 * 40 + 32, kv_block_size=8,
+                  prefix_cache_tokens=1)  # paged: the tier's ENABLE switch
+    ref, _ = _serve(params, cfg, prompts, budgets=10,
+                    decode_attn=BACKEND_REFERENCE, **common)
+    out, srv = _serve(params, cfg, prompts, budgets=10,
+                      decode_attn=BACKEND_PAGED, **common)
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    st = srv.stats()
+    assert st["decode_backend"] == BACKEND_PAGED
+    assert st["prefix_hits"] >= 1          # the tier really was shared
+
+
+def test_serving_kernel_with_preemption(model, capture_events):
+    """Kernel × preemption: a pool barely above one full-length request
+    forces spill/requeue/restore mid-decode — outputs equal the
+    reference backend's and the preempt/resume machinery engaged."""
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 9, 6, 12, 3, 7, 5, 8], seed=2)
+    common = dict(max_batch=4, max_len=32, chunk=4,
+                  kv_pool_tokens=32 + 3 * 8, kv_block_size=8)
+    ref, _ = _serve(params, cfg, prompts, budgets=14,
+                    decode_attn=BACKEND_REFERENCE, **common)
+    (out, srv), events = capture_events(
+        lambda: _serve(params, cfg, prompts, budgets=14,
+                       decode_attn=BACKEND_PAGED, **common),
+    )
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+    st = srv.stats()
+    assert st["decode_backend"] == BACKEND_PAGED
+    assert st["preemptions"] >= 1          # the pool really did spill
+    names = [e.get("name") for e in events]
+    assert "kv_preempt" in names and "kv_resume" in names
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_serving_kernel_tp_identical_to_tp1(model, tp):
+    """Kernel × tensor parallelism: tp=2 shards the pool's KV heads
+    through the shard_map wrapper, tp=4 runs the kv-replicated layout —
+    greedy outputs bit-identical to the kernel at tp=1."""
+    cfg, params = model
+    prompts = _prompts(cfg, [5, 9, 3], seed=7)
+    common = dict(max_batch=2, max_len=32, chunk=4, kv_quant=True,
+                  kv_pool_tokens=96, kv_block_size=4,
+                  decode_attn=BACKEND_PAGED)
+    ref, _ = _serve(params, cfg, prompts, tp=1, **common)
+    out, srv = _serve(params, cfg, prompts, tp=tp, **common)
+    assert srv.stats()["tp_degree"] == tp
+    assert srv.stats()["decode_backend"] == BACKEND_PAGED
+    for r, o in zip(ref, out):
+        np.testing.assert_array_equal(o, r)
+
+
+# ----- backend observability + knob contract --------------------------------
+
+
+def test_decode_attn_backend_event_once_per_server(model, capture_events):
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 6], seed=9)
+    (_, srv), events = capture_events(
+        lambda: _serve(params, cfg, prompts, max_batch=2, max_len=32,
+                       chunk=4, kv_pool_tokens=96, kv_block_size=4,
+                       decode_attn=BACKEND_PAGED),
+    )
+    backend_evs = [e for e in events
+                   if e.get("name") == "decode_attn_backend"]
+    assert len(backend_evs) == 1  # once per server, at the first decode
+    ev = backend_evs[0]
+    assert ev["backend"] == BACKEND_PAGED
+    assert ev["reason"] == ""
+    assert ev["paged"] is True and ev["block_size"] == 4
+    st = srv.stats()
+    assert st["decode_backend"] == BACKEND_PAGED
+    assert st["decode_backend_reason"] == ""
+
+
+def test_decode_attn_auto_on_cpu_reports_reason(model, capture_events):
+    # Automatic selection off-TPU: the XLA path, reason on the event and
+    # in stats — interpret mode must never be the silent default.
+    cfg, params = model
+    prompts = _prompts(cfg, [4], seed=11)
+    (_, srv), events = capture_events(
+        lambda: _serve(params, cfg, prompts, max_batch=1, max_len=32,
+                       chunk=4),
+    )
+    st = srv.stats()
+    assert st["decode_backend"] == BACKEND_REFERENCE
+    assert st["decode_backend_reason"] == "cpu_backend"
+    evs = [e for e in events if e.get("name") == "decode_attn_backend"]
+    assert len(evs) == 1 and evs[0]["reason"] == "cpu_backend"
+
+
+def test_decode_attn_knob_contract(model, monkeypatch, capture_events):
+    cfg, params = model
+    # Explicit unknown backend raises.
+    with pytest.raises(ValueError, match="unknown decode_attn"):
+        GenerationServer(params, cfg, max_batch=1, max_len=16,
+                         decode_attn="magic")
+    # Explicit kernel on an incompatible server raises (ring_kv).
+    from dataclasses import replace
+
+    ring_cfg = replace(cfg, sliding_window=8)
+    ring_params = init_params(jax.random.PRNGKey(1), ring_cfg,
+                              dtype=jnp.float32)
+    with pytest.raises(ValueError, match="incompatible"):
+        GenerationServer(ring_params, ring_cfg, max_batch=1, max_len=16,
+                         ring_kv=True, decode_attn=BACKEND_PAGED)
+    # Env-injected malformed value degrades with an event; env-injected
+    # kernel on an incompatible server degrades with the reason in the
+    # backend event instead of raising.
+    monkeypatch.setenv("KATA_TPU_DECODE_ATTN", "warp9")
+    srv, events = capture_events(
+        lambda: GenerationServer(params, cfg, max_batch=1, max_len=16),
+    )
+    assert any(e.get("name") == "decode_attn_invalid" for e in events)
+    assert srv.stats()["decode_backend"] == BACKEND_REFERENCE
+    monkeypatch.setenv("KATA_TPU_DECODE_ATTN", BACKEND_PAGED)
+    srv2 = GenerationServer(ring_params, ring_cfg, max_batch=1,
+                            max_len=16, ring_kv=True)
+    assert srv2.stats()["decode_backend"] == BACKEND_REFERENCE
+    assert srv2.stats()["decode_backend_reason"] == "ring_kv"
+
+
+def test_decode_attn_speculative_keeps_reference(model):
+    # Speculative verification decodes k+1-token spans — the kernel is
+    # single-token, so spec servers stay on the XLA path with the reason
+    # recorded (and the multi-token branch keeps attn_fn).
+    cfg, params = model
+    srv = GenerationServer(params, cfg, max_batch=1, max_len=32,
+                           speculative_k=2, spec_opt_in=True)
+    st = srv.stats()
+    assert st["decode_backend"] == BACKEND_REFERENCE
+    assert st["decode_backend_reason"] == "speculative"
+
+
+def test_export_metrics_backend_gauge(model):
+    from prometheus_client import REGISTRY
+
+    cfg, params = model
+    srv = GenerationServer(params, cfg, max_batch=1, max_len=16,
+                           kv_pool_tokens=64, kv_block_size=4,
+                           decode_attn=BACKEND_PAGED)
+    label = srv.export_metrics()
+    active = REGISTRY.get_sample_value(
+        "kata_tpu_serving_decode_attn_backend",
+        {"server": label, "backend": BACKEND_PAGED},
+    )
+    inactive = REGISTRY.get_sample_value(
+        "kata_tpu_serving_decode_attn_backend",
+        {"server": label, "backend": BACKEND_REFERENCE},
+    )
+    assert active == 1.0 and inactive == 0.0

@@ -101,6 +101,68 @@ def test_mesh_serving_with_int8_arena(model):
         np.testing.assert_array_equal(o, r)
 
 
+def test_int8_kv_is_the_server_default(model, monkeypatch):
+    """ISSUE 12: with no explicit argument and no env, GenerationServer
+    resolves int8 KV (the conftest pins KATA_TPU_KV_QUANT=bf16 suite-wide
+    because the generate() oracles compare bit-for-bit — this test undoes
+    the pin to observe the shipped default)."""
+    from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+
+    cfg, params = model
+    monkeypatch.delenv("KATA_TPU_KV_QUANT", raising=False)
+    srv = GenerationServer(params, cfg, max_batch=1, max_len=16)
+    assert srv.kv_quant is True
+    assert isinstance(srv.arena[0], QTensor)
+
+
+def test_kv_quant_env_knob_and_explicit_override(model, monkeypatch, capture_events):
+    from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+
+    cfg, params = model
+    # env bf16 opts out; env int8 pins the default explicitly.
+    monkeypatch.setenv("KATA_TPU_KV_QUANT", "bf16")
+    assert GenerationServer(params, cfg, max_batch=1,
+                            max_len=16).kv_quant is False
+    monkeypatch.setenv("KATA_TPU_KV_QUANT", "int8")
+    assert GenerationServer(params, cfg, max_batch=1,
+                            max_len=16).kv_quant is True
+    # An explicit argument always wins over the env.
+    assert GenerationServer(params, cfg, max_batch=1, max_len=16,
+                            kv_quant=False).kv_quant is False
+    monkeypatch.setenv("KATA_TPU_KV_QUANT", "bf16")
+    assert GenerationServer(params, cfg, max_batch=1, max_len=16,
+                            kv_quant=True).kv_quant is True
+    # A malformed node-wide env degrades to the int8 DEFAULT with one
+    # kv_quant_invalid event — never a crash.
+    monkeypatch.setenv("KATA_TPU_KV_QUANT", "fp4")
+    srv, events = capture_events(
+        lambda: GenerationServer(params, cfg, max_batch=1, max_len=16),
+    )
+    assert srv.kv_quant is True
+    bad = [e for e in events if e.get("name") == "kv_quant_invalid"]
+    assert len(bad) == 1 and bad[0]["reason"].startswith("bad_env:")
+
+
+def test_int8_default_quality_gate(model):
+    """The promotion gate behind the int8 default (tools/eval_quality):
+    pooled greedy agreement and first-decode-step logit drift vs the
+    bf16 oracle must clear the shipped thresholds on the fixed prompt
+    set — the tier-1 mirror of `make eval-kv`."""
+    from tools.eval_quality import (
+        _default_prompts,
+        evaluate_kv_quant,
+        gate,
+    )
+
+    cfg, params = model
+    result = evaluate_kv_quant(
+        params, cfg, _default_prompts(cfg, 4), steps=12,
+    )
+    assert gate(result), result
+    assert 0.0 <= result["greedy_match"] <= 1.0
+    assert result["logit_max_abs_err"] >= 0.0
+
+
 def test_serving_with_int8_arena(model):
     cfg, params = model
     key = jax.random.PRNGKey(3)

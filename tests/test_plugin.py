@@ -133,6 +133,23 @@ def test_allocate_cdi_cri(manager, kubelet):
         assert C.ENV_COMPILE_CACHE_DIR not in cresp.envs
 
 
+def test_tpu_allocator_injects_kv_quant_env(v5e8):
+    # config.kv_quant (ISSUE 12) rides the AllocateResponse env: the
+    # daemon's --kv-quant knob opts a node out of (or pins) the guest's
+    # int8-KV default; unset injects nothing and the guest default
+    # applies.
+    from kata_xpu_device_plugin_tpu.discovery import scan_tpus
+    from kata_xpu_device_plugin_tpu.plugin import TpuAllocator
+
+    inv = scan_tpus(v5e8.sysfs, v5e8.dev, env={})
+    bare = TpuAllocator(lambda: inv, "google.com", "tpu").allocate(["0"])
+    assert C.ENV_KV_QUANT not in bare.envs
+    wired = TpuAllocator(
+        lambda: inv, "google.com", "tpu", kv_quant="bf16",
+    ).allocate(["0"])
+    assert wired.envs[C.ENV_KV_QUANT] == "bf16"
+
+
 def test_tpu_allocator_injects_compile_cache_env(v5e8):
     # config.compile_cache_dir (ISSUE 3) rides the AllocateResponse env:
     # every granted workload points jax's persistent compilation cache at

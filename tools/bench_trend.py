@@ -42,6 +42,11 @@ HEADLINE_METRICS = (
     "prefill_tok_per_s",
     "int8_tok_per_s",
     "serving_tok_per_s",
+    # Decode tok/s through the paged-native attention kernel (ISSUE 12):
+    # the serving-decode numbers the ROADMAP item-1 >2× claim rides —
+    # bf16 and the int8-by-default configuration.
+    "serving_decode_attn_tok_per_s",
+    "serving_decode_attn_int8_tok_per_s",
 )
 
 DEFAULT_THRESHOLD = 0.10  # 10%

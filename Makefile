@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn eval eval-kv demo dryrun image clean deploy obs-check
+.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused eval eval-kv demo dryrun image clean deploy obs-check
 
 all: build
 
@@ -100,7 +100,7 @@ bench-load:
 	KATA_TPU_BENCH_INT8=0 KATA_TPU_BENCH_SERVING=0 KATA_TPU_BENCH_SOFTCAP=0 \
 	KATA_TPU_BENCH_TRAIN=0 KATA_TPU_BENCH_PREFIX=0 KATA_TPU_BENCH_PAGED=0 \
 	KATA_TPU_BENCH_FAULTS=0 KATA_TPU_BENCH_SPEC=0 KATA_TPU_BENCH_TP=0 \
-	KATA_TPU_BENCH_DEGRADED=0 KATA_TPU_BENCH_OBS=0 \
+	KATA_TPU_BENCH_DEGRADED=0 KATA_TPU_BENCH_OBS=0 KATA_TPU_BENCH_FUSED=0 \
 	  $(PY) bench.py --smoke
 
 # Bench-bank trend (ISSUE 11 satellite): compare the two newest
@@ -155,6 +155,26 @@ chaos:
 	KATA_TPU_FAULTS="decode_dispatch:3:chip_loss:1" KATA_TPU_FAULTS_SEED=13 \
 	KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_degraded.py -q
+	# Fused × multi-step chaos (ISSUE 13): decode_dispatch faults land
+	# MID-MULTI-STEP — every server in the fused suite that reaches round
+	# 4 is running chunk × K dispatches (the node-injected K=2 below;
+	# explicit-K tests override it), so the fault interrupts a dispatch
+	# carrying K decode steps (and, in the fused tests, an admission
+	# slice) and recovery must keep outputs bit-identical — both strict
+	# modes. sched_tick:3 additionally fires at a fused slice's dispatch
+	# prep.
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_fused_events.jsonl \
+	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
+	KATA_TPU_FAULTS="decode_dispatch:4,sched_tick:3" KATA_TPU_FAULTS_SEED=13 \
+	KATA_TPU_DECODE_STEPS=2 \
+	  $(PY) -m pytest tests/test_fused_decode.py -q
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_fused_events_strict.jsonl \
+	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
+	KATA_TPU_FAULTS="decode_dispatch:4,sched_tick:3" KATA_TPU_FAULTS_SEED=13 \
+	KATA_TPU_DECODE_STEPS=2 KATA_TPU_STRICT=1 \
+	  $(PY) -m pytest tests/test_fused_decode.py -q
 
 # Tensor-parallel serving gate (ISSUE 9): the tp suite — topology-env →
 # guest-mesh round trip, the tp=N ≡ tp=1 greedy-identity matrix
@@ -185,6 +205,23 @@ decode-attn:
 	KATATPU_OBS=1 KATATPU_OBS_FILE=decode_attn_events_strict.jsonl \
 	KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_decode_attn_paged.py -q
+
+# Fused scheduling & multi-step decode gate (ISSUE 13): the fused suite
+# on the forced-8-device host — the bit-identity matrix (fused vs
+# sequential admission, decode_steps K ∈ {1,2,8}) across paged/slotted ×
+# overlap/lockstep × tp{1,2} × prefix-hit × mid-scan EOS × seeded fault
+# schedules with recovery, the knob degrade/raise contract, and the
+# always-present stats/counter schema — with and without
+# KATA_TPU_STRICT=1 (the fused dispatch window must stay
+# transfer-guard-clean too).
+fused:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=fused_events.jsonl \
+	  $(PY) -m pytest tests/test_fused_decode.py -q
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=fused_events_strict.jsonl \
+	KATA_TPU_STRICT=1 \
+	  $(PY) -m pytest tests/test_fused_decode.py -q
 
 # int8-KV promotion gate (ISSUE 12): pooled greedy agreement + first-
 # decode-step logit drift vs the bf16 oracle on a fixed prompt set —

@@ -119,6 +119,16 @@ ENV_SERVING_TP_MIN = "KATA_TPU_TP_MIN"
 # --no-trace-context disables the stamp; guests then mint their own.
 ENV_TRACE_CTX = "KATA_TPU_TRACE_CTX"
 
+# Multi-step decode multiplier handed to the guest (ISSUE 13):
+# guest.serving.GenerationServer runs chunk × K decode steps per host
+# dispatch (on-device EOS/budget masking freezes finished lanes inside
+# the jitted scan) when the caller passes no explicit decode_steps, so
+# the daemon's --decode-steps knob amortizes host scheduling/fence/obs
+# overhead node-wide. Malformed values degrade in-guest with a
+# decode_steps_invalid event. The fused-dispatch kill switch
+# KATA_TPU_FUSED=0 is env-only (guest-side), like KATA_TPU_DEGRADED.
+ENV_DECODE_STEPS = "KATA_TPU_DECODE_STEPS"
+
 # SLO-aware admission scheduling handed to the guest (ISSUE 8):
 # guest.serving.GenerationServer reads these when the caller passes no
 # explicit scheduler args — policy ("fifo_batch" | "slo_chunked"; unknown

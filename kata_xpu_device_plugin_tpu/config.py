@@ -157,6 +157,17 @@ class Config:
     prefill_chunk: int = 0
     itl_slo_ms: float = 0.0
 
+    # Multi-step decode multiplier (ISSUE 13): when > 1, the daemon
+    # injects KATA_TPU_DECODE_STEPS into every TPU AllocateResponse so
+    # in-guest GenerationServers run chunk × K decode steps per host
+    # dispatch (on-device EOS/budget masking inside the jitted scan
+    # freezes finished lanes, so K can be large without overrunning
+    # block reservations) — host scheduling, fence, and obs bookkeeping
+    # amortize over K× more tokens. Same delivery path as the other
+    # serving knobs; malformed values degrade in-guest with a
+    # decode_steps_invalid event. 0/1 leaves the guest default (K=1).
+    decode_steps: int = 0
+
     # Tensor-parallel serving degree (ISSUE 9): when > 0, the daemon
     # injects KATA_TPU_TP into every TPU AllocateResponse so in-guest
     # GenerationServers override their topology-derived default
@@ -224,6 +235,10 @@ class Config:
         if self.itl_slo_ms < 0:
             raise ValueError(
                 f"itl-slo-ms must be >= 0, got {self.itl_slo_ms}"
+            )
+        if self.decode_steps < 0:
+            raise ValueError(
+                f"decode-steps must be >= 0, got {self.decode_steps}"
             )
         if self.serving_tp < 0:
             raise ValueError(

@@ -75,11 +75,16 @@ class Directive:
     admission pass — the fifo_batch behavior. ``admit=False``: advance the
     pending admission by one prefill chunk, then yield the round back to
     decode (``defer_reason`` and the projection say why — they ride the
-    ``sched_defer`` event)."""
+    ``sched_defer`` event). ``fused=True`` (ISSUE 13, only ever set on a
+    deferral by a fused-enabled ``slo_chunked`` policy): the chunk should
+    RIDE the next decode dispatch as one fused forward instead of running
+    as its own fenced slice round — the serving loop's
+    ``_dispatch_decode`` is the single call site for both."""
 
     admit: bool
     defer_reason: str = ""
     projected_itl_ms: float = 0.0
+    fused: bool = False
 
 
 class Scheduler:
@@ -95,21 +100,35 @@ class Scheduler:
 
     def __init__(self, *, chunk_tokens: int = 0,
                  slo_ms: float = 0.0, decode_steps: int = 1,
-                 label: str = ""):
+                 fused: bool = False, label: str = ""):
         self.chunk_tokens = int(chunk_tokens)
         self.slo_ms = float(slo_ms)
-        # The server's decode-chunk step count: rounds deliver this many
-        # tokens per lane, so PER-TOKEN latency (the unit ``slo_ms`` is
-        # in, matching the ``decode_token_s`` metric) is the round
-        # cadence divided by it. 1 = rounds ARE tokens (unit tests).
+        # The server's DEFAULT per-dispatch step count: rounds deliver
+        # this many tokens per lane, so PER-TOKEN latency (the unit
+        # ``slo_ms`` is in, matching the ``decode_token_s`` metric) is
+        # the round cadence divided by the delivered steps. It seeds
+        # ``_last_steps``; :meth:`note_round` overrides it with the
+        # ACTUAL tokens-per-dispatch each round (ISSUE 13 — fused rounds
+        # and multi-step decode change the delivered count at runtime,
+        # so a static divisor would misproject the SLO).
         self.decode_steps = max(1, int(decode_steps))
+        # Fused admission (ISSUE 13): deferrals ask the serving loop to
+        # ride the chunk on the decode dispatch instead of running a
+        # separate fenced slice round.
+        self.fused = bool(fused)
         self.label = label
         self.chunks = 0          # chunked-prefill forwards run
         self.defers = 0          # rounds that deferred admission to decode
         self.slo_violations = 0  # observed rounds over the ITL SLO
         self.queue_delay = obs.Rolling()
         self._prefill_s_per_tok: Optional[float] = None
-        self._round_s: Optional[float] = None
+        # PER-TOKEN decode cadence EWMA (round duration / ACTUAL steps
+        # delivered) — the satellite fix: the old code EWMA'd the raw
+        # round cadence and divided by a static decode_steps at
+        # projection time, which misprojects the moment the delivered
+        # tokens-per-dispatch differ from the configured count.
+        self._tok_s: Optional[float] = None
+        self._last_steps: int = self.decode_steps
 
     # ----- observations (the serving loop feeds these) ---------------------
 
@@ -127,18 +146,26 @@ class Scheduler:
                 per_tok - self._prefill_s_per_tok
             )
 
-    def note_round(self, dur_s: float) -> bool:
-        """One decode round retired at cadence ``dur_s``. Returns True when
-        the round violated the policy's ITL SLO (the serving loop emits the
-        ``slo_violation`` event — the base policy has no SLO and never
-        violates)."""
+    def note_round(self, dur_s: float, steps: int = 0) -> bool:
+        """One decode round retired at cadence ``dur_s``, delivering
+        ``steps`` tokens per live lane (0 = the configured
+        ``decode_steps`` — unit tests and legacy callers). The EWMA
+        tracks the PER-TOKEN cadence from the actual tokens-per-dispatch,
+        so multi-step decode (``decode_steps=K``) and fused rounds feed
+        the projection in the ``slo_ms`` unit directly. Returns True when
+        the round violated the policy's ITL SLO (the serving loop emits
+        the ``slo_violation`` event — the base policy has no SLO and
+        never violates)."""
         if dur_s <= 0:
             return False
-        if self._round_s is None:
-            self._round_s = dur_s
+        steps = max(1, int(steps) if steps else self.decode_steps)
+        self._last_steps = steps
+        per_tok = dur_s / steps
+        if self._tok_s is None:
+            self._tok_s = per_tok
         else:
-            self._round_s += _EWMA_ALPHA * (dur_s - self._round_s)
-        return self._check_slo(dur_s)
+            self._tok_s += _EWMA_ALPHA * (per_tok - self._tok_s)
+        return self._check_slo(per_tok)
 
     def note_queue_delay(self, delay_s: float) -> None:
         """A request left the queue (admission granted): record its
@@ -146,17 +173,40 @@ class Scheduler:
         self.queue_delay.observe(max(0.0, float(delay_s)))
 
     def reset_estimates(self) -> None:
-        """Drop the prefill-rate and round-cadence EWMAs. Called by the
-        serving loop after a degraded-mode mesh shrink (ISSUE 11): the
-        estimates were measured on the OLD mesh, and a shrunken mesh is
-        slower — stale values would mis-project the first post-recovery
-        admissions, either thrashing chunked admission or missing the
-        SLO. Re-bootstrapping keeps the projection honest (the first
-        degraded admission and round re-measure)."""
+        """Drop the prefill-rate and per-token-cadence EWMAs. Called by
+        the serving loop after a degraded-mode mesh shrink (ISSUE 11) and
+        by :meth:`note_config` when the dispatch regime changes (ISSUE
+        13): the estimates were measured under the OLD regime — a
+        shrunken mesh is slower, a different ``decode_steps`` or fused
+        plan changes what one round delivers — and stale values would
+        mis-project the first admissions after the change.
+        Re-bootstrapping keeps the projection honest (the first
+        post-change admission and round re-measure)."""
         self._prefill_s_per_tok = None
-        self._round_s = None
+        self._tok_s = None
+        self._last_steps = self.decode_steps
 
-    def _check_slo(self, dur_s: float) -> bool:
+    def note_config(self, *, decode_steps: Optional[int] = None,
+                    fused: Optional[bool] = None) -> bool:
+        """Adopt a changed dispatch configuration (ISSUE 13 satellite):
+        when the per-dispatch step count K or the fused-plan flag
+        CHANGES, the per-round timings the EWMAs hold were measured
+        under the old regime and would misproject the SLO —
+        :meth:`reset_estimates` drops them. Returns True when anything
+        changed (and estimates were reset)."""
+        changed = False
+        if decode_steps is not None and max(1, int(decode_steps)) != (
+                self.decode_steps):
+            self.decode_steps = max(1, int(decode_steps))
+            changed = True
+        if fused is not None and bool(fused) != self.fused:
+            self.fused = bool(fused)
+            changed = True
+        if changed:
+            self.reset_estimates()
+        return changed
+
+    def _check_slo(self, per_tok_s: float) -> bool:
         return False
 
     # ----- the decision ----------------------------------------------------
@@ -176,15 +226,17 @@ class Scheduler:
 
     def projected_itl_s(self, pending_tokens: int) -> Optional[float]:
         """The PER-TOKEN latency in-flight requests would see if
-        ``pending_tokens`` of prefill ran as one forward now: estimated
-        prefill time plus one decode-round cadence, normalized by the
-        round's step count — the same unit as the ``decode_token_s``
-        metric and ``slo_ms``. None until both estimates exist (the
-        bootstrap admissions measure them)."""
-        if self._prefill_s_per_tok is None or self._round_s is None:
+        ``pending_tokens`` of prefill ran as one forward now: the
+        estimated prefill stall amortized over the tokens one dispatch
+        actually delivers (``_last_steps`` — learned per round, not the
+        static configured count) plus the per-token decode cadence — the
+        same unit as the ``decode_token_s`` metric and ``slo_ms``. None
+        until both estimates exist (the bootstrap admissions measure
+        them)."""
+        if self._prefill_s_per_tok is None or self._tok_s is None:
             return None
-        stall = pending_tokens * self._prefill_s_per_tok + self._round_s
-        return stall / self.decode_steps
+        steps = max(1, self._last_steps)
+        return pending_tokens * self._prefill_s_per_tok / steps + self._tok_s
 
     def stats(self) -> dict:
         """The always-present scheduler fields ``GenerationServer.stats()``
@@ -209,19 +261,19 @@ class SLOChunkedScheduler(Scheduler):
 
     def __init__(self, *, chunk_tokens: int = DEFAULT_PREFILL_CHUNK,
                  slo_ms: float = DEFAULT_ITL_SLO_MS, decode_steps: int = 1,
-                 label: str = ""):
+                 fused: bool = False, label: str = ""):
         if chunk_tokens < 1:
             raise ValueError(
                 f"prefill chunk must be >= 1 token, got {chunk_tokens}"
             )
         super().__init__(chunk_tokens=chunk_tokens, slo_ms=slo_ms,
-                         decode_steps=decode_steps, label=label)
+                         decode_steps=decode_steps, fused=fused, label=label)
 
-    def _check_slo(self, dur_s: float) -> bool:
-        # Per-token, like slo_ms itself: the round delivered decode_steps
-        # tokens per live lane, so the client-visible inter-token latency
-        # is the cadence over the steps (the ``decode_token_s`` metric).
-        if (dur_s / self.decode_steps) * 1000.0 > self.slo_ms:
+    def _check_slo(self, per_tok_s: float) -> bool:
+        # Per-token, like slo_ms itself (note_round already normalized
+        # the cadence by the round's ACTUAL delivered steps — the
+        # ``decode_token_s`` metric's unit).
+        if per_tok_s * 1000.0 > self.slo_ms:
             self.slo_violations += 1
             return True
         return False
@@ -244,27 +296,34 @@ class SLOChunkedScheduler(Scheduler):
         proj_ms = proj * 1000.0
         if proj_ms <= self.slo_ms:
             return Directive(admit=True)
+        # The FUSED PLAN (ISSUE 13): a fused-enabled policy asks the
+        # serving loop to batch the deferred chunk WITH the decode step
+        # (one dispatch, one fence) instead of alternating slice-round /
+        # decode-round — decode lanes stop stalling behind admission.
         return Directive(
             admit=False, defer_reason="projected_itl",
-            projected_itl_ms=round(proj_ms, 3),
+            projected_itl_ms=round(proj_ms, 3), fused=self.fused,
         )
 
 
 def make_scheduler(policy: str, *, chunk_tokens: int, slo_ms: float,
-                   decode_steps: int = 1, label: str = "") -> Scheduler:
+                   decode_steps: int = 1, fused: bool = False,
+                   label: str = "") -> Scheduler:
     """Instantiate a policy by knob value. Raises ``ValueError`` on an
     unknown name — the CALLER owns the env-vs-explicit degrade contract
     (``GenerationServer`` degrades env values with a ``sched_disabled``
     event and raises on explicit arguments, like the pool/prefix knobs).
-    ``decode_steps`` is the server's decode-chunk step count — the
-    round→per-token normalizer that keeps ``slo_ms`` in the same unit as
-    the ``decode_token_s`` metric."""
+    ``decode_steps`` is the server's per-dispatch step count — the
+    DEFAULT round→per-token normalizer (``note_round`` learns the actual
+    delivered count per round) that keeps ``slo_ms`` in the same unit as
+    the ``decode_token_s`` metric. ``fused`` marks deferrals as fused
+    plans (the chunk rides the decode dispatch — ISSUE 13)."""
     if policy == POLICY_FIFO:
         return Scheduler(decode_steps=decode_steps, label=label)
     if policy == POLICY_SLO:
         return SLOChunkedScheduler(
             chunk_tokens=chunk_tokens, slo_ms=slo_ms,
-            decode_steps=decode_steps, label=label,
+            decode_steps=decode_steps, fused=fused, label=label,
         )
     raise ValueError(
         f"unknown scheduler policy {policy!r} (have {POLICIES})"

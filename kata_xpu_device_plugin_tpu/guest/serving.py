@@ -187,6 +187,32 @@ ENV_TRACE_CTX = "KATA_TPU_TRACE_CTX"
 ENV_KV_QUANT = "KATA_TPU_KV_QUANT"
 DEFAULT_KV_QUANT = "int8"
 
+# Multi-step decode (ISSUE 13): ``decode_steps=K`` multiplies the decode
+# scan each host dispatch runs — one dispatch delivers ``chunk × K``
+# tokens per lane, with ON-DEVICE EOS/budget masking (a lane that hits
+# its budget or the eos token FREEZES inside the scan: token and
+# position pin, so its cache rewrites are value-identical no-ops — see
+# transformer._decode_scan) so host scheduling, the fence, and obs
+# bookkeeping amortize over K× more tokens without a lane overrunning
+# its block reservation. Daemon-injectable through the standard
+# constants → allocators → manager path (cdi.constants.ENV_DECODE_STEPS,
+# config.decode_steps, --decode-steps); malformed env values degrade to
+# K=1 with a ``decode_steps_invalid`` event, an explicit argument
+# raises. Greedy outputs are bit-identical to K=1 (tested).
+ENV_DECODE_STEPS = "KATA_TPU_DECODE_STEPS"
+
+# Fused prefill+decode dispatch (ISSUE 13): under ``slo_chunked``, a
+# deferred admission chunk RIDES the decode dispatch — one jitted
+# executable carries the N decode lanes' scan AND the admission lane's
+# ``prefill_chunk``-wide suffix slice, so chunked admission stops
+# alternating slice-round / decode-round (the head-of-line theft the
+# scheduler exists to remove pays one dispatch + one fence instead of
+# two). Default ON whenever ``slo_chunked`` is active; ``KATA_TPU_FUSED=0``
+# is the guest-side kill switch, malformed values degrade with a
+# ``fused_disabled`` event, and an explicit ``fused=True`` on a server
+# whose policy cannot chunk raises.
+ENV_FUSED = "KATA_TPU_FUSED"
+
 
 def resolve_kv_quant(kv_quant, emit=None) -> bool:
     """The ONE int8-by-default resolution (ISSUE 12): explicit argument >
@@ -279,6 +305,13 @@ _PROM_STATS = (
                    "ICI failure survived degraded)"),
     ("request_traces", "Request lifecycle traces emitted (one request_trace "
                        "event per retired/failed request)"),
+    ("decode_steps", "Multi-step decode multiplier K (tokens per dispatch = "
+                     "chunk × K; 1 = one chunk per dispatch)"),
+    # fused_admissions is stats()-only here: its prometheus surface is
+    # the TRUE counter kata_tpu_serving_fused_admissions_total (the
+    # factory stores counters under their _total-stripped stem, so a
+    # same-stem scrape gauge would collide — the sched_chunks /
+    # prefill_chunks_total pair makes the same split).
 )
 
 
@@ -420,6 +453,20 @@ def _ctr_slo_violations():
     )
 
 
+# Fused-admission traffic counter (ISSUE 13): incremented when a chunked
+# admission COMPLETES having ridden at least one fused dispatch (its
+# slices were batched into decode rounds), so rate() works between
+# scrapes like the other _total counters; the same-named scrape gauge
+# mirrors stats().
+def _ctr_fused_admissions():
+    return obs.counter(
+        "kata_tpu_serving_fused_admissions_total",
+        "Chunked admissions whose slices rode fused prefill+decode "
+        "dispatches",
+        ["server"],
+    )
+
+
 def _prom_gauges() -> dict:
     return {
         name: obs.gauge(f"kata_tpu_serving_{name}", desc, ["server"])
@@ -548,6 +595,25 @@ class _PartialPrefill:
     offset: int  # prompt rows already resident (prefix reuse + chunks)
     reused: int  # prefix rows copied from the store (event bookkeeping)
     chunks: int = 0  # chunk forwards run so far
+    fused: int = 0  # chunks that RODE a decode dispatch (ISSUE 13)
+
+
+@dataclass
+class _FusedChunk:
+    """One admission slice riding a decode dispatch (ISSUE 13): the
+    partial it belongs to, the slice geometry consumed AT DISPATCH
+    (``p.offset`` advanced there — overlapped rounds pipeline one slice
+    per dispatch, so the next dispatch's slice must not re-read it), and
+    the slice's last-position logits future. ``last=True``: this was the
+    final slice — retire samples the first token from ``logits`` and
+    lands the admission through the shared ``_finish_admission``
+    epilogue, exactly like the inline chunk path."""
+
+    partial: _PartialPrefill
+    take: int   # real suffix tokens this slice carried
+    width: int  # padded executable width
+    last: bool  # final slice → retire commits the admission
+    logits: Any  # [1, vocab] device future from the fused executable
 
 
 @dataclass
@@ -558,13 +624,16 @@ class _Inflight:
     the async D2H copy of the tokens (and last/pos) started at dispatch.
     ``slots`` pins (slot, request) pairs at dispatch time: a slot refilled
     while the chunk was in flight fails the identity check at retire and
-    its stale tokens are discarded."""
+    its stale tokens are discarded. ``fused`` carries the admission slice
+    that rode this dispatch, when one did (ISSUE 13) — applied at
+    retire."""
     fence: obs.DeviceFence
     last: Any  # [B] device int32 — next chunk's tok input
     pos: Any  # [B] device int32
     slots: list  # [(slot_index, _Request)] host-known-busy at dispatch
     span: obs.Span  # detached; ends (fences + emits) at retire
     t_dispatch: float  # perf_counter at dispatch — round-cadence anchor
+    fused: Optional[_FusedChunk] = None  # admission slice riding the chunk
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -606,13 +675,14 @@ def _merge_rows(dev_vals, host_vals, fresh):
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "do_sample", "top_k",
                                    "top_p", "ring", "block_size",
-                                   "paged_len", "decode_kernel_fn"),
+                                   "paged_len", "decode_kernel_fn",
+                                   "eos_id"),
          donate_argnums=(1,))
 def _serve_decode(params, caches, tok, pos, cfg, steps: int, do_sample: bool,
                   top_k: int, temperature, key, top_p: float = 0.0,
                   ring: bool = False, block_tables=None,
                   block_size: int = 0, paged_len: int = 0,
-                  decode_kernel_fn=None):
+                  decode_kernel_fn=None, eos_id=None, budget=None):
     """The server's one decode executable: a fixed-``steps`` ragged chunk
     with the KV arena DONATED — without donation XLA must copy every arena
     tensor each chunk (the first in-scan cache write would otherwise alias
@@ -626,13 +696,52 @@ def _serve_decode(params, caches, tok, pos, cfg, steps: int, do_sample: bool,
     the paged-native pallas decode-attention callable the transformer's
     ragged branches dispatch through; None keeps the XLA gather path.
     Its identity is part of the executable cache key, so a backend
-    change can never reuse a stale executable."""
+    change can never reuse a stale executable. ``budget`` (+ static
+    ``eos_id`` — ISSUE 13): the per-lane remaining-token upper bounds
+    arming the on-device EOS/budget mask for multi-step dispatches
+    (``decode_steps > 1``); None keeps the legacy unmasked scan."""
     return _decode_scan(params, caches, tok, pos, cfg, steps, None,
                         do_sample, top_k, temperature, key,
                         return_state=True, top_p=top_p, ring=ring,
                         block_tables=block_tables, block_size=block_size,
                         paged_len=paged_len,
-                        decode_kernel_fn=decode_kernel_fn)
+                        decode_kernel_fn=decode_kernel_fn, eos_id=eos_id,
+                        budget=budget)
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "do_sample", "top_k",
+                                   "top_p", "block_size", "paged_len",
+                                   "decode_kernel_fn", "eos_id"),
+         donate_argnums=(1, 5))
+def _fused_serve_decode(params, caches, tok, pos, budget, p_caches, suffix,
+                        offset, true_len, cfg, steps: int, do_sample: bool,
+                        top_k: int, temperature, key, top_p: float = 0.0,
+                        block_tables=None, block_size: int = 0,
+                        paged_len: int = 0, decode_kernel_fn=None,
+                        eos_id=None):
+    """The FUSED prefill+decode executable (ISSUE 13): ONE dispatch
+    carries the decode lanes' ``steps``-token scan over the (donated)
+    arena AND the pending admission's ``prefill_suffix`` slice over its
+    own (donated) standalone caches. The two subgraphs share ``params``
+    but no data flows between them, so XLA is free to interleave the
+    chunk's compute with the scan's — and the host pays one dispatch and
+    one fence where the alternating slice-round/decode-round schedule
+    paid two. Numerics are the composed functions' numerics exactly
+    (``_decode_scan`` + ``prefill_suffix`` — the same jit-inlined
+    callees the unfused paths run), which is the bit-identity argument
+    the fused-vs-sequential test matrix pins."""
+    toks, caches, last, new_pos = _decode_scan(
+        params, caches, tok, pos, cfg, steps, None, do_sample, top_k,
+        temperature, key, return_state=True, top_p=top_p, ring=False,
+        block_tables=block_tables, block_size=block_size,
+        paged_len=paged_len, decode_kernel_fn=decode_kernel_fn,
+        eos_id=eos_id, budget=budget,
+    )
+    p_caches, p_logits, _pos = prefill_suffix(
+        params, suffix, cfg, p_caches, offset, return_logits=True,
+        true_len=true_len,
+    )
+    return toks, caches, last, new_pos, p_caches, p_logits
 
 
 class GenerationServer:
@@ -702,6 +811,28 @@ class GenerationServer:
     runs, never what it computes — tested across paged/slotted × overlap
     × strict × prefix-hit), and chunked admissions are head-of-line so
     FIFO and the crash-replay guarantees are preserved.
+
+    FUSED SCHEDULING & MULTI-STEP DECODE (ISSUE 13,
+    ``docs/guest_guide.md`` "Fused scheduling & multi-step decode"):
+    ``decode_steps=K`` multiplies the per-dispatch decode scan — one
+    host dispatch delivers ``chunk × K`` tokens per lane, with ON-DEVICE
+    EOS/budget masking freezing finished lanes inside the scan (their
+    token/position pin, so the frozen rewrites are value-identical
+    no-ops and a lane never outruns its block reservation) — so host
+    scheduling, the fence, and obs bookkeeping amortize over K× more
+    tokens. ``None`` reads the daemon-injectable
+    ``KATA_TPU_DECODE_STEPS`` (malformed values degrade to 1 with a
+    ``decode_steps_invalid`` event; explicit nonsense raises). Under
+    ``slo_chunked``, ``fused`` (default on; ``KATA_TPU_FUSED=0`` kills,
+    malformed degrades with ``fused_disabled``) batches each deferred
+    admission slice INTO the decode dispatch — one executable carries
+    the decode lanes' scan and the chunk's ``prefill_suffix`` forward,
+    so chunked admission stops alternating slice-round/decode-round and
+    decode lanes stop stalling behind it. Greedy outputs are
+    BIT-IDENTICAL to K=1 unfused across the serving matrix (tested);
+    recovery stays dispatch-boundary-granular and strict-FIFO replay is
+    unchanged (a fault mid-fused-dispatch discards the partial and
+    replays it from the prompt).
 
     ``spec_opt_in`` (``KATA_TPU_SPEC=1``): speculative serving is opt-in
     — ``speculative_k`` alone degrades to plain decoding with a
@@ -779,6 +910,8 @@ class GenerationServer:
                  sched_policy: Optional[str] = None,
                  prefill_chunk: Optional[int] = None,
                  itl_slo_ms: Optional[float] = None,
+                 decode_steps: Optional[int] = None,
+                 fused: Optional[bool] = None,
                  spec_opt_in: Optional[bool] = None,
                  tp: Optional[int] = None,
                  tp_min: Optional[int] = None,
@@ -982,12 +1115,89 @@ class GenerationServer:
                     "sched_disabled", reason=reason,
                 )
                 sched_policy = POLICY_FIFO
+        # Multi-step decode multiplier (ISSUE 13): one host dispatch runs
+        # a ``chunk × decode_steps``-step scan with on-device EOS/budget
+        # masking, so scheduling/fence/obs overhead amortizes over K×
+        # more tokens. The standard knob contract: explicit argument
+        # raises on nonsense, the daemon-injected env degrades to K=1
+        # with a decode_steps_invalid event; incompatible modes
+        # (speculative rounds are host-driven lock-step, the ring/cycle
+        # fold cannot absorb frozen-lane rewrites across the wrap) raise
+        # explicitly and degrade from env.
+        explicit_steps = decode_steps is not None
+        if decode_steps is not None and int(decode_steps) < 1:
+            raise ValueError(
+                f"decode_steps must be >= 1, got {decode_steps}"
+            )
+        k_steps = (
+            resilience.env_int(ENV_DECODE_STEPS, 1,
+                               event="decode_steps_invalid",
+                               server=self._label)
+            if decode_steps is None else int(decode_steps)
+        )
+        if k_steps < 1:
+            # Parseable nonsense from the node env (e.g. "-2") degrades
+            # like every other injected knob — never crashes a guest.
+            self._emit("decode_steps_invalid", reason=f"bad_env:{k_steps}")
+            k_steps = 1
+        if k_steps > 1:
+            reason = None
+            if self.speculative_k or self.draft is not None:
+                reason = "speculative"
+            elif ring_kv:
+                reason = "ring_kv"
+            if reason is not None:
+                if explicit_steps:
+                    raise ValueError(
+                        f"decode_steps={k_steps} is incompatible with this "
+                        f"server ({reason}) — see 'Fused scheduling & "
+                        "multi-step decode' in docs/guest_guide.md"
+                    )
+                self._emit("decode_steps_invalid", reason=reason)
+                k_steps = 1
+        self._decode_steps = k_steps
+        # The per-dispatch step count every decode path uses: host-side
+        # bookkeeping (ITL normalization, budget gates, block lookahead)
+        # keys off this, never off ``chunk`` alone.
+        self._dispatch_steps = self.chunk * k_steps
+        # Fused prefill+decode dispatch (ISSUE 13): default ON whenever
+        # the slo_chunked policy is active (it is inert otherwise — only
+        # slo_chunked creates partials). KATA_TPU_FUSED=0 kills it;
+        # malformed env values degrade with fused_disabled; an explicit
+        # fused=True on a server whose policy never chunks raises.
+        explicit_fused = fused is not None
+        if fused is None:
+            raw_f = os.environ.get(ENV_FUSED, "").strip()
+            if raw_f and raw_f not in ("0", "1"):
+                self._emit("fused_disabled", reason=f"bad_env:{raw_f[:32]}")
+                raw_f = ""
+            fused_ok = raw_f != "0"
+        else:
+            fused_ok = bool(fused)
+        if fused_ok and sched_policy != POLICY_SLO:
+            if explicit_fused:
+                raise ValueError(
+                    "fused=True requires sched_policy='slo_chunked' — only "
+                    "chunked admission produces the slices a fused "
+                    "dispatch carries (docs/guest_guide.md)"
+                )
+            fused_ok = False  # inert without partials; no event (default)
+        self._fused_ok = fused_ok
+        self._fused_admissions = 0
+        self._fuse_pending = False
+        self._fused_ret: Optional[_FusedChunk] = None
+        # The request whose admission slice rides the CURRENT fused
+        # dispatch — part of the recovery blame cohort (a fault in the
+        # fused dispatch implicates it with the lanes; see _recover).
+        self._fused_blame: Optional[_Request] = None
         self._sched = make_scheduler(
             sched_policy, chunk_tokens=chunk_tokens, slo_ms=slo_ms,
-            # The round→per-token normalizer: slo_ms is a PER-TOKEN
-            # deadline (the decode_token_s unit), rounds deliver ``chunk``
-            # tokens per lane.
-            decode_steps=chunk, label=self._label,
+            # The round→per-token normalizer DEFAULT: slo_ms is a
+            # PER-TOKEN deadline (the decode_token_s unit), rounds
+            # deliver ``chunk × decode_steps`` tokens per lane —
+            # note_round then learns the ACTUAL per-dispatch count.
+            decode_steps=self._dispatch_steps, fused=fused_ok,
+            label=self._label,
         )
         self._partial: Optional[_PartialPrefill] = None
         # Recovery supervisor (ISSUE 7). Every knob defaults through the
@@ -1377,6 +1587,17 @@ class GenerationServer:
             prefix_store is not None and self.prefix_store is prefix_store
         )
         self._prefix_capacity = int(prefix_cache_tokens or 0)
+        # One config event per server (ISSUE 13 observability satellite):
+        # the resolved dispatch shape — scheduler policy, decode-steps
+        # multiplier, fused flag — so fleet dashboards can segment every
+        # later serving metric by configuration without joining stats().
+        self._emit(
+            "serving_config", sched_policy=self._sched.name,
+            decode_steps=self._decode_steps, chunk=self.chunk,
+            dispatch_steps=self._dispatch_steps,
+            fused=int(self._fused_ok), overlap=int(bool(overlap)),
+            paged=int(self.paged), tp=self._tp,
+        )
 
     def _emit(self, name: str, **fields) -> None:
         """One emitter for every serving event: attaches the server label
@@ -1472,6 +1693,7 @@ class GenerationServer:
         self._c_sched_chunk = _ctr_sched_chunks().labels(server=self._label)
         self._c_sched_defer = _ctr_sched_defers().labels(server=self._label)
         self._c_slo = _ctr_slo_violations().labels(server=self._label)
+        self._c_fused = _ctr_fused_admissions().labels(server=self._label)
 
     def _pool_conflict(self, pool_tokens: int, ring_kv: bool, draft,
                        speculative_k: int, prefix_store) -> Optional[str]:
@@ -1889,6 +2111,15 @@ class GenerationServer:
         # TTFT component the scheduler controls); sched_chunks/defers and
         # slo_violations mirror the _total prometheus counters.
         out.update(self._sched.stats())
+        # Fused scheduling & multi-step decode (ISSUE 13): ALWAYS present
+        # — decode_steps is 1 and fused_admissions 0 on servers that
+        # never fuse — same no-schema-branch contract; fused_admissions
+        # mirrors the kata_tpu_serving_fused_admissions_total counter.
+        out.update({
+            "decode_steps": self._decode_steps,
+            "fused_enabled": int(self._fused_ok),
+            "fused_admissions": self._fused_admissions,
+        })
         # Resilience fields (ISSUE 7): ALWAYS present — zeros on a server
         # that never failed — so dashboards need no schema branch.
         out.update({
@@ -2617,15 +2848,45 @@ class GenerationServer:
         slice is reached) it runs the rest to completion. Returns
         ``(completed, ran')``: completed=True when the admission landed in
         a lane (the caller loops for more admissions), False when this
-        pass's chunk budget is spent."""
+        pass's chunk budget is spent.
+
+        FUSED PLAN (ISSUE 13): when the policy defers AND asks for
+        fusion (``Directive.fused``) AND somebody is decoding to fuse
+        with, the chunk does not run here at all — ``_fuse_pending``
+        arms the next ``_dispatch_decode``, which batches the slice into
+        the decode executable (one dispatch, one fence). With no live
+        decode lanes there is nothing to fuse with and the inline slice
+        (or run-to-completion) path below is strictly better."""
+        self._fuse_pending = False  # re-decided every pass
         while True:
             p = self._partial
             remaining = len(p.req.prompt) - p.offset
+            if remaining <= 0:
+                # Every slice is already IN FLIGHT on a fused dispatch;
+                # the final slice's retire commits the admission. Nothing
+                # to run inline, and head-of-line holds until then.
+                return False, ran
+            live = sum(r is not None for r in self._slot_req)
             d = self._sched.directive(
-                live_lanes=sum(r is not None for r in self._slot_req),
-                pending_tokens=remaining, partial=True,
+                live_lanes=live, pending_tokens=remaining, partial=True,
             )
             if not d.admit:
+                if d.fused and self._fused_ok and live > 0:
+                    # The slice rides the next decode dispatch instead of
+                    # stalling a round of its own. Still a DEFERRAL —
+                    # the pass chose a chunk over whole admission — so
+                    # the defer counters/event keep their meaning; the
+                    # fused field says no slice round was paid for it.
+                    self._fuse_pending = True
+                    self._sched.defers += 1
+                    self._c_sched_defer.inc()
+                    self._emit(
+                        "sched_defer", rid=p.req.rid, offset=p.offset,
+                        remaining=remaining, queued=len(self._queue),
+                        projected_itl_ms=d.projected_itl_ms,
+                        slo_ms=self._sched.slo_ms, fused=1,
+                    )
+                    return False, ran
                 if ran:
                     return False, ran  # one chunk per decode dispatch
                 self._sched.defers += 1
@@ -2657,12 +2918,7 @@ class GenerationServer:
         exact width. True when the admission completed."""
         req = p.req
         n = len(req.prompt)
-        c = self._sched.chunk_tokens
-        take = min(c, n - p.offset)
-        width = c if p.offset + c <= self.max_len else take
-        suffix = req.prompt[p.offset:p.offset + take]
-        if width > take:
-            suffix = np.pad(suffix, (0, width - take))
+        suffix, take, width = self._slice_geometry(p)
         last = p.offset + take >= n
         # Blast-radius attribution: a fault in this chunk implicates only
         # this request (stays set through the raise; _recover reads it).
@@ -2696,9 +2952,22 @@ class GenerationServer:
             self._admit_current = []
             return False
         t_first = time.monotonic()  # the sample's int() fenced the forward
+        self._commit_partial(p, first, t_first)
+        return True
+
+    def _commit_partial(self, p: _PartialPrefill, first: int,
+                        t_first: float) -> None:
+        """The final-slice commit BOTH chunked-completion paths share —
+        the inline :meth:`_prefill_one_chunk` and the fused
+        :meth:`_apply_fused` (ISSUE 13): land the partial's caches in a
+        lane and run the standard admission epilogue. One body, so the
+        two paths cannot drift (the bit-identity claim rests on it).
+        Lane free by construction: one existed when the partial started
+        and nothing fills lanes while it is head-of-line. A partial with
+        ``fused`` slices counts as a fused admission wherever its final
+        slice ran — earlier slices already rode decode dispatches."""
+        req = p.req
         self._inj.fire("admission_commit")
-        # Lane free by construction: one existed when the partial started
-        # and nothing fills lanes while it is head-of-line.
         b = next(
             i for i in range(self.max_batch) if self._slot_req[i] is None
         )
@@ -2711,12 +2980,37 @@ class GenerationServer:
             # the caches now hold the whole prompt's KV.
             self.prefix_store.insert(req.prompt, p.caches, 0)
         self._partial = None
+        if p.fused:
+            self._fused_admissions += 1
+            self._c_fused.inc()
         self._finish_admission(
-            b, req, first, n, t_first, hit=p.hit,
-            prefix_reused=p.reused, chunked=p.chunks,
+            b, req, first, len(req.prompt), t_first, hit=p.hit,
+            prefix_reused=p.reused, chunked=p.chunks, fused=p.fused,
         )
         self._admit_current = []
-        return True
+
+    def _apply_fused(self, fc: Optional[_FusedChunk]) -> None:
+        """Land one admission slice that rode a decode dispatch (ISSUE
+        13). Intermediate slices were fully booked at dispatch (offset,
+        chunk counters); only the FINAL slice has retire-side work:
+        sample the first token from the slice's logits future, stamp
+        TTFT at that fence, and commit the admission through the same
+        arena-write / store-insert / ``_finish_admission`` epilogue the
+        inline chunk path uses — bit-identical by construction. A
+        recovery that discarded the partial mid-flight (its caches were
+        donated into the failed dispatch) leaves ``self._partial``
+        changed; the stale record is dropped, and the request replays
+        from its prompt via ``_admitting`` as usual."""
+        if fc is None:
+            return
+        p = fc.partial
+        if self._partial is not p or not fc.last:
+            return
+        with jaxapi.allow_transfer("fused admission commit + first token"):
+            first = self._sample_first(fc.logits)
+            t_first = time.monotonic()  # the int() above fenced the slice
+            self._admit_current = [p.req]
+            self._commit_partial(p, first, t_first)
 
     def _maybe_finish(self, b: int, new_tokens: list) -> None:
         req = self._slot_req[b]
@@ -2949,8 +3243,12 @@ class GenerationServer:
             return
         bs = self.kv_block
         # Overlap keeps one chunk in flight beyond the host-known pos, so
-        # the next dispatch can write up to two chunks ahead of it.
-        lookahead = self.chunk * (2 if self.overlap else 1)
+        # the next dispatch can write up to two dispatch windows ahead of
+        # it — at decode_steps=K that window is chunk × K tokens (ISSUE
+        # 13: the reservation must cover every token one dispatch can
+        # write; the on-device budget mask bounds the tail at each
+        # request's own cap, which the ``cap`` term below already is).
+        lookahead = self._dispatch_steps * (2 if self.overlap else 1)
         lanes = sorted(
             (b for b in range(self.max_batch)
              if self._slot_req[b] is not None),
@@ -3187,6 +3485,25 @@ class GenerationServer:
                 for _b, req in self._inflight.slots:
                     if not req.done:
                         blamed.add(req.rid)
+            # An admission slice rode the failed dispatch (ISSUE 13): its
+            # request shares the executable with the decode lanes and
+            # joins the cohort — a poison prompt fusing every round
+            # accrues quarantine strikes like any lane resident, instead
+            # of replaying forever while innocents are failed around it.
+            # Three sources cover the slice's whole lifecycle: the
+            # prep→record window (_fused_blame), a lockstep record
+            # awaiting its fence (_fused_ret), and an overlapped record
+            # riding the in-flight chunk.
+            fused_recs = (
+                self._fused_ret,
+                self._inflight.fused if self._inflight is not None
+                else None,
+            )
+            for fc in fused_recs:
+                if fc is not None and not fc.partial.req.done:
+                    blamed.add(fc.partial.req.rid)
+            if self._fused_blame is not None and not self._fused_blame.done:
+                blamed.add(self._fused_blame.rid)
         lost: dict[int, _Request] = {}
         for b in range(self.max_batch):
             req = self._slot_req[b]
@@ -3521,8 +3838,12 @@ class GenerationServer:
         self._fresh_rows.clear()
         # A half-built chunked admission's caches are device state from
         # the failed round — discard; its request is in the lost set (it
-        # rides _admitting) and replays from the prompt.
+        # rides _admitting) and replays from the prompt. Any fused slice
+        # record of the failed dispatch dies with it (ISSUE 13).
         self._partial = None
+        self._fuse_pending = False
+        self._fused_ret = None
+        self._fused_blame = None
         self._admitting = []
         self._admit_current = []
 
@@ -3612,16 +3933,19 @@ class GenerationServer:
         self._drain_done = True
 
     def _note_round(self, dur_s: float, busy: int) -> None:
-        """Feed one decode-round cadence to the scheduler's estimator; an
-        SLO-violating round (slo_chunked only) counts and events — the
-        measured ground truth the deadline-driven admission steers by."""
-        if self._sched.note_round(dur_s):
+        """Feed one decode-round cadence to the scheduler's estimator —
+        with the round's ACTUAL delivered steps, so the per-token EWMA
+        stays honest under multi-step decode and fused rounds (ISSUE 13
+        satellite); an SLO-violating round (slo_chunked only) counts and
+        events — the measured ground truth the deadline-driven admission
+        steers by."""
+        if self._sched.note_round(dur_s, steps=self._dispatch_steps):
             self._c_slo.inc()
             self._emit(
                 "slo_violation", round_s=round(dur_s, 6),
                 # The per-token figure actually compared to slo_ms (the
                 # round cadence over its delivered steps).
-                itl_s=round(dur_s / self.chunk, 6),
+                itl_s=round(dur_s / self._dispatch_steps, 6),
                 slo_ms=self._sched.slo_ms, slots_busy=busy,
             )
 
@@ -3640,13 +3964,84 @@ class GenerationServer:
             trace=self._trace,
         )
 
+    def _decode_budget(self):
+        """Per-lane remaining-token UPPER BOUNDS for the on-device
+        EOS/budget mask (``decode_steps > 1`` only — K=1 keeps the
+        legacy executables untouched). Computed from the host's retired
+        token counts, so under overlap it over-estimates by at most the
+        in-flight chunk — the mask freezes LATE (trimmed garbage), never
+        early (which would drop real tokens). Dead lanes get 0 and
+        freeze from step one: their stale rows stop being scribbled."""
+        if self._decode_steps <= 1:
+            return None
+        b = np.zeros(self.max_batch, np.int32)
+        for i in range(self.max_batch):
+            r = self._slot_req[i]
+            if r is not None and not r.done:
+                b[i] = max(0, r.max_new_tokens - len(r.out))
+        return jnp.asarray(b)
+
+    def _slice_geometry(self, p: _PartialPrefill) -> tuple:
+        """The ONE chunk-slice shape rule both chunk paths share (inline
+        :meth:`_prefill_one_chunk` and the fused dispatch — the
+        bit-identity claim rests on them staying identical):
+        ``chunk_tokens`` wide, right-padded + true_len-masked, exact
+        width near the arena end (padding past ``max_len`` would clamp
+        real rows). Returns ``(suffix, take, width)``."""
+        n = len(p.req.prompt)
+        c = self._sched.chunk_tokens
+        take = min(c, n - p.offset)
+        width = c if p.offset + c <= self.max_len else take
+        suffix = p.req.prompt[p.offset:p.offset + take]
+        if width > take:
+            suffix = np.pad(suffix, (0, width - take))
+        return suffix, take, width
+
+    def _prepare_fused_chunk(self) -> Optional[tuple]:
+        """Consume the pending admission slice for a fused dispatch
+        (ISSUE 13): :meth:`_slice_geometry`, with ``p.offset``/counters
+        advanced AT DISPATCH so an overlapped pipeline carries one slice
+        per round without re-reading the same tokens. Returns
+        ``(suffix, offset, take, width, is_last)`` or None when no slice
+        is pending. The slice's request joins the recovery BLAME COHORT
+        of the dispatch it rides (``_fused_blame`` — cleared by
+        ``_note_progress`` once a round survives): a fault anywhere in
+        the fused dispatch implicates it alongside the decode lanes, so
+        a poison prompt riding fused dispatches accrues quarantine
+        strikes instead of replaying forever."""
+        if not (self._fuse_pending and self._fused_ok
+                and self._partial is not None):
+            self._fuse_pending = False
+            return None
+        self._fuse_pending = False
+        p = self._partial
+        n = len(p.req.prompt)
+        if p.offset >= n:
+            return None  # final slice already in flight
+        self._fused_blame = p.req
+        suffix, take, width = self._slice_geometry(p)
+        self._inj.fire("sched_tick")
+        offset = p.offset
+        p.offset += take
+        p.chunks += 1
+        p.fused += 1
+        self._sched.chunks += 1
+        self._c_sched_chunk.inc()
+        return suffix, offset, take, width, p.offset >= n
+
     def _dispatch_decode(self, last, pos, sub):
-        """The one ``_serve_decode`` call site (lock-step and overlapped
-        share it): paged servers decode through the block pool (tables
-        uploaded host→device each chunk — a few KB riding the dispatch,
-        like ``last``/``pos``; allocation itself is pure host work), slot
-        servers through the dense arena. Returns ``(toks, last, pos)``
-        futures; the donated arena's successor is stored back."""
+        """The ONE decode dispatch site (lock-step and overlapped share
+        it — and since ISSUE 13, plain AND fused rounds): paged servers
+        decode through the block pool (tables uploaded host→device each
+        chunk — a few KB riding the dispatch, like ``last``/``pos``;
+        allocation itself is pure host work), slot servers through the
+        dense arena. When an admission slice is pending under the fused
+        plan, the SAME dispatch carries it: ``_fused_serve_decode``
+        composes the decode scan and the slice's ``prefill_suffix`` into
+        one executable, the slice's logits ride back as a future in
+        ``self._fused_ret``, and the caller's retire applies it. Returns
+        ``(toks, last, pos)`` futures; the donated arena's successor is
+        stored back."""
         self._inj.fire("decode_dispatch")
         if not self._decode_attn_emitted:
             # One decode_attn_backend event per server, at the first
@@ -3666,22 +4061,73 @@ class GenerationServer:
                 ),
                 kv_quant="int8" if self.kv_quant else "bf16",
             )
+        steps = self._dispatch_steps
+        budget = self._decode_budget()
+        eos = self.eos_id if budget is not None else None
+        fuse = self._prepare_fused_chunk()
+        if fuse is not None:
+            p = self._partial
+            suffix, offset, take, width, is_last = fuse
+            # The slice's prompt tokens and offsets are ADMISSION inputs
+            # riding a decode dispatch — the same sanctioned upload class
+            # as the _admit window (the strict-mode transfer guard covers
+            # the overlapped dispatch this runs inside).
+            with jaxapi.allow_transfer("fused admission slice upload"):
+                if self.paged:
+                    (toks, caches, new_last, new_pos, p_caches,
+                     p_logits) = _fused_serve_decode(
+                        self.params, self.kv_pool.arena, last, pos, budget,
+                        p.caches, jnp.asarray(suffix)[None, :],
+                        jnp.int32(offset), jnp.int32(take), self.cfg,
+                        steps, self._do_sample, self.top_k, self._temp_dev,
+                        sub, top_p=self.top_p,
+                        block_tables=jnp.asarray(self._bt_host),
+                        block_size=self.kv_block, paged_len=self.max_len,
+                        decode_kernel_fn=self._decode_kernel, eos_id=eos,
+                    )
+                    self.kv_pool.arena = caches
+                else:
+                    (toks, caches, new_last, new_pos, p_caches,
+                     p_logits) = _fused_serve_decode(
+                        self.params, self.arena, last, pos, budget,
+                        p.caches, jnp.asarray(suffix)[None, :],  # jaxguard: allow(JG102) exclusive if/else branch — the paged call above never ran; p.caches rebinds right below
+                        jnp.int32(offset), jnp.int32(take), self.cfg,
+                        steps, self._do_sample, self.top_k, self._temp_dev,
+                        sub, top_p=self.top_p,
+                        decode_kernel_fn=self._decode_kernel, eos_id=eos,
+                    )
+                    self.arena = caches
+            p.caches = p_caches  # jaxguard: allow(JG102) this IS the rebind — the donated tree's successor replaces it, nothing reads the donated buffers
+            self._fused_ret = _FusedChunk(
+                partial=p, take=take, width=width, last=is_last,
+                logits=p_logits,
+            )
+            # Blame handoff: the record now carries the slice through the
+            # rest of its dispatch's life (lockstep apply / the
+            # overlapped _Inflight) — _recover reads it from there. The
+            # side variable only covers the prep→record window, where a
+            # sched_tick injection or a raising dispatch would otherwise
+            # leave the slice's request unimplicated.
+            self._fused_blame = None
+            return toks, new_last, new_pos
         if self.paged:
             toks, caches, new_last, new_pos = _serve_decode(
                 self.params, self.kv_pool.arena, last, pos, self.cfg,
-                self.chunk, self._do_sample, self.top_k, self._temp_dev,
+                steps, self._do_sample, self.top_k, self._temp_dev,
                 sub, top_p=self.top_p, ring=False,
                 block_tables=jnp.asarray(self._bt_host),
                 block_size=self.kv_block, paged_len=self.max_len,
-                decode_kernel_fn=self._decode_kernel,
+                decode_kernel_fn=self._decode_kernel, eos_id=eos,
+                budget=budget,
             )
             self.kv_pool.arena = caches
         else:
             toks, caches, new_last, new_pos = _serve_decode(
-                self.params, self.arena, last, pos, self.cfg, self.chunk,
+                self.params, self.arena, last, pos, self.cfg, steps,
                 self._do_sample, self.top_k, self._temp_dev, sub,
                 top_p=self.top_p, ring=self.ring_kv,
-                decode_kernel_fn=self._decode_kernel,
+                decode_kernel_fn=self._decode_kernel, eos_id=eos,
+                budget=budget,
             )
             self.arena = caches
         return toks, new_last, new_pos
@@ -3721,30 +4167,34 @@ class GenerationServer:
                 )
             return alive
 
-        # Always decode exactly ``chunk`` steps: ``steps`` is a static arg,
-        # so a data-dependent chunk would compile a fresh full-model decode
-        # executable per distinct value (a multi-second latency spike
-        # whenever a request neared its budget). Overrun is harmless by
-        # construction — writes past max_len clamp to the last entry of a
-        # slot that is finished (and refill overwrites the whole slot), and
-        # _maybe_finish trims tokens past eos/budget.
+        # Always decode exactly ``chunk × decode_steps`` steps: ``steps``
+        # is a static arg, so a data-dependent count would compile a
+        # fresh full-model decode executable per distinct value (a
+        # multi-second latency spike whenever a request neared its
+        # budget). Overrun is harmless by construction — writes past
+        # max_len clamp to the last entry of a slot that is finished (and
+        # refill overwrites the whole slot), _maybe_finish trims tokens
+        # past eos/budget, and at decode_steps > 1 the on-device mask
+        # freezes finished lanes inside the scan (ISSUE 13).
         self._key, sub = jax.random.split(self._key)
         # The chunk span's duration is honest by construction: np.asarray
         # on the chunk's tokens is a device→host transfer, i.e. the fence.
         with obs.span(
             "serving.decode_chunk",
-            trace_id=self._trace, server=self._label, tokens=len(active) * self.chunk,
+            trace_id=self._trace, server=self._label,
+            tokens=len(active) * self._dispatch_steps,
             slots_busy=len(active), queued=len(self._queue),
             batch_occupancy=round(len(active) / self.max_batch, 4),
         ) as sp:
             toks, last, pos = self._dispatch_decode(
                 jnp.asarray(self._last), jnp.asarray(self._pos), sub
             )
-            # Watchdog-fenced chunk boundary: [max_batch, chunk] tokens.
+            # Watchdog-fenced chunk boundary: [max_batch, steps] tokens.
             toks = self._fence_wait(lambda: np.asarray(toks))  # jaxguard: allow(JG101) lock-step round fence — the transfer IS the chunk boundary
-        # Per-token decode latency as a client sees it: chunk wall time
-        # over the chunk's steps (each step yields one token per slot).
-        tok_lat = sp.duration_s / self.chunk
+        # Per-token decode latency as a client sees it: dispatch wall
+        # time over its delivered steps (each step yields one token per
+        # slot) — STAYS per-token however large decode_steps is.
+        tok_lat = sp.duration_s / self._dispatch_steps
         self._tok_lat.observe(tok_lat)
         self._h_tok_lat.observe(tok_lat)
         self._note_round(sp.duration_s, len(active))
@@ -3758,6 +4208,10 @@ class GenerationServer:
             self._slot_req[b].out.extend(new)
             self._emitted += len(new)
             self._maybe_finish(b, new)
+        # An admission slice that rode this dispatch (ISSUE 13) lands
+        # after the decode tokens, mirroring the overlapped retire order.
+        fc, self._fused_ret = self._fused_ret, None
+        self._apply_fused(fc)
         return True
 
     # ----- pipelined rounds (overlap=True) ---------------------------------
@@ -3824,7 +4278,7 @@ class GenerationServer:
                 continue
             if prev_req.get(b) is not req:
                 return True  # refilled since dispatch: untouched budget
-            if len(req.out) + self.chunk < req.max_new_tokens:
+            if len(req.out) + self._dispatch_steps < req.max_new_tokens:
                 return True
         return False
 
@@ -3846,17 +4300,21 @@ class GenerationServer:
         # rate from that instead.
         sp = obs.start_span(
             "serving.decode_chunk",
-            trace_id=self._trace, server=self._label, chunk_tokens=len(active) * self.chunk,
+            trace_id=self._trace, server=self._label,
+            chunk_tokens=len(active) * self._dispatch_steps,
             slots_busy=len(active), queued=len(self._queue),
             batch_occupancy=round(len(active) / self.max_batch, 4),
             overlapped=True,
         )
         toks, new_last, new_pos = self._dispatch_decode(last, pos, sub)
         sp.mark("dispatch")
+        # A fused admission slice dispatched above rides the in-flight
+        # record to retire (ISSUE 13) — one slice per pipelined round.
+        fc, self._fused_ret = self._fused_ret, None
         self._inflight = _Inflight(
             fence=obs.DeviceFence(toks=toks, last=new_last, pos=new_pos),
             last=new_last, pos=new_pos, slots=active, span=sp,
-            t_dispatch=time.perf_counter(),
+            t_dispatch=time.perf_counter(), fused=fc,
         )
 
     def _retire(self, fl: _Inflight) -> None:
@@ -3877,14 +4335,14 @@ class GenerationServer:
         now = time.perf_counter()
         round_s = now - max(fl.t_dispatch, self._t_last_retire)
         self._t_last_retire = now
-        n_tokens = len(fl.slots) * self.chunk
+        n_tokens = len(fl.slots) * self._dispatch_steps
         fl.span.set(
             round_s=round(round_s, 6),
             tokens_per_s=round(n_tokens / round_s, 2) if round_s > 0 else 0.0,
         )
         fl.span.end()
         toks, last, pos = host["toks"], host["last"], host["pos"]
-        tok_lat = round_s / self.chunk
+        tok_lat = round_s / self._dispatch_steps
         self._tok_lat.observe(tok_lat)
         self._h_tok_lat.observe(tok_lat)
         # Retire cadence is the ITL ground truth under pipelining: an
@@ -3901,6 +4359,10 @@ class GenerationServer:
             req.out.extend(new)
             self._emitted += len(new)
             self._maybe_finish(b, new)
+        # An admission slice that rode this chunk (ISSUE 13) lands before
+        # the admission pass below — a completed partial unblocks the
+        # head of the line for this very pass.
+        self._apply_fused(fl.fused)
         self._admit()  # freed slots refill; rows land in _fresh_rows
 
     def _step_speculative(self, active: list) -> bool:

@@ -436,6 +436,22 @@ def _paged_write_token(cache, x: jax.Array, phys: jax.Array):
     return cache.at[0, phys].set(x[:, 0].astype(cache.dtype))
 
 
+def _paged_write_span(cache, x: jax.Array, phys: jax.Array):
+    """Multi-token sibling of :func:`_paged_write_token` (the mixed-batch
+    branch, ISSUE 13): row ``b``'s ``S`` fresh k/v vectors land at
+    PHYSICAL pool rows ``phys[b, j]`` (each resolved through the row's
+    block table by the caller). x: [B, S, KV, D]; phys: [B, S]. Same
+    scratch-block contract as the single-token form — positions past a
+    lane's span aim at SCRATCH by table-filler design."""
+    if isinstance(cache, QTensor):
+        qt = quantize_kv(x)
+        return QTensor(
+            cache.q.at[0, phys].set(qt.q),
+            cache.scale.at[0, phys].set(qt.scale),
+        )
+    return cache.at[0, phys].set(x.astype(cache.dtype))
+
+
 def _paged_view(cache, idx: jax.Array):
     """Gather each row's block-table view out of the pool:
     ``cache [1, NT, ...]`` + ``idx [B, Lm]`` physical row indices →
@@ -599,7 +615,7 @@ def _layer(
         )
         new_cache = (ck, cv)
     elif kv_cache is not None and block_tables is not None:
-        # PAGED ragged decode (S == 1): the cache pair is this layer's
+        # PAGED ragged decode: the cache pair is this layer's
         # [1, NT, KV, D] slice of the shared block pool
         # (guest.kv_arena.KVPool); ``block_tables`` [B, NB] maps row b's
         # logical block j to pool block ``block_tables[b, j]``. Write the
@@ -613,28 +629,50 @@ def _layer(
         # overrunning its budget, same class as the dense clamp-at-
         # max_len-1) clamp to the last table entry, whose filler is the
         # scratch block — garbage lands where nothing live reads.
-        assert S == 1, "paged decode is single-token (S == 1)"
+        # S > 1 is the MIXED-BATCH form (ISSUE 13): each row writes its
+        # S-token span at its own positions (cache_offset[b] .. +S-1)
+        # through its table and attends with per-row query offsets — the
+        # per-lane-query-length forward fused prefill+decode dispatches
+        # ride (the single-token decode scan is the S == 1 case).
         ck, cv = kv_cache
         bs = block_size
         rows = jnp.arange(B)
-        blk = jnp.minimum(cache_offset // bs, block_tables.shape[1] - 1)
-        phys = block_tables[rows, blk] * bs + cache_offset % bs  # [B]
-        ck = _paged_write_token(ck, k, phys)
-        cv = _paged_write_token(cv, v, phys)
+        if S == 1:
+            blk = jnp.minimum(cache_offset // bs, block_tables.shape[1] - 1)
+            phys = block_tables[rows, blk] * bs + cache_offset % bs  # [B]
+            ck = _paged_write_token(ck, k, phys)
+            cv = _paged_write_token(cv, v, phys)
+        else:
+            span = cache_offset[:, None] + jnp.arange(S)[None, :]  # [B, S]
+            blk = jnp.minimum(span // bs, block_tables.shape[1] - 1)
+            phys = block_tables[rows[:, None], blk] * bs + span % bs
+            ck = _paged_write_span(ck, k, phys)
+            cv = _paged_write_span(cv, v, phys)
         view_tables = jnp.where(
             block_tables == PAGED_SCRATCH_BLOCK, PAGED_ZERO_BLOCK,
             block_tables,
         )
-        if decode_kernel_fn is not None:
+        if decode_kernel_fn is not None and (
+                S == 1 or getattr(decode_kernel_fn, "multi_query", False)):
             # Paged-NATIVE kernel (ISSUE 12): each lane's program walks
             # its block table in place — the dense [B, paged_len] view
             # below (a full copy of every live lane's KV through HBM,
             # every layer, every step) never materializes. int8 pools
             # dequantize in-kernel. The mask semantics are the gather
             # path's exactly (unmapped→ZERO rows, every column > pos
-            # replaced before softmax), so greedy tokens match.
-            attn_out = decode_kernel_fn(q, ck, cv, view_tables,
-                                        cache_offset)
+            # replaced before softmax), so greedy tokens match. S > 1
+            # spans pass the LAST query's position + a per-lane q_lens
+            # vector (ISSUE 13 — the per-lane-query-length kernel form);
+            # the tp shard_map wrapper is single-token only, so sharded
+            # spans keep the gather path (make_decode_attn_fn).
+            if S == 1:
+                attn_out = decode_kernel_fn(q, ck, cv, view_tables,
+                                            cache_offset)
+            else:
+                attn_out = decode_kernel_fn(
+                    q, ck, cv, view_tables, cache_offset + (S - 1),
+                    jnp.full((B,), S, jnp.int32),
+                )
         else:
             view_idx = (
                 (view_tables * bs)[:, :, None]
@@ -1264,7 +1302,7 @@ def prefill_batch(params: Params, prompts: jax.Array, cfg: DecoderConfig,
 @partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn", "do_sample",
                                    "top_k", "top_p", "return_state", "ring",
                                    "block_size", "paged_len",
-                                   "decode_kernel_fn"))
+                                   "decode_kernel_fn", "eos_id"))
 def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
                  cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn],
                  do_sample: bool, top_k: int, temperature, key: jax.Array,
@@ -1272,16 +1310,37 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
                  top_p: float = 0.0,
                  block_tables: Optional[jax.Array] = None,
                  block_size: int = 0, paged_len: int = 0,
-                 decode_kernel_fn=None):
+                 decode_kernel_fn=None, eos_id: Optional[int] = None,
+                 budget: Optional[jax.Array] = None):
+    """``budget`` ([B] int32, ragged callers only — ISSUE 13) arms the
+    ON-DEVICE EOS/BUDGET MASK for multi-step dispatches: a lane that has
+    emitted ``budget[b]`` tokens (or the static ``eos_id``) FREEZES — its
+    ``tok``/``pos`` pin, so every later step recomputes the SAME k/v at
+    the SAME cache position (an idempotent, value-identical rewrite: k/v
+    depend only on tok + rope(pos), never on the cache) and its emitted
+    token repeats the pinned one. Live lanes are untouched, so greedy
+    outputs per request are bit-identical to the unmasked scan after the
+    host's eos/budget trim (tested); the mask's job is bounding state —
+    a frozen lane never advances past its block reservation however
+    large the dispatch's step count. ``budget`` must be an UPPER bound
+    on each lane's remaining tokens (freezing late is trimmed garbage;
+    freezing early would drop real tokens). ``budget=None`` keeps the
+    legacy carry — existing executables are untouched."""
     if attn_fn is None:
         from ..ops.attention import flash_attention
 
         attn_fn = flash_attention
     B = tok.shape[0]
     ragged = jnp.ndim(pos) == 1  # [B] per-slot positions (continuous batching)
+    masked = budget is not None
+    assert not masked or ragged, "budget masking is per-lane (ragged pos)"
 
     def step(carry, step_key):
-        caches, tok, pos = carry
+        if masked:
+            caches, tok, pos, rem = carry
+            alive = rem > 0
+        else:
+            caches, tok, pos = carry
         positions = (pos[:, None] if ragged
                      else jnp.full((B, 1), pos, jnp.int32))
         logits, caches = forward(
@@ -1292,10 +1351,20 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
         )
         nxt = _next_token(logits[:, -1, :], step_key, do_sample, temperature,
                           top_k, top_p)
+        if masked:
+            nxt = jnp.where(alive, nxt, tok)          # frozen: pin the token
+            new_pos = jnp.where(alive, pos + 1, pos)  # frozen: pin the slot
+            rem = jnp.where(alive, rem - 1, rem)
+            if eos_id is not None:
+                rem = jnp.where(alive & (nxt == eos_id), 0, rem)
+            return (caches, nxt, new_pos, rem), nxt
         return (caches, nxt, pos + 1), nxt
 
     init = (caches, tok, jnp.asarray(pos, jnp.int32))
-    (caches, tok, pos), out = lax.scan(step, init, jax.random.split(key, steps))
+    if masked:
+        init = init + (jnp.asarray(budget, jnp.int32),)
+    carry, out = lax.scan(step, init, jax.random.split(key, steps))
+    caches, tok, pos = carry[0], carry[1], carry[2]
     return (out.T, caches, tok, pos) if return_state else out.T
 
 

@@ -268,7 +268,7 @@ def make_decode_attn_fn(
             f"{cfg.head_dim}, kv_tile={bs} (interpret={interpret})"
         )
 
-    def pool_form(q, ck, cv, tables, pos):
+    def pool_form(q, ck, cv, tables, pos, q_lens=None):
         if not paged:
             # Zero-copy re-view of the slotted arena as a pool: row
             # b·S + s IS lane b's position s, tables are the identity
@@ -286,11 +286,17 @@ def make_decode_attn_fn(
             ck = jax.tree.map(reshape, ck)
             cv = jax.tree.map(reshape, cv)
         return pallas_paged_decode_attention(
-            q, ck, cv, tables, pos, block_size=bs, paged_len=plen,
+            q, ck, cv, tables, pos, q_lens, block_size=bs, paged_len=plen,
             interpret=interpret,
         )
 
     if mesh is None or tp <= 1:
+        # Multi-token spans with per-lane query lengths (ISSUE 13) are
+        # supported on the unsharded wrapper only — the transformer's
+        # paged S > 1 branch checks this marker; the tp shard_map forms
+        # below keep their single-token signature (sharded spans take
+        # the gather path).
+        pool_form.multi_query = True
         return pool_form
 
     from ..compat.jaxapi import P, shard_map

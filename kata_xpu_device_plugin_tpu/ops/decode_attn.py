@@ -211,14 +211,16 @@ def supports_paged_decode(d: int, block_size: int,
 
 
 def _paged_decode_kernel(
-    pos_ref,  # scalar prefetch: [B] int32 — per-lane last valid position
+    pos_ref,  # scalar prefetch: [B] int32 — per-lane LAST query position
     tbl_ref,  # scalar prefetch: [B, NB] int32 — physical block tables
-    q_ref,  # [1, 1, G, D] block of [B, 1, H, D]
+    qlen_ref,  # scalar prefetch: [B] int32 — per-lane query lengths (≤ SQ)
+    q_ref,  # [1, SQ, G, D] block of [B, SQ, H, D]
     *refs,  # k, v (each payload [, scale]) blocks, o block, 3 scratches
     scale: float,
     block_k: int,
     grid_k: int,
     quantized: bool,
+    sq: int,
 ):
     if quantized:
         k_ref, ks_ref, v_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
@@ -227,6 +229,7 @@ def _paged_decode_kernel(
     b = pl.program_id(0)
     ki = pl.program_id(2)
     pos = pos_ref[b]
+    q_len = qlen_ref[b]
 
     @pl.when(ki == 0)
     def _init():
@@ -237,10 +240,12 @@ def _paged_decode_kernel(
     # Whole-split skip above the lane's causal frontier (the index maps
     # clamp the physical block at the frontier too, so skipped splits are
     # never DMA'd — per-lane decode traffic scales with pos[b], not the
-    # table width).
+    # table width). ``pos`` is the LAST query's position, so every
+    # earlier query's frontier is inside the skip bound.
     @pl.when(ki * block_k <= pos)
     def _compute():
-        q = q_ref[0, 0]  # [G, D] native dtype
+        G = q_ref.shape[2]
+        q = q_ref[0].reshape(sq * G, q_ref.shape[3])  # [SQ·G, D] native
         if quantized:
             # Fused int8 dequant: value-identical to quant.dequantize_kv
             # (int8→fp32, ·fp32 scale, cast to the activation dtype) but
@@ -254,9 +259,20 @@ def _paged_decode_kernel(
             v = v_ref[0, :, 0, :]
         logits = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [G, BK] fp32
+        ) * scale  # [SQ·G, BK] fp32
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-        logits = jnp.where(k_pos <= pos, logits, NEG_INF)
+        # Per-lane query lengths (ISSUE 13): queries are RIGHT-ALIGNED —
+        # row j (of SQ) sits at absolute position pos - (SQ-1) + j, and
+        # rows j < SQ - q_len are padding: every logit masks to the
+        # FINITE NEG_INF, so p = exp(0) = 1 across the row and finalize
+        # emits a harmless mean of V — garbage the caller never reads
+        # (bounded, no NaN/inf), NOT zeros. SQ == 1 with q_len == 1
+        # reduces to the original single-token mask (k_pos <= pos)
+        # bit-for-bit.
+        j = lax.broadcasted_iota(jnp.int32, logits.shape, 0) // G
+        q_pos = pos - (sq - 1) + j
+        mask = (k_pos <= q_pos) & (j >= sq - q_len)
+        logits = jnp.where(mask, logits, NEG_INF)
 
         # Split-K partial-softmax reduction: running max/denominator/
         # accumulator carried across splits in VMEM scratch (flash-decode
@@ -278,20 +294,24 @@ def _paged_decode_kernel(
 
     @pl.when(ki == grid_k - 1)
     def _finalize():
+        G = q_ref.shape[2]
         denom = l_scr[:, 0:1]
         denom = jnp.where(denom == 0.0, 1.0, denom)
-        o_ref[0, 0] = (acc_scr[...] / denom).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[...] / denom).reshape(
+            sq, G, acc_scr.shape[-1]
+        ).astype(o_ref.dtype)
 
 
 @functools.partial(
     jax.jit, static_argnames=("block_size", "paged_len", "interpret")
 )
 def pallas_paged_decode_attention(
-    q: jax.Array,  # [B, 1, H, D]
+    q: jax.Array,  # [B, SQ, H, D] (SQ == 1: the decode-scan step)
     k,  # [1, NT, KV, D] pool slice — jax.Array or int8 QTensor
     v,
     tables: jax.Array,  # [B, NB] int32 physical block ids (SCRATCH→ZERO'd)
-    pos: jax.Array,  # [B] int32: per-lane last valid position (ragged)
+    pos: jax.Array,  # [B] int32: per-lane LAST query position (ragged)
+    q_lens: "jax.Array | None" = None,  # [B] int32 per-lane query lengths
     *,
     block_size: int,
     paged_len: int,
@@ -306,17 +326,30 @@ def pallas_paged_decode_attention(
     regardless, which is the same bit-identity argument the gather path
     makes. Dead lanes (stale ``pos``) clamp their index maps into the
     table and produce garbage no caller reads — exactly the dense
-    contract."""
+    contract.
+
+    PER-LANE QUERY LENGTHS (ISSUE 13, the mixed-batch form): ``SQ > 1``
+    carries a multi-token span per lane — query row ``j`` sits at
+    absolute position ``pos[b] - (SQ-1) + j`` (right-aligned), and
+    ``q_lens[b] <= SQ`` marks how many trailing rows are real; the
+    leading pad rows are fully masked and emit bounded garbage (a mean
+    of V — finite, never NaN) that nothing reads. One
+    dispatch can therefore carry N decode lanes at ``q_len = 1``
+    alongside an admission lane running a chunk-wide slice. ``q_lens``
+    defaults to all-``SQ`` (every row real — the uniform span the
+    transformer's paged S > 1 branch passes); ``SQ == 1`` reduces
+    bit-for-bit to the single-token kernel."""
     quantized = isinstance(k, QTensor)
     B, Sq, H, D = q.shape
     kq = k.q if quantized else k
     NT, KV = kq.shape[1], kq.shape[2]
-    assert Sq == 1, "paged decode kernel is single-token"
     assert H % KV == 0, (H, KV)
     G = H // KV
     NB = tables.shape[1]
     bs = block_size
     assert NT % bs == 0, (NT, bs)
+    if q_lens is None:
+        q_lens = jnp.full((B,), Sq, jnp.int32)
     # Splits actually visible through the view (the gather path truncates
     # its view at paged_len; here the causal mask covers the tail of the
     # last partial block — see the bit-identity note above).
@@ -324,22 +357,23 @@ def pallas_paged_decode_attention(
     grid = (B, KV, grid_k)
     kernel = functools.partial(
         _paged_decode_kernel, scale=float(1.0 / (D**0.5)), block_k=bs,
-        grid_k=grid_k, quantized=quantized,
+        grid_k=grid_k, quantized=quantized, sq=Sq,
     )
 
-    def q_index(b, h, ki, pos_ref, tbl_ref):
-        del ki, pos_ref, tbl_ref
+    def q_index(b, h, ki, pos_ref, tbl_ref, qlen_ref):
+        del ki, pos_ref, tbl_ref, qlen_ref
         return (b, 0, h, 0)
 
-    def kv_index(b, h, ki, pos_ref, tbl_ref):
+    def kv_index(b, h, ki, pos_ref, tbl_ref, qlen_ref):
         # Clamp at the lane's causal frontier: splits past pos[b] map to
         # the frontier block, whose copy pallas elides (same index as the
         # previous grid step) — the unwritten tail is never fetched. The
         # second clamp bounds a dead lane's stale pos inside the table.
+        del qlen_ref
         blk = jnp.minimum(jnp.minimum(ki, pos_ref[b] // bs), NB - 1)
         return (0, tbl_ref[b, blk], h, 0)
 
-    in_specs = [pl.BlockSpec((1, 1, G, D), q_index)]
+    in_specs = [pl.BlockSpec((1, Sq, G, D), q_index)]
     operands = [q]
     for c in (k, v):
         in_specs.append(pl.BlockSpec((1, bs, 1, D), kv_index))
@@ -352,17 +386,17 @@ def pallas_paged_decode_attention(
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=grid,
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, 1, G, D), q_index),
+            out_specs=pl.BlockSpec((1, Sq, G, D), q_index),
             scratch_shapes=[
-                pltpu.VMEM((G, 128), jnp.float32),
-                pltpu.VMEM((G, 128), jnp.float32),
-                pltpu.VMEM((G, D), jnp.float32),
+                pltpu.VMEM((Sq * G, 128), jnp.float32),
+                pltpu.VMEM((Sq * G, 128), jnp.float32),
+                pltpu.VMEM((Sq * G, D), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, 1, H, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Sq, H, D), q.dtype),
         compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
@@ -370,6 +404,7 @@ def pallas_paged_decode_attention(
     )(
         jnp.asarray(pos, jnp.int32).reshape(B),
         jnp.asarray(tables, jnp.int32),
+        jnp.asarray(q_lens, jnp.int32).reshape(B),
         *operands,
     )
     return out

@@ -52,6 +52,7 @@ class TpuAllocator:
         sched_policy: str = "",
         prefill_chunk: int = 0,
         itl_slo_ms: float = 0.0,
+        decode_steps: int = 0,
         serving_tp: int = 0,
         serving_tp_min: int = 0,
         trace_context: bool = True,
@@ -94,6 +95,10 @@ class TpuAllocator:
         self._sched_policy = str(sched_policy)
         self._prefill_chunk = int(prefill_chunk)
         self._itl_slo_ms = float(itl_slo_ms)
+        # Multi-step decode multiplier (ISSUE 13, config.decode_steps):
+        # same delivery path — in-guest servers run chunk × K decode
+        # steps per dispatch when the caller passes nothing explicit.
+        self._decode_steps = int(decode_steps)
         # Tensor-parallel serving override (ISSUE 9, config.serving_tp):
         # same delivery path — in-guest servers mesh the granted slice by
         # default (guest/tp_serving.py derives the degree from
@@ -188,6 +193,8 @@ class TpuAllocator:
             resp.envs[C.ENV_PREFILL_CHUNK] = str(self._prefill_chunk)
         if self._itl_slo_ms > 0:
             resp.envs[C.ENV_ITL_SLO_MS] = str(self._itl_slo_ms)
+        if self._decode_steps > 1:
+            resp.envs[C.ENV_DECODE_STEPS] = str(self._decode_steps)
         if self._serving_tp_min > 0:
             resp.envs[C.ENV_SERVING_TP_MIN] = str(self._serving_tp_min)
         if self._serving_tp > 0:

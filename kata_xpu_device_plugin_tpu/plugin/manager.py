@@ -558,6 +558,7 @@ class PluginManager:
                 sched_policy=cfg.sched_policy,
                 prefill_chunk=cfg.prefill_chunk,
                 itl_slo_ms=cfg.itl_slo_ms,
+                decode_steps=cfg.decode_steps,
                 serving_tp=cfg.serving_tp,
                 serving_tp_min=cfg.serving_tp_min,
                 trace_context=cfg.trace_context,

@@ -122,7 +122,55 @@ def test_bench_trend_single_bank_is_not_a_failure(tmp_path, capsys):
 
     _bank(tmp_path, "20260101T000000Z", value=1.0)
     assert bench_trend.main(["--dir", str(tmp_path)]) == 0
-    assert "nothing to compare" in capsys.readouterr().err
+    assert "no trend yet" in capsys.readouterr().out
+
+
+def test_bench_trend_zero_banks_notes_and_exits_zero(tmp_path, capsys):
+    # ISSUE 13 satellite: an empty workspace degrades to the "no trend
+    # yet" note on stdout and exit 0 — the CI step must be non-blocking
+    # by CONTENT, not because continue-on-error masks a crash.
+    from tools import bench_trend
+
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+    assert "no trend yet" in capsys.readouterr().out
+
+
+def test_bench_trend_corrupt_bank_degrades(tmp_path, capsys):
+    # A truncated/corrupt newest bank (a half-written file from an
+    # interrupted bench round) is SKIPPED with a note, never a
+    # traceback: with only one readable bank left the tool prints the
+    # "no trend yet" note and exits 0; with two readable banks the
+    # corrupt one is simply not part of the comparison.
+    from tools import bench_trend
+
+    _bank(tmp_path, "20260101T000000Z", value=1.0)
+    (tmp_path / "BENCH_TPU_20260102T000000Z.json").write_text('{"value": 1.1')
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+    cap = capsys.readouterr()
+    assert "no trend yet" in cap.out
+    assert "skipping unreadable bank" in cap.err
+    # A non-dict bank (e.g. a JSONL list dumped by mistake) is the same
+    # degrade class.
+    (tmp_path / "BENCH_TPU_20260102T000000Z.json").write_text('[1, 2]')
+    _bank(tmp_path, "20260103T000000Z", value=1.5)
+    rc = bench_trend.main(["--dir", str(tmp_path)])
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "20260101" in cap.out and "20260103" in cap.out
+
+
+def test_bench_trend_fused_headline_present():
+    # The fused serving tok/s is part of the headline set (ISSUE 13):
+    # a >threshold drop must flag as a regression like the other
+    # throughput headlines.
+    from tools import bench_trend
+
+    assert "serving_fused_tok_per_s" in bench_trend.HEADLINE_METRICS
+    rows = bench_trend.compare(
+        {"serving_fused_tok_per_s": 100.0},
+        {"serving_fused_tok_per_s": 80.0},
+    )
+    assert rows[0]["status"] == "regression"
 
 
 def test_bench_trend_numeric_metrics_filter():

@@ -133,6 +133,27 @@ def test_allocate_cdi_cri(manager, kubelet):
         assert C.ENV_COMPILE_CACHE_DIR not in cresp.envs
 
 
+def test_tpu_allocator_injects_decode_steps_env(v5e8):
+    # config.decode_steps (ISSUE 13) rides the AllocateResponse env: the
+    # daemon's --decode-steps knob sets the in-guest multi-step decode
+    # multiplier node-wide; unset (or 1) injects nothing and the guest
+    # default (K=1) applies.
+    from kata_xpu_device_plugin_tpu.discovery import scan_tpus
+    from kata_xpu_device_plugin_tpu.plugin import TpuAllocator
+
+    inv = scan_tpus(v5e8.sysfs, v5e8.dev, env={})
+    bare = TpuAllocator(lambda: inv, "google.com", "tpu").allocate(["0"])
+    assert C.ENV_DECODE_STEPS not in bare.envs
+    one = TpuAllocator(
+        lambda: inv, "google.com", "tpu", decode_steps=1,
+    ).allocate(["0"])
+    assert C.ENV_DECODE_STEPS not in one.envs
+    wired = TpuAllocator(
+        lambda: inv, "google.com", "tpu", decode_steps=4,
+    ).allocate(["0"])
+    assert wired.envs[C.ENV_DECODE_STEPS] == "4"
+
+
 def test_tpu_allocator_injects_kv_quant_env(v5e8):
     # config.kv_quant (ISSUE 12) rides the AllocateResponse env: the
     # daemon's --kv-quant knob opts a node out of (or pins) the guest's

@@ -155,15 +155,56 @@ def test_slo_policy_per_token_normalization():
     s = SLOChunkedScheduler(chunk_tokens=8, slo_ms=5.0, decode_steps=16)
     assert s.note_round(0.032) is False  # 2 ms/token < 5 ms
     assert s.note_round(0.160) is True   # 10 ms/token > 5 ms
-    # The projection divides the same way: the stall (prefill + round)
-    # is amortized over the round's delivered tokens.
+    # The EWMA tracks PER-TOKEN cadence (ISSUE 13 satellite — the old
+    # code EWMA'd the raw round cadence and divided by a STATIC
+    # decode_steps at projection time, misprojecting the moment the
+    # delivered tokens-per-dispatch differ from the configured count).
+    assert s._tok_s == pytest.approx(0.002 + 0.3 * (0.010 - 0.002))
+    # The projection amortizes the prefill stall over the round's
+    # delivered tokens and adds the per-token cadence.
     s.note_prefill(1000, 0.1)  # 0.1 ms/token prefill rate
     proj = s.projected_itl_s(1600)
-    assert proj == pytest.approx((1600 * 0.0001 + s._round_s) / 16)
+    assert proj == pytest.approx(1600 * 0.0001 / 16 + s._tok_s)
     # And the deferral decision uses the normalized figure: 1600 tokens
     # project ~14 ms/token (defer), 16 tokens ~4.5 ms (admit).
     assert not s.directive(live_lanes=2, pending_tokens=1600).admit
     assert s.directive(live_lanes=2, pending_tokens=16).admit
+
+
+def test_note_round_tracks_actual_steps():
+    # ISSUE 13 satellite: note_round learns the ACTUAL tokens-per-
+    # dispatch — a fused or multi-step round passes its delivered count
+    # and both the violation check and the projection divisor follow it,
+    # not the configured default.
+    s = SLOChunkedScheduler(chunk_tokens=8, slo_ms=5.0, decode_steps=4)
+    assert s.note_round(0.032, steps=16) is False  # 2 ms/token at K×chunk
+    assert s._last_steps == 16
+    s.note_prefill(1000, 0.1)
+    assert s.projected_itl_s(1600) == pytest.approx(
+        1600 * 0.0001 / 16 + 0.002
+    )
+    # Fewer steps delivered → the same wall time violates.
+    assert s.note_round(0.032, steps=4) is True  # 8 ms/token > 5 ms
+    assert s._last_steps == 4
+
+
+def test_note_config_resets_estimates_on_regime_change():
+    # ISSUE 13 satellite: a changed decode_steps K or fused-plan flag
+    # invalidates the per-round timings — note_config drops the EWMAs so
+    # the first post-change round re-measures; an unchanged config keeps
+    # them.
+    s = SLOChunkedScheduler(chunk_tokens=8, slo_ms=5.0, decode_steps=4)
+    s.note_prefill(1000, 0.1)
+    s.note_round(0.02)
+    assert s._tok_s is not None
+    assert s.note_config(decode_steps=4, fused=False) is False
+    assert s._tok_s is not None  # unchanged config keeps estimates
+    assert s.note_config(decode_steps=8) is True
+    assert s._tok_s is None and s._prefill_s_per_tok is None
+    assert s._last_steps == 8
+    s.note_round(0.02)
+    assert s.note_config(fused=True) is True
+    assert s._tok_s is None
 
 
 def test_make_scheduler_rejects_unknown_policy():

@@ -47,6 +47,10 @@ HEADLINE_METRICS = (
     # bf16 and the int8-by-default configuration.
     "serving_decode_attn_tok_per_s",
     "serving_decode_attn_int8_tok_per_s",
+    # Fused prefill+decode + multi-step dispatch serving tok/s (ISSUE
+    # 13): the serving-vs-raw-decode-gap number the fused scheduler and
+    # decode_steps=K exist to move.
+    "serving_fused_tok_per_s",
 )
 
 DEFAULT_THRESHOLD = 0.10  # 10%
@@ -140,19 +144,37 @@ def main(argv: Optional[list[str]] = None) -> int:
                          "of the table")
     args = ap.parse_args(argv)
 
-    banks = find_banks(args.dir)
-    if len(banks) < 2:
+    # Degrade-to-a-note contract (ISSUE 13 satellite): a workspace with
+    # fewer than two READABLE banks — none at all, a single bank, or a
+    # newest bank that is truncated/corrupt JSON or not a dict (a
+    # half-written file from an interrupted bench round) — must print a
+    # "no trend yet" note and exit 0, never unwind with a traceback; the
+    # CI step should be non-blocking by CONTENT, not because
+    # continue-on-error masks a crash.
+    loaded: list[tuple[str, dict]] = []
+    for path in reversed(find_banks(args.dir)):  # newest first
+        try:
+            with open(path, encoding="utf-8") as fh:
+                bank = json.load(fh)
+            if not isinstance(bank, dict):
+                raise ValueError(f"bank is {type(bank).__name__}, not dict")
+        except (OSError, ValueError) as exc:
+            print(
+                f"bench-trend: skipping unreadable bank "
+                f"{os.path.basename(path)} ({exc})",
+                file=sys.stderr,
+            )
+            continue
+        loaded.append((path, bank))
+        if len(loaded) == 2:
+            break  # only the two newest readable banks compare
+    if len(loaded) < 2:
         print(
-            f"bench-trend: need two BENCH_TPU_*.json banks in "
-            f"{args.dir!r}, found {len(banks)} — nothing to compare",
-            file=sys.stderr,
+            f"bench-trend: no trend yet — need two readable "
+            f"BENCH_TPU_*.json banks in {args.dir!r}, found {len(loaded)}"
         )
         return 0  # an empty bank is not a failure
-    old_path, new_path = banks[-2], banks[-1]
-    with open(old_path, encoding="utf-8") as fh:
-        old = json.load(fh)
-    with open(new_path, encoding="utf-8") as fh:
-        new = json.load(fh)
+    (new_path, new), (old_path, old) = loaded[0], loaded[1]
     rows = compare(old, new, threshold=args.threshold)
     if args.json:
         print(json.dumps({

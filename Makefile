@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused eval eval-kv demo dryrun image clean deploy obs-check
+.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused kv-layout eval eval-kv demo dryrun image clean deploy obs-check
 
 all: build
 
@@ -101,6 +101,7 @@ bench-load:
 	KATA_TPU_BENCH_TRAIN=0 KATA_TPU_BENCH_PREFIX=0 KATA_TPU_BENCH_PAGED=0 \
 	KATA_TPU_BENCH_FAULTS=0 KATA_TPU_BENCH_SPEC=0 KATA_TPU_BENCH_TP=0 \
 	KATA_TPU_BENCH_DEGRADED=0 KATA_TPU_BENCH_OBS=0 KATA_TPU_BENCH_FUSED=0 \
+	KATA_TPU_BENCH_KV=0 \
 	  $(PY) bench.py --smoke
 
 # Bench-bank trend (ISSUE 11 satellite): compare the two newest
@@ -175,6 +176,24 @@ chaos:
 	KATA_TPU_FAULTS="decode_dispatch:4,sched_tick:3" KATA_TPU_FAULTS_SEED=13 \
 	KATA_TPU_DECODE_STEPS=2 KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_fused_decode.py -q
+	# KV layout chaos (ISSUE 14): pool_alloc faults land MID-DEMOTION —
+	# the pool_alloc seam fires inside the allocation pressure path that
+	# drives host-tier demotions — under the node-injected blocks layout,
+	# and fence faults interrupt rounds whose resume prefetch is staged;
+	# recovery must keep outputs bit-identical and none vanish under
+	# drain — both strict modes.
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_kv_events.jsonl \
+	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
+	KATA_TPU_FAULTS="pool_alloc:4,fence:6" KATA_TPU_FAULTS_SEED=13 \
+	KATA_TPU_KV_LAYOUT=blocks \
+	  $(PY) -m pytest tests/test_kv_layout.py -q
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_kv_events_strict.jsonl \
+	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
+	KATA_TPU_FAULTS="pool_alloc:4,fence:6" KATA_TPU_FAULTS_SEED=13 \
+	KATA_TPU_KV_LAYOUT=blocks KATA_TPU_STRICT=1 \
+	  $(PY) -m pytest tests/test_kv_layout.py -q
 
 # Tensor-parallel serving gate (ISSUE 9): the tp suite — topology-env →
 # guest-mesh round trip, the tp=N ≡ tp=1 greedy-identity matrix
@@ -205,6 +224,24 @@ decode-attn:
 	KATATPU_OBS=1 KATATPU_OBS_FILE=decode_attn_events_strict.jsonl \
 	KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_decode_attn_paged.py -q
+
+# KV layout + host-tier gate (ISSUE 14): the layout/offload suite on
+# the forced-8-device host — heads/blocks/tp=1 greedy bit-identity
+# across paged × int8/bf16 × overlap/lockstep × prefix-hit ×
+# preemption, the int8 spill/restore round-trip at tp>1, the
+# oversubscription matrix (demotion-before-preemption ordering, resume
+# prefetch racing the decode dispatch, degraded mesh shrink re-placing
+# a block-sharded pool), and the knob raise-vs-degrade contract — with
+# and without KATA_TPU_STRICT=1 (demotion D2H / prefetch H2D must ride
+# sanctioned allow_transfer paths only); obs JSONL artifacts uploaded.
+kv-layout:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=kv_layout_events.jsonl \
+	  $(PY) -m pytest tests/test_kv_layout.py -q
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=kv_layout_events_strict.jsonl \
+	KATA_TPU_STRICT=1 \
+	  $(PY) -m pytest tests/test_kv_layout.py -q
 
 # Fused scheduling & multi-step decode gate (ISSUE 13): the fused suite
 # on the forced-8-device host — the bit-identity matrix (fused vs

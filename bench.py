@@ -62,6 +62,12 @@ a fused-dispatch A/B (ISSUE 13: ``serving_fused_*`` — slo_chunked
 unfused K=1 baseline vs fused K∈{1,4} closed-loop tok/s plus ITL p99 at
 3× capacity over identical arrivals; ``serving_fused_tok_per_s`` joins
 the bench-trend headline set, ``KATA_TPU_BENCH_FUSED=0`` skips it),
+a KV layout + host-tier capacity A/B (ISSUE 14: ``serving_kv_*`` —
+heads-vs-blocks pool placement at forced tp on a GQA/MQA config where
+heads replicates, per-shard pool bytes + peak concurrent sessions +
+preemptions at the SAME per-chip budget, and host-RAM tier on/off under
+an idle-session zipfian resume workload; ``serving_kv_sessions`` joins
+the bench-trend headline set, ``KATA_TPU_BENCH_KV=0`` skips it),
 and a train-step MFU
 section — one Llama-3-style ~256M model, one optimizer step on a 1-device
 mesh, pallas-flash vs reference attention, reported against the chip's
@@ -296,6 +302,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
             env["KATA_TPU_BENCH_TRAIN"] = "0"
             env["KATA_TPU_BENCH_PREFIX"] = "0"
             env["KATA_TPU_BENCH_PAGED"] = "0"
+            env["KATA_TPU_BENCH_KV"] = "0"
             env["KATA_TPU_BENCH_DECODE_ATTN"] = "0"
             env["KATA_TPU_BENCH_FAULTS"] = "0"
             env["KATA_TPU_BENCH_LOAD"] = "0"
@@ -342,6 +349,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
         env["KATA_TPU_BENCH_TRAIN"] = "0"
         env["KATA_TPU_BENCH_PREFIX"] = "0"
         env["KATA_TPU_BENCH_PAGED"] = "0"
+        env["KATA_TPU_BENCH_KV"] = "0"
         env["KATA_TPU_BENCH_DECODE_ATTN"] = "0"
         env["KATA_TPU_BENCH_FAULTS"] = "0"
         env["KATA_TPU_BENCH_LOAD"] = "0"
@@ -1107,6 +1115,218 @@ def worker(args: argparse.Namespace) -> None:
             }
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"paged_error": f"{type(exc).__name__}: {exc}"[:200]}
+
+    def measure_kv_capacity() -> dict:  # lint: allow(JX004) srv.run()/step() return host numpy tokens each round — inherently fenced
+        # KV layout + host-tier capacity A/B (ISSUE 14). Two comparisons:
+        # (a) heads-vs-blocks pool layout at forced tp on a config whose
+        # KV head count does NOT divide the mesh (smoke-tiny has 2 KV
+        # heads, Gemma-2B is MQA — the heads layout REPLICATES the pool
+        # onto every chip, the kv_replicated cliff) at the SAME per-chip
+        # pool budget: the blocks pool is tp× the logical tokens for the
+        # same per-chip bytes, so it sustains ~tp× the concurrent
+        # sessions with fewer preemptions; (b) host-RAM tier on/off at
+        # fixed device pool bytes under an idle-session zipfian resume
+        # workload — with the tier, a resumed session's KV survives pool
+        # pressure in host RAM (demotion instead of eviction) and
+        # prefetches back on the hit. SIDE measurement with the usual
+        # protections: after the banked headline, crash-guarded,
+        # KATA_TPU_BENCH_KV=0 disables (the supervisor's retry kill
+        # switch).
+        if os.environ.get("KATA_TPU_BENCH_KV", "1") == "0":
+            return {}
+        try:
+            from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+
+            # The A/B's premise is that the HEADS layout replicates:
+            # pick the largest degree this host offers whose mesh the
+            # config's KV head count does NOT divide (smoke-tiny has 2
+            # KV heads → tp=8; Gemma-2B is MQA → any tp>1). A host
+            # where every feasible degree divides cannot show the cliff
+            # — skip honestly rather than bank an inverted comparison.
+            tp = min(8, jax.device_count())
+            while tp >= 2 and cfg.n_kv_heads % tp == 0:
+                tp -= 1
+            if tp < 2:
+                return {"kv_capacity_note":
+                        "no kv-replicating tp on this host — skipped"}
+            sess_prompt = min(16, PROMPT_LEN)
+            sess_new = 8
+            sess_len = sess_prompt + sess_new
+            rng = jax.random.PRNGKey(67)
+
+            def prompt(i, salt=0):
+                return np.asarray(jax.random.randint(
+                    jax.random.fold_in(rng, salt + i), (sess_prompt,), 0,
+                    cfg.vocab_size, dtype=jnp.int32,
+                ))
+
+            def drive(srv, rids):  # jaxguard: hot  # lint: allow(JX004) srv.step()/run() return host numpy tokens each round — inherently fenced
+                peak = 0
+                t0 = time.perf_counter()
+                while srv.step():
+                    peak = max(peak, srv.stats()["slots_busy"])
+                dt_s = time.perf_counter() - t0
+                results = srv.run()
+                total = sum(len(results[r]) for r in rids)
+                return total, dt_s, peak, srv.stats()
+
+            # -- (a) layout A/B at the same PER-CHIP pool budget --------
+            # heads replicates: per-chip bytes == the LOGICAL pool, so a
+            # per-chip budget of T tokens caps the heads pool at T while
+            # the blocks pool (per-chip ~logical/tp) holds T*tp.
+            budget_tokens = 3 * sess_len + 4 * 16
+            n_req = 2 * tp
+            lanes = min(n_req, 8)
+
+            def layout_server(layout, pool_tokens):
+                return GenerationServer(
+                    params, cfg, max_batch=lanes,
+                    max_len=sess_len + 16, chunk=4 if args.smoke else 8,
+                    prefill_buckets=(sess_prompt,),
+                    # Explicit args on BOTH sides: node-injected layout/
+                    # pool/host envs must not flip either config.
+                    kv_pool_tokens=pool_tokens, kv_block_size=8,
+                    kv_layout=layout, kv_host_tokens=0,
+                    prefix_cache_tokens=0, tp=tp,
+                )
+
+            def timed_layout(layout, pool_tokens, salt):  # jaxguard: hot
+                warm = layout_server(layout, pool_tokens)
+                for i in range(min(4, n_req)):
+                    warm.submit(prompt(i, salt=9000 + salt), sess_new)
+                warm.run()
+                srv = layout_server(layout, pool_tokens)
+                # Placement bytes read BEFORE traffic: decode donates the
+                # pool every round and XLA's output-sharding inference
+                # can drift a replicated pool off its placed spec — the
+                # configured placement is the honest per-chip figure.
+                placed = srv.stats()["arena_bytes"]
+                rids = [
+                    srv.submit(prompt(i, salt=salt), sess_new)
+                    for i in range(n_req)
+                ]
+                return drive(srv, rids) + (placed,)
+
+            h_tot, h_dt, h_peak, h_st, h_bytes = timed_layout(
+                "heads", budget_tokens, salt=0)
+            b_tot, b_dt, b_peak, b_st, b_bytes = timed_layout(
+                "blocks", budget_tokens * tp, salt=300)
+            # arena_bytes sums ADDRESSABLE shards: a replicated heads
+            # pool reports tp × logical, a block-sharded pool its
+            # logical bytes — per-chip is /tp either way.
+            h_shard = h_bytes // tp
+            b_shard = b_bytes // tp
+            out_kv = {
+                "serving_kv_layout": "blocks",
+                "serving_kv_tp": tp,
+                "serving_kv_heads_per_shard_bytes": h_shard,
+                "serving_kv_blocks_per_shard_bytes": b_shard,
+                # The replication overhead each layout pays per chip
+                # beyond logical/tp at ITS OWN pool size. Heads
+                # replicates (arena_bytes = tp × logical ⇒ per-chip =
+                # logical, extra = (tp-1)/tp of it). Blocks holds tp×
+                # the tokens at the same logical/tp-per-chip target —
+                # which IS the heads pool's per-chip figure (same bytes
+                # per token, tp× the tokens, /tp placement) — so its
+                # extra is MEASURED against that independent number: ~0
+                # when the layout truly shards, ~(tp−1)·h_shard if a
+                # regression ever made it replicate.
+                "serving_kv_heads_extra_bytes": (
+                    h_shard - h_shard // tp
+                ),
+                "serving_kv_blocks_extra_bytes": (
+                    b_shard - h_shard
+                ),
+                "serving_kv_heads_tok_per_s": round(h_tot / h_dt, 1),
+                "serving_kv_blocks_tok_per_s": round(b_tot / b_dt, 1),
+                "serving_kv_sessions": b_peak,
+                "serving_kv_sessions_heads": h_peak,
+                "serving_kv_heads_preemptions": h_st["preemptions"],
+                "serving_kv_blocks_preemptions": b_st["preemptions"],
+            }
+
+            # -- (b) host tier on/off at fixed device pool bytes --------
+            # Idle-session resume workload: every session runs turn 1,
+            # then a zipfian-ordered resume stream replays extended
+            # prompts — a resume whose turn-1 KV is still reachable
+            # (device OR host tier) hits the prefix store; without the
+            # tier, pool pressure EVICTED it and the session re-prefills
+            # cold. "Sessions sustained" = sessions whose resume hit.
+            n_sess = 6
+            fixed_pool = 8 * (2 * (sess_prompt // 8 + 2) + 6)
+            zipf = [0, 1, 0, 2, 0, 1, 3, 0, 4, 1, 5, 2]
+
+            def session_server(host_tokens, pool_tokens=None):
+                return GenerationServer(
+                    params, cfg, max_batch=2,
+                    max_len=2 * sess_len + 16, chunk=4,
+                    prefill_buckets=(sess_prompt, 2 * sess_prompt),
+                    kv_pool_tokens=pool_tokens or fixed_pool,
+                    kv_block_size=8, kv_layout="heads",
+                    kv_host_tokens=host_tokens,
+                    prefix_cache_tokens=1, tp=1,
+                )
+
+            def _timed_sessions_once(host_tokens, salt, pool_tokens=None):  # jaxguard: hot  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
+                srv = session_server(host_tokens, pool_tokens)
+                firsts = {}
+                for i in range(n_sess):
+                    r = srv.submit(prompt(i, salt=salt), sess_new)
+                    firsts[i] = np.concatenate([
+                        prompt(i, salt=salt), srv.run()[r]
+                    ]).astype(np.int32)
+                hits0 = srv.stats()["prefix_hits"]
+                sustained = set()
+                t0 = time.perf_counter()
+                total = 0
+                for j in zipf:
+                    before = srv.stats()["prefix_hits"]
+                    r = srv.submit(firsts[j], sess_new)
+                    total += len(srv.run()[r])
+                    if srv.stats()["prefix_hits"] > before:
+                        sustained.add(j)
+                dt_s = time.perf_counter() - t0
+                st = srv.stats()
+                return (len(sustained), total / dt_s, st,
+                        st["prefix_hits"] - hits0)
+
+            def timed_sessions(host_tokens, salt, pool_tokens=None):
+                # Best of 2: the first run of each (pool size, tier)
+                # variant pays that shape family's compiles (pool ops
+                # key on NT; the tier adds demote/prefetch executables)
+                # — the second is warm by construction, so ordering
+                # between variants cannot bias the A/B.
+                a = _timed_sessions_once(host_tokens, salt, pool_tokens)
+                b = _timed_sessions_once(host_tokens, salt, pool_tokens)
+                return b if b[1] > a[1] else a
+
+            h_sess, h_tok, host_st, h_hits = timed_sessions(
+                64 * sess_len, salt=600)
+            n_sessions, n_tok, nohost_st, n_hits = timed_sessions(
+                0, salt=600)
+            # No-pressure control: a pool that holds everything — the
+            # tier must cost nothing when it never engages.
+            _, idle_on, _, _ = timed_sessions(
+                64 * sess_len, salt=900, pool_tokens=64 * sess_len)
+            _, idle_off, _, _ = timed_sessions(
+                0, salt=900, pool_tokens=64 * sess_len)
+            out_kv.update({
+                "serving_kv_host_sessions": h_sess,
+                "serving_kv_nohost_sessions": n_sessions,
+                "serving_kv_host_resume_hits": h_hits,
+                "serving_kv_nohost_resume_hits": n_hits,
+                "serving_kv_host_tok_per_s": round(h_tok, 1),
+                "serving_kv_nohost_tok_per_s": round(n_tok, 1),
+                "serving_kv_host_demotions": host_st["kv_demotions"],
+                "serving_kv_host_prefetches": host_st["kv_prefetches"],
+                "serving_kv_host_preemptions": host_st["preemptions"],
+                "serving_kv_nohost_preemptions": nohost_st["preemptions"],
+                "serving_kv_host_idle_ratio": round(
+                    idle_on / idle_off, 3) if idle_off else 0.0,
+            })
+            return out_kv
+        except Exception as exc:  # noqa: BLE001 — headline must survive
+            return {"kv_capacity_error": f"{type(exc).__name__}: {exc}"[:200]}
 
     def measure_decode_attn() -> dict:  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
         # Paged-native decode-attention kernel A/B (ISSUE 12): the same
@@ -2190,6 +2410,10 @@ def worker(args: argparse.Namespace) -> None:
     paged_out = measure_paged()
     if paged_out:
         out.update(paged_out)
+        print(json.dumps(out), flush=True)
+    kv_out = measure_kv_capacity()
+    if kv_out:
+        out.update(kv_out)
         print(json.dumps(out), flush=True)
     decode_attn_out = measure_decode_attn()
     if decode_attn_out:

@@ -80,6 +80,24 @@ ENV_KV_POOL_TOKENS = "KATA_TPU_KV_POOL_TOKENS"
 # argument always wins.
 ENV_KV_QUANT = "KATA_TPU_KV_QUANT"
 
+# Paged-pool placement layout handed to the guest (ISSUE 14):
+# guest.serving.GenerationServer reads this when the caller passes no
+# explicit kv_layout — "blocks" shards the paged pool by physical BLOCKS
+# across the serving mesh (per-chip pool bytes ~logical/tp for every
+# model, GQA included; no kv_replicated cliff), "heads" pins the legacy
+# divide-or-replicate head-axis sharding. Malformed values degrade
+# in-guest with a kv_layout_invalid event; a slotted server degrades the
+# injected default with kv_layout_disabled.
+ENV_KV_LAYOUT = "KATA_TPU_KV_LAYOUT"
+
+# Host-RAM KV offload tier capacity handed to the guest (ISSUE 14):
+# when > 0, in-guest paged servers park cold KV — unpinned prefix
+# segments under pool pressure, preempted idle sessions — in host RAM
+# (LRU demotion BEFORE youngest-first preemption) and prefetch it back
+# asynchronously on prefix hit / session resume. Malformed values
+# degrade in-guest with a kv_host_invalid event.
+ENV_KV_HOST_TOKENS = "KATA_TPU_KV_HOST_TOKENS"
+
 # Recovery-checkpoint cadence handed to the guest (ISSUE 7):
 # guest.serving.GenerationServer snapshots live-lane KV to host every N
 # rounds when the caller passes no checkpoint_rounds, so the daemon's

@@ -114,6 +114,30 @@ class Config:
     # default (fixed slots unless the server opts in via kv_pool_tokens=).
     kv_pool_tokens: int = 0
 
+    # Paged-pool placement layout (ISSUE 14): when set ("heads" |
+    # "blocks"), the daemon injects KATA_TPU_KV_LAYOUT into every TPU
+    # AllocateResponse so in-guest paged GenerationServers place their
+    # block pool accordingly — "blocks" shards the pool by physical
+    # blocks across the serving mesh (per-chip pool bytes ~logical/tp
+    # for every model, GQA included; the kv_replicated replication cliff
+    # does not exist), "heads" pins the legacy divide-or-replicate
+    # head-axis sharding. Same delivery path as the other serving knobs;
+    # malformed guest-side values degrade with a kv_layout_invalid
+    # event, slotted servers with kv_layout_disabled. Empty leaves the
+    # guest default (heads).
+    kv_layout: str = ""
+
+    # Host-RAM KV offload tier (ISSUE 14): when > 0, the daemon injects
+    # KATA_TPU_KV_HOST_TOKENS so in-guest paged servers park cold KV
+    # (unpinned prefix segments under pool pressure, preempted idle
+    # sessions) in up to this many tokens of host RAM — LRU demotion
+    # runs BEFORE youngest-first preemption, and prefix hits / session
+    # resumes prefetch the rows back with the H2D upload overlapping the
+    # in-flight decode dispatch. Same delivery path; malformed values
+    # degrade in-guest with a kv_host_invalid event. 0 leaves the tier
+    # off.
+    kv_host_tokens: int = 0
+
     # KV-cache quantization default (ISSUE 12): when set ("int8" |
     # "bf16"), the daemon injects KATA_TPU_KV_QUANT into every TPU
     # AllocateResponse so in-guest GenerationServers resolve their KV
@@ -227,6 +251,14 @@ class Config:
         if self.kv_quant not in ("", "int8", "bf16"):
             raise ValueError(
                 f"kv-quant must be int8 or bf16, got {self.kv_quant!r}"
+            )
+        if self.kv_layout not in ("", "heads", "blocks"):
+            raise ValueError(
+                f"kv-layout must be heads or blocks, got {self.kv_layout!r}"
+            )
+        if self.kv_host_tokens < 0:
+            raise ValueError(
+                f"kv-host-tokens must be >= 0, got {self.kv_host_tokens}"
             )
         if self.prefill_chunk < 0:
             raise ValueError(

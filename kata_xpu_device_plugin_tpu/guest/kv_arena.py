@@ -217,23 +217,45 @@ class KVPool:
     still serves correctly — the server's backend resolution falls back
     to the XLA gather path with an ``unsupported_shape`` reason on its
     ``decode_attn_backend`` event — it just forfeits the kernel.
+
+    **Block-sharded placement (ISSUE 14).** Under the ``blocks`` pool
+    layout (``shards = tp``) the pool's TOKEN axis shards across the
+    serving mesh: ``num_blocks`` rounds down to a multiple of ``shards``
+    so every physical block lives WHOLE on exactly one shard —
+    ``shard_of(t) = t // shard_blocks``, local id ``t % shard_blocks``
+    (the ``lane → (shard, physical block)`` mapping the block table
+    implies). The free list splits per shard and :meth:`try_alloc`
+    draws from the emptiest shards first, keeping per-shard occupancy
+    balanced; both reserved blocks (ZERO, SCRATCH) land on shard 0.
+    Per-chip pool bytes are ``~logical/shards`` for EVERY model — the
+    GQA divide-or-replicate cliff of the ``heads`` layout does not
+    exist here. ``shards=1`` (the default, and every ``heads``-layout
+    pool) is the historical single-free-list behavior unchanged.
     """
 
     def __init__(self, cfg: DecoderConfig, pool_tokens: int,
                  block_size: int = 16, *, kv_quant: bool = False,
-                 dtype=None, label: str = "") -> None:
+                 dtype=None, label: str = "", shards: int = 1) -> None:
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
         num_blocks = int(pool_tokens) // int(block_size)
+        # Whole blocks per shard: the token axis must divide the mesh so
+        # every physical block is shard-local (the kernel's shard-local
+        # DMA form and the table's shard mapping both rest on this).
+        num_blocks = (num_blocks // shards) * shards
         if num_blocks - RESERVED_BLOCKS < 1:
             raise ValueError(
                 f"pool_tokens={pool_tokens} holds {num_blocks} blocks of "
-                f"{block_size} — need at least {RESERVED_BLOCKS + 1} "
-                "(two reserved + one usable)"
+                f"{block_size} across {shards} shard(s) — need at least "
+                f"{RESERVED_BLOCKS + 1} (two reserved + one usable)"
             )
         self.cfg = cfg
         self.block_size = int(block_size)
         self.num_blocks = num_blocks
+        self.shards = int(shards)
+        self.shard_blocks = num_blocks // self.shards
         self.kv_quant = bool(kv_quant)
         self.dtype = dtype or cfg.dtype
         self.label = label
@@ -241,7 +263,14 @@ class KVPool:
             cfg, 1, num_blocks * self.block_size, dtype=self.dtype,
             quantized=kv_quant,
         )
-        self._free: deque[int] = deque(range(RESERVED_BLOCKS, num_blocks))
+        self._free: list[deque[int]] = [
+            deque(
+                b for b in range(s * self.shard_blocks,
+                                 (s + 1) * self.shard_blocks)
+                if b >= RESERVED_BLOCKS
+            )
+            for s in range(self.shards)
+        ]
         self._refs = np.zeros(num_blocks, np.int64)
 
     # -- block accounting ----------------------------------------------------
@@ -253,11 +282,11 @@ class KVPool:
 
     @property
     def blocks_free(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free)
 
     @property
     def blocks_in_use(self) -> int:
-        return self.blocks_total - len(self._free)
+        return self.blocks_total - self.blocks_free
 
     @property
     def capacity_tokens(self) -> int:
@@ -266,14 +295,37 @@ class KVPool:
     def occupancy(self) -> float:
         return round(self.blocks_in_use / max(1, self.blocks_total), 4)
 
+    def shard_of(self, block: int) -> int:
+        """Which mesh shard physically holds ``block`` (always 0 on an
+        unsharded pool)."""
+        return block // self.shard_blocks
+
+    def shard_occupancy(self) -> list[float]:
+        """Per-shard fill: blocks in use over each shard's usable blocks
+        (shard 0 carries the two reserved blocks, so its usable count is
+        smaller). Length ``shards``."""
+        out = []
+        for s, free in enumerate(self._free):
+            usable = self.shard_blocks - (RESERVED_BLOCKS if s == 0 else 0)
+            out.append(
+                round((usable - len(free)) / max(1, usable), 4)
+            )
+        return out
+
     def try_alloc(self, n: int) -> Optional[list[int]]:
         """``n`` blocks at refcount 1, or None (all-or-nothing — a partial
-        grant would deadlock two growing lanes against each other)."""
+        grant would deadlock two growing lanes against each other). On a
+        sharded pool, blocks come from the emptiest shards first so the
+        per-shard sub-pools fill evenly (a lane's table freely mixes
+        shards — the decode kernel's merge recombines them)."""
         if n < 0:
             raise ValueError(f"try_alloc({n})")
-        if len(self._free) < n:
+        if self.blocks_free < n:
             return None
-        out = [self._free.popleft() for _ in range(n)]
+        out: list[int] = []
+        for _ in range(n):
+            free = max(self._free, key=len)
+            out.append(free.popleft())
         self._refs[out] += 1
         return out
 
@@ -285,14 +337,14 @@ class KVPool:
             self._refs[b] += 1
 
     def unref(self, blocks) -> None:
-        """Drop one holder per block; blocks at refcount 0 return to the
-        free list."""
+        """Drop one holder per block; blocks at refcount 0 return to their
+        shard's free list."""
         for b in blocks:
             assert b >= RESERVED_BLOCKS, f"unref of reserved block {b}"
             self._refs[b] -= 1
             assert self._refs[b] >= 0, f"block {b} over-released"
             if self._refs[b] == 0:
-                self._free.append(b)
+                self._free[self.shard_of(b)].append(b)
 
     def stats(self) -> dict:
         return {
@@ -302,24 +354,146 @@ class KVPool:
             "blocks_free": self.blocks_free,
             "capacity_tokens": self.capacity_tokens,
             "occupancy": self.occupancy(),
+            "shards": self.shards,
+            "shard_occupancy": self.shard_occupancy(),
+        }
+
+
+# ----- the host-RAM offload tier (ISSUE 14) ---------------------------------
+
+
+@dataclass
+class _HostEntry:
+    """One host-resident KV parcel: ``rows`` is the spilled pytree (None
+    for accounting-only entries whose payload lives elsewhere — the
+    preempted-session spills the serving loop already holds), ``tokens``
+    its length, ``pinned`` marks in-flight session state that must not
+    LRU out (and is allowed to overflow the capacity — correctness
+    outranks the budget; the budget bounds the *cache* tier)."""
+
+    tokens: int
+    rows: Any = None
+    tick: int = 0
+    pinned: bool = False
+
+
+class HostKVTier:
+    """Bounded host-RAM store below the device KV pool (ISSUE 14,
+    ROADMAP item 5b): cold KV — demoted prefix segments, preempted idle
+    sessions' spills — parks here instead of occupying HBM, and rides
+    the proven spill/restore upload path back on access. This class is
+    the ACCOUNTING + payload store only; placement policy (what demotes,
+    when to prefetch) lives with its clients
+    (:class:`PagedPrefixTier` demotion/promotion,
+    ``serving.GenerationServer`` preemption spills), so the tier itself
+    never touches the device.
+
+    ``capacity_tokens`` bounds the unpinned (cache) population; callers
+    make room via :meth:`room` before :meth:`put` and evict their own
+    LRU entries (they own the index state a drop must also clean up —
+    radix nodes for prefix segments)."""
+
+    def __init__(self, capacity_tokens: int, block_size: int,
+                 *, label: str = "") -> None:
+        if capacity_tokens < 1:
+            raise ValueError(
+                f"host tier capacity must be >= 1 token, got "
+                f"{capacity_tokens}"
+            )
+        self.capacity_tokens = int(capacity_tokens)
+        self.block_size = int(block_size)
+        self.label = label
+        self._entries: dict[Any, _HostEntry] = {}
+        self._tick = 0
+
+    def _next_tick(self) -> int:
+        self._tick += 1
+        return self._tick
+
+    def room(self, tokens: int) -> bool:
+        return self.tokens_used + int(tokens) <= self.capacity_tokens
+
+    def put(self, key, tokens: int, rows: Any = None, *,
+            pinned: bool = False) -> bool:
+        """Store (or re-account) one parcel. Unpinned puts respect the
+        capacity (False = no room — the caller evicts its own LRU first
+        or falls back to dropping); pinned puts always land."""
+        if not pinned and not self.room(tokens):
+            return False
+        self._entries[key] = _HostEntry(
+            tokens=int(tokens), rows=rows, tick=self._next_tick(),
+            pinned=pinned,
+        )
+        return True
+
+    def get(self, key) -> Optional[_HostEntry]:
+        entry = self._entries.get(key)
+        if entry is not None:
+            entry.tick = self._next_tick()
+        return entry
+
+    def pop(self, key) -> Optional[_HostEntry]:
+        return self._entries.pop(key, None)
+
+    def drop_unpinned(self) -> int:
+        """Drop every unpinned entry (a prefix-tier rebuild orphans its
+        demoted segments — their radix index died with the tier). Pinned
+        session spills survive. Returns the count dropped."""
+        dead = [k for k, e in self._entries.items() if not e.pinned]
+        for k in dead:
+            del self._entries[k]
+        return len(dead)
+
+    def lru_unpinned(self) -> Optional[Any]:
+        """The least-recently-used unpinned key (the caller's eviction
+        candidate), or None."""
+        victims = [
+            (e.tick, k) for k, e in self._entries.items() if not e.pinned
+        ]
+        return min(victims)[1] if victims else None
+
+    @property
+    def tokens_used(self) -> int:
+        return sum(e.tokens for e in self._entries.values())
+
+    @property
+    def blocks_used(self) -> int:
+        return sum(
+            -(-e.tokens // self.block_size) for e in self._entries.values()
+        )
+
+    @property
+    def entries(self) -> int:
+        return len(self._entries)
+
+    def stats(self) -> dict:
+        return {
+            "capacity_tokens": self.capacity_tokens,
+            "tokens_used": self.tokens_used,
+            "blocks_used": self.blocks_used,
+            "entries": self.entries,
         }
 
 
 # ----- the shared-prefix tier ----------------------------------------------
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: segments key the host tier
 class _TierSegment:
     """One cached prefix: rows ``[0, length)`` live in ``blocks`` (the
     last block may be partially covered). ``refs`` counts in-flight hit
     pins; ``tick`` is the LRU clock; ``nodes`` are the radix entries (one
-    per bucket boundary) pointing here."""
+    per bucket boundary) pointing here. ``host=True`` marks a segment
+    DEMOTED to the host-RAM tier (ISSUE 14): its rows live in the
+    :class:`HostKVTier`, ``blocks`` is empty, the radix entries stay so
+    a later hit can prefetch it back."""
 
     blocks: list
     length: int
     refs: int = 0
     tick: int = 0
     nodes: list = field(default_factory=list)
+    host: bool = False
 
 
 @dataclass(frozen=True)
@@ -349,10 +523,25 @@ class PagedPrefixTier:
     :meth:`evict_one` for the pool's allocation pressure path. Inserts
     copy rows into tier-owned blocks (one jitted D2D scatter, exactly like
     the standalone store) and SKIP under pool pressure rather than evict
-    live decode state — decode always outranks the cache."""
+    live decode state — decode always outranks the cache.
+
+    With a :class:`HostKVTier` attached (ISSUE 14), pool pressure
+    DEMOTES the LRU unpinned segment to host RAM instead of dropping it
+    (one D2D block gather + one sanctioned D2H copy — the PR 6 spill
+    machinery; its radix entries survive), and a later hit on a demoted
+    segment PREFETCHES it back: pool blocks allocate, the H2D upload
+    starts asynchronously during admission — overlapping the in-flight
+    decode dispatch under pipelined rounds — and the restore scatter
+    re-lands the rows verbatim, so greedy outputs are bit-identical to
+    a never-demoted run. Demotion always runs BEFORE the serving loop
+    resorts to youngest-first preemption (``_alloc_blocks`` drains this
+    tier first), converting "evict the cache" into "park it in a larger,
+    slower tier"."""
 
     def __init__(self, pool: KVPool, cfg: DecoderConfig, buckets: tuple,
-                 *, label: str = "") -> None:
+                 *, label: str = "",
+                 host_tier: Optional[HostKVTier] = None,
+                 on_demote=None, on_prefetch=None) -> None:
         buckets = tuple(sorted(buckets))
         if not buckets:
             raise ValueError(
@@ -364,6 +553,12 @@ class PagedPrefixTier:
         self.kv_quant = pool.kv_quant
         self.dtype = pool.dtype
         self.label = label
+        self.host_tier = host_tier
+        # Counter hooks (the server's kv_demotions_total /
+        # kv_prefetches_total prometheus children — bound per label, so
+        # the tier cannot resolve them itself).
+        self._on_demote = on_demote
+        self._on_prefetch = on_prefetch
         self._index = RadixIndex()
         self._segments: list[_TierSegment] = []
         self._tick = 0
@@ -375,6 +570,10 @@ class PagedPrefixTier:
         self.evictions = 0
         self.inserts = 0
         self.insert_skips = 0
+        self.demotions = 0
+        self.prefetches = 0
+        self.host_evictions = 0
+        self.prefetch_stalls = 0
 
     # -- host-side index operations -----------------------------------------
 
@@ -385,10 +584,18 @@ class PagedPrefixTier:
     def lookup(self, prompt: np.ndarray) -> Optional[TierHit]:
         """Longest bucket-aligned cached prefix of ``prompt``, pinned
         (same contract as ``PrefixStore.lookup``: capped at
-        ``len(prompt) - 1`` so at least one suffix token remains)."""
+        ``len(prompt) - 1`` so at least one suffix token remains). A hit
+        on a HOST-resident (demoted) segment prefetches it back into
+        pool blocks first — when the pool cannot hold it right now the
+        lookup degrades to a miss (the segment stays parked; cold
+        admission is always correct)."""
         prompt = np.asarray(prompt)
         depth, seg = self._index.longest_match(prompt[: len(prompt) - 1])
         if seg is None:
+            self.misses += 1
+            return None
+        if seg.host and not self._promote(seg):
+            self.prefetch_stalls += 1
             self.misses += 1
             return None
         seg.refs += 1
@@ -476,14 +683,20 @@ class PagedPrefixTier:
             seg.nodes.append(self._index.insert(prompt[:b], seg))
 
     def evict_one(self) -> bool:
-        """Drop the least-recently-used UNREFERENCED segment, returning
-        its pool refs (blocks recycle once any lane tables sharing them
-        finish). False when every segment is pinned by an in-flight
-        hit."""
-        victims = [s for s in self._segments if s.refs == 0]
+        """Relieve pool pressure by one segment: with a host tier
+        attached, DEMOTE the least-recently-used unreferenced
+        device-resident segment to host RAM (data survives — a later hit
+        prefetches it back); without one — or when the host budget
+        cannot absorb it even after dropping ITS least-recent entries —
+        drop the segment outright. False when every device-resident
+        segment is pinned by an in-flight hit (the caller falls through
+        to preemption — demotion-before-preemption by construction)."""
+        victims = [s for s in self._segments if s.refs == 0 and not s.host]
         if not victims:
             return False
         seg = min(victims, key=lambda s: s.tick)
+        if self.host_tier is not None and self._demote(seg):
+            return True
         for node in seg.nodes:
             self._index.remove(node)
         self.pool.unref(seg.blocks)
@@ -493,6 +706,119 @@ class PagedPrefixTier:
             "serving", "prefix_evict",
             store=self.label, tokens=seg.length, blocks=len(seg.blocks),
             segments_left=len(self._segments), tier="kv_pool",
+        )
+        return True
+
+    # -- host-RAM offload (ISSUE 14) -----------------------------------------
+
+    def _demote(self, seg: _TierSegment) -> bool:
+        """Park ``seg`` in the host tier: make room there (dropping ITS
+        LRU host-resident segments first), gather the segment's block
+        rows device-side, copy them down through the sanctioned
+        spill path, and free the pool blocks. The radix entries stay —
+        the segment is still indexed, just one tier colder."""
+        from ..compat import jaxapi
+
+        while not self.host_tier.room(seg.length):
+            if not self._evict_host_one():
+                return False  # budget cannot absorb it: caller drops
+        nb = len(seg.blocks)
+        with jaxapi.allow_transfer(
+                "kv host tier demotion (D2H spill of cold prefix blocks)"):
+            rows = jax.tree.map(
+                np.asarray,  # jaxguard: allow(JG101) demotion spill — sanctioned slow-path sync under pool pressure (guarded by allow_transfer)
+                pool_gather_rows(
+                    self.pool.arena,
+                    jnp.asarray(np.asarray(seg.blocks, np.int32)),
+                    block_size=self.pool.block_size,
+                ),
+            )
+        self.host_tier.put(seg, seg.length, rows=rows)
+        self.pool.unref(seg.blocks)
+        seg.blocks = []
+        seg.host = True
+        seg.tick = self._next_tick()
+        self.demotions += 1
+        if self._on_demote is not None:
+            self._on_demote()
+        obs.emit(
+            "serving", "kv_demote",
+            store=self.label, tokens=seg.length, blocks=nb,
+            host_tokens=self.host_tier.tokens_used,
+            host_entries=self.host_tier.entries,
+        )
+        return True
+
+    def _promote(self, seg: _TierSegment) -> bool:
+        """Prefetch a demoted segment back into pool blocks: allocate
+        (draining colder tier state under pressure), start the H2D
+        upload — asynchronous, so under pipelined serving it overlaps
+        the decode dispatch already in flight — and re-land the rows
+        verbatim with the standard restore scatter. False when the pool
+        cannot hold it right now (the segment stays parked)."""
+        from ..compat import jaxapi
+
+        entry = self.host_tier.get(seg)
+        if entry is None or entry.rows is None:
+            # Inconsistent (host flag without a host entry): drop the
+            # segment from the index — a miss, never a crash.
+            for node in seg.nodes:
+                self._index.remove(node)
+            if seg in self._segments:
+                self._segments.remove(seg)
+            return False
+        bs = self.pool.block_size
+        nb = -(-seg.length // bs)
+        # Pin the promotion target for the duration: the allocation
+        # pressure loop below can DEMOTE other segments, and the room-
+        # making host eviction inside that demotion must not select the
+        # very entry being promoted (it is unpinned and LRU-cold).
+        entry.pinned = True
+        try:
+            blocks = self.pool.try_alloc(nb)
+            while blocks is None:
+                if not self.evict_one():
+                    return False
+                blocks = self.pool.try_alloc(nb)
+        finally:
+            entry.pinned = False
+        self.host_tier.pop(seg)
+        with jaxapi.allow_transfer(
+                "kv host tier prefetch (H2D upload of a demoted prefix)"):
+            rows = jax.tree.map(jnp.asarray, entry.rows)
+            self.pool.arena = pool_scatter_rows(
+                self.pool.arena, rows,
+                jnp.asarray(np.asarray(blocks, np.int32)), block_size=bs,
+            )
+        seg.blocks = blocks
+        seg.host = False
+        seg.tick = self._next_tick()
+        self.prefetches += 1
+        if self._on_prefetch is not None:
+            self._on_prefetch()
+        obs.emit(
+            "serving", "kv_prefetch",
+            store=self.label, tokens=seg.length, blocks=nb,
+            host_tokens=self.host_tier.tokens_used,
+        )
+        return True
+
+    def _evict_host_one(self) -> bool:
+        """Drop the host tier's LRU unpinned entry THAT IS OURS (a
+        demoted segment — the serving loop's pinned session spills never
+        LRU out), removing its radix entries with it."""
+        key = self.host_tier.lru_unpinned()
+        if not isinstance(key, _TierSegment):
+            return False
+        self.host_tier.pop(key)
+        for node in key.nodes:
+            self._index.remove(node)
+        self._segments.remove(key)
+        self.host_evictions += 1
+        obs.emit(
+            "serving", "prefix_evict",
+            store=self.label, tokens=key.length, blocks=0,
+            segments_left=len(self._segments), tier="kv_host",
         )
         return True
 
@@ -515,12 +841,15 @@ class PagedPrefixTier:
 
     @property
     def tokens_used(self) -> int:
-        return sum(s.length for s in self._segments)
+        """DEVICE-resident tier tokens (host-demoted segments park their
+        rows in the host tier's own accounting, not the pool's)."""
+        return sum(s.length for s in self._segments if not s.host)
 
     @property
     def blocks_used(self) -> int:
         """Pool blocks the tier's segments hold a reference on (some may
-        also be shared into lane tables)."""
+        also be shared into lane tables; host-demoted segments hold
+        none)."""
         return sum(len(s.blocks) for s in self._segments)
 
     def occupancy(self) -> float:
@@ -541,4 +870,9 @@ class PagedPrefixTier:
             "inserts": self.inserts,
             "insert_skips": self.insert_skips,
             "evictions": self.evictions,
+            "demotions": self.demotions,
+            "prefetches": self.prefetches,
+            "host_evictions": self.host_evictions,
+            "prefetch_stalls": self.prefetch_stalls,
+            "host_segments": sum(1 for s in self._segments if s.host),
         }

@@ -137,6 +137,7 @@ from . import resilience, tp_serving
 from .kv_arena import (
     RESERVED_BLOCKS,
     SCRATCH_BLOCK,
+    HostKVTier,
     KVPool,
     PagedPrefixTier,
     pool_gather_rows,
@@ -144,6 +145,7 @@ from .kv_arena import (
     pool_write_batch,
     pool_write_seq,
 )
+from .tp_serving import KV_LAYOUT_BLOCKS, KV_LAYOUT_HEADS, KV_LAYOUTS
 from .prefix_cache import PrefixHit, PrefixStore
 from .resilience import DeviceStallError, FaultInjector
 from .scheduler import (
@@ -212,6 +214,26 @@ ENV_DECODE_STEPS = "KATA_TPU_DECODE_STEPS"
 # ``fused_disabled`` event, and an explicit ``fused=True`` on a server
 # whose policy cannot chunk raises.
 ENV_FUSED = "KATA_TPU_FUSED"
+
+# Paged-pool placement layout + host-RAM KV offload tier (ISSUE 14):
+# KATA_TPU_KV_LAYOUT selects "heads" (the historical divide-or-replicate
+# head-axis sharding) or "blocks" (the paged pool's token axis shards
+# across the tp mesh — per-shard pool bytes ~logical/tp for EVERY model,
+# GQA included; the kv_replicated cliff does not exist). The layout is
+# purely a PLACEMENT decision: every jitted pool op computes the same
+# values over the same logical array, and the decode kernel's blocks
+# form recombines shard-local split-K partials with the online-softmax
+# merge — greedy outputs are bit-identical across layouts (tested).
+# KATA_TPU_KV_HOST_TOKENS arms the host-RAM tier below the device pool:
+# under pool pressure, cold KV (unpinned prefix segments; preempted idle
+# sessions already spill there) DEMOTES to host RAM before any lane is
+# preempted, and a prefix hit / session resume PREFETCHES it back with
+# the H2D upload overlapping the in-flight decode dispatch. Standard
+# knob contract: explicit args raise on conflict, the daemon-injected
+# env degrades with kv_layout_invalid / kv_layout_disabled /
+# kv_host_invalid / kv_host_disabled events.
+ENV_KV_LAYOUT = tp_serving.ENV_KV_LAYOUT
+ENV_KV_HOST_TOKENS = "KATA_TPU_KV_HOST_TOKENS"
 
 
 def resolve_kv_quant(kv_quant, emit=None) -> bool:
@@ -289,6 +311,8 @@ _PROM_STATS = (
     ("prefix_store_occupancy", "Prefix store fill (tokens used / capacity)"),
     ("kv_pool_occupancy", "Paged KV pool fill (blocks in use / usable)"),
     ("kv_blocks_in_use", "Paged KV pool blocks currently referenced"),
+    ("kv_host_blocks", "Host-RAM KV tier blocks resident (demoted prefix "
+                       "segments + preempted session spills)"),
     ("preemptions", "Requests preempted (KV spilled, requeued FIFO)"),
     ("cow_copies", "Prefix-tier boundary blocks privatized copy-on-write"),
     ("recoveries", "Supervisor recoveries from a failed scheduler round"),
@@ -397,6 +421,27 @@ def _ctr_cow_copies():
     return obs.counter(
         "kata_tpu_serving_kv_cow_copies_total",
         "Prefix-tier boundary blocks privatized copy-on-write at admission",
+        ["server"],
+    )
+
+
+# Host-RAM KV tier traffic counters (ISSUE 14): incremented at the moment
+# of the D2H demotion / H2D prefetch so rate() works between scrapes; the
+# kv_host_blocks scrape gauge mirrors the resident population.
+def _ctr_kv_demotions():
+    return obs.counter(
+        "kata_tpu_serving_kv_demotions_total",
+        "Cold KV demoted from the device pool to the host-RAM tier "
+        "(prefix segments under pool pressure + preempted session spills)",
+        ["server"],
+    )
+
+
+def _ctr_kv_prefetches():
+    return obs.counter(
+        "kata_tpu_serving_kv_prefetches_total",
+        "Host-tier KV prefetched back to the device pool (prefix hits on "
+        "demoted segments + preempted session resumes)",
         ["server"],
     )
 
@@ -902,6 +947,8 @@ class GenerationServer:
                  prefix_store: Optional[PrefixStore] = None,
                  kv_pool_tokens: Optional[int] = None,
                  kv_block_size: int = 16,
+                 kv_layout: Optional[str] = None,
+                 kv_host_tokens: Optional[int] = None,
                  checkpoint_rounds: Optional[int] = None,
                  fault_injector: Optional[FaultInjector] = None,
                  fence_timeout_s: Optional[float] = None,
@@ -1382,6 +1429,45 @@ class GenerationServer:
         self.kv_block = int(kv_block_size)
         self.paged = False
         self.kv_pool: Optional[KVPool] = None
+        # Pool placement layout + host-RAM offload tier (ISSUE 14) —
+        # resolved BEFORE the pool is built (the blocks layout sizes
+        # per-shard sub-pools). Standard knob contract: explicit args
+        # raise on nonsense, daemon-injected env degrades with events.
+        explicit_layout = kv_layout is not None
+        if kv_layout is not None:
+            if kv_layout not in KV_LAYOUTS:
+                raise ValueError(
+                    f"unknown kv_layout {kv_layout!r} (have {KV_LAYOUTS})"
+                )
+        else:
+            raw = os.environ.get(ENV_KV_LAYOUT, "").strip()
+            if raw and raw not in KV_LAYOUTS:
+                self._emit("kv_layout_invalid", reason=f"bad_env:{raw[:32]}")
+                raw = ""
+            kv_layout = raw or KV_LAYOUT_HEADS
+        # Set early: _pool_conflict's progress-guarantee arithmetic needs
+        # the layout's shard rounding (re-assigned below if the slotted
+        # degrade flips it back to heads).
+        self._kv_layout = kv_layout
+        explicit_host = kv_host_tokens is not None
+        if kv_host_tokens is not None:
+            kv_host_tokens = int(kv_host_tokens)
+            if kv_host_tokens < 0:
+                raise ValueError(
+                    f"kv_host_tokens must be >= 0, got {kv_host_tokens}"
+                )
+        else:
+            raw = os.environ.get(ENV_KV_HOST_TOKENS, "")
+            try:
+                kv_host_tokens = int(raw or 0)
+            except ValueError:
+                self._emit("kv_host_invalid", reason=f"bad_env:{raw[:32]}")
+                kv_host_tokens = 0
+            if kv_host_tokens < 0:
+                self._emit(
+                    "kv_host_invalid", reason=f"bad_env:{kv_host_tokens}"
+                )
+                kv_host_tokens = 0
         explicit_pool = kv_pool_tokens is not None
         if kv_pool_tokens is None:
             raw = os.environ.get("KATA_TPU_KV_POOL_TOKENS", "")
@@ -1413,11 +1499,66 @@ class GenerationServer:
                 )
             else:
                 self.paged = True
+        # The layout and the host tier are PAGED-pool features: the dense
+        # slot grid has no block granularity to shard or demote at. An
+        # explicit argument on a slotted server raises; the node-injected
+        # env degrades with an event (the standard knob contract).
+        if not self.paged:
+            if kv_layout == KV_LAYOUT_BLOCKS:
+                if explicit_layout:
+                    raise ValueError(
+                        "kv_layout='blocks' requires a paged KV pool "
+                        "(kv_pool_tokens) — see 'KV layouts & host offload "
+                        "tier' in docs/guest_guide.md"
+                    )
+                self._emit("kv_layout_disabled", reason="not_paged")
+                kv_layout = KV_LAYOUT_HEADS
+            if kv_host_tokens > 0:
+                if explicit_host:
+                    raise ValueError(
+                        "kv_host_tokens requires a paged KV pool "
+                        "(kv_pool_tokens) — see 'KV layouts & host offload "
+                        "tier' in docs/guest_guide.md"
+                    )
+                self._emit("kv_host_disabled", reason="not_paged")
+                kv_host_tokens = 0
+        self._kv_layout = kv_layout  # re-assign: the slotted degrade above
+        self._kv_host: Optional[HostKVTier] = (
+            HostKVTier(kv_host_tokens, self.kv_block, label=self._label)
+            if kv_host_tokens > 0 else None
+        )
+        # Host-tier traffic, cumulative across prefix-tier rebuilds
+        # (recovery folds a dying tier's counts in — stats() snapshot
+        # semantics: counters only grow).
+        self._host_demotions = 0
+        self._host_prefetches = 0
+        # One staged resume prefetch (ISSUE 14): the oldest preempted
+        # request's spilled rows, uploaded H2D while a decode chunk is in
+        # flight so _resume_one lands an already-overlapped transfer.
+        # Split rid/rows attributes: every branch tests the HOST int rid
+        # only — the device rows tree is never truth-tested.
+        self._resume_stage_rid: Optional[int] = None
+        self._resume_stage_rows: Any = None
         if self.paged:
             self.arena = None  # the pool IS the arena — no slot grid
             self.kv_pool = KVPool(
                 cfg, kv_pool_tokens, self.kv_block, kv_quant=kv_quant,
-                label=self._label,
+                label=self._label, shards=self._kv_shards(),
+            )
+            # Once-per-server layout event (ISSUE 14): the pool's
+            # placement shape — under blocks, per-shard bytes are
+            # ~logical/tp for every model and the kv_replicated cliff
+            # does not exist (that event stays heads-layout-only).
+            logical = sum(
+                leaf.nbytes for leaf in jax.tree_util.tree_leaves(
+                    self.kv_pool.arena
+                )
+            )
+            self._emit(
+                "kv_layout", layout=self._kv_layout,
+                shards=self.kv_pool.shards,
+                per_shard_bytes=logical // self.kv_pool.shards,
+                host_tier_tokens=kv_host_tokens,
             )
             self._nb_max = -(-max_len // self.kv_block)
             self._lane_blocks: list[list[int]] = [
@@ -1551,7 +1692,9 @@ class GenerationServer:
                 # disables the pool instead — see _pool_conflict.)
                 self.prefix_store = PagedPrefixTier(
                     self.kv_pool, cfg, self.prefill_buckets,
-                    label=self._label,
+                    label=self._label, host_tier=self._kv_host,
+                    on_demote=lambda: self._c_kv_demote.inc(),
+                    on_prefetch=lambda: self._c_kv_prefetch.inc(),
                 )
             elif prefix_store is not None:
                 if (prefix_store.cfg != cfg
@@ -1597,6 +1740,10 @@ class GenerationServer:
             dispatch_steps=self._dispatch_steps,
             fused=int(self._fused_ok), overlap=int(bool(overlap)),
             paged=int(self.paged), tp=self._tp,
+            kv_layout=self._kv_layout,
+            kv_host_tokens=(
+                self._kv_host.capacity_tokens if self._kv_host else 0
+            ),
         )
 
     def _emit(self, name: str, **fields) -> None:
@@ -1687,6 +1834,8 @@ class GenerationServer:
         )
         self._c_preempt = _ctr_preemptions().labels(server=self._label)
         self._c_cow = _ctr_cow_copies().labels(server=self._label)
+        self._c_kv_demote = _ctr_kv_demotions().labels(server=self._label)
+        self._c_kv_prefetch = _ctr_kv_prefetches().labels(server=self._label)
         self._c_recover = _ctr_recoveries().labels(server=self._label)
         self._c_quarantine = _ctr_quarantined().labels(server=self._label)
         self._c_stall = _ctr_stalls().labels(server=self._label)
@@ -1694,6 +1843,17 @@ class GenerationServer:
         self._c_sched_defer = _ctr_sched_defers().labels(server=self._label)
         self._c_slo = _ctr_slo_violations().labels(server=self._label)
         self._c_fused = _ctr_fused_admissions().labels(server=self._label)
+
+    def _kv_shards(self) -> int:
+        """How many per-shard sub-pools the paged pool splits into: the
+        serving mesh's degree under the blocks layout, 1 everywhere else
+        (heads layout, tp=1, slotted). Re-read at every pool (re)build —
+        a degraded mesh shrink rebuilds the pool against the CURRENT
+        ``self._tp``, so the block-sharded pool re-places onto the
+        shrunken mesh with matching sub-pools."""
+        if getattr(self, "_kv_layout", KV_LAYOUT_HEADS) == KV_LAYOUT_BLOCKS:
+            return max(1, self._tp)
+        return 1
 
     def _pool_conflict(self, pool_tokens: int, ring_kv: bool, draft,
                        speculative_k: int, prefix_store) -> Optional[str]:
@@ -1714,7 +1874,16 @@ class GenerationServer:
             return "speculative"
         if prefix_store is not None:
             return "injected_prefix_store"
-        usable = pool_tokens // self.kv_block - RESERVED_BLOCKS
+        # Whole blocks per shard (ISSUE 14): the blocks layout rounds the
+        # pool down to a multiple of the mesh degree, so the progress
+        # guarantee must hold AFTER that rounding — or a node-injected
+        # pool one block shy would crash the KVPool constructor instead
+        # of degrading here.
+        shards = self._kv_shards()
+        usable = (
+            (pool_tokens // self.kv_block) // shards * shards
+            - RESERVED_BLOCKS
+        )
         if usable < -(-self.max_len // self.kv_block):
             # Progress guarantee: the drained pool must hold at least one
             # full-length request, or the oldest request could deadlock.
@@ -1833,6 +2002,7 @@ class GenerationServer:
             paged_len=self.max_len, arena_len=self.max_len,
             quantized=self.kv_quant, mesh=mesh if tp > 1 else None,
             tp=tp, interpret=self._decode_interpret,
+            kv_layout=self._kv_layout if self.paged else KV_LAYOUT_HEADS,
         )
 
     def _shard_over(self, mesh) -> None:
@@ -1886,8 +2056,12 @@ class GenerationServer:
         from ..parallel.mesh import AXIS_MODEL
 
         tp = mesh.shape.get(AXIS_MODEL, 1)
-        sh = NamedSharding(mesh, tp_serving.kv_cache_spec(self.cfg, tp))
-        if (tp > 1 and not tp_serving.kv_heads_shardable(self.cfg, tp)
+        layout = self._kv_layout if self.paged else KV_LAYOUT_HEADS
+        sh = NamedSharding(
+            mesh, tp_serving.kv_cache_spec(self.cfg, tp, layout=layout)
+        )
+        if (tp > 1 and layout == KV_LAYOUT_HEADS
+                and not tp_serving.kv_heads_shardable(self.cfg, tp)
                 and tp not in self._kv_replicated_warned):
             # The paged×tp memory cliff's worst edge made LOUD (ISSUE 10
             # satellite; ROADMAP item 3b): when n_kv_heads does not
@@ -1895,6 +2069,9 @@ class GenerationServer:
             # every shard — correct, but real HBM is tp × the logical
             # figure. One warning event per (server, degree) with the
             # measured extra bytes, instead of the silent replication.
+            # HEADS layout only (ISSUE 14): under the blocks layout the
+            # cliff does not exist — the once-per-server kv_layout event
+            # carries the per-shard figure instead.
             self._kv_replicated_warned.add(tp)
             logical = sum(
                 leaf.nbytes for leaf in jax.tree_util.tree_leaves(
@@ -2063,6 +2240,12 @@ class GenerationServer:
         # servers — so dashboards need no schema branch (the _PROM_STATS
         # gauges scrape these by name).
         pool = self.kv_pool
+        # Host-tier traffic (ISSUE 14): the live prefix tier's counts
+        # plus everything folded in from rebuilds and session spills —
+        # cumulative, like every other counter here.
+        tier = self.prefix_store
+        tier_dem = tier.demotions if isinstance(tier, PagedPrefixTier) else 0
+        tier_pre = tier.prefetches if isinstance(tier, PagedPrefixTier) else 0
         out.update({
             "kv_pool_occupancy": pool.occupancy() if pool else 0.0,
             "kv_blocks_in_use": pool.blocks_in_use if pool else 0,
@@ -2071,6 +2254,19 @@ class GenerationServer:
             "preemptions": self._preemptions,
             "preempted_waiting": len(self._preempted) if self.paged else 0,
             "cow_copies": self._cow_copies,
+            # KV layout + host tier (ISSUE 14): ALWAYS present — layout
+            # "heads", shards 1 and zeros on slotted / tier-off servers,
+            # so dashboards need no schema branch.
+            "kv_layout": self._kv_layout,
+            "kv_pool_shards": pool.shards if pool else 1,
+            "kv_host_tokens": (
+                self._kv_host.capacity_tokens if self._kv_host else 0
+            ),
+            "kv_host_blocks": (
+                self._kv_host.blocks_used if self._kv_host else 0
+            ),
+            "kv_demotions": self._host_demotions + tier_dem,
+            "kv_prefetches": self._host_prefetches + tier_pre,
         })
         # Tensor-parallel fields (ISSUE 9): ALWAYS present — tp_degree 1
         # and shard occupancies 0.0 on unsharded servers — so dashboards
@@ -2161,15 +2357,18 @@ class GenerationServer:
         return out
 
     def _pool_shard_occupancy(self) -> list[float]:
-        """Per-mesh-shard paged-pool fill, one entry per tp shard. The
-        pool shards its KV HEAD axis, so every block spans all shards
-        and each shard's fill equals the logical occupancy today; the
-        field is per-shard anyway so dashboards keep working unchanged
-        if a future layout shards blocks across the mesh. ALWAYS a
-        length-``max(1, tp)`` list — zeros at tp=1 and on slotted
-        servers (no schema branch)."""
+        """Per-mesh-shard paged-pool fill, one entry per tp shard.
+        Under the BLOCKS layout (ISSUE 14) each shard is a real
+        sub-pool — the entries are each shard's own blocks-in-use over
+        its usable blocks, and they genuinely diverge. Under the HEADS
+        layout every block spans all shards (the pool shards its KV head
+        axis or replicates), so each shard's fill equals the logical
+        occupancy. ALWAYS a length-``max(1, tp)`` list — zeros at tp=1
+        and on slotted servers (no schema branch)."""
         if self._tp <= 1 or not self.paged or self.kv_pool is None:
             return [0.0] * max(1, self._tp)
+        if self.kv_pool.shards > 1:
+            return self.kv_pool.shard_occupancy()
         return [self.kv_pool.occupancy()] * self._tp
 
     def _kv_slot_utilization(self) -> float:
@@ -3187,6 +3386,17 @@ class GenerationServer:
         self._slot_req[b] = None
         self._preemptions += 1
         self._c_preempt.inc()
+        if self._kv_host is not None:
+            # The spill IS a demotion of an idle session to the host
+            # tier (ISSUE 14): account its tokens there — PINNED
+            # (in-flight state must never LRU out, and correctness
+            # outranks the budget, so it may overflow) — so
+            # kv_host_blocks reports the real host-resident population.
+            self._kv_host.put(
+                ("spill", req.rid), int(self._pos[b]), pinned=True
+            )
+            self._host_demotions += 1
+            self._c_kv_demote.inc()
         self._ledger_to(req, PHASE_PREEMPTED)  # spilled: decode stops here
         self._emit(
             "kv_preempt", rid=req.rid, pos=int(self._pos[b]),
@@ -3207,8 +3417,19 @@ class GenerationServer:
             return False
         full = np.full(self._nb_max, SCRATCH_BLOCK, np.int32)
         full[:nb] = blocks
+        # Consume the staged resume prefetch when it targeted this
+        # request (ISSUE 14): the H2D upload started while the previous
+        # decode chunk was still in flight, so the restore scatter lands
+        # an already-overlapped transfer instead of serializing one here.
+        staged = self._resume_stage_rid == pre.req.rid
+        rows = (
+            self._resume_stage_rows if staged
+            else self._kv_host_upload(pre.kv, paged_rows=True)
+        )
+        self._resume_stage_rid = None
+        self._resume_stage_rows = None
         self.kv_pool.arena = pool_scatter_rows(
-            self.kv_pool.arena, self._kv_host_upload(pre.kv, paged_rows=True),
+            self.kv_pool.arena, rows,
             jnp.asarray(full), block_size=self.kv_block,
         )
         self._set_lane_table(b, blocks)
@@ -3221,10 +3442,15 @@ class GenerationServer:
         # scatter must still find the request in _preempted (the lost-set
         # source for spilled work) or it would vanish from recovery.
         self._preempted.popleft()
+        if self._kv_host is not None:
+            self._kv_host.pop(("spill", pre.req.rid))
+            self._host_prefetches += 1
+            self._c_kv_prefetch.inc()
         self._ledger_to(pre.req, self._decode_state())  # restored: decoding
         self._emit(
             "kv_resume", rid=pre.req.rid, pos=pre.pos,
             waiting=len(self._preempted), queued=len(self._queue),
+            prefetched=int(staged),
         )
         return True
 
@@ -3274,6 +3500,32 @@ class GenerationServer:
                     key=lambda v: self._slot_req[v].rid,
                 )
                 self._preempt_lane(victim, reason="pool_exhausted")
+
+    def _stage_resume_prefetch(self) -> None:
+        """Async resume prefetch (ISSUE 14): start the H2D upload of the
+        OLDEST preempted request's spilled rows while a decode chunk is
+        in flight, so by the time ``_resume_one`` lands them the
+        transfer has overlapped device compute instead of serializing
+        the admission pass. Armed only with the host tier (the knob that
+        buys host RAM for idle sessions); one staged upload at a time,
+        invalidated whenever the device state rebuilds. The upload rides
+        the same sanctioned ``allow_transfer`` class as the restore it
+        feeds; ordering against the in-flight chunk is by data
+        dependency (the restore scatter consumes the uploaded rows
+        inside jit), so strict mode stays clean."""
+        if (self._kv_host is None or not self.paged
+                or not self._preempted):
+            return
+        pre = self._preempted[0]
+        if self._resume_stage_rid == pre.req.rid:
+            return  # already staged for the current head
+        with jaxapi.allow_transfer(
+                "kv host tier resume prefetch (H2D upload overlapping "
+                "the in-flight decode chunk)"):
+            self._resume_stage_rows = self._kv_host_upload(
+                pre.kv, paged_rows=True
+            )
+            self._resume_stage_rid = pre.req.rid
 
     def step(self) -> bool:
         """One SUPERVISED scheduler round. Lock-step (``overlap=False``
@@ -3433,6 +3685,17 @@ class GenerationServer:
         dropped, never retried again."""
         req.done = True
         self._failures[req.rid] = error or reason
+        if self._kv_host is not None:
+            # A spilled session that terminally fails releases its
+            # host-tier accounting (drained mid-flight, quarantined,
+            # chip_lost) — the pinned entry must not leak capacity.
+            self._kv_host.pop(("spill", req.rid))
+        if self._resume_stage_rid == req.rid:
+            # And its staged resume upload: with the request dead the
+            # stage would never be consumed, pinning a full spill's
+            # device arrays for the server's remaining lifetime.
+            self._resume_stage_rid = None
+            self._resume_stage_rows = None
         self._emit(
             "request_failed", rid=req.rid, reason=reason,
             error=(error or reason)[:200], emitted=len(req.out),
@@ -3797,14 +4060,34 @@ class GenerationServer:
             self.kv_pool = KVPool(
                 self.cfg, self.kv_pool.num_blocks * self.kv_block,
                 self.kv_block, kv_quant=self.kv_quant, label=self._label,
+                # Re-read per rebuild: after a degraded mesh shrink the
+                # block-sharded pool re-places onto the SHRUNKEN mesh
+                # with matching per-shard sub-pools (ISSUE 14).
+                shards=self._kv_shards(),
             )
             self._lane_blocks = [[] for _ in range(self.max_batch)]
             self._bt_host[:] = SCRATCH_BLOCK
             self._plans.clear()
+            # The staged resume upload targeted the dead pool's placement
+            # — discard it; _resume_one re-uploads against the rebuild.
+            self._resume_stage_rid = None
+            self._resume_stage_rows = None
             if isinstance(self.prefix_store, PagedPrefixTier):
+                # Fold the dying tier's host-traffic counts into the
+                # server's cumulative totals (stats() snapshot semantics
+                # — counters only grow across rebuilds), and drop its
+                # demoted segments from the host tier: their radix index
+                # dies with the tier, so the parked rows are
+                # unreachable; pinned session spills stay.
+                self._host_demotions += self.prefix_store.demotions
+                self._host_prefetches += self.prefix_store.prefetches
+                if self._kv_host is not None:
+                    self._kv_host.drop_unpinned()
                 self.prefix_store = PagedPrefixTier(
                     self.kv_pool, self.cfg, self.prefill_buckets,
-                    label=self._label,
+                    label=self._label, host_tier=self._kv_host,
+                    on_demote=lambda: self._c_kv_demote.inc(),
+                    on_prefetch=lambda: self._c_kv_prefetch.inc(),
                 )
             if self._mesh is not None:
                 # Tensor-parallel paged serving: the rebuilt pool must be
@@ -3862,7 +4145,8 @@ class GenerationServer:
         from jax.sharding import NamedSharding
 
         sh = NamedSharding(self._mesh, tp_serving.kv_rows_spec(
-            self.cfg, self._tp, head_axis=2 if paged_rows else 3
+            self.cfg, self._tp, head_axis=2 if paged_rows else 3,
+            layout=self._kv_layout if self.paged else KV_LAYOUT_HEADS,
         ))
         return jax.tree.map(lambda a: jax.device_put(a, sh), host_tree)
 
@@ -4212,6 +4496,10 @@ class GenerationServer:
         # after the decode tokens, mirroring the overlapped retire order.
         fc, self._fused_ret = self._fused_ret, None
         self._apply_fused(fc)
+        # Lock-step rounds have no chunk in flight to overlap, but the
+        # staged upload still runs ahead of the NEXT round's admission
+        # pass (ISSUE 14) — the resume consumes an already-moving copy.
+        self._stage_resume_prefetch()
         return True
 
     # ----- pipelined rounds (overlap=True) ---------------------------------
@@ -4249,6 +4537,10 @@ class GenerationServer:
                 last, pos = prev.last, prev.pos
             self._fresh_rows.clear()
             self._dispatch_chunk(last, pos)
+            # A pending resume's H2D upload overlaps the chunk just
+            # dispatched (ISSUE 14) — by retire's admission pass the
+            # rows are in flight or landed.
+            self._stage_resume_prefetch()
         if prev is not None:
             self._retire(prev)  # host work overlaps the dispatched chunk
         return (

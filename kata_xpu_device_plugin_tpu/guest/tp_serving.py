@@ -51,6 +51,16 @@ from .. import obs
 # same AllocateResponse path as the pool/prefix/scheduler knobs).
 ENV_TP = "KATA_TPU_TP"
 
+# Paged-pool placement layout (ISSUE 14, docs/guest_guide.md "KV layouts
+# & host offload tier"): "heads" keeps the historical divide-or-replicate
+# head-axis sharding; "blocks" shards the paged pool's TOKEN axis across
+# the model mesh — per-shard pool bytes are ~logical/tp for EVERY model,
+# GQA included (the kv_replicated cliff does not exist under blocks).
+ENV_KV_LAYOUT = "KATA_TPU_KV_LAYOUT"
+KV_LAYOUT_HEADS = "heads"
+KV_LAYOUT_BLOCKS = "blocks"
+KV_LAYOUTS = (KV_LAYOUT_HEADS, KV_LAYOUT_BLOCKS)
+
 # Degraded-mode knobs (ISSUE 10, docs/resilience.md "Degraded mode"):
 # the floor of the elastic mesh-shrink ladder a permanent chip fault
 # walks (daemon-injectable, cdi.constants.ENV_SERVING_TP_MIN), and the
@@ -199,30 +209,47 @@ def kv_heads_shardable(cfg, tp: int) -> bool:
     return tp > 1 and cfg.n_kv_heads % tp == 0
 
 
-def kv_cache_spec(cfg, tp: int):
+def kv_cache_spec(cfg, tp: int, layout: str = KV_LAYOUT_HEADS):
     """PartitionSpec for every serving KV ARENA layout — the dense slot
     arena ``[L, B, S, KV, D]``, the paged pool ``[L, 1, NT, KV, D]`` and
     the prefix-store arena share the head axis at position 3 (int8
     ``QTensor`` scales carry the same leading axes) — sharded over
-    ``model`` per :func:`kv_heads_shardable`."""
+    ``model`` per :func:`kv_heads_shardable` under the default "heads"
+    layout. Under the "blocks" layout (ISSUE 14, paged pools only) the
+    TOKEN axis (position 2 — the ``NT`` dim of the pool; whole blocks,
+    the pool keeps ``num_blocks`` a multiple of tp) shards over ``model``
+    instead: per-shard pool bytes are ``~logical/tp`` for every model —
+    no divide-or-replicate decision, no GQA replication cliff."""
     from ..compat.jaxapi import P
     from ..parallel.mesh import AXIS_MODEL
 
+    if layout == KV_LAYOUT_BLOCKS:
+        if tp > 1:
+            return P(None, None, AXIS_MODEL, None, None)
+        return P()
     if kv_heads_shardable(cfg, tp):
         return P(None, None, None, AXIS_MODEL, None)
     return P()
 
 
-def kv_rows_spec(cfg, tp: int, head_axis: int):
+def kv_rows_spec(cfg, tp: int, head_axis: int,
+                 layout: str = KV_LAYOUT_HEADS):
     """PartitionSpec for host-spill ROW layouts (checkpoint/preemption
     restore uploads) whose KV head axis sits at ``head_axis`` — the
     paged full-table spill ``[L, NT, KV, D]`` (axis 2) and the slotted
     snapshot ``[L, 1, S, KV, D]`` (axis 3). Same
     :func:`kv_heads_shardable` decision as the arenas they restore
-    into, so a restore never forces a resharding."""
+    into, so a restore never forces a resharding. Under the "blocks"
+    layout the uploaded rows REPLICATE (a spill's row count is a lane's
+    table width, not the pool's — it need not divide tp); the restore
+    scatter then re-distributes the rows into the token-sharded pool
+    inside the same jitted dispatch, which is data movement GSPMD
+    already owns."""
     from ..compat.jaxapi import P
     from ..parallel.mesh import AXIS_MODEL
 
+    if layout == KV_LAYOUT_BLOCKS:
+        return P()
     if kv_heads_shardable(cfg, tp):
         return P(*([None] * head_axis), AXIS_MODEL, None)
     return P()

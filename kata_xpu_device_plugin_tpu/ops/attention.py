@@ -219,6 +219,7 @@ def make_decode_attn_fn(
     mesh=None,
     tp: int = 1,
     interpret: bool = False,
+    kv_layout: str = "heads",
 ):
     """Build the serving decode-attention kernel callable
     ``fn(q, ck, cv, tables, pos) -> [B, 1, H, D]`` the transformer's
@@ -238,6 +239,18 @@ def make_decode_attn_fn(
     what let a custom call partition over the model axis instead of
     replicating; the kv-replicated layout (n_kv_heads % tp != 0) runs
     fully replicated inside the same wrapper.
+
+    ``kv_layout="blocks"`` (ISSUE 14, paged × tp only): the pool shards
+    its TOKEN axis, so each shard runs the kernel's SHARD-LOCAL form
+    over only the physical blocks it owns (DMA stays on-shard;
+    ownership-masked splits) with raw split-K partials out, and the
+    wrapper recombines shards with the same online-softmax merge the
+    kernel carries across splits — ``m = pmax``, the correction factor
+    ``exp(m_s − m)`` rescales each shard's denominator and accumulator
+    into one psum, division happens once after the merge. The merge is
+    the associative recombination flash-decode is built on; a lane
+    whose blocks all live on one shard reduces bit-for-bit to the
+    unsharded kernel (the other shards contribute exact zeros).
 
     Raises on configs the kernel cannot model (sliding windows, the
     Gemma-2 attention-logit softcap, unsupported tiles) — eligibility
@@ -300,9 +313,59 @@ def make_decode_attn_fn(
         return pool_form
 
     from ..compat.jaxapi import P, shard_map
+    from ..parallel.mesh import AXIS_MODEL
     from ..parallel.sharding import decode_attn_specs
 
-    q_spec, kv_spec, out_spec = decode_attn_specs(cfg, tp, quantized)
+    q_spec, kv_spec, out_spec = decode_attn_specs(
+        cfg, tp, quantized, kv_layout=kv_layout
+    )
+    if paged and kv_layout == "blocks":
+        from jax import lax
+
+        from .decode_attn import pallas_paged_decode_attention as _paged
+        from .quant import QTensor
+
+        def blocks_form(q, ck, cv, tables, pos):
+            # Shard-local kernel + online-softmax merge over the model
+            # axis (see the docstring's layout note). Operand shapes in
+            # here are LOCAL: the pool slice is [1, NT/tp, KV, D].
+            kq = ck.q if isinstance(ck, QTensor) else ck
+            nb_local = kq.shape[1] // bs
+            lo = (lax.axis_index(AXIS_MODEL) * nb_local).astype(
+                jnp.int32
+            ).reshape(1)
+            acc, m, l = _paged(
+                q, ck, cv, tables, pos, None, block_size=bs,
+                paged_len=plen, interpret=interpret,
+                shard_lo=lo, shard_blocks=nb_local, return_stats=True,
+            )
+            B, Sq, H, D = q.shape
+            KV = m.shape[1]
+            G = H // KV
+            m0, l0 = m[..., 0], l[..., 0]  # [B, KV, Sq·G]
+            m_all = lax.pmax(m0, AXIS_MODEL)
+            # exp(m_s − m) rescales each shard's partials onto the
+            # global max; a shard with no owned splits for a lane sits
+            # at m_s = NEG_INF → factor 0 → exact zero contribution.
+            corr = jnp.exp(m0 - m_all)
+            l_all = lax.psum(l0 * corr, AXIS_MODEL)
+
+            def to_h(x):  # [B, KV, Sq·G] → [B, Sq, H] (H = KV·G order)
+                return x.reshape(B, KV, Sq, G).transpose(
+                    0, 2, 1, 3
+                ).reshape(B, Sq, H)
+
+            acc_all = lax.psum(acc * to_h(corr)[..., None], AXIS_MODEL)
+            denom = to_h(jnp.where(l_all == 0.0, 1.0, l_all))
+            return (acc_all / denom[..., None]).astype(q.dtype)
+
+        return shard_map(
+            blocks_form,
+            mesh=mesh,
+            in_specs=(q_spec, kv_spec, kv_spec, P(None, None), P(None)),
+            out_specs=out_spec,
+            check_vma=False,
+        )
     if paged:
         return shard_map(
             pool_form,

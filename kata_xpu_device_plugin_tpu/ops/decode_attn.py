@@ -214,18 +214,37 @@ def _paged_decode_kernel(
     pos_ref,  # scalar prefetch: [B] int32 — per-lane LAST query position
     tbl_ref,  # scalar prefetch: [B, NB] int32 — physical block tables
     qlen_ref,  # scalar prefetch: [B] int32 — per-lane query lengths (≤ SQ)
-    q_ref,  # [1, SQ, G, D] block of [B, SQ, H, D]
-    *refs,  # k, v (each payload [, scale]) blocks, o block, 3 scratches
+    *refs,  # [lo,] q, k, v (each payload [, scale]) blocks, outs, scratches
     scale: float,
     block_k: int,
     grid_k: int,
     quantized: bool,
     sq: int,
+    shard_blocks: int = 0,
+    stats: bool = False,
 ):
+    if shard_blocks:
+        # Shard-local form (ISSUE 14, the blocks pool layout): this
+        # program sees only its shard's [1, NT/tp, KV, D] pool slice;
+        # ``lo_ref`` is the shard's first global block id and splits
+        # whose table entry falls outside [lo, lo + shard_blocks) are
+        # SKIPPED entirely (ownership mask — the owner shard computes
+        # them; the merge in make_decode_attn_fn recombines).
+        lo_ref, *refs = refs
+    q_ref, *refs = refs  # [1, SQ, G, D] block of [B, SQ, H, D]
     if quantized:
-        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        k_ref, ks_ref, v_ref, vs_ref, *refs = refs
     else:
-        k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        k_ref, v_ref, *refs = refs
+    if stats:
+        # Raw split-K partials instead of the normalized output: the
+        # fp32 accumulator (pre-division) plus the running max and
+        # denominator — what the cross-shard online-softmax merge
+        # consumes (same quantities the VMEM scratch carries across
+        # splits, surfaced per lane × KV head).
+        o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
     b = pl.program_id(0)
     ki = pl.program_id(2)
     pos = pos_ref[b]
@@ -241,8 +260,15 @@ def _paged_decode_kernel(
     # clamp the physical block at the frontier too, so skipped splits are
     # never DMA'd — per-lane decode traffic scales with pos[b], not the
     # table width). ``pos`` is the LAST query's position, so every
-    # earlier query's frontier is inside the skip bound.
-    @pl.when(ki * block_k <= pos)
+    # earlier query's frontier is inside the skip bound. Shard-local
+    # programs additionally skip splits their shard does not own.
+    run = ki * block_k <= pos
+    if shard_blocks:
+        t = tbl_ref[b, jnp.minimum(ki, tbl_ref.shape[1] - 1)]
+        lo = lo_ref[0]
+        run = run & (t >= lo) & (t < lo + shard_blocks)
+
+    @pl.when(run)
     def _compute():
         G = q_ref.shape[2]
         q = q_ref[0].reshape(sq * G, q_ref.shape[3])  # [SQ·G, D] native
@@ -295,6 +321,16 @@ def _paged_decode_kernel(
     @pl.when(ki == grid_k - 1)
     def _finalize():
         G = q_ref.shape[2]
+        if stats:
+            # Raw partials out: the merge divides AFTER recombining the
+            # shards (dividing here would bake in a denominator the
+            # other shards still add to).
+            m_ref[0, 0] = m_scr[...]
+            l_ref[0, 0] = l_scr[...]
+            o_ref[0] = acc_scr[...].reshape(
+                sq, G, acc_scr.shape[-1]
+            ).astype(o_ref.dtype)
+            return
         denom = l_scr[:, 0:1]
         denom = jnp.where(denom == 0.0, 1.0, denom)
         o_ref[0] = (acc_scr[...] / denom).reshape(
@@ -303,7 +339,8 @@ def _paged_decode_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_size", "paged_len", "interpret")
+    jax.jit, static_argnames=("block_size", "paged_len", "interpret",
+                              "shard_blocks", "return_stats")
 )
 def pallas_paged_decode_attention(
     q: jax.Array,  # [B, SQ, H, D] (SQ == 1: the decode-scan step)
@@ -316,7 +353,10 @@ def pallas_paged_decode_attention(
     block_size: int,
     paged_len: int,
     interpret: bool = False,
-) -> jax.Array:
+    shard_lo: "jax.Array | None" = None,  # [1] int32: shard's 1st block id
+    shard_blocks: int = 0,  # blocks this shard holds (0 = unsharded)
+    return_stats: bool = False,
+):
     """Paged-native ragged decode attention: each lane attends its block-
     table view of the shared pool IN PLACE — no ``_paged_view`` gather
     back to a dense ``[B, paged_len]`` operand. ``tables`` must already
@@ -338,7 +378,20 @@ def pallas_paged_decode_attention(
     alongside an admission lane running a chunk-wide slice. ``q_lens``
     defaults to all-``SQ`` (every row real — the uniform span the
     transformer's paged S > 1 branch passes); ``SQ == 1`` reduces
-    bit-for-bit to the single-token kernel."""
+    bit-for-bit to the single-token kernel.
+
+    SHARD-LOCAL FORM (ISSUE 14, the blocks pool layout): when
+    ``shard_blocks > 0``, ``k``/``v`` are ONE shard's ``[1, NT/tp, KV,
+    D]`` slice of a token-axis-sharded pool and ``shard_lo`` its first
+    global block id; splits whose table entry this shard does not own
+    are skipped (never DMA'd — each shard reads only its local blocks)
+    and DMA indices localize as ``table[b, ki] - lo``. Pair it with
+    ``return_stats=True``: the call then returns ``(acc, m, l)`` — the
+    fp32 pre-division accumulator plus the running max / denominator
+    per ``[B, KV, SQ·G]`` row (trailing 128 lane broadcast, col 0 is
+    the value) — and the caller recombines shards with the standard
+    online-softmax merge before dividing
+    (``ops.attention.make_decode_attn_fn``)."""
     quantized = isinstance(k, QTensor)
     B, Sq, H, D = q.shape
     kq = k.q if quantized else k
@@ -350,6 +403,10 @@ def pallas_paged_decode_attention(
     assert NT % bs == 0, (NT, bs)
     if q_lens is None:
         q_lens = jnp.full((B,), Sq, jnp.int32)
+    shard_local = shard_blocks > 0
+    if shard_local:
+        assert NT // bs == shard_blocks, (NT, bs, shard_blocks)
+        assert shard_lo is not None, "shard-local form needs shard_lo"
     # Splits actually visible through the view (the gather path truncates
     # its view at paged_len; here the causal mask covers the tail of the
     # last partial block — see the bit-identity note above).
@@ -358,20 +415,38 @@ def pallas_paged_decode_attention(
     kernel = functools.partial(
         _paged_decode_kernel, scale=float(1.0 / (D**0.5)), block_k=bs,
         grid_k=grid_k, quantized=quantized, sq=Sq,
+        shard_blocks=shard_blocks, stats=return_stats,
     )
 
-    def q_index(b, h, ki, pos_ref, tbl_ref, qlen_ref):
-        del ki, pos_ref, tbl_ref, qlen_ref
+    n_prefetch = 4 if shard_local else 3
+
+    def q_index(b, h, ki, *prefetch):
+        del ki, prefetch
         return (b, 0, h, 0)
 
-    def kv_index(b, h, ki, pos_ref, tbl_ref, qlen_ref):
+    def stat_index(b, h, ki, *prefetch):
+        del ki, prefetch
+        return (b, h, 0, 0)
+
+    def kv_index(b, h, ki, pos_ref, tbl_ref, qlen_ref, *rest):
         # Clamp at the lane's causal frontier: splits past pos[b] map to
         # the frontier block, whose copy pallas elides (same index as the
         # previous grid step) — the unwritten tail is never fetched. The
         # second clamp bounds a dead lane's stale pos inside the table.
+        # Shard-local: localize the global block id; table entries the
+        # shard does not own map to the CONSTANT local block 0 — their
+        # splits are ownership-masked (the fetched block is never read),
+        # and the constant index lets pallas elide consecutive non-owned
+        # splits' copies exactly like the frontier clamp does, so each
+        # shard's DMA traffic stays ~its own blocks, not the full table.
         del qlen_ref
         blk = jnp.minimum(jnp.minimum(ki, pos_ref[b] // bs), NB - 1)
-        return (0, tbl_ref[b, blk], h, 0)
+        t = tbl_ref[b, blk]
+        if shard_local:
+            loc = t - rest[0][0]
+            owned = (loc >= 0) & (loc < shard_blocks)
+            t = jnp.where(owned, loc, 0)
+        return (0, t, h, 0)
 
     in_specs = [pl.BlockSpec((1, Sq, G, D), q_index)]
     operands = [q]
@@ -383,28 +458,45 @@ def pallas_paged_decode_attention(
         else:
             operands.append(c)
 
+    if return_stats:
+        out_specs = (
+            pl.BlockSpec((1, Sq, G, D), q_index),
+            pl.BlockSpec((1, 1, Sq * G, 128), stat_index),
+            pl.BlockSpec((1, 1, Sq * G, 128), stat_index),
+        )
+        out_shape = (
+            jax.ShapeDtypeStruct((B, Sq, H, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, KV, Sq * G, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, KV, Sq * G, 128), jnp.float32),
+        )
+    else:
+        out_specs = pl.BlockSpec((1, Sq, G, D), q_index)
+        out_shape = jax.ShapeDtypeStruct((B, Sq, H, D), q.dtype)
+
+    prefetch = [
+        jnp.asarray(pos, jnp.int32).reshape(B),
+        jnp.asarray(tables, jnp.int32),
+        jnp.asarray(q_lens, jnp.int32).reshape(B),
+    ]
+    if shard_local:
+        prefetch.append(jnp.asarray(shard_lo, jnp.int32).reshape(1))
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=n_prefetch,
             grid=grid,
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, Sq, G, D), q_index),
+            out_specs=out_specs,
             scratch_shapes=[
                 pltpu.VMEM((Sq * G, 128), jnp.float32),
                 pltpu.VMEM((Sq * G, 128), jnp.float32),
                 pltpu.VMEM((Sq * G, D), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, Sq, H, D), q.dtype),
+        out_shape=out_shape,
         compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(
-        jnp.asarray(pos, jnp.int32).reshape(B),
-        jnp.asarray(tables, jnp.int32),
-        jnp.asarray(q_lens, jnp.int32).reshape(B),
-        *operands,
-    )
+    )(*prefetch, *operands)
     return out

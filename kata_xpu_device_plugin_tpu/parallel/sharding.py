@@ -187,7 +187,8 @@ SERVING_RULES: tuple[tuple[str, P], ...] = (
 )
 
 
-def decode_attn_specs(cfg, tp: int, quantized: bool):
+def decode_attn_specs(cfg, tp: int, quantized: bool,
+                      kv_layout: str = "heads"):
     """``shard_map`` PartitionSpecs for the paged-native decode kernel
     (ISSUE 12): ``(q_spec, kv_spec, out_spec)`` over the serving mesh's
     ``model`` axis. A pallas call has no SPMD partitioning rule (the
@@ -206,10 +207,24 @@ def decode_attn_specs(cfg, tp: int, quantized: bool):
     replicates: each device runs the full kernel on the full operands —
     correct, memory-heavier, exactly the dense arena's replication trade.
     int8 ``QTensor`` pools expand leaf-wise (payload and per-vector scale
-    share the head axis), like :func:`_layout_spec` everywhere else."""
-    from ..guest.tp_serving import kv_heads_shardable
+    share the head axis), like :func:`_layout_spec` everywhere else.
+
+    Under the BLOCKS layout (ISSUE 14) the pool slice ``[1, NT, KV, D]``
+    shards its TOKEN axis (position 1) over ``model`` — every shard
+    holds its own physical blocks, whatever the model's KV head count —
+    while q and the output replicate: each shard runs the kernel over
+    ONLY its local blocks (shard-local DMA, ownership-masked splits) and
+    cross-shard lanes combine through the same online-softmax split-K
+    merge the kernel already carries across splits (the merge is
+    associative — see ``ops.attention.make_decode_attn_fn``)."""
+    from ..guest.tp_serving import KV_LAYOUT_BLOCKS, kv_heads_shardable
     from ..ops.quant import QTensor
 
+    if kv_layout == KV_LAYOUT_BLOCKS:
+        rep = P(None, None, None, None)
+        tok = P(None, AXIS_MODEL, None, None)
+        kv = QTensor(q=tok, scale=tok) if quantized else tok
+        return rep, kv, rep
     if kv_heads_shardable(cfg, tp):
         head = P(None, None, AXIS_MODEL, None)
     else:

@@ -47,6 +47,8 @@ class TpuAllocator:
         prefix_cache_tokens: int = 0,
         kv_pool_tokens: int = 0,
         kv_quant: str = "",
+        kv_layout: str = "",
+        kv_host_tokens: int = 0,
         checkpoint_rounds: int = 0,
         fault_schedule: str = "",
         sched_policy: str = "",
@@ -80,6 +82,15 @@ class TpuAllocator:
         # delivery path — the guest default is int8 (eval_quality-gated);
         # "bf16" opts the node out, "int8" pins it explicitly.
         self._kv_quant = str(kv_quant)
+        # Paged-pool placement layout + host-RAM offload tier (ISSUE 14,
+        # config.kv_layout / kv_host_tokens): same delivery path —
+        # "blocks" shards the guest pool by physical blocks across the
+        # serving mesh; kv_host_tokens arms the host-RAM tier cold KV
+        # demotes to before preemption. Malformed/incompatible values
+        # degrade in-guest with kv_layout_invalid / kv_layout_disabled /
+        # kv_host_invalid / kv_host_disabled events.
+        self._kv_layout = str(kv_layout)
+        self._kv_host_tokens = int(kv_host_tokens)
         # Crash-tolerance knobs (ISSUE 7, config.checkpoint_rounds /
         # config.faults): recovery-checkpoint cadence and the chaos
         # fault schedule, same delivery path — in-guest servers read
@@ -183,6 +194,10 @@ class TpuAllocator:
             resp.envs[C.ENV_KV_POOL_TOKENS] = str(self._kv_pool_tokens)
         if self._kv_quant:
             resp.envs[C.ENV_KV_QUANT] = self._kv_quant
+        if self._kv_layout:
+            resp.envs[C.ENV_KV_LAYOUT] = self._kv_layout
+        if self._kv_host_tokens > 0:
+            resp.envs[C.ENV_KV_HOST_TOKENS] = str(self._kv_host_tokens)
         if self._checkpoint_rounds > 0:
             resp.envs[C.ENV_CHECKPOINT_ROUNDS] = str(self._checkpoint_rounds)
         if self._fault_schedule:

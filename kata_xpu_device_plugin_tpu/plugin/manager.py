@@ -553,6 +553,8 @@ class PluginManager:
                 prefix_cache_tokens=cfg.prefix_cache_tokens,
                 kv_pool_tokens=cfg.kv_pool_tokens,
                 kv_quant=cfg.kv_quant,
+                kv_layout=cfg.kv_layout,
+                kv_host_tokens=cfg.kv_host_tokens,
                 checkpoint_rounds=cfg.checkpoint_rounds,
                 fault_schedule=cfg.faults,
                 sched_policy=cfg.sched_policy,

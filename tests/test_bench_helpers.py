@@ -117,6 +117,33 @@ def test_bench_trend_newest_two_and_sparse_banks(tmp_path, capsys):
     assert "20260102" in out and "20260103" in out
 
 
+def test_bench_trend_layout_flip_is_not_a_regression(tmp_path, capsys):
+    # ISSUE 14 satellite: banks that flipped a *_layout config field
+    # between rounds (an intentional heads → blocks A/B) print that
+    # family's moved headline as "layout" — a fact, not a perf alarm —
+    # and the flip itself is rendered; unrelated headline regressions
+    # still flag.
+    from tools import bench_trend
+
+    _bank(tmp_path, "20260101T000000Z", value=1000.0,
+          serving_kv_layout="heads", serving_kv_sessions=4.0)
+    _bank(tmp_path, "20260102T000000Z", value=1000.0,
+          serving_kv_layout="blocks", serving_kv_sessions=32.0)
+    rc = bench_trend.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "layout change: serving_kv_layout heads -> blocks" in out
+    assert "0 regression(s)" in out
+    # The moved family metric carries the layout status, not improved.
+    line = next(ln for ln in out.splitlines()
+                if ln.startswith("serving_kv_sessions"))
+    assert line.rstrip().endswith("layout")
+    # A genuine regression elsewhere still fails even with a flip.
+    _bank(tmp_path, "20260103T000000Z", value=500.0,
+          serving_kv_layout="heads", serving_kv_sessions=4.0)
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 1
+
+
 def test_bench_trend_single_bank_is_not_a_failure(tmp_path, capsys):
     from tools import bench_trend
 

@@ -1,0 +1,643 @@
+"""Block-sharded paged KV pool + host-RAM offload tier (ISSUE 14).
+
+Oracle, as everywhere in serving: the pool LAYOUT is a placement
+decision and the host tier a memory tier — greedy tokens must be
+bit-identical across ``blocks`` ≡ ``heads`` ≡ ``tp=1`` for every
+composition (paged × int8/bf16 × overlap/lockstep × prefix-hit ×
+preemption × fault-recovery), while the block accounting (per-shard
+sub-pools, lane → (shard, physical block) mapping), the host-tier
+ordering contract (demotion BEFORE preemption, LRU within the tier,
+pinned session spills never dropped), and the knob raise-vs-degrade
+contract obey their documented semantics. ``make kv-layout`` runs this
+file with and without ``KATA_TPU_STRICT=1`` (demotion D2H / prefetch
+H2D must ride sanctioned ``allow_transfer`` paths only), and ``make
+chaos`` re-runs it under a seeded ``pool_alloc``/``fence`` schedule
+with the blocks layout node-injected — so every server here that needs
+a quiet schedule pins a disarmed injector explicitly.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu import obs
+from kata_xpu_device_plugin_tpu.guest import tp_serving
+from kata_xpu_device_plugin_tpu.guest.kv_arena import (
+    RESERVED_BLOCKS,
+    HostKVTier,
+    KVPool,
+)
+from kata_xpu_device_plugin_tpu.guest.resilience import (
+    FaultInjector,
+    FaultSpec,
+)
+from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import init_params
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _prompts(cfg, lengths, seed=1, shared=0):
+    key = jax.random.PRNGKey(seed)
+    head = np.asarray(
+        jax.random.randint(key, (shared,), 0, cfg.vocab_size), np.int32
+    ) if shared else np.zeros((0,), np.int32)
+    out = []
+    for i, n in enumerate(lengths):
+        tail = np.asarray(jax.random.randint(
+            jax.random.fold_in(key, i), (n,), 0, cfg.vocab_size
+        ), np.int32)
+        out.append(np.concatenate([head, tail]))
+    return out
+
+
+def _serve(params, cfg, prompts, budgets=10, injector=None, **kw):
+    srv = GenerationServer(
+        params, cfg,
+        fault_injector=injector if injector is not None else FaultInjector(),
+        **kw,
+    )
+    if isinstance(budgets, int):
+        budgets = [budgets] * len(prompts)
+    rids = [srv.submit(p, n) for p, n in zip(prompts, budgets)]
+    res = srv.run()
+    return [res[r] for r in rids], srv
+
+
+def _capture_events(tmp_path, fn, name="ev.jsonl"):
+    sink = obs.EventSink(str(tmp_path / name))
+    prev = obs.set_default_sink(sink)
+    try:
+        result = fn()
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    return result, obs.read_events(str(tmp_path / name))
+
+
+# ----- KVPool per-shard sub-pools -------------------------------------------
+
+
+def test_pool_blocks_sharding_accounting(model):
+    cfg, _ = model
+    # 4 shards: 35 raw blocks round DOWN to 32 — whole blocks per shard.
+    pool = KVPool(cfg, 35 * 8, 8, shards=4)
+    assert pool.num_blocks == 32 and pool.shard_blocks == 8
+    assert pool.blocks_total == 32 - RESERVED_BLOCKS
+    # The lane → (shard, physical block) mapping: block t lives WHOLE on
+    # shard t // shard_blocks.
+    assert pool.shard_of(0) == 0 and pool.shard_of(7) == 0
+    assert pool.shard_of(8) == 1 and pool.shard_of(31) == 3
+    # Both reserved blocks land on shard 0, so its usable count is short.
+    occ = pool.shard_occupancy()
+    assert occ == [0.0, 0.0, 0.0, 0.0]
+    # Allocation balances by FREE count: after 8 grants the per-shard
+    # free lists are level (shard 0 starts two short — the reserved
+    # blocks — so it is drawn from last).
+    got = pool.try_alloc(8)
+    assert all(sum(pool.shard_of(b) == s for b in got) > 0
+               for s in range(1, 4))
+    free_lens = [len(f) for f in pool._free]
+    assert max(free_lens) - min(free_lens) <= 1
+    assert all(o > 0 for o in pool.shard_occupancy())
+    # unref returns each block to ITS shard's free list.
+    pool.unref(got)
+    assert pool.blocks_free == pool.blocks_total
+    assert pool.shard_occupancy() == [0.0, 0.0, 0.0, 0.0]
+    # shards=1 keeps the historical single-free-list behavior.
+    one = KVPool(cfg, 35 * 8, 8)
+    assert one.shards == 1 and one.num_blocks == 35
+
+
+def test_pool_blocks_rounding_too_small(model):
+    cfg, _ = model
+    # 7 raw blocks round to 4 with 4 shards: 2 usable — fine; 3 raw
+    # blocks round to 0 — must refuse, not build an empty pool.
+    KVPool(cfg, 7 * 8, 8, shards=4)
+    with pytest.raises(ValueError):
+        KVPool(cfg, 3 * 8, 8, shards=4)
+    with pytest.raises(ValueError):
+        KVPool(cfg, 64, 8, shards=0)
+
+
+# ----- HostKVTier ----------------------------------------------------------
+
+
+def test_host_tier_capacity_lru_and_pinned():
+    tier = HostKVTier(100, 8)
+    assert tier.put("a", 40) and tier.put("b", 40)
+    # Over capacity unpinned: refused (callers evict their own LRU).
+    assert not tier.put("c", 40)
+    assert tier.room(20) and not tier.room(21)
+    # Pinned entries always land — correctness outranks the budget.
+    assert tier.put(("spill", 1), 40, pinned=True)
+    assert tier.tokens_used == 120 and tier.entries == 3
+    # LRU among unpinned only; get() refreshes recency.
+    tier.get("a")
+    assert tier.lru_unpinned() == "b"
+    assert tier.pop("b").tokens == 40
+    # drop_unpinned clears cache entries, keeps pinned session spills.
+    assert tier.drop_unpinned() == 1
+    assert tier.entries == 1 and tier.get(("spill", 1)).pinned
+    with pytest.raises(ValueError):
+        HostKVTier(0, 8)
+
+
+# ----- placement specs ------------------------------------------------------
+
+
+def test_kv_specs_by_layout(model):
+    from kata_xpu_device_plugin_tpu.compat.jaxapi import P
+    from kata_xpu_device_plugin_tpu.parallel.mesh import AXIS_MODEL
+
+    cfg, _ = model  # n_kv_heads=2
+    # heads: divide-or-replicate on the head axis (position 3).
+    assert tp_serving.kv_cache_spec(cfg, 2) == P(
+        None, None, None, AXIS_MODEL, None)
+    assert tp_serving.kv_cache_spec(cfg, 8) == P()
+    # blocks: the TOKEN axis (position 2) shards for EVERY model — the
+    # GQA replication cliff does not exist.
+    assert tp_serving.kv_cache_spec(cfg, 8, layout="blocks") == P(
+        None, None, AXIS_MODEL, None, None)
+    assert tp_serving.kv_cache_spec(cfg, 1, layout="blocks") == P()
+    # blocks spills upload replicated (lane-table widths need not divide
+    # the mesh); heads keeps the arena-matching row spec.
+    assert tp_serving.kv_rows_spec(cfg, 2, head_axis=2) == P(
+        None, None, AXIS_MODEL, None)
+    assert tp_serving.kv_rows_spec(cfg, 2, head_axis=2,
+                                   layout="blocks") == P()
+    # The decode kernel's shard_map specs follow the same split.
+    from kata_xpu_device_plugin_tpu.parallel.sharding import decode_attn_specs
+
+    q, kv, out = decode_attn_specs(cfg, 8, quantized=False,
+                                   kv_layout="blocks")
+    assert kv == P(None, AXIS_MODEL, None, None)
+    assert q == P(None, None, None, None) == out
+
+
+# ----- knob contract --------------------------------------------------------
+
+
+def test_kv_layout_env_select_and_malformed_degrade(model, monkeypatch,
+                                                    tmp_path):
+    cfg, params = model
+    pool = dict(kv_pool_tokens=256, kv_block_size=8, max_batch=2,
+                max_len=32)
+    monkeypatch.setenv("KATA_TPU_KV_LAYOUT", "blocks")
+    srv = GenerationServer(params, cfg, **pool)
+    assert srv.stats()["kv_layout"] == "blocks"
+    monkeypatch.setenv("KATA_TPU_KV_LAYOUT", "banana")
+    srv, events = _capture_events(
+        tmp_path, lambda: GenerationServer(params, cfg, **pool)
+    )
+    assert srv.stats()["kv_layout"] == "heads"
+    assert any(e.get("name") == "kv_layout_invalid" for e in events)
+    monkeypatch.delenv("KATA_TPU_KV_LAYOUT")
+    # An explicit argument always wins over the env.
+    monkeypatch.setenv("KATA_TPU_KV_LAYOUT", "heads")
+    srv = GenerationServer(params, cfg, kv_layout="blocks", **pool)
+    assert srv.stats()["kv_layout"] == "blocks"
+
+
+def test_kv_layout_explicit_invalid_raises(model):
+    cfg, params = model
+    with pytest.raises(ValueError, match="kv_layout"):
+        GenerationServer(params, cfg, max_batch=2, max_len=32,
+                         kv_pool_tokens=256, kv_layout="banana")
+
+
+def test_blocks_layout_requires_paged(model, monkeypatch, tmp_path):
+    cfg, params = model
+    # Explicit blocks on a slotted server: raise.
+    with pytest.raises(ValueError, match="paged"):
+        GenerationServer(params, cfg, max_batch=2, max_len=32,
+                         kv_pool_tokens=0, kv_layout="blocks")
+    # Node-injected env on a slotted server: degrade with an event.
+    monkeypatch.setenv("KATA_TPU_KV_LAYOUT", "blocks")
+    srv, events = _capture_events(
+        tmp_path,
+        lambda: GenerationServer(params, cfg, max_batch=2, max_len=32,
+                                 kv_pool_tokens=0),
+    )
+    assert srv.stats()["kv_layout"] == "heads"
+    assert any(
+        e.get("name") == "kv_layout_disabled" and e["reason"] == "not_paged"
+        for e in events
+    )
+
+
+def test_kv_host_knob_contract(model, monkeypatch, tmp_path):
+    cfg, params = model
+    with pytest.raises(ValueError, match="kv_host_tokens"):
+        GenerationServer(params, cfg, max_batch=2, max_len=32,
+                         kv_pool_tokens=256, kv_host_tokens=-1)
+    with pytest.raises(ValueError, match="paged"):
+        GenerationServer(params, cfg, max_batch=2, max_len=32,
+                         kv_pool_tokens=0, kv_host_tokens=512)
+    monkeypatch.setenv("KATA_TPU_KV_HOST_TOKENS", "16k")
+    srv, events = _capture_events(
+        tmp_path,
+        lambda: GenerationServer(params, cfg, max_batch=2, max_len=32,
+                                 kv_pool_tokens=256),
+    )
+    assert srv.stats()["kv_host_tokens"] == 0
+    assert any(e.get("name") == "kv_host_invalid" for e in events)
+    # A node-wide host tier on a slotted server degrades with an event.
+    monkeypatch.setenv("KATA_TPU_KV_HOST_TOKENS", "512")
+    srv, events = _capture_events(
+        tmp_path,
+        lambda: GenerationServer(params, cfg, max_batch=2, max_len=32,
+                                 kv_pool_tokens=0),
+    )
+    assert srv.stats()["kv_host_tokens"] == 0
+    assert any(
+        e.get("name") == "kv_host_disabled" and e["reason"] == "not_paged"
+        for e in events
+    )
+
+
+# ----- layout events --------------------------------------------------------
+
+
+def test_kv_layout_event_once_per_server(model, tmp_path):
+    cfg, params = model
+    srv, events = _capture_events(
+        tmp_path,
+        lambda: GenerationServer(
+            params, cfg, max_batch=2, max_len=32, kv_pool_tokens=256,
+            kv_block_size=8, kv_layout="blocks", kv_host_tokens=512,
+        ),
+    )
+    kv = [e for e in events if e.get("name") == "kv_layout"]
+    assert len(kv) == 1
+    assert kv[0]["layout"] == "blocks"
+    assert kv[0]["shards"] == 1  # tp=1: one sub-pool
+    assert kv[0]["per_shard_bytes"] > 0
+    assert kv[0]["host_tier_tokens"] == 512
+
+
+def test_kv_replicated_only_under_heads_layout(model, tmp_path):
+    if jax.device_count() < 8:
+        pytest.skip("needs the forced 8-device CPU host")
+    cfg, params = model  # n_kv_heads=2 does not divide tp=8
+    pool = dict(max_batch=2, max_len=32, kv_pool_tokens=8 * 64,
+                kv_block_size=8, tp=8)
+
+    _, events = _capture_events(
+        tmp_path,
+        lambda: GenerationServer(params, cfg, kv_layout="heads", **pool),
+        name="heads.jsonl",
+    )
+    assert any(e.get("name") == "kv_replicated" for e in events)
+
+    srv, events = _capture_events(
+        tmp_path,
+        lambda: GenerationServer(params, cfg, kv_layout="blocks", **pool),
+        name="blocks.jsonl",
+    )
+    assert not any(e.get("name") == "kv_replicated" for e in events)
+    kv = [e for e in events if e.get("name") == "kv_layout"]
+    assert kv and kv[0]["shards"] == 8
+    # Real per-shard sub-pools: the occupancy list has 8 entries.
+    assert len(srv.stats()["kv_pool_shard_occupancy"]) == 8
+    assert srv.kv_pool.shards == 8
+
+
+# ----- bit-identity across layouts ------------------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+@pytest.mark.parametrize("overlap", [False, True])
+def test_blocks_identity_matrix(model, kv_quant, overlap):
+    """The acceptance criterion: blocks ≡ heads ≡ tp=1, greedy
+    bit-identical, across int8/bf16 × overlap/lockstep × prefix-hit ×
+    preemption pressure (tight pool), on the forced-8-device host."""
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    cfg, params = model
+    prompts = _prompts(cfg, [10, 12, 9, 11, 8, 10], shared=16)
+    kw = dict(
+        max_batch=3, max_len=64, chunk=4, prefill_buckets=(16, 32),
+        prefix_cache_tokens=1, kv_quant=kv_quant, overlap=overlap,
+        kv_block_size=8, kv_pool_tokens=8 * 14,  # tight: preempts
+    )
+    ref, rsrv = _serve(params, cfg, prompts, budgets=24, **kw, tp=1)
+    assert rsrv.stats()["preemptions"] > 0, "matrix must exercise pressure"
+    for layout in ("heads", "blocks"):
+        got, srv = _serve(params, cfg, prompts, budgets=24, **kw, tp=2,
+                          kv_layout=layout)
+        assert srv.stats()["kv_layout"] == layout
+        for i, r in enumerate(ref):
+            np.testing.assert_array_equal(got[i], r)
+
+
+def test_blocks_identity_with_paged_kernel(model):
+    """The blocks layout through the SHARD-LOCAL kernel form: each shard
+    DMAs only its own blocks, cross-shard lanes recombine through the
+    online-softmax merge — greedy tokens equal the tp=1 XLA path."""
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    cfg, params = model
+    prompts = _prompts(cfg, [6, 9, 7, 5])
+    kw = dict(max_batch=2, max_len=48, chunk=4, prefill_buckets=(16,),
+              kv_block_size=8, kv_pool_tokens=512, prefix_cache_tokens=0)
+    ref, _ = _serve(params, cfg, prompts, **kw, tp=1)
+    for kv_quant in (False, True):
+        got, srv = _serve(
+            params, cfg, prompts, **kw, tp=2, kv_layout="blocks",
+            kv_quant=kv_quant, decode_attn="pallas_paged",
+        )
+        assert srv.stats()["decode_backend"] == "pallas_paged"
+        if not kv_quant:
+            for i, r in enumerate(ref):
+                np.testing.assert_array_equal(got[i], r)
+        else:
+            # int8 arenas round each cache write; the kernel's fused
+            # dequant is value-identical to the gather path, so compare
+            # against the tp=1 int8 ORACLE instead of the bf16 ref.
+            ref8, _ = _serve(params, cfg, prompts, **kw, tp=1,
+                             kv_quant=True)
+            for i, r in enumerate(ref8):
+                np.testing.assert_array_equal(got[i], r)
+
+
+def test_int8_spill_restore_roundtrip_blocks_tp(model):
+    """ISSUE 14 bug-risk satellite: preempting an int8 QTensor pool at
+    tp>1 under the BLOCKS layout spills payload+scale rows whose blocks
+    straddle shard boundaries (lane tables freely mix shards, spill
+    widths need not divide tp); the host round-trip must restore them
+    verbatim — greedy outputs bit-identical to the never-preempted run,
+    with and without strict mode."""
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    cfg, params = model
+    prompts = _prompts(cfg, [10, 12, 9, 11, 8, 10])
+    for strict in (False, True):
+        kw = dict(
+            max_batch=3, max_len=64, chunk=4, prefill_buckets=(16,),
+            kv_quant=True, kv_block_size=8, strict=strict,
+            prefix_cache_tokens=0,
+        )
+        ref, _ = _serve(params, cfg, prompts, budgets=24, **kw, tp=1,
+                        kv_pool_tokens=512)
+        got, srv = _serve(params, cfg, prompts, budgets=24, **kw, tp=2,
+                          kv_layout="blocks", kv_pool_tokens=8 * 14)
+        assert srv.stats()["preemptions"] > 0, "must exercise the spill"
+        for i, r in enumerate(ref):
+            np.testing.assert_array_equal(got[i], r)
+
+
+# ----- host tier: demotion / prefetch semantics -----------------------------
+
+
+def _session_heads(cfg, n=2, seed=5):
+    key = jax.random.PRNGKey(seed)
+    return [
+        np.asarray(jax.random.randint(
+            jax.random.fold_in(key, i), (16,), 0, cfg.vocab_size
+        ), np.int32)
+        for i in range(n)
+    ]
+
+
+def _lineage_server(params, cfg, host_tokens, **kw):
+    return GenerationServer(
+        params, cfg, max_batch=1, max_len=48, chunk=4,
+        prefill_buckets=(16, 32), prefix_cache_tokens=1, kv_block_size=8,
+        kv_pool_tokens=8 * 8, kv_host_tokens=host_tokens,
+        fault_injector=FaultInjector(), **kw,
+    )
+
+
+def test_demotion_before_preemption_and_survival(model, tmp_path):
+    """Pool pressure demotes unpinned prefix segments to host RAM
+    BEFORE any lane is preempted, the demoted segment's later hit
+    prefetches it back, and outputs stay bit-identical to the
+    tier-less run."""
+    cfg, params = model
+    h1, h2 = _session_heads(cfg)
+
+    def burst(host_tokens):
+        srv = _lineage_server(params, cfg, host_tokens)
+        outs = []
+        for i, head in enumerate([h1, h2, h1, h2, h1]):
+            p = np.concatenate([head, np.asarray([50 + i] * 4, np.int32)])
+            r = srv.submit(p, 8)
+            outs.append(srv.run()[r])
+        return outs, srv
+
+    (ref, cold), _ = _capture_events(tmp_path, lambda: burst(0),
+                                     name="cold.jsonl")
+    (out, srv), events = _capture_events(tmp_path, lambda: burst(1024),
+                                         name="host.jsonl")
+    for a, b in zip(ref, out):
+        np.testing.assert_array_equal(a, b)
+    st = srv.stats()
+    # Demotions happened, preemption never did: the tier absorbed the
+    # pressure (demotion-before-preemption), and the parked segments
+    # came back as hits the tier-less run lost to eviction.
+    assert st["kv_demotions"] > 0 and st["kv_prefetches"] > 0
+    assert st["preemptions"] == 0
+    assert st["prefix_hits"] > cold.stats()["prefix_hits"]
+    names = [e.get("name") for e in events]
+    assert "kv_demote" in names and "kv_prefetch" in names
+    # The tier-less run evicted (dropped) instead.
+    assert cold.prefix_store.stats()["evictions"] > 0
+    assert cold.stats()["kv_demotions"] == 0
+
+
+@pytest.mark.parametrize("overlap", [False, True])
+def test_resume_prefetch_races_decode_dispatch(model, overlap, tmp_path):
+    """Preempted sessions resume through the staged H2D prefetch — the
+    upload starts while a decode chunk is in flight (overlap) or ahead
+    of the next round (lockstep) — with outputs bit-identical to the
+    tier-less baseline and the prefetch visible on kv_resume events."""
+    cfg, params = model
+    prompts = _prompts(cfg, [10, 12, 9, 11, 8, 10], seed=3)
+    kw = dict(max_batch=3, max_len=64, chunk=4, prefill_buckets=(16,),
+              kv_block_size=8, kv_pool_tokens=8 * 14, overlap=overlap,
+              prefix_cache_tokens=0)
+    ref, rsrv = _serve(params, cfg, prompts, budgets=24, **kw)
+    assert rsrv.stats()["preemptions"] > 0
+    (got, srv), events = _capture_events(
+        tmp_path,
+        lambda: _serve(params, cfg, prompts, budgets=24,
+                       kv_host_tokens=2048, **kw),
+    )
+    for i, r in enumerate(ref):
+        np.testing.assert_array_equal(got[i], r)
+    st = srv.stats()
+    assert st["preemptions"] > 0 and st["kv_prefetches"] > 0
+    resumes = [e for e in events if e.get("name") == "kv_resume"]
+    assert resumes and any(e.get("prefetched") for e in resumes)
+
+
+def test_degrade_mesh_replaces_block_sharded_pool(model):
+    """Chip loss at tp=4 under the BLOCKS layout: the shrink re-places
+    the pool onto the tp=2 mesh with matching per-shard sub-pools and
+    the replayed load finishes bit-identically."""
+    if jax.device_count() < 4:
+        pytest.skip("needs the forced 8-device CPU host")
+    cfg, params = model
+    prompts = _prompts(cfg, [4, 8, 6, 3])
+    kw = dict(max_batch=2, max_len=48, chunk=4, prefill_buckets=(16,),
+              kv_block_size=8, kv_pool_tokens=8 * 16, kv_layout="blocks",
+              prefix_cache_tokens=0)
+    ref, _ = _serve(params, cfg, prompts, **kw, tp=4)
+    got, srv = _serve(
+        params, cfg, prompts, **kw, tp=4,
+        injector=FaultInjector(
+            [FaultSpec("decode_dispatch", 2, "chip_loss", 1)], seed=3
+        ),
+    )
+    for i, r in enumerate(ref):
+        np.testing.assert_array_equal(got[i], r)
+    st = srv.stats()
+    assert st["tp_degraded"] == 1 and st["tp_degree"] == 2
+    assert srv.failures() == {}
+    # The rebuilt pool's sub-pools match the shrunken mesh.
+    assert srv.kv_pool.shards == 2
+    assert len(st["kv_pool_shard_occupancy"]) == 2
+
+
+def test_seeded_faults_mid_demotion_recover_bit_identical(model):
+    """pool_alloc faults fire INSIDE the allocation-pressure path that
+    drives demotions, and a fence fault interrupts rounds with spilled
+    sessions pending — recovery must keep greedy outputs bit-identical
+    and fail nothing."""
+    cfg, params = model
+    h1, h2 = _session_heads(cfg, seed=11)
+
+    def burst(injector):
+        srv = GenerationServer(
+            params, cfg, max_batch=1, max_len=48, chunk=4,
+            prefill_buckets=(16, 32), prefix_cache_tokens=1,
+            kv_block_size=8, kv_pool_tokens=8 * 8,
+            kv_host_tokens=1024, fault_injector=injector,
+        )
+        outs = []
+        for i, head in enumerate([h1, h2, h1, h2]):
+            p = np.concatenate([head, np.asarray([60 + i] * 4, np.int32)])
+            r = srv.submit(p, 8)
+            outs.append(srv.run()[r])
+        return outs, srv
+
+    ref, refsrv = burst(FaultInjector())
+    assert refsrv.stats()["kv_demotions"] > 0, "must exercise demotion"
+    out, srv = burst(FaultInjector(
+        [FaultSpec("pool_alloc", 2), FaultSpec("fence", 1)], seed=7,
+    ))
+    for a, b in zip(ref, out):
+        np.testing.assert_array_equal(a, b)
+    assert srv.stats()["recoveries"] >= 1
+    assert srv.failures() == {}
+
+
+def test_none_vanish_under_drain_with_host_tier(model):
+    """A drain over a host-tier server with spilled (host-resident)
+    sessions: started work finishes, the tail fails loudly, every rid
+    ends in exactly one of results/failures, and failed spills release
+    their host-tier accounting."""
+    cfg, params = model
+    prompts = _prompts(cfg, [9, 7, 8, 6, 9, 7], seed=9)
+    srv = GenerationServer(
+        params, cfg, max_batch=2, max_len=48, chunk=4,
+        prefill_buckets=(16,), kv_block_size=8, kv_pool_tokens=8 * 14,
+        kv_host_tokens=2048, fault_injector=FaultInjector(),
+        prefix_cache_tokens=0,
+    )
+    rids = [srv.submit(p, 16) for p in prompts]
+    # A few rounds so lanes fill and pressure spills someone to host.
+    for _ in range(6):
+        if not srv.step():
+            break
+    results = srv.drain(reason="test")
+    failures = srv.failures()
+    for r in rids:
+        assert (r in results) != (r in failures), f"rid {r} vanished"
+    # Terminal spills released their pinned host entries; live-completed
+    # ones released at resume — nothing leaks.
+    if srv._kv_host is not None:
+        assert all(
+            not (isinstance(k, tuple) and k[0] == "spill")
+            or srv._kv_host.get(k) is None
+            for k in list(srv._kv_host._entries)
+        )
+
+
+# ----- stats / metrics / daemon plumbing ------------------------------------
+
+
+def test_stats_schema_always_present(model):
+    cfg, params = model
+    slotted = GenerationServer(params, cfg, max_batch=2, max_len=32,
+                               kv_pool_tokens=0, kv_layout=None)
+    st = slotted.stats()
+    assert st["kv_layout"] == "heads" and st["kv_pool_shards"] == 1
+    assert st["kv_host_tokens"] == 0 and st["kv_host_blocks"] == 0
+    assert st["kv_demotions"] == 0 and st["kv_prefetches"] == 0
+    paged = GenerationServer(params, cfg, max_batch=2, max_len=32,
+                             kv_pool_tokens=256, kv_block_size=8,
+                             kv_layout="blocks", kv_host_tokens=512)
+    st = paged.stats()
+    assert st["kv_layout"] == "blocks"
+    assert st["kv_host_tokens"] == 512
+
+
+def test_export_metrics_includes_host_tier_gauges(model):
+    from prometheus_client import REGISTRY, generate_latest
+
+    cfg, params = model
+    srv = GenerationServer(params, cfg, max_batch=2, max_len=32,
+                           kv_pool_tokens=256, kv_block_size=8,
+                           kv_host_tokens=512)
+    label = srv.export_metrics()
+    text = generate_latest(REGISTRY).decode()
+    assert f'kata_tpu_serving_kv_host_blocks{{server="{label}"}}' in text
+    for ctr in ("kv_demotions_total", "kv_prefetches_total"):
+        assert f'kata_tpu_serving_{ctr}{{server="{label}"}}' in text
+
+
+def test_allocator_injects_kv_layout_env():
+    """Daemon side of the knobs: config.kv_layout / kv_host_tokens ride
+    the TPU AllocateResponse env (plugin/allocators.py), the same
+    delivery path as the pool/quant knobs. Host-only — no jax."""
+    from kata_xpu_device_plugin_tpu.cdi import constants as C
+    from kata_xpu_device_plugin_tpu.discovery.tpu import TpuChip, TpuInventory
+    from kata_xpu_device_plugin_tpu.plugin import TpuAllocator
+    from kata_xpu_device_plugin_tpu.topology.slice import HostTopology
+
+    inv = TpuInventory(
+        chips=(TpuChip(index=0, dev_path="/dev/accel0"),),
+        topology=HostTopology.from_accelerator_type("v5litepod-8"),
+        model_suffix="TPU_V5E",
+    )
+    alive = lambda _chip: True  # noqa: E731 — no real /dev in this test
+    wired = TpuAllocator(
+        lambda: inv, "google.com", "tpu", revalidate=alive,
+        kv_layout="blocks", kv_host_tokens=1 << 20,
+    ).allocate(["0"])
+    assert wired.envs[C.ENV_KV_LAYOUT] == "blocks"
+    assert wired.envs[C.ENV_KV_HOST_TOKENS] == str(1 << 20)
+    bare = TpuAllocator(
+        lambda: inv, "google.com", "tpu", revalidate=alive
+    ).allocate(["0"])
+    assert C.ENV_KV_LAYOUT not in bare.envs
+    assert C.ENV_KV_HOST_TOKENS not in bare.envs
+
+
+def test_config_validates_layout_and_host_tokens():
+    from kata_xpu_device_plugin_tpu.config import Config
+
+    assert Config(kv_layout="blocks", kv_host_tokens=4096).kv_layout == \
+        "blocks"
+    with pytest.raises(ValueError, match="kv-layout"):
+        Config(kv_layout="banana")
+    with pytest.raises(ValueError, match="kv-host-tokens"):
+        Config(kv_host_tokens=-1)

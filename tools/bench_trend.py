@@ -25,6 +25,11 @@ Conventions:
 - A headline metric whose value is bit-identical across both banks is
   marked ``flat`` — the "nobody is moving this number" signal this tool
   exists to raise.
+- Banks that flipped a ``*_layout`` config field between rounds (e.g. a
+  ``serving_kv_layout`` heads → blocks A/B, ISSUE 14) mark that family's
+  moved metrics ``layout`` instead of ``regression``/``improved`` — an
+  intentional config flip is a fact to print, not a perf alarm, and it
+  must not fail the trend gate.
 """
 from __future__ import annotations
 
@@ -51,6 +56,10 @@ HEADLINE_METRICS = (
     # 13): the serving-vs-raw-decode-gap number the fused scheduler and
     # decode_steps=K exist to move.
     "serving_fused_tok_per_s",
+    # Peak concurrent sessions the block-sharded pool sustains at the
+    # fixed per-chip budget (ISSUE 14): the sessions-per-chip capacity
+    # number the blocks layout + host tier exist to move.
+    "serving_kv_sessions",
 )
 
 DEFAULT_THRESHOLD = 0.10  # 10%
@@ -79,13 +88,34 @@ def numeric_metrics(bank: dict) -> dict[str, float]:
     return out
 
 
+def layout_flips(old: dict, new: dict) -> dict[str, tuple]:
+    """String-valued ``*_layout`` config fields both banks carry whose
+    values DIFFER — an intentional A/B flip (the operator changed the
+    bank's configuration between rounds), keyed by field name with the
+    (old, new) pair. The metric family sharing the field's prefix (e.g.
+    ``serving_kv_`` for ``serving_kv_layout``) is then printed as
+    ``layout`` rather than flagged."""
+    out: dict[str, tuple] = {}
+    for k, v in old.items():
+        if k.endswith("_layout") and isinstance(v, str):
+            w = new.get(k)
+            if isinstance(w, str) and w != v:
+                out[k] = (v, w)
+    return out
+
+
 def compare(old: dict, new: dict,
             threshold: float = DEFAULT_THRESHOLD) -> list[dict]:
     """Per-metric rows for the fields both banks carry: old/new values,
     relative delta, and a status — ``regression`` (headline, dropped
     beyond threshold), ``improved`` (headline, rose beyond threshold),
-    ``flat`` (headline, bit-identical), or ``""`` (context)."""
+    ``flat`` (headline, bit-identical), ``layout`` (the metric's family
+    flipped a ``*_layout`` config field between the banks — an
+    intentional A/B, never a regression), or ``""`` (context)."""
     om, nm = numeric_metrics(old), numeric_metrics(new)
+    flip_prefixes = tuple(
+        k[: -len("layout")] for k in layout_flips(old, new)
+    )
     rows: list[dict] = []
     for k in sorted(set(om) & set(nm)):
         a, b = om[k], nm[k]
@@ -98,6 +128,9 @@ def compare(old: dict, new: dict,
                 status = "regression"
             elif delta > threshold:
                 status = "improved"
+            if status in ("regression", "improved") and any(
+                    k.startswith(p) for p in flip_prefixes):
+                status = "layout"
         rows.append({
             "metric": k,
             "old": a,
@@ -112,12 +145,17 @@ def compare(old: dict, new: dict,
     return rows
 
 
-def render(rows: list[dict], old_path: str, new_path: str) -> str:
+def render(rows: list[dict], old_path: str, new_path: str,
+           flips: Optional[dict] = None) -> str:
     lines = [
         f"bench trend: {os.path.basename(old_path)} -> "
         f"{os.path.basename(new_path)}",
-        f"{'metric':<38} {'old':>12} {'new':>12} {'delta':>9}  status",
     ]
+    for field, (a, b) in sorted((flips or {}).items()):
+        lines.append(f"layout change: {field} {a} -> {b}")
+    lines.append(
+        f"{'metric':<38} {'old':>12} {'new':>12} {'delta':>9}  status"
+    )
     for r in rows:
         lines.append(
             f"{r['metric']:<38} {r['old']:>12.4g} {r['new']:>12.4g} "
@@ -176,15 +214,17 @@ def main(argv: Optional[list[str]] = None) -> int:
         return 0  # an empty bank is not a failure
     (new_path, new), (old_path, old) = loaded[0], loaded[1]
     rows = compare(old, new, threshold=args.threshold)
+    flips = layout_flips(old, new)
     if args.json:
         print(json.dumps({
             "old": os.path.basename(old_path),
             "new": os.path.basename(new_path),
             "threshold": args.threshold,
+            "layout_changes": {k: list(v) for k, v in flips.items()},
             "rows": rows,
         }, indent=2))
     else:
-        print(render(rows, old_path, new_path))
+        print(render(rows, old_path, new_path, flips=flips))
     return 1 if any(r["status"] == "regression" for r in rows) else 0
 
 

@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused kv-layout eval eval-kv demo dryrun image clean deploy obs-check
+.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused kv-layout eval eval-kv demo dryrun image clean deploy obs-check obs-report
 
 all: build
 
@@ -59,8 +59,21 @@ verify-static: lint obs-check analyze
 # artifact CI uploads.
 obs-check:
 	$(PY) -m tools.lint --rule JX005
-	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=obs_check_events.jsonl \
+	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/obs_check_events.jsonl \
 	  $(PY) -m pytest tests/test_obs.py tests/test_lint.py -q
+
+# Offline-reporter smoke gate (ISSUE 15): generate a fresh instrumented
+# serving burst (heartbeats, request traces, spans), render it through
+# tools/obs_report.py (markdown + JSON), and FAIL on report-schema drift
+# (--check also demands a non-empty phase waterfall and heartbeat
+# section — an empty report from a fresh stream is drift upstream of
+# the schema). Wired into CI next to the chaos/kv-layout jobs.
+obs-report:
+	JAX_PLATFORMS=cpu $(PY) -m tools.obs_report --generate \
+	  artifacts/obs_report_smoke_events.jsonl
+	$(PY) -m tools.obs_report artifacts/obs_report_smoke_events.jsonl \
+	  --md artifacts/obs_report_smoke.md \
+	  --json artifacts/obs_report_smoke.json --check --quiet
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -83,7 +96,7 @@ bench:
 # bench_smoke_events.jsonl next to the tier-1 timing artifact. The number
 # printed is NOT the headline metric.
 bench-smoke:
-	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=bench_smoke_events.jsonl \
+	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/bench_smoke_events.jsonl \
 	KATA_TPU_COMPILE_CACHE_DIR=$${KATA_TPU_COMPILE_CACHE_DIR:-.cache/xla-compile} \
 	XLA_FLAGS="$${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
 	  $(PY) bench.py --smoke
@@ -95,7 +108,7 @@ bench-smoke:
 # CI's bench-smoke job runs the same sweep as part of the full smoke and
 # uploads the result lines + events JSONL as artifacts.
 bench-load:
-	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=bench_load_events.jsonl \
+	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/bench_load_events.jsonl \
 	KATA_TPU_COMPILE_CACHE_DIR=$${KATA_TPU_COMPILE_CACHE_DIR:-.cache/xla-compile} \
 	KATA_TPU_BENCH_INT8=0 KATA_TPU_BENCH_SERVING=0 KATA_TPU_BENCH_SOFTCAP=0 \
 	KATA_TPU_BENCH_TRAIN=0 KATA_TPU_BENCH_PREFIX=0 KATA_TPU_BENCH_PAGED=0 \
@@ -126,15 +139,15 @@ bench-trend:
 # that crosses it, so recovery × chunked-prefill replay (mid-chunk fault →
 # strict-FIFO requeue from the prompt) runs under BOTH strict modes.
 chaos:
-	rm -rf chaos_flight_dumps
-	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_events.jsonl \
-	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
+	rm -rf artifacts/chaos_flight_dumps
+	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/chaos_events.jsonl \
+	KATATPU_FLIGHT_DIR=artifacts/chaos_flight_dumps \
 	KATA_TPU_FAULTS="decode_dispatch:5,fence:7:hang,prefill:3,sched_tick:2" \
 	KATA_TPU_FAULTS_SEED=13 \
 	  $(PY) -m pytest tests/test_recovery.py tests/test_serving.py \
 	    tests/test_serving_pipeline.py tests/test_scheduler.py -q
-	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_events_strict.jsonl \
-	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
+	JAX_PLATFORMS=cpu KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/chaos_events_strict.jsonl \
+	KATATPU_FLIGHT_DIR=artifacts/chaos_flight_dumps \
 	KATA_TPU_FAULTS="decode_dispatch:5,fence:7:hang,prefill:3,sched_tick:2" \
 	KATA_TPU_FAULTS_SEED=13 KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_recovery.py tests/test_serving.py \
@@ -146,13 +159,13 @@ chaos:
 	# with and without KATA_TPU_STRICT=1 (the shrink's re-shard path runs
 	# under allow_transfer and must stay transfer-guard-clean).
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_chiploss_events.jsonl \
-	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/chaos_chiploss_events.jsonl \
+	KATATPU_FLIGHT_DIR=artifacts/chaos_flight_dumps \
 	KATA_TPU_FAULTS="decode_dispatch:3:chip_loss:1" KATA_TPU_FAULTS_SEED=13 \
 	  $(PY) -m pytest tests/test_degraded.py -q
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_chiploss_events_strict.jsonl \
-	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/chaos_chiploss_events_strict.jsonl \
+	KATATPU_FLIGHT_DIR=artifacts/chaos_flight_dumps \
 	KATA_TPU_FAULTS="decode_dispatch:3:chip_loss:1" KATA_TPU_FAULTS_SEED=13 \
 	KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_degraded.py -q
@@ -165,14 +178,14 @@ chaos:
 	# modes. sched_tick:3 additionally fires at a fused slice's dispatch
 	# prep.
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_fused_events.jsonl \
-	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/chaos_fused_events.jsonl \
+	KATATPU_FLIGHT_DIR=artifacts/chaos_flight_dumps \
 	KATA_TPU_FAULTS="decode_dispatch:4,sched_tick:3" KATA_TPU_FAULTS_SEED=13 \
 	KATA_TPU_DECODE_STEPS=2 \
 	  $(PY) -m pytest tests/test_fused_decode.py -q
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_fused_events_strict.jsonl \
-	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/chaos_fused_events_strict.jsonl \
+	KATATPU_FLIGHT_DIR=artifacts/chaos_flight_dumps \
 	KATA_TPU_FAULTS="decode_dispatch:4,sched_tick:3" KATA_TPU_FAULTS_SEED=13 \
 	KATA_TPU_DECODE_STEPS=2 KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_fused_decode.py -q
@@ -183,17 +196,33 @@ chaos:
 	# recovery must keep outputs bit-identical and none vanish under
 	# drain — both strict modes.
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_kv_events.jsonl \
-	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/chaos_kv_events.jsonl \
+	KATATPU_FLIGHT_DIR=artifacts/chaos_flight_dumps \
 	KATA_TPU_FAULTS="pool_alloc:4,fence:6" KATA_TPU_FAULTS_SEED=13 \
 	KATA_TPU_KV_LAYOUT=blocks \
 	  $(PY) -m pytest tests/test_kv_layout.py -q
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=chaos_kv_events_strict.jsonl \
-	KATATPU_FLIGHT_DIR=chaos_flight_dumps \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/chaos_kv_events_strict.jsonl \
+	KATATPU_FLIGHT_DIR=artifacts/chaos_flight_dumps \
 	KATA_TPU_FAULTS="pool_alloc:4,fence:6" KATA_TPU_FAULTS_SEED=13 \
 	KATA_TPU_KV_LAYOUT=blocks KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_kv_layout.py -q
+	# Watchdog chaos (ISSUE 15): the heartbeat/watchdog suite — its
+	# chip_loss integration test drives the breach → watchdog flight
+	# dump → recovery-clears-alert sequence with an explicit seeded
+	# injector (deterministic; the env schedule must not double-fault
+	# it), so the pinned KATATPU_FLIGHT_DIR collects a
+	# katatpu_flight_watchdog_* postmortem as the chaos artifact — both
+	# strict modes.
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/chaos_watchdog_events.jsonl \
+	KATATPU_FLIGHT_DIR=artifacts/chaos_flight_dumps \
+	  $(PY) -m pytest tests/test_watchdog.py -q
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/chaos_watchdog_events_strict.jsonl \
+	KATATPU_FLIGHT_DIR=artifacts/chaos_flight_dumps \
+	KATA_TPU_STRICT=1 \
+	  $(PY) -m pytest tests/test_watchdog.py -q
 
 # Tensor-parallel serving gate (ISSUE 9): the tp suite — topology-env →
 # guest-mesh round trip, the tp=N ≡ tp=1 greedy-identity matrix
@@ -203,10 +232,10 @@ chaos:
 # must stay transfer-guard-clean too).
 tp:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=tp_events.jsonl \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/tp_events.jsonl \
 	  $(PY) -m pytest tests/test_tp_serving.py -q
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=tp_events_strict.jsonl \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/tp_events_strict.jsonl \
 	KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_tp_serving.py -q
 
@@ -218,10 +247,10 @@ tp:
 # dispatch window must stay transfer-guard-clean too).
 decode-attn:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=decode_attn_events.jsonl \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/decode_attn_events.jsonl \
 	  $(PY) -m pytest tests/test_decode_attn_paged.py -q
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=decode_attn_events_strict.jsonl \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/decode_attn_events_strict.jsonl \
 	KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_decode_attn_paged.py -q
 
@@ -236,10 +265,10 @@ decode-attn:
 # sanctioned allow_transfer paths only); obs JSONL artifacts uploaded.
 kv-layout:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=kv_layout_events.jsonl \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/kv_layout_events.jsonl \
 	  $(PY) -m pytest tests/test_kv_layout.py -q
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=kv_layout_events_strict.jsonl \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/kv_layout_events_strict.jsonl \
 	KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_kv_layout.py -q
 
@@ -253,10 +282,10 @@ kv-layout:
 # transfer-guard-clean too).
 fused:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=fused_events.jsonl \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/fused_events.jsonl \
 	  $(PY) -m pytest tests/test_fused_decode.py -q
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	KATATPU_OBS=1 KATATPU_OBS_FILE=fused_events_strict.jsonl \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/fused_events_strict.jsonl \
 	KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_fused_decode.py -q
 

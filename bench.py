@@ -2102,25 +2102,26 @@ def worker(args: argparse.Namespace) -> None:
                     os.environ[k] = v
 
     def measure_obs() -> dict:  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
-        # Telemetry-overhead A/B (ISSUE 11): the same burst served three
-        # ways — (a) the PRODUCTION DEFAULT: request lifecycle ledger +
-        # always-armed flight-recorder ring, JSONL sink off
-        # (serving_obs_*); (b) everything disarmed, recorder included
-        # (serving_obs_off_*); (c) the full opt-in KATATPU_OBS JSONL
-        # sink (serving_obs_sink_*). What this pins: the always-on
-        # tier's cost is noise (serving_obs_overhead_ratio ~1.0,
-        # acceptance <= 1% tok/s — the ring is a dict append per event
-        # at scheduling cadence), greedy outputs are BIT-IDENTICAL
-        # tracing on/off (serving_obs_token_match == 1.0 — telemetry
-        # must never touch numerics), and phase attribution is complete
-        # (serving_obs_trace_coverage ~1.0: request_trace phases sum to
-        # request wall time — read from the RING, proving the flight
-        # recorder captures lifecycle traces with the sink off). The
-        # sink side's ratio is context: per-line flushed file I/O is
-        # the documented opt-in cost, visible at smoke-tiny round
-        # times. SIDE measurement with the usual protections: after the
-        # banked headline, crash-guarded, KATA_TPU_BENCH_OBS=0 disables
-        # (off on retries/fallback).
+        # Telemetry-overhead A/B (ISSUE 11 + 15): the same burst served
+        # three ways — (a) the PRODUCTION DEFAULT: request lifecycle
+        # ledger + always-armed flight-recorder ring + serving HEARTBEAT
+        # (every 4 rounds here — denser than the production 32, so the
+        # measured cost upper-bounds it) + SLO-burn watchdog, JSONL sink
+        # off (serving_obs_*); (b) everything disarmed, recorder and
+        # heartbeat included (serving_obs_off_*); (c) the full opt-in
+        # KATATPU_OBS JSONL sink (serving_obs_sink_*). What this pins:
+        # the always-on tier's cost is noise (serving_obs_overhead_ratio
+        # ~1.0, acceptance <= 1% tok/s — ISSUE 15's bar now INCLUDES
+        # heartbeat+watchdog), greedy outputs are BIT-IDENTICAL tracing
+        # on/off (serving_obs_token_match == 1.0 — telemetry must never
+        # touch numerics), phase attribution is complete
+        # (serving_obs_trace_coverage ~1.0), and heartbeats actually
+        # flowed (serving_obs_heartbeats > 0, watchdog fed, zero alerts
+        # on a healthy run). The sink side's ratio is context: per-line
+        # flushed file I/O is the documented opt-in cost, visible at
+        # smoke-tiny round times. SIDE measurement with the usual
+        # protections: after the banked headline, crash-guarded,
+        # KATA_TPU_BENCH_OBS=0 disables (off on retries/fallback).
         if os.environ.get("KATA_TPU_BENCH_OBS", "1") == "0":
             return {}
         try:
@@ -2136,7 +2137,7 @@ def worker(args: argparse.Namespace) -> None:
             rng = jax.random.PRNGKey(53)
             len_step = max(1, PROMPT_LEN // 8)
 
-            def make_server():
+            def make_server(instrumented: bool = True):
                 return GenerationServer(
                     params, cfg, max_batch=BATCH,
                     max_len=PROMPT_LEN + 72, chunk=srv_chunk,
@@ -2144,6 +2145,11 @@ def worker(args: argparse.Namespace) -> None:
                     # Explicit offs: daemon-injected pool/prefix envs
                     # must not contaminate the A/B.
                     prefix_cache_tokens=0, kv_pool_tokens=0,
+                    # Heartbeat + watchdog ride the instrumented sides
+                    # (ISSUE 15): 4-round cadence beats the production
+                    # default 8×, so the ratio upper-bounds the real
+                    # cost; the off side runs the uninstrumented loop.
+                    heartbeat_rounds=4 if instrumented else 0,
                 )
 
             def reqs(srv, salt=0):
@@ -2181,7 +2187,7 @@ def worker(args: argparse.Namespace) -> None:
                 prev_rec = obs_flight.set_default_recorder(rec)
                 prev_sink = obs.set_default_sink(sink)
                 try:
-                    srv = make_server()
+                    srv = make_server(instrumented=mode != "off")
                     rids = reqs(srv, salt=0)
                     t0 = time.perf_counter()
                     results = srv.run()
@@ -2218,9 +2224,16 @@ def worker(args: argparse.Namespace) -> None:
                 outputs_equal(ring_results, off_results),
                 outputs_equal(sink_results, off_results),
             )
+            ring_events = ring_rec.snapshot() if ring_rec else []
             traces = [
-                e for e in (ring_rec.snapshot() if ring_rec else [])
-                if e.get("name") == "request_trace"
+                e for e in ring_events if e.get("name") == "request_trace"
+            ]
+            heartbeats = [
+                e for e in ring_events
+                if e.get("name") == "serving_heartbeat"
+            ]
+            wd_alerts = [
+                e for e in ring_events if e.get("name") == "watchdog_alert"
             ]
             coverage = (
                 sum(
@@ -2248,6 +2261,11 @@ def worker(args: argparse.Namespace) -> None:
                 "serving_obs_token_match": match,
                 "serving_obs_traces": len(traces),
                 "serving_obs_trace_coverage": round(coverage, 4),
+                # Heartbeat + watchdog rode the instrumented sides
+                # (ISSUE 15): heartbeats flowed at the 4-round cadence,
+                # and a healthy burst must fire zero watchdog alerts.
+                "serving_obs_heartbeats": len(heartbeats),
+                "serving_obs_watchdog_alerts": len(wd_alerts),
             }
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"obs_error": f"{type(exc).__name__}: {exc}"[:200]}

@@ -157,6 +157,19 @@ ENV_SCHED_POLICY = "KATA_TPU_SCHED_POLICY"
 ENV_PREFILL_CHUNK = "KATA_TPU_PREFILL_CHUNK"
 ENV_ITL_SLO_MS = "KATA_TPU_ITL_SLO_MS"
 
+# Guest telemetry uplink (ISSUE 15): with --guest-events-dir set, every
+# TPU Allocate switches the guest's JSONL event stream ON and points it
+# at a per-allocation file under that (shared, e.g. hostPath-mounted)
+# directory — the daemon's heartbeat aggregator (plugin/manager.py)
+# tails those files and re-exports per-allocation serving gauges on the
+# existing utils.metrics endpoint: the upward twin of the ISSUE 11
+# daemon→guest trace handoff. ENV_HEARTBEAT_ROUNDS sets the in-guest
+# heartbeat cadence (guest/serving.py; malformed values degrade with a
+# heartbeat_invalid event).
+ENV_OBS = "KATATPU_OBS"
+ENV_OBS_FILE = "KATATPU_OBS_FILE"
+ENV_HEARTBEAT_ROUNDS = "KATA_TPU_HEARTBEAT_ROUNDS"
+
 # Default location where containerd/CRI-O pick up CDI spec files
 # (ref pkg/device_plugin/device_plugin.go:20).
 DEFAULT_CDI_DIR = "/var/run/cdi"

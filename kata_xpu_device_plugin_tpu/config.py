@@ -212,6 +212,33 @@ class Config:
     # tp_min_invalid event. 0 leaves the guest default (shrink to 1).
     serving_tp_min: int = 0
 
+    # Guest telemetry uplink (ISSUE 15): when set, every TPU Allocate
+    # switches the guest's JSONL event stream on (KATATPU_OBS=1) and
+    # points KATATPU_OBS_FILE at a per-allocation file under this
+    # directory — a host path shared with the guests (hostPath volume /
+    # Kata shared dir). The daemon's heartbeat AGGREGATOR tails those
+    # files (rotation-safe incremental reads, obs.tail_events) and
+    # re-exports per-allocation serving gauges — tokens/s, ITL p99,
+    # queue depth, pool occupancy, watchdog alerts — on the existing
+    # /metrics endpoint, so fleet dashboards see every allocation's
+    # serving health without scraping guests. "" disables both the env
+    # stamp and the aggregator.
+    guest_events_dir: str = ""
+    # Aggregator poll cadence (seconds between tail passes).
+    guest_events_poll_s: float = 5.0
+    # Per-stream growth cap in MiB: the aggregator truncates a guest
+    # event file once its consumed prefix exceeds this (the guest's
+    # O_APPEND writer continues at the new EOF; nothing in-guest
+    # rotates these files, so the daemon is the rotator of last
+    # resort). 0 disables truncation.
+    guest_events_max_mb: int = 64
+    # In-guest serving heartbeat cadence override (ISSUE 15): when > 0,
+    # injected as KATA_TPU_HEARTBEAT_ROUNDS so guests emit their
+    # serving_heartbeat every K rounds (guest default 32; malformed
+    # values degrade in-guest with a heartbeat_invalid event). 0 leaves
+    # the guest default.
+    heartbeat_rounds: int = 0
+
     # Per-allocation trace context (ISSUE 11): when enabled (default),
     # every TPU Allocate stamps the trace id of its own plugin.Allocate
     # span into KATA_TPU_TRACE_CTX in the AllocateResponse env, so
@@ -284,6 +311,20 @@ class Config:
             raise ValueError(
                 f"serving-tp-min {self.serving_tp_min} exceeds serving-tp "
                 f"{self.serving_tp} — the shrink ladder could never start"
+            )
+        if self.guest_events_poll_s <= 0:
+            raise ValueError(
+                f"guest-events-poll-s must be > 0, got "
+                f"{self.guest_events_poll_s}"
+            )
+        if self.guest_events_max_mb < 0:
+            raise ValueError(
+                f"guest-events-max-mb must be >= 0, got "
+                f"{self.guest_events_max_mb}"
+            )
+        if self.heartbeat_rounds < 0:
+            raise ValueError(
+                f"heartbeat-rounds must be >= 0, got {self.heartbeat_rounds}"
             )
         if self.register_attempts < 1:
             raise ValueError(

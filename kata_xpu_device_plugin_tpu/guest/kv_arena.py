@@ -466,12 +466,19 @@ class HostKVTier:
     def entries(self) -> int:
         return len(self._entries)
 
+    def occupancy(self) -> float:
+        """Tier fill, tokens resident over capacity — the host-RAM twin
+        of ``KVPool.occupancy()`` (the serving heartbeat reports the two
+        side by side as the per-tier memory picture, ISSUE 15)."""
+        return round(self.tokens_used / self.capacity_tokens, 4)
+
     def stats(self) -> dict:
         return {
             "capacity_tokens": self.capacity_tokens,
             "tokens_used": self.tokens_used,
             "blocks_used": self.blocks_used,
             "entries": self.entries,
+            "occupancy": self.occupancy(),
         }
 
 

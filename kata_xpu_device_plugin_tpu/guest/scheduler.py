@@ -251,6 +251,20 @@ class Scheduler:
             "sched_queue_delay_s": self.queue_delay.summary(),
         }
 
+    def heartbeat_fields(self) -> dict:
+        """The scheduler's slice of the serving heartbeat (ISSUE 15):
+        the admission-wait rolling quantiles in the heartbeat's ms unit
+        — queue depth's latency twin, and the number the fleet router
+        will route on (how long does THIS replica make requests wait).
+        The chunk/defer/violation counters are NOT repeated here: the
+        heartbeat already derives their interval ``*_delta`` fields
+        from this object's raw counters."""
+        q = self.queue_delay.summary()
+        return {
+            "admission_wait_p50_ms": round(q.get("p50", 0.0) * 1e3, 3),
+            "admission_wait_p99_ms": round(q.get("p99", 0.0) * 1e3, 3),
+        }
+
 
 class SLOChunkedScheduler(Scheduler):
     """``slo_chunked``: defer (chunk) the pending admission whenever the

@@ -235,6 +235,80 @@ ENV_FUSED = "KATA_TPU_FUSED"
 ENV_KV_LAYOUT = tp_serving.ENV_KV_LAYOUT
 ENV_KV_HOST_TOKENS = "KATA_TPU_KV_HOST_TOKENS"
 
+# Serving heartbeat cadence (ISSUE 15): every K rounds the loop rolls
+# its per-dispatch accounting into ONE ``serving_heartbeat`` event —
+# tokens/s, rolling ITL/TTFT quantiles, per-tier occupancy, host-tier
+# hit/prefetch rates, queue depth and admission wait, and the loop-phase
+# time breakdown — from data the loop already holds, so the hot path
+# pays ~one dict per heartbeat (the bench serving_obs_* A/B pins the
+# cost <= 1% tok/s). The SLO-burn watchdog (obs/watchdog.py) consumes
+# each heartbeat in-process. Daemon-injectable through the standard
+# constants → allocators → manager path (cdi.constants
+# ENV_HEARTBEAT_ROUNDS, config.heartbeat_rounds); malformed env values
+# degrade to the default with a ``heartbeat_invalid`` event, an explicit
+# negative argument raises. 0 disables heartbeat, watchdog, AND the
+# phase clock — the fully uninstrumented loop.
+ENV_HEARTBEAT_ROUNDS = "KATA_TPU_HEARTBEAT_ROUNDS"
+DEFAULT_HEARTBEAT_ROUNDS = 32
+
+# Loop-phase buckets of the heartbeat's time breakdown: where one
+# heartbeat interval's host wall clock went. ``admit`` — admission
+# passes (prefill forwards included); ``dispatch`` — building/enqueueing
+# decode executables; ``retire`` — fence waits + token landing;
+# ``host_transfer`` — checkpoint gathers, preemption spills, resume
+# prefetch/restores (the D2H/H2D tier traffic); ``other`` — everything
+# between (scheduling bookkeeping, queue work).
+LOOP_PHASE_ADMIT = "admit"
+LOOP_PHASE_DISPATCH = "dispatch"
+LOOP_PHASE_RETIRE = "retire"
+LOOP_PHASE_HOST = "host_transfer"
+LOOP_PHASE_OTHER = "other"
+LOOP_PHASES = (
+    LOOP_PHASE_ADMIT, LOOP_PHASE_DISPATCH, LOOP_PHASE_RETIRE,
+    LOOP_PHASE_HOST, LOOP_PHASE_OTHER,
+)
+
+
+class _PhaseClock:
+    """Exclusive loop-phase wall-time accounting (ISSUE 15): the serving
+    loop brackets its admission / dispatch / retire / host-transfer
+    sections with :meth:`push`/:meth:`pop`, and elapsed time is charged
+    to the INNERMOST open phase — a checkpoint gather inside a retire
+    window lands in ``host_transfer``, not twice. Disarmed
+    (``heartbeat_rounds=0``) both calls are one attribute test, so the
+    uninstrumented loop stays uninstrumented. Host-only arithmetic:
+    never fences or touches device state (the phase boundaries sit at
+    calls the loop already makes)."""
+
+    __slots__ = ("armed", "acc", "_stack", "_mark")
+
+    def __init__(self, armed: bool):
+        self.armed = armed
+        self.acc = {p: 0.0 for p in LOOP_PHASES[:-1]}
+        self._stack: list = []
+        self._mark = 0.0
+
+    def push(self, phase: str) -> None:
+        if not self.armed:
+            return
+        now = time.perf_counter()
+        if self._stack:
+            top = self._stack[-1]
+            self.acc[top] = self.acc.get(top, 0.0) + (now - self._mark)
+        self._stack.append(phase)
+        self._mark = now
+
+    def pop(self) -> None:
+        if not self.armed or not self._stack:
+            return
+        now = time.perf_counter()
+        phase = self._stack.pop()
+        self.acc[phase] = self.acc.get(phase, 0.0) + (now - self._mark)
+        self._mark = now
+
+    def snapshot(self) -> dict:
+        return dict(self.acc)
+
 
 def resolve_kv_quant(kv_quant, emit=None) -> bool:
     """The ONE int8-by-default resolution (ISSUE 12): explicit argument >
@@ -331,6 +405,14 @@ _PROM_STATS = (
                        "event per retired/failed request)"),
     ("decode_steps", "Multi-step decode multiplier K (tokens per dispatch = "
                      "chunk × K; 1 = one chunk per dispatch)"),
+    ("heartbeats", "Serving heartbeats emitted (one serving_heartbeat "
+                   "event every heartbeat_rounds rounds)"),
+    ("heartbeat_tokens_per_s", "Decoded tokens/s over the last heartbeat "
+                               "interval (0.0 before the first heartbeat)"),
+    ("watchdog_alerts", "SLO-burn watchdog alerts fired (sustained "
+                        "breaches; each dumped the flight ring)"),
+    ("watchdog_active", "Watchdog alert kinds currently active (0 = "
+                        "healthy)"),
     # fused_admissions is stats()-only here: its prometheus surface is
     # the TRUE counter kata_tpu_serving_fused_admissions_total (the
     # factory stores counters under their _total-stripped stem, so a
@@ -347,6 +429,20 @@ def _hist_phase():
         "kata_tpu_serving_request_phase_seconds",
         "Per-request lifecycle phase time attributed at retire "
         "(queue/prefill/decode/decode_degraded/preempted/recovery)",
+        ["server", "phase"],
+    )
+
+
+# Loop-phase time per heartbeat interval (ISSUE 15): where the serving
+# loop's host wall clock goes — one labeled child per LOOP_PHASES entry,
+# observed once per heartbeat, so rate() over the histogram sum answers
+# "what fraction of this replica's time is admission vs dispatch vs
+# fence waits vs tier traffic".
+def _hist_loop_phase():
+    return obs.histogram(
+        "kata_tpu_serving_loop_phase_seconds",
+        "Serving-loop phase time per heartbeat interval "
+        "(admit/dispatch/retire/host_transfer/other)",
         ["server", "phase"],
     )
 
@@ -932,6 +1028,24 @@ class GenerationServer:
     ``KATA_TPU_DEGRADED=0`` kills the path (and skips the donor copy);
     with no feasible rung left the load fails loudly into
     :meth:`failures` (reason ``chip_lost``) — none vanish.
+
+    HEARTBEAT & WATCHDOG (ISSUE 15, ``docs/observability.md`` "Serving
+    heartbeat"): every ``heartbeat_rounds`` rounds (default 32,
+    ``KATA_TPU_HEARTBEAT_ROUNDS``; 0 disables) the loop emits ONE
+    ``serving_heartbeat`` event rolled up from data it already holds —
+    interval tokens/s, rolling ITL/TTFT p50/p99, batch and per-tier pool
+    occupancy (device shards / host-RAM / prefix), host-tier
+    demotion/prefetch and prefix hit rates, queue depth + admission
+    wait, and the loop-phase time breakdown
+    (admit/dispatch/retire/host_transfer) — and feeds it to the SLO-burn
+    watchdog (:class:`..obs.watchdog.SLOBurnWatchdog`; ``watchdog=``
+    injects a configured one, ``False`` disarms,
+    ``KATA_TPU_WATCHDOG=0`` node-wide). On a sustained breach the
+    watchdog dumps the always-armed flight ring with the breach as the
+    reason and can open a bounded profiler window — zero operator
+    action. Pure host arithmetic at existing boundaries: greedy outputs
+    are bit-identical with heartbeat+watchdog on (tested), and
+    ``heartbeat_rounds=0`` restores the fully uninstrumented loop.
     """
 
     def __init__(self, params: Any, cfg: DecoderConfig, max_batch: int = 4,
@@ -963,7 +1077,9 @@ class GenerationServer:
                  tp: Optional[int] = None,
                  tp_min: Optional[int] = None,
                  degraded: Optional[bool] = None,
-                 decode_attn: Optional[str] = None):
+                 decode_attn: Optional[str] = None,
+                 heartbeat_rounds: Optional[int] = None,
+                 watchdog: Any = None):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         if speculative_k < 0:
@@ -1730,6 +1846,61 @@ class GenerationServer:
             prefix_store is not None and self.prefix_store is prefix_store
         )
         self._prefix_capacity = int(prefix_cache_tokens or 0)
+        # Serving heartbeat + SLO-burn watchdog (ISSUE 15). Standard knob
+        # contract: an explicit negative cadence raises, the
+        # daemon-injected env degrades to the default with a
+        # heartbeat_invalid event. Cadence 0 disables heartbeat AND
+        # watchdog AND the loop-phase clock — the uninstrumented path.
+        if heartbeat_rounds is not None and int(heartbeat_rounds) < 0:
+            raise ValueError(
+                f"heartbeat_rounds must be >= 0, got {heartbeat_rounds}"
+            )
+        hb_every = (
+            resilience.env_int(ENV_HEARTBEAT_ROUNDS,
+                               DEFAULT_HEARTBEAT_ROUNDS,
+                               event="heartbeat_invalid",
+                               server=self._label, trace=self._trace)
+            if heartbeat_rounds is None else int(heartbeat_rounds)
+        )
+        if hb_every < 0:
+            # Parseable nonsense from the node env degrades like every
+            # other injected knob — never crashes a guest.
+            self._emit("heartbeat_invalid", reason=f"bad_env:{hb_every}")
+            hb_every = DEFAULT_HEARTBEAT_ROUNDS
+        self._hb_every = hb_every
+        self._hb_round = 0          # rounds counter at the last heartbeat
+        self._hb_count = 0
+        self._hb_t_last = time.monotonic()
+        self._hb_last: Optional[dict] = None
+        self._hb_prev: dict = {}    # counter snapshot the deltas diff against
+        self._clock = _PhaseClock(armed=hb_every > 0)
+        self._clock_prev: dict = {}
+        # Watchdog resolution: an injected SLOBurnWatchdog wins (it must
+        # have heartbeats to consume — explicit conflict raises); True
+        # forces the default config on; False/env "0" disarms; None is
+        # the default (armed whenever the heartbeat is).
+        if isinstance(watchdog, obs.SLOBurnWatchdog) or watchdog is True:
+            if hb_every <= 0:
+                raise ValueError(
+                    "watchdog requires heartbeat_rounds > 0 — it consumes "
+                    "the heartbeats (docs/observability.md)"
+                )
+            self._watchdog: Optional[obs.SLOBurnWatchdog] = (
+                watchdog if isinstance(watchdog, obs.SLOBurnWatchdog)
+                else obs.SLOBurnWatchdog(
+                    obs.WatchdogConfig.from_env(slo_ms=self._sched.slo_ms),
+                    label=self._label, trace=self._trace, emit=self._emit,
+                )
+            )
+        elif watchdog is None and hb_every > 0 and obs.watchdog.enabled():
+            self._watchdog = obs.SLOBurnWatchdog(
+                obs.WatchdogConfig.from_env(slo_ms=self._sched.slo_ms),
+                label=self._label, trace=self._trace, emit=self._emit,
+            )
+        else:
+            self._watchdog = None
+        if self._watchdog is not None:
+            self._watchdog.bind(self._emit)
         # One config event per server (ISSUE 13 observability satellite):
         # the resolved dispatch shape — scheduler policy, decode-steps
         # multiplier, fused flag — so fleet dashboards can segment every
@@ -1744,6 +1915,8 @@ class GenerationServer:
             kv_host_tokens=(
                 self._kv_host.capacity_tokens if self._kv_host else 0
             ),
+            heartbeat_rounds=self._hb_every,
+            watchdog=int(self._watchdog is not None),
         )
 
     def _emit(self, name: str, **fields) -> None:
@@ -1820,12 +1993,139 @@ class GenerationServer:
             replays=req.replays, **fields,
         )
 
+    # ----- serving heartbeat (ISSUE 15) ------------------------------------
+
+    def _hb_counters(self) -> dict:
+        """The cumulative counters the heartbeat turns into interval
+        deltas — all host ints the loop already maintains."""
+        tier = self.prefix_store
+        tier_dem = tier.demotions if isinstance(tier, PagedPrefixTier) else 0
+        tier_pre = tier.prefetches if isinstance(tier, PagedPrefixTier) else 0
+        return {
+            "tokens": self._emitted - self._prefills,
+            "prefills": self._prefills,
+            "prefix_hits": self._prefix_hits,
+            "prefix_misses": self._prefix_misses,
+            "preemptions": self._preemptions,
+            "recoveries": self._recoveries,
+            "kv_demotions": self._host_demotions + tier_dem,
+            "kv_prefetches": self._host_prefetches + tier_pre,
+            "slo_violations": self._sched.slo_violations,
+            "sched_chunks": self._sched.chunks,
+            "sched_defers": self._sched.defers,
+        }
+
+    def _maybe_heartbeat(self, force: bool = False) -> None:
+        """Emit the periodic ``serving_heartbeat`` when the cadence says
+        so (``force`` flushes a partial interval — the end-of-run tail,
+        so short bursts still leave one heartbeat on the stream). One
+        dict build + one emit every K rounds; everything read is host
+        state, so the dispatch pipeline never notices."""
+        if not self._hb_every:
+            return
+        lag = self._rounds - self._hb_round
+        if lag < self._hb_every and not (force and lag > 0):
+            return
+        now = time.monotonic()
+        interval_s = max(now - self._hb_t_last, 1e-9)
+        snap = self._hb_counters()
+        prev = self._hb_prev
+        d = {k: snap[k] - prev.get(k, 0) for k in snap}
+        itl = self._tok_lat.summary()
+        ttft = self._ttft.summary()
+        pool = self.kv_pool
+        lookups = d["prefix_hits"] + d["prefix_misses"]
+        phases = self._clock.snapshot()
+        ph = {
+            p: round(phases.get(p, 0.0) - self._clock_prev.get(p, 0.0), 6)
+            for p in LOOP_PHASES[:-1]
+        }
+        ph[LOOP_PHASE_OTHER] = round(
+            max(interval_s - sum(ph.values()), 0.0), 6
+        )
+        hb = {
+            "round": self._rounds,
+            "interval_rounds": lag,
+            "interval_s": round(interval_s, 6),
+            "tokens_delta": d["tokens"],
+            "tokens_per_s": round(d["tokens"] / interval_s, 2),
+            "prefills_delta": d["prefills"],
+            "slots_busy": sum(r is not None for r in self._slot_req),
+            "queued": len(self._queue),
+            "preempted_waiting": len(self._preempted) if self.paged else 0,
+            "batch_occupancy": round(
+                sum(r is not None for r in self._slot_req) / self.max_batch,
+                4,
+            ),
+            # Per-tier memory picture: device pool (+ per-shard fills),
+            # host-RAM tier, prefix tier — the capacity numbers PR 14
+            # turned sessions-per-chip into.
+            "kv_pool_occupancy": pool.occupancy() if pool else 0.0,
+            "kv_pool_shard_occupancy": self._pool_shard_occupancy(),
+            "kv_host_occupancy": (
+                self._kv_host.occupancy() if self._kv_host else 0.0
+            ),
+            "kv_host_blocks": (
+                self._kv_host.blocks_used if self._kv_host else 0
+            ),
+            "kv_host_tokens": (
+                self._kv_host.capacity_tokens if self._kv_host else 0
+            ),
+            "prefix_store_occupancy": (
+                self.prefix_store.occupancy() if self.prefix_store else 0.0
+            ),
+            # Interval tier traffic + hit rates (the watchdog's
+            # host_hit_collapse input).
+            "prefix_hits_delta": d["prefix_hits"],
+            "prefix_misses_delta": d["prefix_misses"],
+            "prefix_hit_rate": (
+                round(d["prefix_hits"] / lookups, 4) if lookups else 0.0
+            ),
+            "kv_demotions_delta": d["kv_demotions"],
+            "kv_prefetches_delta": d["kv_prefetches"],
+            "preemptions_delta": d["preemptions"],
+            "recoveries_delta": d["recoveries"],
+            "slo_violations_delta": d["slo_violations"],
+            "sched_chunks_delta": d["sched_chunks"],
+            "sched_defers_delta": d["sched_defers"],
+            # Rolling latency quantiles in ms (recent-window, the
+            # Rolling reservoir) — 0.0 before any observation.
+            "itl_p50_ms": round(itl.get("p50", 0.0) * 1e3, 3),
+            "itl_p99_ms": round(itl.get("p99", 0.0) * 1e3, 3),
+            "ttft_p50_ms": round(ttft.get("p50", 0.0) * 1e3, 3),
+            "ttft_p99_ms": round(ttft.get("p99", 0.0) * 1e3, 3),
+            "slo_ms": self._sched.slo_ms,
+            "tp": self._tp,
+            "tp_degraded": int(self._tp < self._tp_initial),
+            "decode_steps": self._decode_steps,
+            # The daemon-granted chip set (the per-allocation join key
+            # the host-side aggregator labels its gauges with).
+            "chips": tp_serving.allocation_chips(),
+        }
+        hb.update(self._sched.heartbeat_fields())
+        hb.update({f"phase_{p}_s": v for p, v in ph.items()})
+        self._emit("serving_heartbeat", **hb)
+        for p, v in ph.items():
+            self._h_loop[p].observe(v)
+        self._hb_count += 1
+        self._hb_last = hb
+        self._hb_prev = snap
+        self._hb_round = self._rounds
+        self._hb_t_last = now
+        self._clock_prev = phases
+        if self._watchdog is not None:
+            self._watchdog.observe(hb)
+
     def _bind_histograms(self) -> None:
         self._h_ttft = _hist_ttft().labels(server=self._label)
         self._h_tok_lat = _hist_decode_token().labels(server=self._label)
         self._h_phase = {
             p: _hist_phase().labels(server=self._label, phase=p)
             for p in PHASES
+        }
+        self._h_loop = {
+            p: _hist_loop_phase().labels(server=self._label, phase=p)
+            for p in LOOP_PHASES
         }
         self._c_prefix_hits = _ctr_prefix_hits().labels(server=self._label)
         self._c_prefix_misses = _ctr_prefix_misses().labels(server=self._label)
@@ -2316,6 +2616,27 @@ class GenerationServer:
             "fused_enabled": int(self._fused_ok),
             "fused_admissions": self._fused_admissions,
         })
+        # Heartbeat + watchdog (ISSUE 15): ALWAYS present — zeros with
+        # the heartbeat disabled — same no-schema-branch contract. The
+        # numeric alert fields ride the scrape loop; the ``watchdog``
+        # dict carries the detail (active kinds, last dump path).
+        wd = self._watchdog.stats() if self._watchdog is not None else {
+            "alerts": 0, "active": [], "observed": 0, "last_dump": "",
+        }
+        out.update({
+            "heartbeats": self._hb_count,
+            "heartbeat_rounds": self._hb_every,
+            "heartbeat_tokens_per_s": (
+                self._hb_last.get("tokens_per_s", 0.0)
+                if self._hb_last else 0.0
+            ),
+            "loop_phase_s": {
+                p: round(v, 6) for p, v in self._clock.snapshot().items()
+            },
+            "watchdog_alerts": wd["alerts"],
+            "watchdog_active": len(wd["active"]),
+            "watchdog": wd,
+        })
         # Resilience fields (ISSUE 7): ALWAYS present — zeros on a server
         # that never failed — so dashboards need no schema branch.
         out.update({
@@ -2794,8 +3115,12 @@ class GenerationServer:
         (the other: DeviceFence retire): the prefill uploads the prompt
         and the first-token sample reads it back — inherently
         synchronous, and outside the overlap window's steady state."""
-        with jaxapi.allow_transfer("admission prefill + first-token read"):
-            self._admit_unguarded()
+        self._clock.push(LOOP_PHASE_ADMIT)
+        try:
+            with jaxapi.allow_transfer("admission prefill + first-token read"):
+                self._admit_unguarded()
+        finally:
+            self._clock.pop()
 
     def _admit_unguarded(self) -> None:
         # Chunks already run THIS pass: the one-chunk-per-decode-round
@@ -2829,7 +3154,12 @@ class GenerationServer:
                 # for the pool to drain) — EXCEPT crash-recovery replays,
                 # which front-requeue lane residents that can be older
                 # still; the rid comparison keeps global FIFO across both.
-                if not self._resume_one(free[0]):
+                self._clock.push(LOOP_PHASE_HOST)
+                try:
+                    resumed = self._resume_one(free[0])
+                finally:
+                    self._clock.pop()
+                if not resumed:
                     if self._draining and len(free) == self.max_batch:
                         # Every lane is free and the full rebuilt pool
                         # still cannot hold the spill — it can never
@@ -3499,7 +3829,11 @@ class GenerationServer:
                      if self._slot_req[v] is not None),
                     key=lambda v: self._slot_req[v].rid,
                 )
-                self._preempt_lane(victim, reason="pool_exhausted")
+                self._clock.push(LOOP_PHASE_HOST)
+                try:
+                    self._preempt_lane(victim, reason="pool_exhausted")
+                finally:
+                    self._clock.pop()
 
     def _stage_resume_prefetch(self) -> None:
         """Async resume prefetch (ISSUE 14): start the H2D upload of the
@@ -3582,6 +3916,12 @@ class GenerationServer:
         if self._draining and not self._drain_done and self._drain_idle():
             self._finish_drain()
             alive = False
+        # Heartbeat cadence check: one int compare per round; the flush
+        # (force=) when the loop idles out leaves a final partial
+        # interval on the stream and closes any watchdog profile window.
+        self._maybe_heartbeat(force=not alive)
+        if not alive and self._watchdog is not None:
+            self._watchdog.close()
         return alive
 
     def _step_inner(self) -> bool:
@@ -3604,7 +3944,11 @@ class GenerationServer:
                 req.fails = 0
         if (self._ckpt_every
                 and self._rounds - self._ckpt_round >= self._ckpt_every):
-            self._checkpoint()
+            self._clock.push(LOOP_PHASE_HOST)
+            try:
+                self._checkpoint()
+            finally:
+                self._clock.pop()
 
     def _drain_idle(self) -> bool:
         """Nothing in flight anymore: lanes empty, pipeline empty, no
@@ -4470,11 +4814,19 @@ class GenerationServer:
             slots_busy=len(active), queued=len(self._queue),
             batch_occupancy=round(len(active) / self.max_batch, 4),
         ) as sp:
-            toks, last, pos = self._dispatch_decode(
-                jnp.asarray(self._last), jnp.asarray(self._pos), sub
-            )
+            self._clock.push(LOOP_PHASE_DISPATCH)
+            try:
+                toks, last, pos = self._dispatch_decode(
+                    jnp.asarray(self._last), jnp.asarray(self._pos), sub
+                )
+            finally:
+                self._clock.pop()
             # Watchdog-fenced chunk boundary: [max_batch, steps] tokens.
-            toks = self._fence_wait(lambda: np.asarray(toks))  # jaxguard: allow(JG101) lock-step round fence — the transfer IS the chunk boundary
+            self._clock.push(LOOP_PHASE_RETIRE)
+            try:
+                toks = self._fence_wait(lambda: np.asarray(toks))  # jaxguard: allow(JG101) lock-step round fence — the transfer IS the chunk boundary
+            finally:
+                self._clock.pop()
         # Per-token decode latency as a client sees it: dispatch wall
         # time over its delivered steps (each step yields one token per
         # slot) — STAYS per-token however large decode_steps is.
@@ -4499,7 +4851,11 @@ class GenerationServer:
         # Lock-step rounds have no chunk in flight to overlap, but the
         # staged upload still runs ahead of the NEXT round's admission
         # pass (ISSUE 14) — the resume consumes an already-moving copy.
-        self._stage_resume_prefetch()
+        self._clock.push(LOOP_PHASE_HOST)
+        try:
+            self._stage_resume_prefetch()
+        finally:
+            self._clock.pop()
         return True
 
     # ----- pipelined rounds (overlap=True) ---------------------------------
@@ -4540,9 +4896,17 @@ class GenerationServer:
             # A pending resume's H2D upload overlaps the chunk just
             # dispatched (ISSUE 14) — by retire's admission pass the
             # rows are in flight or landed.
-            self._stage_resume_prefetch()
+            self._clock.push(LOOP_PHASE_HOST)
+            try:
+                self._stage_resume_prefetch()
+            finally:
+                self._clock.pop()
         if prev is not None:
-            self._retire(prev)  # host work overlaps the dispatched chunk
+            self._clock.push(LOOP_PHASE_RETIRE)
+            try:
+                self._retire(prev)  # host work overlaps the dispatched chunk
+            finally:
+                self._clock.pop()
         return (
             self._inflight is not None
             or bool(self._queue)
@@ -4598,7 +4962,11 @@ class GenerationServer:
             batch_occupancy=round(len(active) / self.max_batch, 4),
             overlapped=True,
         )
-        toks, new_last, new_pos = self._dispatch_decode(last, pos, sub)
+        self._clock.push(LOOP_PHASE_DISPATCH)
+        try:
+            toks, new_last, new_pos = self._dispatch_decode(last, pos, sub)
+        finally:
+            self._clock.pop()
         sp.mark("dispatch")
         # A fused admission slice dispatched above rides the in-flight
         # record to retire (ISSUE 13) — one slice per pipelined round.

@@ -111,6 +111,18 @@ def shrink_ladder(tp: int, survivors: int,
     return None
 
 
+def allocation_chips(env: Optional[dict] = None) -> str:
+    """The daemon-granted chip set this guest serves on — the normalized
+    ``TPU_VISIBLE_CHIPS`` list, ``""`` outside an allocation. Every
+    serving heartbeat carries it (ISSUE 15), so the daemon-side
+    aggregator can label its per-allocation gauges with the SAME
+    identity its Allocate handler journaled, instead of trusting file
+    naming conventions."""
+    env = os.environ if env is None else env
+    raw = env.get("TPU_VISIBLE_CHIPS", "").strip()
+    return ",".join(c.strip() for c in raw.split(",") if c.strip())
+
+
 def _topology_chips(env) -> int:
     """Chip count the injected topology env describes (1 when absent)."""
     raw = env.get("TPU_VISIBLE_CHIPS", "").strip()

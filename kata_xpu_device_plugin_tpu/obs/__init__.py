@@ -23,6 +23,12 @@ plugin gRPC — one pipeline for the signals production traffic needs:
   failed drain). ``KATATPU_FLIGHT=0`` disarms.
 - :mod:`.profiler` — optional ``jax.profiler`` start/stop around N
   configurable steps.
+- :mod:`.watchdog` — the SLO-burn WATCHDOG (ISSUE 15): consumes the
+  serving loop's periodic heartbeats, and on a sustained ITL-budget
+  burn or anomaly (preemption storm, host-tier hit collapse, tokens/s
+  regression) dumps the flight ring and opens a bounded profiler
+  window — "serving got slow" becomes an on-disk artifact with zero
+  operator action.
 
 Import discipline: NOTHING here imports jax at module level — the host
 daemon (plugin/, utils/) imports this package and must stay jax-free;
@@ -40,6 +46,7 @@ from .events import (
     read_events,
     set_default_sink,
     summarize_phases,
+    tail_events,
 )
 from .flight import (
     FlightRecorder,
@@ -55,6 +62,7 @@ from .metrics import (
     serve,
 )
 from .profiler import ProfilerHook, profiler_from_env
+from .watchdog import ALERT_KINDS, SLOBurnWatchdog, WatchdogConfig
 from .trace import (
     DeviceFence,
     Span,
@@ -76,6 +84,7 @@ __all__ = [
     "read_events",
     "set_default_sink",
     "summarize_phases",
+    "tail_events",
     "FlightRecorder",
     "set_default_recorder",
     "DEFAULT_REGISTRY",
@@ -87,6 +96,9 @@ __all__ = [
     "serve",
     "ProfilerHook",
     "profiler_from_env",
+    "ALERT_KINDS",
+    "SLOBurnWatchdog",
+    "WatchdogConfig",
     "DeviceFence",
     "Span",
     "current_span_id",

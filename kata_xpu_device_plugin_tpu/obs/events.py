@@ -203,6 +203,69 @@ def read_events(path: str, offset: int = 0) -> list[dict]:
     return out
 
 
+def tail_events(path: str, offset: int = 0) -> tuple[list[dict], int]:
+    """Incremental, rotation-safe tail of a JSONL event file (ISSUE 15):
+    parse the COMPLETE lines past byte ``offset`` and return them with
+    the offset to resume from — so pollers (the SLO watchdog, the
+    daemon-side heartbeat aggregator, ``bench_watch``) stop re-reading
+    whole files every pass.
+
+    Contract:
+
+    - The returned offset always lands on a line boundary: a torn final
+      line (a writer killed or caught mid-``write``) is NOT consumed —
+      the next call picks it up once the writer completes it, so no
+      event is ever half-parsed or skipped.
+    - Rotation/truncation safe: when the file shrank below ``offset``
+      (logrotate, a fresh sink truncating) the tail restarts from byte
+      0 instead of silently returning nothing forever. A truncated file
+      that REGREW past the old offset between polls is caught by the
+      line-boundary check below (a valid resume offset always sits just
+      after a newline; rewritten content almost never does) — the
+      residual blind spot is a regrown file whose new content happens
+      to place a newline exactly at ``offset - 1``, in which case the
+      spliced lines are skipped as corrupt rather than mis-parsed.
+    - A missing file returns ``([], 0)`` — the poller's steady state
+      before the guest emits its first event.
+
+    Complete-but-unparseable lines are skipped (the ``read_events``
+    leniency) but their bytes ARE consumed — a corrupt line must not
+    wedge the tail on every subsequent poll."""
+    out: list[dict] = []
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return out, 0
+    if size < offset:
+        offset = 0  # rotated/truncated under us: the stream restarted
+    if size == offset:
+        return out, offset
+    with open(path, "rb") as fh:
+        if offset:
+            # Every offset this function returns lands just past a
+            # newline; if that byte is no longer one, the file was
+            # truncated AND regrew past the old offset between polls —
+            # restart from 0 rather than splicing into the new stream.
+            fh.seek(offset - 1)
+            if fh.read(1) != b"\n":
+                offset = 0
+        fh.seek(offset)
+        data = fh.read(size - offset)
+    # Only complete lines are consumed; a torn tail stays unread.
+    end = data.rfind(b"\n") + 1
+    if end == 0:
+        return out, offset
+    for line in data[:end].splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line.decode("utf-8")))
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            continue
+    return out, offset + end
+
+
 def summarize_phases(
     events: Iterable[dict], prefix: str = ""
 ) -> dict[str, dict]:

@@ -29,7 +29,9 @@ Knobs (env, read when the recorder is (re)configured):
 
 - ``KATATPU_FLIGHT=0``      — kill switch (default armed);
 - ``KATATPU_FLIGHT_RING``   — ring capacity in events (default 512);
-- ``KATATPU_FLIGHT_DIR``    — dump directory (default: working dir).
+- ``KATATPU_FLIGHT_DIR``    — dump directory (default: ``artifacts/``
+  under the working dir — postmortems join the other telemetry
+  artifacts instead of littering the repo/pod root, ISSUE 15).
 
 Dumps are named ``katatpu_flight_<event>_<pid>_<seq>.jsonl`` so several
 terminal events (or processes) never clobber each other. The module is
@@ -50,6 +52,7 @@ ENV_RING = "KATATPU_FLIGHT_RING"
 ENV_DIR = "KATATPU_FLIGHT_DIR"
 
 DEFAULT_RING = 512
+DEFAULT_DIR = "artifacts"
 
 # (kind, name) pairs that always trigger a dump. serving/drain is
 # conditional (failed > 0) and handled in _is_terminal.
@@ -74,7 +77,7 @@ def ring_capacity() -> int:
 
 
 def dump_dir() -> str:
-    return os.environ.get(ENV_DIR, "") or "."
+    return os.environ.get(ENV_DIR, "") or DEFAULT_DIR
 
 
 class FlightRecorder:

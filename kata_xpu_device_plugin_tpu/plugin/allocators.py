@@ -58,6 +58,8 @@ class TpuAllocator:
         serving_tp: int = 0,
         serving_tp_min: int = 0,
         trace_context: bool = True,
+        guest_events_dir: str = "",
+        heartbeat_rounds: int = 0,
     ):
         self._inventory = inventory
         self._vendor = vendor
@@ -124,6 +126,15 @@ class TpuAllocator:
         # when no span is open) into KATA_TPU_TRACE_CTX, so the guest's
         # serving telemetry joins the daemon's allocation trace.
         self._trace_context = bool(trace_context)
+        # Guest telemetry uplink (ISSUE 15, config.guest_events_dir /
+        # heartbeat_rounds): each Allocate switches the guest's JSONL
+        # event stream on and points it at a per-allocation file under
+        # the shared dir, so the manager's heartbeat aggregator can tail
+        # serving heartbeats back out — the upward twin of the trace
+        # handoff above. heartbeat_rounds > 0 additionally pins the
+        # in-guest heartbeat cadence node-wide.
+        self._guest_events_dir = str(guest_events_dir)
+        self._heartbeat_rounds = int(heartbeat_rounds)
         # Driver-level liveness check supplied by the manager
         # (``manager.tpu_chip_alive``: node_alive over the same
         # dev+driver-state pair health watches); bare existence would hand a
@@ -184,6 +195,19 @@ class TpuAllocator:
             resp.envs[C.ENV_TRACE_CTX] = (
                 obs.current_trace_id() or obs.new_trace()
             )
+        if self._guest_events_dir:
+            # Per-allocation heartbeat stream (ISSUE 15): the file name
+            # carries the granted chip set — the same identity the
+            # journal records and the heartbeat's own "chips" field
+            # reports — so the aggregator can label gauges even for a
+            # stream that dies before its first heartbeat.
+            ident = "-".join(str(c.index) for c in chips)
+            resp.envs[C.ENV_OBS] = "1"
+            resp.envs[C.ENV_OBS_FILE] = os.path.join(
+                self._guest_events_dir, f"guest_{ident}.jsonl"
+            )
+        if self._heartbeat_rounds > 0:
+            resp.envs[C.ENV_HEARTBEAT_ROUNDS] = str(self._heartbeat_rounds)
         if self._compile_cache_dir:
             resp.envs[C.ENV_COMPILE_CACHE_DIR] = self._compile_cache_dir
         if self._prefix_cache_tokens > 0:

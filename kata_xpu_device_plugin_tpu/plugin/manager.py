@@ -316,6 +316,203 @@ class AllocationJournal:
         return reconciled, orphaned
 
 
+# ----- guest heartbeat aggregation (ISSUE 15) -------------------------------
+
+
+class HeartbeatAggregator:
+    """Tail guest heartbeat streams, re-export per-allocation gauges.
+
+    The allocator points every allocation's ``KATATPU_OBS_FILE`` at a
+    per-allocation JSONL under ``--guest-events-dir`` (a host dir shared
+    into the guests); this aggregator tails those files with
+    ``obs.tail_events`` — incremental, rotation-safe, no whole-file
+    re-reads per poll — extracts each ``serving_heartbeat`` /
+    ``watchdog_alert`` / ``watchdog_clear``, and sets the
+    ``utils.metrics.guest_*`` gauges keyed by (allocation, server). The
+    workload-layer signal surfaces through the device layer (the
+    Kubernetes Network Driver Model argument, PAPERS.md) — and is the
+    per-replica occupancy/ITL feed the ROADMAP fleet-router tier
+    balances on. jax-free, stdlib + obs.events only: the host daemon
+    stays jax-free.
+
+    A guest watchdog alert is additionally re-emitted on the DAEMON's
+    own event stream as ``plugin/guest_alert`` (allocation, server,
+    kind, the guest's dump path), so one host-side stream records every
+    guest incident on the node.
+
+    RESTART semantics: offsets are in-memory, and the stream files live
+    on a hostPath that outlives the daemon pod — so after a restart the
+    first poll re-reads whole files. That replay restores STATE (the
+    gauges and active-alert sets take their last-written values, which
+    is exactly what a fresh /metrics endpoint needs) but must not
+    re-announce HISTORY: events stamped before the aggregator was
+    constructed skip the ``_total`` counter increments, the
+    ``guest_alert`` re-emission, and the warning log — a day of old
+    incidents does not replay as a burst of new ones.
+
+    GROWTH bound: the allocator arms the guest's FULL event stream
+    (spans included), nothing in-guest rotates it, and the files live
+    on a hostPath — so the aggregator is the rotator of last resort:
+    once a file's consumed prefix exceeds ``max_stream_bytes`` (64 MiB
+    default; 0 disables) it is truncated to zero. Safe against the
+    writer: the guest sink appends with O_APPEND (the next write lands
+    at the new EOF), a line torn by the race parses as the torn-tail
+    case ``tail_events`` already skips, and the truncation-restart
+    logic resets the offset — at worst a poll interval's telemetry is
+    lost from a file that had already grown past the cap."""
+
+    def __init__(self, events_dir: str, poll_interval_s: float = 5.0,
+                 max_stream_bytes: int = 64 * 1024 * 1024):
+        self.events_dir = events_dir
+        self.poll_interval_s = poll_interval_s
+        self.max_stream_bytes = int(max_stream_bytes)
+        self._offsets: dict[str, int] = {}
+        # (allocation, server) -> last heartbeat (staleness + debug).
+        self._last: dict[tuple[str, str], dict] = {}
+        self._active_alerts: dict[tuple[str, str], set] = {}
+        # Replay horizon: guest events stamped before this are catch-up
+        # state, not news (guest and daemon share the node clock).
+        self._t0 = time.time()
+        # snapshot() runs on the SIGUSR1 debug-report thread while
+        # _consume inserts on the aggregator thread — same contract as
+        # the manager's own _lock.
+        self._lock = threading.Lock()
+
+    def poll_once(self) -> int:
+        """One tail pass over every stream file; returns the number of
+        heartbeats consumed. Never raises — a torn file or vanished dir
+        must not kill the daemon loop."""
+        consumed = 0
+        try:
+            names = sorted(os.listdir(self.events_dir))
+        except OSError:
+            return 0
+        for name in names:
+            if not name.endswith(".jsonl"):
+                continue
+            path = os.path.join(self.events_dir, name)
+            try:
+                events, offset = obs.tail_events(
+                    path, self._offsets.get(path, 0)
+                )
+            except Exception:
+                continue
+            if self.max_stream_bytes and offset > self.max_stream_bytes:
+                # Rotator of last resort (see the class docstring): the
+                # consumed prefix outgrew the cap — drop it. The guest's
+                # O_APPEND writer lands its next line at the new EOF.
+                try:
+                    os.truncate(path, 0)
+                    offset = 0
+                except OSError:
+                    pass
+            self._offsets[path] = offset
+            # Fallback allocation identity from the allocator's file
+            # naming (guest_<chips>.jsonl) for events predating the
+            # heartbeat's own "chips" field.
+            stem = name[:-len(".jsonl")]
+            fallback = stem[len("guest_"):].replace("-", ",") if (
+                stem.startswith("guest_")
+            ) else stem
+            for ev in events:
+                if ev.get("kind") != "serving":
+                    continue
+                consumed += self._consume(ev, fallback)
+        return consumed
+
+    def _consume(self, ev: dict, fallback_alloc: str) -> int:
+        name = ev.get("name")
+        server = str(ev.get("server", "") or "unknown")
+        alloc = str(ev.get("chips") or fallback_alloc or "unknown")
+        key = (alloc, server)
+        # Restart replay: state updates below always run; the "news"
+        # surfaces (counters, guest_alert re-emission, warning log) only
+        # for events from this daemon's lifetime.
+        try:
+            fresh = float(ev.get("ts") or 0.0) >= self._t0
+        except (TypeError, ValueError):
+            fresh = True
+        if name == "serving_heartbeat":
+            with self._lock:
+                self._last[key] = ev
+            labels = {"allocation": alloc, "server": server}
+            metrics.guest_tokens_per_s.labels(**labels).set(
+                float(ev.get("tokens_per_s") or 0.0)
+            )
+            metrics.guest_itl_p99_ms.labels(**labels).set(
+                float(ev.get("itl_p99_ms") or 0.0)
+            )
+            metrics.guest_queue_depth.labels(**labels).set(
+                float(ev.get("queued") or 0)
+            )
+            metrics.guest_batch_occupancy.labels(**labels).set(
+                float(ev.get("batch_occupancy") or 0.0)
+            )
+            metrics.guest_kv_pool_occupancy.labels(**labels).set(
+                float(ev.get("kv_pool_occupancy") or 0.0)
+            )
+            metrics.guest_kv_host_occupancy.labels(**labels).set(
+                float(ev.get("kv_host_occupancy") or 0.0)
+            )
+            metrics.guest_last_heartbeat_ts.labels(**labels).set(
+                float(ev.get("ts") or 0.0)
+            )
+            if fresh:
+                metrics.guest_heartbeats_total.labels(**labels).inc()
+            return 1
+        if name == "watchdog_alert":
+            kind = str(ev.get("alert", "") or "unknown")
+            with self._lock:
+                active = self._active_alerts.setdefault(key, set())
+                active.add(kind)
+                n_active = len(active)
+            metrics.guest_watchdog_active.labels(
+                allocation=alloc, server=server
+            ).set(n_active)
+            if fresh:
+                metrics.guest_alerts_total.labels(
+                    allocation=alloc, server=server, kind=kind
+                ).inc()
+                obs.emit(
+                    "plugin", "guest_alert",
+                    allocation=alloc, server=server, alert=kind,
+                    reason=ev.get("reason", ""), dump=ev.get("dump", ""),
+                    trace=ev.get("trace", ""),
+                )
+                LOG.warning(
+                    "guest watchdog alert",
+                    extra=log.kv(
+                        allocation=alloc, server=server, kind=kind,
+                        reason=ev.get("reason", ""),
+                    ),
+                )
+        elif name == "watchdog_clear":
+            kind = str(ev.get("alert", "") or "unknown")
+            with self._lock:
+                active = self._active_alerts.setdefault(key, set())
+                active.discard(kind)
+                n_active = len(active)
+            metrics.guest_watchdog_active.labels(
+                allocation=alloc, server=server
+            ).set(n_active)
+        return 0
+
+    def snapshot(self) -> dict:
+        """Debug-report slice: last heartbeat per (allocation, server)."""
+        with self._lock:
+            return {
+                f"{alloc}/{server}": {
+                    "ts": hb.get("ts"),
+                    "tokens_per_s": hb.get("tokens_per_s"),
+                    "queued": hb.get("queued"),
+                    "active_alerts": sorted(
+                        self._active_alerts.get((alloc, server), ())
+                    ),
+                }
+                for (alloc, server), hb in sorted(self._last.items())
+            }
+
+
 # ----- manager -------------------------------------------------------------
 
 
@@ -336,6 +533,17 @@ class PluginManager:
         self._watcher: Optional[HealthWatcher] = None
         self._stop = threading.Event()
         self._rescan_thread: Optional[threading.Thread] = None
+        # Guest heartbeat aggregation (ISSUE 15): tails the per-
+        # allocation event streams the allocator points into
+        # cfg.guest_events_dir; "" disables (no env stamp, no thread).
+        self._aggregator: Optional[HeartbeatAggregator] = (
+            HeartbeatAggregator(
+                cfg.guest_events_dir, cfg.guest_events_poll_s,
+                max_stream_bytes=cfg.guest_events_max_mb * 1024 * 1024,
+            )
+            if cfg.guest_events_dir else None
+        )
+        self._aggregator_thread: Optional[threading.Thread] = None
         # Allocation-state journal (ISSUE 10): lives in the same state
         # dir as the persisted worker identity; "" disables (the daemon
         # then restarts blind, the reference behavior).
@@ -564,6 +772,8 @@ class PluginManager:
                 serving_tp=cfg.serving_tp,
                 serving_tp_min=cfg.serving_tp_min,
                 trace_context=cfg.trace_context,
+                guest_events_dir=cfg.guest_events_dir,
+                heartbeat_rounds=cfg.heartbeat_rounds,
             ),
             # Journal every grant at the moment it happens (the Allocate
             # handler's on_allocate hook) — the restart reconcile's input.
@@ -597,6 +807,12 @@ class PluginManager:
                 target=self._rescan_loop, name="rescan", daemon=True
             )
             self._rescan_thread.start()
+        if self._aggregator is not None:
+            self._aggregator_thread = threading.Thread(
+                target=self._aggregator_loop, name="guest-heartbeats",
+                daemon=True,
+            )
+            self._aggregator_thread.start()
 
     def _spawn_vfio_plugin(
         self, key: tuple[str, str], groups: list[str], register: bool
@@ -678,7 +894,13 @@ class PluginManager:
             "rescan_alive": bool(
                 self._rescan_thread and self._rescan_thread.is_alive()
             ),
+            "aggregator_alive": bool(
+                self._aggregator_thread
+                and self._aggregator_thread.is_alive()
+            ),
         }
+        if self._aggregator is not None:
+            report["guest_heartbeats"] = self._aggregator.snapshot()
         if tpu_inv is not None:
             topo = tpu_inv.topology
             report["tpu"] = {
@@ -735,6 +957,13 @@ class PluginManager:
                 self.rescan_once()
             except Exception:
                 LOG.exception("rescan failed")
+
+    def _aggregator_loop(self) -> None:
+        while not self._stop.wait(self._aggregator.poll_interval_s):
+            try:
+                self._aggregator.poll_once()
+            except Exception:
+                LOG.exception("guest heartbeat aggregation failed")
 
     def run_forever(self) -> None:
         """Block until stop()/request_stop() (ref ``<-stop``,

@@ -69,6 +69,67 @@ alloc_orphaned = obs_metrics.gauge(
     ["resource"],
 )
 
+# Guest heartbeat aggregation (ISSUE 15): per-allocation serving gauges
+# the daemon re-exports from the guest heartbeat streams it tails
+# (plugin/manager.py HeartbeatAggregator; the allocator points each
+# allocation's KATATPU_OBS_FILE into --guest-events-dir). Labels:
+# ``allocation`` is the granted chip set ("0,1"), ``server`` the
+# in-guest GenerationServer label — several servers can share one
+# allocation. These are the per-replica occupancy/ITL signals the
+# ROADMAP fleet-router tier balances on.
+guest_tokens_per_s = obs_metrics.gauge(
+    f"{NS}_guest_tokens_per_s",
+    "Decoded tokens/s over the guest's last heartbeat interval",
+    ["allocation", "server"],
+)
+guest_itl_p99_ms = obs_metrics.gauge(
+    f"{NS}_guest_itl_p99_ms",
+    "Guest rolling inter-token-latency p99 (ms) at the last heartbeat",
+    ["allocation", "server"],
+)
+guest_queue_depth = obs_metrics.gauge(
+    f"{NS}_guest_queue_depth",
+    "Requests queued in the guest server at the last heartbeat",
+    ["allocation", "server"],
+)
+guest_batch_occupancy = obs_metrics.gauge(
+    f"{NS}_guest_batch_occupancy",
+    "Guest serving-lane occupancy (busy slots / max_batch) at the last "
+    "heartbeat",
+    ["allocation", "server"],
+)
+guest_kv_pool_occupancy = obs_metrics.gauge(
+    f"{NS}_guest_kv_pool_occupancy",
+    "Guest paged KV pool fill at the last heartbeat (0.0 slotted)",
+    ["allocation", "server"],
+)
+guest_kv_host_occupancy = obs_metrics.gauge(
+    f"{NS}_guest_kv_host_occupancy",
+    "Guest host-RAM KV tier fill at the last heartbeat (0.0 tier off)",
+    ["allocation", "server"],
+)
+guest_last_heartbeat_ts = obs_metrics.gauge(
+    f"{NS}_guest_last_heartbeat_ts",
+    "Unix timestamp of the guest's last heartbeat (alert on "
+    "time() - this for staleness)",
+    ["allocation", "server"],
+)
+guest_watchdog_active = obs_metrics.gauge(
+    f"{NS}_guest_watchdog_active",
+    "Guest watchdog alert kinds currently active (0 = healthy)",
+    ["allocation", "server"],
+)
+guest_heartbeats_total = obs_metrics.counter(
+    f"{NS}_guest_heartbeats_total",
+    "Guest serving heartbeats consumed by the daemon aggregator",
+    ["allocation", "server"],
+)
+guest_alerts_total = obs_metrics.counter(
+    f"{NS}_guest_alerts_total",
+    "Guest watchdog alerts observed by the daemon aggregator",
+    ["allocation", "server", "kind"],
+)
+
 # gRPC handler latency (ISSUE 2): one histogram, labeled by method —
 # Allocate / GetPreferredAllocation / ListAndWatch_update share it.
 grpc_handler_seconds = obs_metrics.histogram(

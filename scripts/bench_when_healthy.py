@@ -22,8 +22,8 @@ Design notes:
   git-commits it, then keeps watching at a long interval so later,
   faster code can bank improved numbers (every bank is a separate file;
   nothing is overwritten).
-- All activity appends to ``bench_watch.log`` so the round's tunnel
-  health history is reconstructable.
+- All activity appends to ``artifacts/bench_watch.log`` so the round's
+  tunnel health history is reconstructable.
 
 Usage: ``python scripts/bench_when_healthy.py [--interval 300] [--once]``
 or ``make bench-watch``.
@@ -39,7 +39,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(REPO, "bench_watch.log")
+LOG = os.path.join(REPO, "artifacts", "bench_watch.log")
 
 sys.path.insert(0, REPO)
 import bench as _bench  # reuse probe_tunnel: one probe implementation, not two
@@ -49,6 +49,7 @@ def log(msg: str) -> None:
     stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
     line = f"[{stamp}] {msg}"
     print(line, flush=True)
+    os.makedirs(os.path.dirname(LOG), exist_ok=True)
     with open(LOG, "a") as f:
         f.write(line + "\n")
 

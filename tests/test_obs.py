@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import importlib
 import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -518,6 +519,88 @@ def test_read_events_offset_isolates_a_run(tmp_path):
     this_run = obs.read_events(path, offset=offset)
     assert [e["dur_s"] for e in this_run] == [2.0]
     assert obs.summarize_phases(this_run, prefix="bench.")["decode"]["count"] == 1
+
+
+def test_tail_events_incremental(tmp_path):
+    """The ISSUE 15 poller contract: each call returns only the events
+    past the previous offset, and the returned offset resumes exactly —
+    the watchdog/daemon-aggregator/bench_watch loops stop re-reading
+    whole files every poll."""
+    path = str(tmp_path / "tail.jsonl")
+    assert obs.tail_events(path) == ([], 0)  # missing file: steady state
+    with obs.EventSink(path) as s:
+        s.emit("serving", "a")
+        s.emit("serving", "b")
+    evs, off = obs.tail_events(path)
+    assert [e["name"] for e in evs] == ["a", "b"]
+    assert off == os.path.getsize(path)
+    assert obs.tail_events(path, off) == ([], off)  # nothing new
+    with obs.EventSink(path) as s:
+        s.emit("serving", "c")
+    evs2, off2 = obs.tail_events(path, off)
+    assert [e["name"] for e in evs2] == ["c"]
+    assert off2 == os.path.getsize(path)
+
+
+def test_tail_events_leaves_torn_tail_unconsumed(tmp_path):
+    path = str(tmp_path / "torn.jsonl")
+    with obs.EventSink(path) as s:
+        s.emit("serving", "a")
+    with open(path, "a") as fh:
+        fh.write('{"kind": "serving", "name": "part')  # writer mid-line
+    evs, off = obs.tail_events(path)
+    assert [e["name"] for e in evs] == ["a"]
+    assert off < os.path.getsize(path)  # torn bytes not consumed
+    with open(path, "a") as fh:
+        fh.write('ial"}\n')  # writer completes the line
+    evs2, off2 = obs.tail_events(path, off)
+    assert [e["name"] for e in evs2] == ["partial"]
+    assert off2 == os.path.getsize(path)
+
+
+def test_tail_events_rotation_restarts(tmp_path):
+    path = str(tmp_path / "rot.jsonl")
+    with obs.EventSink(path) as s:
+        s.emit("serving", "old1")
+        s.emit("serving", "old2")
+    _, off = obs.tail_events(path)
+    # Rotation: the file is truncated and a new stream starts — the
+    # tail must restart from 0, not hang past-EOF forever.
+    os.truncate(path, 0)
+    with obs.EventSink(path) as s:
+        s.emit("serving", "fresh")
+    evs, off2 = obs.tail_events(path, off)
+    assert [e["name"] for e in evs] == ["fresh"]
+    assert off2 == os.path.getsize(path)
+
+
+def test_tail_events_truncate_then_regrow_restarts(tmp_path):
+    """copytruncate-style rotation where the new stream regrows PAST
+    the old offset between polls: the stale offset no longer sits on a
+    line boundary, so the tail restarts from 0 instead of splicing
+    mid-line into the new content."""
+    path = str(tmp_path / "regrow.jsonl")
+    with obs.EventSink(path) as s:
+        s.emit("serving", "old")
+    _, off = obs.tail_events(path)
+    os.truncate(path, 0)
+    with obs.EventSink(path) as s:
+        # Longer than the old stream, and the byte at off-1 is mid-line.
+        s.emit("serving", "new1", pad="x" * 256)
+        s.emit("serving", "new2")
+    evs, off2 = obs.tail_events(path, off)
+    assert [e["name"] for e in evs] == ["new1", "new2"]
+    assert off2 == os.path.getsize(path)
+
+
+def test_tail_events_skips_corrupt_complete_lines(tmp_path):
+    path = str(tmp_path / "bad.jsonl")
+    with open(path, "w") as fh:
+        fh.write('not json at all\n{"kind": "serving", "name": "ok"}\n')
+    evs, off = obs.tail_events(path)
+    assert [e["name"] for e in evs] == ["ok"]
+    # Corrupt-but-complete bytes ARE consumed — the tail never wedges.
+    assert off == os.path.getsize(path)
 
 
 def test_summarize_phases():

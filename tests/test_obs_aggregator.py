@@ -1,0 +1,204 @@
+"""Daemon-side guest heartbeat aggregation (ISSUE 15).
+
+The allocator points every allocation's ``KATATPU_OBS_FILE`` at a
+per-allocation JSONL under ``--guest-events-dir``; the manager's
+:class:`HeartbeatAggregator` tails those files incrementally
+(rotation-safe ``obs.tail_events``) and re-exports per-allocation
+serving gauges on the daemon's existing /metrics endpoint — the upward
+twin of the ISSUE 11 daemon→guest trace handoff. Host-side, jax-free."""
+import json
+import os
+import time
+
+from kata_xpu_device_plugin_tpu import obs
+from kata_xpu_device_plugin_tpu.plugin.manager import HeartbeatAggregator
+from kata_xpu_device_plugin_tpu.utils import metrics
+
+
+def _write_events(path, events, mode="a"):
+    with open(path, mode, encoding="utf-8") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+
+
+def _hb(server="server0", chips="0,1", **kw):
+    base = {
+        "ts": 1700000000.0, "kind": "serving", "name": "serving_heartbeat",
+        "server": server, "chips": chips, "tokens_per_s": 123.4,
+        "itl_p99_ms": 12.5, "queued": 3, "batch_occupancy": 0.75,
+        "kv_pool_occupancy": 0.5, "kv_host_occupancy": 0.25,
+    }
+    base.update(kw)
+    return base
+
+
+def _gauge(g, **labels):
+    return g.labels(**labels)._value.get()
+
+
+def test_aggregator_exports_per_allocation_gauges(tmp_path):
+    d = str(tmp_path)
+    _write_events(os.path.join(d, "guest_0-1.jsonl"), [
+        {"kind": "serving", "name": "serving_config", "server": "server0"},
+        _hb(tokens_per_s=50.0),
+        _hb(tokens_per_s=123.4, queued=3),
+    ])
+    agg = HeartbeatAggregator(d, poll_interval_s=0.01)
+    assert agg.poll_once() == 2
+    labels = {"allocation": "0,1", "server": "server0"}
+    assert _gauge(metrics.guest_tokens_per_s, **labels) == 123.4
+    assert _gauge(metrics.guest_itl_p99_ms, **labels) == 12.5
+    assert _gauge(metrics.guest_queue_depth, **labels) == 3
+    assert _gauge(metrics.guest_batch_occupancy, **labels) == 0.75
+    assert _gauge(metrics.guest_kv_pool_occupancy, **labels) == 0.5
+    assert _gauge(metrics.guest_kv_host_occupancy, **labels) == 0.25
+    assert _gauge(metrics.guest_last_heartbeat_ts, **labels) == 1700000000.0
+    # Incremental: a second poll with nothing new consumes nothing.
+    assert agg.poll_once() == 0
+    _write_events(os.path.join(d, "guest_0-1.jsonl"), [
+        _hb(tokens_per_s=99.0)
+    ])
+    assert agg.poll_once() == 1
+    assert _gauge(metrics.guest_tokens_per_s, **labels) == 99.0
+    snap = agg.snapshot()
+    assert snap["0,1/server0"]["tokens_per_s"] == 99.0
+
+
+def test_aggregator_allocation_falls_back_to_file_naming(tmp_path):
+    # Events predating the heartbeat's own "chips" field (or emitted
+    # outside an allocation) label by the allocator's file naming.
+    d = str(tmp_path)
+    _write_events(os.path.join(d, "guest_2-3.jsonl"), [
+        _hb(server="srvX", chips="", tokens_per_s=7.0),
+    ])
+    agg = HeartbeatAggregator(d)
+    assert agg.poll_once() == 1
+    assert _gauge(
+        metrics.guest_tokens_per_s, allocation="2,3", server="srvX"
+    ) == 7.0
+
+
+def test_aggregator_reemits_guest_alerts_host_side(tmp_path, capsys):
+    d = str(tmp_path)
+    path = os.path.join(d, "guest_4.jsonl")
+    # Live tailing: the aggregator (daemon) is up BEFORE the guest
+    # emits — its construction stamp is the catch-up horizon.
+    agg = HeartbeatAggregator(d)
+    now = time.time()
+    _write_events(path, [
+        _hb(server="s1", chips="4", ts=now),
+        {"ts": now, "kind": "serving", "name": "watchdog_alert",
+         "server": "s1", "chips": "4", "alert": "slo_burn",
+         "reason": "burn_rate=1.00", "dump": "/tmp/dump.jsonl",
+         "trace": "abc"},
+    ])
+    sink_path = os.path.join(d, "daemon_events.jsonl")
+    sink = obs.EventSink(sink_path)
+    prev = obs.set_default_sink(sink)
+    try:
+        agg.poll_once()
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    labels = {"allocation": "4", "server": "s1"}
+    assert _gauge(metrics.guest_watchdog_active, **labels) == 1
+    assert metrics.guest_alerts_total.labels(
+        allocation="4", server="s1", kind="slo_burn"
+    )._value.get() == 1
+    host_events = obs.read_events(sink_path)
+    alerts = [e for e in host_events if e["name"] == "guest_alert"]
+    assert alerts and alerts[0]["allocation"] == "4"
+    assert alerts[0]["alert"] == "slo_burn"
+    assert alerts[0]["dump"] == "/tmp/dump.jsonl"
+    # The guest's clear drops the active gauge back to healthy.
+    _write_events(path, [
+        {"ts": time.time(), "kind": "serving", "name": "watchdog_clear",
+         "server": "s1", "chips": "4", "alert": "slo_burn"},
+    ])
+    agg.poll_once()
+    assert _gauge(metrics.guest_watchdog_active, **labels) == 0
+    assert "4/s1" in agg.snapshot()
+    assert agg.snapshot()["4/s1"]["active_alerts"] == []
+
+
+def test_aggregator_restart_replay_restores_state_without_news(tmp_path):
+    """Daemon restart: the hostPath stream outlives the pod, so the
+    first poll re-reads history. State (gauges, active alerts,
+    snapshot) must be restored; NEWS (counter increments, guest_alert
+    re-emission) must not replay — a day of old incidents is catch-up,
+    not a fresh burst."""
+    d = str(tmp_path)
+    old = time.time() - 3600  # history from before this "daemon" started
+    _write_events(os.path.join(d, "guest_7.jsonl"), [
+        _hb(server="s7", chips="7", ts=old, tokens_per_s=42.0),
+        {"ts": old, "kind": "serving", "name": "watchdog_alert",
+         "server": "s7", "chips": "7", "alert": "preempt_storm",
+         "reason": "old", "dump": ""},
+    ])
+    sink_path = os.path.join(d, "daemon_events.jsonl")
+    sink = obs.EventSink(sink_path)
+    prev = obs.set_default_sink(sink)
+    try:
+        labels = {"allocation": "7", "server": "s7"}
+        before = metrics.guest_alerts_total.labels(
+            allocation="7", server="s7", kind="preempt_storm"
+        )._value.get()
+        hb_before = metrics.guest_heartbeats_total.labels(
+            **labels
+        )._value.get()
+        agg = HeartbeatAggregator(d)
+        assert agg.poll_once() == 1
+        # State restored: last heartbeat's gauges + the still-active
+        # alert (the guest never cleared it before the restart).
+        assert _gauge(metrics.guest_tokens_per_s, **labels) == 42.0
+        assert _gauge(metrics.guest_watchdog_active, **labels) == 1
+        assert agg.snapshot()["7/s7"]["active_alerts"] == ["preempt_storm"]
+        # No news: counters unchanged, nothing re-emitted host-side.
+        assert metrics.guest_alerts_total.labels(
+            allocation="7", server="s7", kind="preempt_storm"
+        )._value.get() == before
+        assert metrics.guest_heartbeats_total.labels(
+            **labels
+        )._value.get() == hb_before
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    # Nothing re-emitted host-side: the sink never even opened (its
+    # file is created lazily on first emit).
+    assert not os.path.exists(sink_path) or not [
+        e for e in obs.read_events(sink_path) if e["name"] == "guest_alert"
+    ]
+
+
+def test_aggregator_truncates_streams_past_the_cap(tmp_path):
+    """Rotator of last resort: the guest's full event stream grows
+    unbounded on the hostPath, so once the consumed prefix passes the
+    cap the aggregator truncates it — and the truncation-restart logic
+    keeps tailing the stream's continuation from byte 0."""
+    d = str(tmp_path)
+    path = os.path.join(d, "guest_5.jsonl")
+    agg = HeartbeatAggregator(d, max_stream_bytes=200)
+    now = time.time()
+    _write_events(path, [_hb(server="s5", chips="5", ts=now)] * 3)
+    assert os.path.getsize(path) > 200
+    assert agg.poll_once() == 3
+    assert os.path.getsize(path) == 0  # consumed prefix dropped
+    _write_events(path, [_hb(server="s5", chips="5", ts=now,
+                             tokens_per_s=9.0)])
+    assert agg.poll_once() == 1  # the continuation tails from byte 0
+    assert _gauge(
+        metrics.guest_tokens_per_s, allocation="5", server="s5"
+    ) == 9.0
+
+
+def test_aggregator_survives_junk_and_missing_dir(tmp_path):
+    agg = HeartbeatAggregator(str(tmp_path / "missing"))
+    assert agg.poll_once() == 0
+    d = str(tmp_path)
+    with open(os.path.join(d, "guest_9.jsonl"), "w") as fh:
+        fh.write("not json\n")
+        fh.write('{"kind": "serving", "name": "serving_heartbeat"')  # torn
+    with open(os.path.join(d, "notes.txt"), "w") as fh:
+        fh.write("ignored — not a .jsonl stream\n")
+    agg2 = HeartbeatAggregator(d)
+    assert agg2.poll_once() == 0  # junk consumed, torn tail left alone

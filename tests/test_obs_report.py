@@ -1,0 +1,135 @@
+"""Offline telemetry reporter (ISSUE 15): report assembly, markdown
+rendering, and the schema-drift gate — all over synthetic events, so the
+suite needs no serving run (the jax-touching ``--generate`` path is the
+``make obs-report`` smoke gate's job; its output schema is pinned here
+by construction because both go through ``build_report``)."""
+import json
+
+from tools.obs_report import (
+    SCHEMA_VERSION,
+    build_report,
+    check_schema,
+    main,
+    render_markdown,
+)
+
+
+def _events():
+    evs = [
+        {"ts": 10.0, "kind": "span", "name": "serving.prefill",
+         "dur_s": 0.5},
+        {"ts": 10.2, "kind": "span", "name": "serving.prefill",
+         "dur_s": 0.3},
+        {"ts": 10.4, "kind": "span", "name": "serving.decode_chunk",
+         "dur_s": 1.2},
+        {"ts": 11.0, "kind": "serving", "name": "serving_heartbeat",
+         "server": "server0", "round": 4, "tokens_per_s": 100.0,
+         "itl_p99_ms": 9.0, "batch_occupancy": 1.0,
+         "kv_pool_occupancy": 0.5, "kv_host_occupancy": 0.0, "queued": 2,
+         "phase_admit_s": 0.2, "phase_dispatch_s": 0.5},
+        {"ts": 12.0, "kind": "serving", "name": "serving_heartbeat",
+         "server": "server0", "round": 8, "tokens_per_s": 200.0,
+         "itl_p99_ms": 7.0, "batch_occupancy": 0.5,
+         "kv_pool_occupancy": 0.25, "kv_host_occupancy": 0.0, "queued": 0,
+         "phase_admit_s": 0.1, "phase_dispatch_s": 0.6},
+        {"ts": 12.5, "kind": "serving", "name": "request_trace",
+         "server": "server0", "rid": 7, "outcome": "completed",
+         "wall_s": 2.5, "tokens": 64, "prompt_len": 128, "replays": 0,
+         "queue_s": 0.5, "prefill_s": 0.4, "decode_s": 1.6,
+         "preempted_s": 0.0},
+        {"ts": 12.6, "kind": "serving", "name": "request_trace",
+         "server": "server0", "rid": 8, "outcome": "failed",
+         "reason": "quarantined", "wall_s": 4.0, "tokens": 3,
+         "prompt_len": 16, "replays": 2, "queue_s": 1.0, "recovery_s": 3.0},
+        {"ts": 12.7, "kind": "serving", "name": "watchdog_alert",
+         "server": "server0", "alert": "slo_burn",
+         "reason": "burn_rate=0.83", "dump": "artifacts/d.jsonl",
+         "round": 9},
+        {"ts": 12.9, "kind": "serving", "name": "watchdog_clear",
+         "server": "server0", "alert": "slo_burn", "round": 12},
+        {"ts": 12.95, "kind": "serving", "name": "recovery",
+         "server": "server0", "restored": 1},
+    ]
+    return evs
+
+
+def test_build_report_sections():
+    rep = build_report(_events(), source="synthetic", top=1)
+    assert rep["schema"] == SCHEMA_VERSION
+    assert rep["events"]["count"] == len(_events())
+    assert rep["phases"]["serving.prefill"]["count"] == 2
+    hb = rep["heartbeats"]["servers"]["server0"]
+    assert hb["count"] == 2
+    assert hb["tokens_per_s"] == {"min": 100.0, "mean": 150.0, "max": 200.0}
+    assert hb["loop_phase_s"] == {"admit": 0.3, "dispatch": 1.1}
+    assert len(hb["timeline"]) == 2
+    # top=1 keeps only the SLOWEST request; the failed 4.0s one wins.
+    assert rep["requests"]["total_traces"] == 2
+    (slow,) = rep["requests"]["slowest"]
+    assert slow["rid"] == 8 and slow["outcome"] == "failed"
+    assert slow["phases"] == {"queue": 1.0, "recovery": 3.0}
+    inc = rep["incidents"]
+    assert [a["alert"] for a in inc["alerts"]] == ["slo_burn"]
+    assert [c["alert"] for c in inc["clears"]] == ["slo_burn"]
+    assert inc["event_counts"]["recovery"] == 1
+    assert check_schema(rep, require_data=True) == []
+
+
+def test_markdown_renders_waterfall_requests_incidents():
+    md = render_markdown(build_report(_events(), source="synthetic"))
+    assert "## Phase waterfall" in md
+    assert "serving.decode_chunk" in md and "█" in md
+    assert "## Serving heartbeats" in md and "| 4 | 100.0 |" in md
+    assert "rid     8" in md and "failed(quarantined)" in md
+    assert "recovery 3.000s" in md
+    assert "**slo_burn**" in md and "artifacts/d.jsonl" in md
+    assert "cleared **slo_burn**" in md
+
+
+def test_empty_stream_renders_without_data():
+    rep = build_report([], source="empty")
+    assert check_schema(rep) == []  # structurally valid...
+    errs = check_schema(rep, require_data=True)  # ...but fails the smoke bar
+    assert any("waterfall" in e for e in errs)
+    assert any("heartbeat" in e for e in errs)
+    md = render_markdown(rep)
+    assert "no span events" in md and "no watchdog alerts" in md
+
+
+def test_check_schema_catches_drift():
+    rep = build_report(_events())
+    del rep["incidents"]
+    assert any("incidents" in e for e in check_schema(rep))
+    rep2 = build_report(_events())
+    rep2["schema"] = 99
+    assert any("schema version" in e for e in check_schema(rep2))
+    rep3 = build_report(_events())
+    for r in rep3["requests"]["slowest"]:
+        del r["phases"]
+    assert any("missing phases" in e for e in check_schema(rep3))
+
+
+def test_cli_round_trip(tmp_path, capsys):
+    events_path = tmp_path / "ev.jsonl"
+    with open(events_path, "w") as fh:
+        for ev in _events():
+            fh.write(json.dumps(ev) + "\n")
+    json_path = tmp_path / "rep.json"
+    md_path = tmp_path / "rep.md"
+    rc = main([
+        str(events_path), "--json", str(json_path), "--md", str(md_path),
+        "--check", "--quiet",
+    ])
+    assert rc == 0
+    assert "schema ok" in capsys.readouterr().err
+    rep = json.loads(json_path.read_text())
+    assert check_schema(rep, require_data=True) == []
+    assert "## Phase waterfall" in md_path.read_text()
+
+
+def test_cli_check_fails_on_dataless_stream(tmp_path, capsys):
+    events_path = tmp_path / "empty.jsonl"
+    events_path.write_text("")
+    rc = main([str(events_path), "--check", "--quiet"])
+    assert rc == 2
+    assert "SCHEMA DRIFT" in capsys.readouterr().err

@@ -154,6 +154,31 @@ def test_tpu_allocator_injects_decode_steps_env(v5e8):
     assert wired.envs[C.ENV_DECODE_STEPS] == "4"
 
 
+def test_tpu_allocator_injects_guest_events_env(v5e8):
+    # config.guest_events_dir (ISSUE 15) rides the AllocateResponse env:
+    # the daemon switches the guest's JSONL stream on and points it at a
+    # per-allocation file its heartbeat aggregator tails; the file name
+    # carries the granted chip set. heartbeat_rounds > 0 additionally
+    # pins the in-guest cadence. Unset injects nothing (guest defaults).
+    from kata_xpu_device_plugin_tpu.discovery import scan_tpus
+    from kata_xpu_device_plugin_tpu.plugin import TpuAllocator
+
+    inv = scan_tpus(v5e8.sysfs, v5e8.dev, env={})
+    bare = TpuAllocator(lambda: inv, "google.com", "tpu").allocate(["0"])
+    assert C.ENV_OBS not in bare.envs
+    assert C.ENV_OBS_FILE not in bare.envs
+    assert C.ENV_HEARTBEAT_ROUNDS not in bare.envs
+    wired = TpuAllocator(
+        lambda: inv, "google.com", "tpu",
+        guest_events_dir="/run/kata-tpu/guest-events", heartbeat_rounds=16,
+    ).allocate(["0", "1"])
+    assert wired.envs[C.ENV_OBS] == "1"
+    assert wired.envs[C.ENV_OBS_FILE] == (
+        "/run/kata-tpu/guest-events/guest_0-1.jsonl"
+    )
+    assert wired.envs[C.ENV_HEARTBEAT_ROUNDS] == "16"
+
+
 def test_tpu_allocator_injects_kv_quant_env(v5e8):
     # config.kv_quant (ISSUE 12) rides the AllocateResponse env: the
     # daemon's --kv-quant knob opts a node out of (or pins) the guest's

@@ -1,0 +1,420 @@
+"""Serving heartbeat + SLO-burn watchdog (ISSUE 15).
+
+Three layers under test. UNIT: the watchdog's burn/anomaly rules over
+synthetic heartbeats — sustain-before-alert, clear-after-healthy, the
+flight dump naming, the bounded profiler window. SERVER: the heartbeat's
+cadence, field schema, interval-delta correctness, the knob contract
+(explicit raises / env degrades), and the uninstrumented path at
+cadence 0. INTEGRATION: a seeded ``chip_loss`` mid-decode at tp=2 must
+produce breach → flight dump carrying the watchdog reason →
+recovery-clears-alert, with greedy outputs BIT-IDENTICAL to a fault-free
+run — deterministic in both strict modes (the breach signal is the
+recovery COUNTER, not wall-clock timing)."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu import obs
+from kata_xpu_device_plugin_tpu.guest.resilience import (
+    FaultInjector,
+    FaultSpec,
+)
+from kata_xpu_device_plugin_tpu.guest.serving import (
+    DEFAULT_HEARTBEAT_ROUNDS,
+    ENV_HEARTBEAT_ROUNDS,
+    LOOP_PHASES,
+    GenerationServer,
+)
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import init_params
+from kata_xpu_device_plugin_tpu.obs import watchdog as wd_mod
+from kata_xpu_device_plugin_tpu.obs.watchdog import (
+    ALERT_HOST_HIT_COLLAPSE,
+    ALERT_PREEMPT_STORM,
+    ALERT_RECOVERY_STORM,
+    ALERT_SLO_BURN,
+    ALERT_TOKENS_REGRESSION,
+    SLOBurnWatchdog,
+    WatchdogConfig,
+)
+
+
+# ----- unit: rule mechanics over synthetic heartbeats ------------------------
+
+
+def _hb(**kw):
+    base = dict(
+        round=1, interval_rounds=4, interval_s=1.0, tokens_per_s=100.0,
+        itl_p99_ms=10.0, preemptions_delta=0, recoveries_delta=0,
+        prefix_hits_delta=0, prefix_misses_delta=0, kv_host_tokens=0,
+    )
+    base.update(kw)
+    return base
+
+
+def _watchdog(cfg, evs, dumps=None):
+    dump = (
+        (lambda reason: dumps.append(reason) or f"/dev/null/{reason}")
+        if dumps is not None else None
+    )
+    return SLOBurnWatchdog(
+        cfg,
+        emit=lambda name, **f: evs.append({"name": name, **f}),
+        dump=dump,
+    )
+
+
+def test_slo_burn_fires_after_window_and_sustain_then_clears():
+    evs, dumps = [], []
+    wd = _watchdog(
+        WatchdogConfig(slo_ms=50.0, window=2, sustain=2, clear=2),
+        evs, dumps,
+    )
+    slow, fast = _hb(itl_p99_ms=120.0), _hb(itl_p99_ms=5.0)
+    assert wd.observe(slow) == []      # window not yet full
+    assert wd.observe(slow) == []      # burn=1.0, streak 1 < sustain
+    assert wd.observe(slow) == [ALERT_SLO_BURN]
+    assert wd.active == (ALERT_SLO_BURN,)
+    assert dumps == [f"watchdog_{ALERT_SLO_BURN}"]
+    alert = [e for e in evs if e["name"] == "watchdog_alert"][0]
+    assert alert["alert"] == ALERT_SLO_BURN
+    assert "burn_rate=1.00" in alert["reason"]
+    assert alert["dump"].endswith(ALERT_SLO_BURN)
+    # An active alert never re-fires while it stays breaching.
+    assert wd.observe(slow) == []
+    assert wd.stats()["alerts"] == 1
+    # One fast heartbeat still leaves burn at 0.5 >= threshold (window
+    # 2); the second empties the window of breaches and starts the
+    # healthy streak — clear after two healthy evaluations.
+    wd.observe(fast)
+    wd.observe(fast)
+    wd.observe(fast)
+    assert wd.active == ()
+    clears = [e for e in evs if e["name"] == "watchdog_clear"]
+    assert clears and clears[0]["alert"] == ALERT_SLO_BURN
+
+
+def test_anomaly_rules_fire_on_their_signals():
+    evs = []
+    wd = _watchdog(
+        WatchdogConfig(
+            slo_ms=0.0, sustain=1, clear=1, preempt_storm=4,
+            recovery_storm=2, hit_floor=0.5, min_lookups=4,
+        ),
+        evs,
+    )
+    assert wd.observe(_hb(preemptions_delta=4)) == [ALERT_PREEMPT_STORM]
+    assert wd.observe(_hb(recoveries_delta=2)) == [ALERT_RECOVERY_STORM]
+    # Hit collapse needs the host tier armed AND real lookup traffic.
+    assert wd.observe(
+        _hb(prefix_hits_delta=1, prefix_misses_delta=9)
+    ) == []  # tier off: not a host-tier signal
+    assert wd.observe(
+        _hb(prefix_hits_delta=1, prefix_misses_delta=9,
+            kv_host_tokens=1024)
+    ) == [ALERT_HOST_HIT_COLLAPSE]
+    # Healthy heartbeats clear all three (clear=1).
+    wd.observe(_hb())
+    assert wd.active == ()
+
+
+def test_tokens_regression_against_own_ewma():
+    evs = []
+    wd = _watchdog(
+        WatchdogConfig(slo_ms=0.0, sustain=1, clear=1, min_samples=3,
+                       regress_ratio=0.5),
+        evs,
+    )
+    for _ in range(4):
+        assert wd.observe(_hb(tokens_per_s=100.0)) == []
+    # 30 < 0.5 × ewma(100): breach. The slump must NOT be folded into
+    # the baseline — a second slumped heartbeat still breaches.
+    assert wd.observe(_hb(tokens_per_s=30.0)) == [ALERT_TOKENS_REGRESSION]
+    wd.observe(_hb(tokens_per_s=100.0))  # clears
+    assert wd.observe(_hb(tokens_per_s=30.0)) == [ALERT_TOKENS_REGRESSION]
+    # Idle heartbeats (no rounds) never count as regression.
+    assert wd.observe(
+        _hb(tokens_per_s=0.0, interval_rounds=0)
+    ) == []
+
+
+def test_watchdog_dump_reason_names_the_postmortem(tmp_path):
+    """The default dump path goes through the always-armed flight ring:
+    the postmortem file name carries watchdog_<kind> — the on-disk
+    artifact the chaos gate asserts on."""
+    from kata_xpu_device_plugin_tpu.obs import flight
+
+    rec = flight.FlightRecorder(capacity=64)
+    prev = flight.set_default_recorder(rec)
+    prev_dir = os.environ.get(flight.ENV_DIR)
+    os.environ[flight.ENV_DIR] = str(tmp_path)
+    try:
+        evs = []
+        wd = _watchdog(
+            WatchdogConfig(slo_ms=0.0, sustain=1, preempt_storm=1), evs
+        )
+        rec.record({"kind": "serving", "name": "warmup"})  # ring non-empty
+        assert wd.observe(_hb(preemptions_delta=1)) == [ALERT_PREEMPT_STORM]
+        alert = [e for e in evs if e["name"] == "watchdog_alert"][0]
+        assert alert["dump"]
+        assert os.path.exists(alert["dump"])
+        assert f"watchdog_{ALERT_PREEMPT_STORM}" in os.path.basename(
+            alert["dump"]
+        )
+        assert wd.stats()["last_dump"] == alert["dump"]
+    finally:
+        if prev_dir is None:
+            os.environ.pop(flight.ENV_DIR, None)
+        else:
+            os.environ[flight.ENV_DIR] = prev_dir
+        flight.set_default_recorder(prev)
+
+
+def test_watchdog_profiler_window_bounded(tmp_path, monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, "start_trace", lambda d: calls.append(("start", d))
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: calls.append(("stop",))
+    )
+    evs = []
+    wd = _watchdog(
+        WatchdogConfig(slo_ms=0.0, sustain=1, clear=1, preempt_storm=1,
+                       profile_dir=str(tmp_path), profile_steps=2),
+        evs, dumps=[],
+    )
+    wd.observe(_hb(preemptions_delta=1))       # alert → window opens
+    assert calls == [("start", str(tmp_path))]
+    wd.observe(_hb())                           # step 1
+    wd.observe(_hb())                           # step 2 → window closes
+    assert calls[-1] == ("stop",)
+    assert len(calls) == 2
+    # close() after the window already stopped is a no-op.
+    wd.close()
+    assert len(calls) == 2
+
+
+def test_watchdog_observe_never_raises():
+    wd = SLOBurnWatchdog(
+        WatchdogConfig(slo_ms=50.0, sustain=1),
+        emit=lambda name, **f: None,
+        dump=lambda reason: None,
+    )
+    assert wd.observe({"itl_p99_ms": "garbage", "interval_rounds": "x"}) == []
+    assert wd.observe({}) == []
+
+
+def test_config_from_env_degrades_malformed(monkeypatch):
+    monkeypatch.setenv(wd_mod.ENV_WINDOW, "not-a-number")
+    monkeypatch.setenv(wd_mod.ENV_BURN_THRESHOLD, "0.9")
+    cfg = WatchdogConfig.from_env(slo_ms=25.0)
+    assert cfg.window == WatchdogConfig().window  # malformed → default
+    assert cfg.burn_threshold == 0.9
+    assert cfg.slo_ms == 25.0
+
+
+# ----- server: heartbeat emission -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _prompts(cfg, lengths, seed=5):
+    key = jax.random.PRNGKey(seed)
+    return [
+        np.asarray(
+            jax.random.randint(jax.random.fold_in(key, i), (n,), 0,
+                               cfg.vocab_size),
+            np.int32,
+        )
+        for i, n in enumerate(lengths)
+    ]
+
+
+def _server(params, cfg, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 48)
+    kw.setdefault("chunk", 2)
+    kw.setdefault("kv_quant", False)
+    kw.setdefault("fault_injector", FaultInjector())
+    kw.setdefault("recovery_backoff_s", 0.0)
+    return GenerationServer(params, cfg, **kw)
+
+
+def test_heartbeat_cadence_fields_and_deltas(model, capture_events):
+    cfg, params = model
+
+    def run():
+        srv = _server(params, cfg, heartbeat_rounds=2)
+        for p in _prompts(cfg, [6, 8, 6, 8]):
+            srv.submit(p, 8)
+        return srv, srv.run()
+
+    (srv2, results2), events = capture_events(run)
+    hbs = [e for e in events if e.get("name") == "serving_heartbeat"]
+    assert hbs, "no heartbeats at cadence 2"
+    # Cadence: every non-final heartbeat covers exactly 2 rounds; the
+    # final flush may carry a shorter tail interval.
+    assert all(hb["interval_rounds"] <= 2 for hb in hbs)
+    assert sum(hb["interval_rounds"] for hb in hbs) == srv2.stats()["rounds"]
+    # Interval token deltas sum to the cumulative decoded total.
+    decoded = srv2.stats()["tokens_emitted"] - srv2.stats()["prefills"]
+    assert sum(hb["tokens_delta"] for hb in hbs) == decoded
+    # Schema: every heartbeat carries the full field set (no branches).
+    required = {
+        "round", "interval_rounds", "interval_s", "tokens_per_s",
+        "slots_busy", "queued", "batch_occupancy", "kv_pool_occupancy",
+        "kv_pool_shard_occupancy", "kv_host_occupancy", "kv_host_blocks",
+        "prefix_store_occupancy", "prefix_hit_rate", "kv_demotions_delta",
+        "kv_prefetches_delta", "preemptions_delta", "recoveries_delta",
+        "slo_violations_delta", "itl_p50_ms", "itl_p99_ms", "ttft_p50_ms",
+        "ttft_p99_ms", "slo_ms", "tp", "tp_degraded", "decode_steps",
+        "chips", "admission_wait_p50_ms", "admission_wait_p99_ms",
+    } | {f"phase_{p}_s" for p in LOOP_PHASES}
+    assert required <= set(hbs[0])
+    st = srv2.stats()
+    assert st["heartbeats"] == len(hbs)
+    assert st["heartbeat_rounds"] == 2
+    assert st["heartbeat_tokens_per_s"] == hbs[-1]["tokens_per_s"]
+    assert set(st["loop_phase_s"]) == set(LOOP_PHASES[:-1])
+    # The loop actually spent time in admit and dispatch.
+    assert st["loop_phase_s"]["admit"] > 0
+    assert st["loop_phase_s"]["dispatch"] > 0
+
+
+def test_heartbeat_disabled_is_uninstrumented(model, capture_events):
+    cfg, params = model
+
+    def run():
+        srv = _server(params, cfg, heartbeat_rounds=0)
+        for p in _prompts(cfg, [6, 8]):
+            srv.submit(p, 6)
+        srv.run()
+        return srv
+
+    srv, events = capture_events(run)
+    assert not [e for e in events if e.get("name") == "serving_heartbeat"]
+    assert srv._watchdog is None
+    assert not srv._clock.armed
+    st = srv.stats()
+    assert st["heartbeats"] == 0
+    assert st["watchdog_alerts"] == 0
+    assert all(v == 0.0 for v in st["loop_phase_s"].values())
+
+
+def test_heartbeat_outputs_bit_identical_on_off(model):
+    cfg, params = model
+    outs = []
+    for hb in (0, 1):
+        srv = _server(params, cfg, heartbeat_rounds=hb)
+        rids = [srv.submit(p, 8) for p in _prompts(cfg, [6, 8, 6])]
+        res = srv.run()
+        outs.append([res[r].tolist() for r in rids])
+    assert outs[0] == outs[1]
+
+
+def test_heartbeat_knob_contract(model, capture_events, monkeypatch):
+    cfg, params = model
+    # Explicit nonsense raises.
+    with pytest.raises(ValueError, match="heartbeat_rounds"):
+        _server(params, cfg, heartbeat_rounds=-1)
+    with pytest.raises(ValueError, match="watchdog requires"):
+        _server(params, cfg, heartbeat_rounds=0, watchdog=True)
+    # Malformed env degrades to the default with an event.
+    monkeypatch.setenv(ENV_HEARTBEAT_ROUNDS, "sometimes")
+    srv, events = capture_events(lambda: _server(params, cfg))
+    assert srv._hb_every == DEFAULT_HEARTBEAT_ROUNDS
+    assert any(e.get("name") == "heartbeat_invalid" for e in events)
+    # Parseable nonsense degrades too.
+    monkeypatch.setenv(ENV_HEARTBEAT_ROUNDS, "-3")
+    srv2, events2 = capture_events(lambda: _server(params, cfg))
+    assert srv2._hb_every == DEFAULT_HEARTBEAT_ROUNDS
+    assert any(e.get("name") == "heartbeat_invalid" for e in events2)
+    # The watchdog kill switch disarms without touching the heartbeat.
+    monkeypatch.setenv(ENV_HEARTBEAT_ROUNDS, "4")
+    monkeypatch.setenv(wd_mod.ENV_WATCHDOG, "0")
+    srv3 = _server(params, cfg)
+    assert srv3._hb_every == 4
+    assert srv3._watchdog is None
+
+
+def test_serving_config_event_carries_heartbeat_shape(model, capture_events):
+    cfg, params = model
+    srv, events = capture_events(
+        lambda: _server(params, cfg, heartbeat_rounds=7)
+    )
+    sc = [e for e in events if e.get("name") == "serving_config"][0]
+    assert sc["heartbeat_rounds"] == 7
+    assert sc["watchdog"] == 1
+
+
+# ----- integration: chip_loss → breach → dump → clear ------------------------
+
+
+@pytest.mark.skipif(jax.device_count() < 2, reason="needs 2 devices")
+def test_chip_loss_breach_dump_and_clear_bit_identical(model, capture_events):
+    """The ISSUE 15 chaos acceptance: a seeded ``chip_loss`` mid-decode
+    at tp=2 shrinks the mesh (ISSUE 10); the recovery shows up in the
+    next heartbeat's ``recoveries_delta``, the watchdog fires
+    ``recovery_storm`` (sustain 1), dumps the flight ring with the
+    watchdog reason, and — once recovered rounds flow — clears the
+    alert. Greedy outputs stay bit-identical to a fault-free run, and
+    the whole sequence is counter-driven (deterministic in both strict
+    modes)."""
+    cfg, params = model
+    prompts = _prompts(cfg, [8, 6, 8], seed=11)
+
+    def serve(injector):
+        wd = SLOBurnWatchdog(
+            WatchdogConfig(slo_ms=0.0, sustain=1, clear=1,
+                           recovery_storm=1),
+        )
+        srv = _server(
+            params, cfg, tp=2, tp_min=1, heartbeat_rounds=1,
+            watchdog=wd, fault_injector=injector, max_len=32, chunk=4,
+        )
+        rids = [srv.submit(p, 8) for p in prompts]
+        res = srv.run()
+        return srv, [res[r].tolist() for r in rids]
+
+    clean_srv, clean_out = serve(FaultInjector())
+    assert clean_srv.stats()["watchdog_alerts"] == 0
+
+    def faulted():
+        return serve(FaultInjector(
+            [FaultSpec("decode_dispatch", 2, "chip_loss", 1)], seed=3
+        ))
+
+    (srv, out), events = capture_events(faulted)
+    # Degraded recovery happened and outputs are bit-identical.
+    assert srv.stats()["tp_shrinks"] == 1
+    assert out == clean_out
+    # Breach: the watchdog fired on the recovery counter and dumped.
+    alerts = [e for e in events if e.get("name") == "watchdog_alert"]
+    assert [a["alert"] for a in alerts] == [ALERT_RECOVERY_STORM]
+    dump = alerts[0]["dump"]
+    assert dump and os.path.exists(dump)
+    assert "watchdog_recovery_storm" in os.path.basename(dump)
+    # The postmortem carries the incident: the tp_degraded/recovery
+    # events leading into the breach and the alert itself as context.
+    dumped = obs.read_events(dump)
+    names = {e.get("name") for e in dumped}
+    assert "tp_degraded" in names
+    assert "serving_heartbeat" in names
+    # Recovery clears the alert before the run ends.
+    clears = [e for e in events if e.get("name") == "watchdog_clear"]
+    assert [c["alert"] for c in clears] == [ALERT_RECOVERY_STORM]
+    assert srv.stats()["watchdog_active"] == 0
+    assert srv.stats()["watchdog"]["last_dump"] == dump
+    # Ordering: alert strictly before its clear.
+    ts = [e.get("name") for e in events
+          if e.get("name") in ("watchdog_alert", "watchdog_clear")]
+    assert ts == ["watchdog_alert", "watchdog_clear"]

@@ -1,0 +1,505 @@
+"""Offline telemetry reporter (ISSUE 15): events JSONL → human report.
+
+Turn any recorded event stream — a bench smoke run, a chaos gate, a
+production guest's heartbeat file, a flight-recorder postmortem — into a
+readable report with four sections:
+
+- **phase waterfall** — span events aggregated per phase name
+  (``obs.summarize_phases``), rendered as scaled bars: where the wall
+  clock went;
+- **heartbeat timelines** — per-server tokens/s, occupancy, queue depth
+  and ITL over the ``serving_heartbeat`` stream, with interval summaries
+  and a downsampled timeline table;
+- **top-N slowest requests** — ``request_trace`` events ranked by wall
+  time, each with its PR 11 phase ledger (queue/prefill/decode/...)
+  spelled out;
+- **watchdog incidents** — ``watchdog_alert``/``watchdog_clear`` pairs
+  (kind, reason, flight-dump path) plus the recovery/degraded/fatal
+  event counts around them.
+
+Outputs: markdown (stdout by default, ``--md PATH``) and machine JSON
+(``--json PATH``). ``--check`` validates the report against the
+required schema (:func:`check_schema`) and exits non-zero on drift —
+the ``make obs-report`` CI smoke gate. ``--generate PATH`` produces a
+fresh smoke events file by running a tiny instrumented serving burst on
+CPU (the only mode that imports jax).
+
+Reading and rendering are stdlib + ``obs.events`` only, so the reporter
+runs on any machine the JSONL landed on — no jax, no prometheus.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Iterable, Optional
+
+from kata_xpu_device_plugin_tpu.obs import events as obs_events
+
+SCHEMA_VERSION = 1
+
+# Required report shape: top-level keys and the per-section fields the
+# --check gate pins. Adding a field is fine; REMOVING or renaming one of
+# these is schema drift and fails CI (downstream dashboards parse the
+# JSON form).
+REQUIRED_TOP = (
+    "schema", "source", "events", "phases", "heartbeats", "requests",
+    "incidents",
+)
+REQUIRED_HEARTBEAT_FIELDS = (
+    "count", "tokens_per_s", "itl_p99_ms", "batch_occupancy",
+    "kv_pool_occupancy", "queued", "timeline",
+)
+REQUIRED_REQUEST_FIELDS = ("rid", "outcome", "wall_s", "tokens", "phases")
+REQUIRED_INCIDENT_FIELDS = ("alerts", "clears", "event_counts")
+
+# Event names folded into the incident section's context counts.
+_INCIDENT_EVENTS = (
+    "recovery", "tp_degraded", "device_stall", "fault_injected",
+    "chip_loss_fatal", "fatal_error", "request_failed", "kv_preempt",
+    "drain",
+)
+
+_BAR_WIDTH = 40
+
+
+def _bar(frac: float, width: int = _BAR_WIDTH) -> str:
+    n = max(0, min(width, round(frac * width)))
+    return "█" * n + "·" * (width - n)
+
+
+def _downsample(rows: list, limit: int = 48) -> list:
+    """Keep at most ``limit`` evenly spaced rows (first and last always
+    survive) — a day-long heartbeat stream must not render as ten
+    thousand table lines."""
+    if len(rows) <= limit:
+        return rows
+    step = (len(rows) - 1) / (limit - 1)
+    return [rows[round(i * step)] for i in range(limit)]
+
+
+def _minmeanmax(vals: Iterable[float]) -> dict:
+    vals = [float(v) for v in vals]
+    if not vals:
+        return {"min": 0.0, "mean": 0.0, "max": 0.0}
+    return {
+        "min": round(min(vals), 3),
+        "mean": round(sum(vals) / len(vals), 3),
+        "max": round(max(vals), 3),
+    }
+
+
+# ----- report assembly ------------------------------------------------------
+
+
+def build_report(events: list[dict], source: str = "",
+                 top: int = 10) -> dict:
+    """Assemble the machine-readable report from parsed events."""
+    heartbeats: dict[str, list[dict]] = {}
+    requests: list[dict] = []
+    alerts: list[dict] = []
+    clears: list[dict] = []
+    event_counts: dict[str, int] = {}
+    kinds: dict[str, int] = {}
+    ts_min = ts_max = None
+    for ev in events:
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)):
+            ts_min = ts if ts_min is None else min(ts_min, ts)
+            ts_max = ts if ts_max is None else max(ts_max, ts)
+        kinds[str(ev.get("kind"))] = kinds.get(str(ev.get("kind")), 0) + 1
+        name = ev.get("name")
+        if name == "serving_heartbeat":
+            heartbeats.setdefault(
+                str(ev.get("server", "unknown")), []
+            ).append(ev)
+        elif name == "request_trace":
+            requests.append(ev)
+        elif name == "watchdog_alert":
+            alerts.append({
+                "server": ev.get("server", ""),
+                "alert": ev.get("alert", ""),
+                "reason": ev.get("reason", ""),
+                "dump": ev.get("dump", ""),
+                "round": ev.get("round"),
+                "ts": ev.get("ts"),
+            })
+        elif name == "watchdog_clear":
+            clears.append({
+                "server": ev.get("server", ""),
+                "alert": ev.get("alert", ""),
+                "round": ev.get("round"),
+                "ts": ev.get("ts"),
+            })
+        if name in _INCIDENT_EVENTS:
+            event_counts[str(name)] = event_counts.get(str(name), 0) + 1
+
+    hb_sections = {}
+    for server, hbs in sorted(heartbeats.items()):
+        timeline = _downsample([
+            {
+                "ts": hb.get("ts"),
+                "round": hb.get("round"),
+                "tokens_per_s": hb.get("tokens_per_s", 0.0),
+                "itl_p99_ms": hb.get("itl_p99_ms", 0.0),
+                "batch_occupancy": hb.get("batch_occupancy", 0.0),
+                "kv_pool_occupancy": hb.get("kv_pool_occupancy", 0.0),
+                "kv_host_occupancy": hb.get("kv_host_occupancy", 0.0),
+                "queued": hb.get("queued", 0),
+            }
+            for hb in hbs
+        ])
+        phase_totals: dict[str, float] = {}
+        for hb in hbs:
+            for k, v in hb.items():
+                if k.startswith("phase_") and k.endswith("_s"):
+                    phase = k[len("phase_"):-len("_s")]
+                    phase_totals[phase] = (
+                        phase_totals.get(phase, 0.0) + float(v or 0.0)
+                    )
+        hb_sections[server] = {
+            "count": len(hbs),
+            "tokens_per_s": _minmeanmax(
+                hb.get("tokens_per_s", 0.0) for hb in hbs
+            ),
+            "itl_p99_ms": _minmeanmax(
+                hb.get("itl_p99_ms", 0.0) for hb in hbs
+            ),
+            "batch_occupancy": _minmeanmax(
+                hb.get("batch_occupancy", 0.0) for hb in hbs
+            ),
+            "kv_pool_occupancy": _minmeanmax(
+                hb.get("kv_pool_occupancy", 0.0) for hb in hbs
+            ),
+            "queued": _minmeanmax(hb.get("queued", 0) for hb in hbs),
+            "loop_phase_s": {
+                k: round(v, 6) for k, v in sorted(phase_totals.items())
+            },
+            "timeline": timeline,
+        }
+
+    requests.sort(key=lambda r: -float(r.get("wall_s") or 0.0))
+    slowest = [
+        {
+            "rid": r.get("rid"),
+            "server": r.get("server", ""),
+            "outcome": r.get("outcome", ""),
+            "reason": r.get("reason", ""),
+            "wall_s": round(float(r.get("wall_s") or 0.0), 6),
+            "tokens": r.get("tokens", 0),
+            "prompt_len": r.get("prompt_len", 0),
+            "replays": r.get("replays", 0),
+            # The PR 11 phase ledger: only phases with time in them.
+            "phases": {
+                k[:-len("_s")]: round(float(v), 6)
+                for k, v in r.items()
+                if k.endswith("_s") and k not in ("wall_s", "attributed_s")
+                and float(v or 0.0) > 0
+            },
+        }
+        for r in requests[:top]
+    ]
+
+    return {
+        "schema": SCHEMA_VERSION,
+        "source": source,
+        "events": {
+            "count": len(events),
+            "span_s": (
+                round(ts_max - ts_min, 3)
+                if ts_min is not None and ts_max is not None else 0.0
+            ),
+            "kinds": dict(sorted(kinds.items())),
+        },
+        "phases": obs_events.summarize_phases(events),
+        "heartbeats": {"servers": hb_sections},
+        "requests": {"total_traces": len(requests), "slowest": slowest},
+        "incidents": {
+            "alerts": alerts,
+            "clears": clears,
+            "event_counts": dict(sorted(event_counts.items())),
+        },
+    }
+
+
+# ----- schema gate ----------------------------------------------------------
+
+
+def check_schema(report: dict, require_data: bool = False) -> list[str]:
+    """Validate the report structure; returns a list of drift errors
+    (empty = clean). ``require_data=True`` additionally demands a
+    non-empty phase waterfall and at least one heartbeat server — the
+    smoke gate's bar (a reporter that renders an empty report from a
+    fresh smoke stream IS drift, just upstream of the schema)."""
+    errors: list[str] = []
+    for key in REQUIRED_TOP:
+        if key not in report:
+            errors.append(f"missing top-level key: {key}")
+    if errors:
+        return errors
+    if report["schema"] != SCHEMA_VERSION:
+        errors.append(
+            f"schema version {report['schema']} != {SCHEMA_VERSION}"
+        )
+    for name, stats in report["phases"].items():
+        for k in ("count", "total_s", "mean_s"):
+            if k not in stats:
+                errors.append(f"phase {name!r} missing field {k}")
+    for server, sec in report["heartbeats"].get("servers", {}).items():
+        for k in REQUIRED_HEARTBEAT_FIELDS:
+            if k not in sec:
+                errors.append(f"heartbeat section {server!r} missing {k}")
+    for req in report["requests"].get("slowest", []):
+        for k in REQUIRED_REQUEST_FIELDS:
+            if k not in req:
+                errors.append(f"request entry missing {k}: {req}")
+    for k in REQUIRED_INCIDENT_FIELDS:
+        if k not in report["incidents"]:
+            errors.append(f"incidents section missing {k}")
+    if require_data:
+        if not report["phases"]:
+            errors.append("empty phase waterfall (no span events parsed)")
+        if not report["heartbeats"].get("servers"):
+            errors.append("no serving_heartbeat events parsed")
+    return errors
+
+
+# ----- markdown rendering ---------------------------------------------------
+
+
+def render_markdown(report: dict) -> str:
+    out: list[str] = []
+    ev = report["events"]
+    out.append("# Telemetry report")
+    out.append("")
+    out.append(
+        f"`{report['source']}` — {ev['count']} events over "
+        f"{ev['span_s']:.1f}s (schema v{report['schema']})"
+    )
+
+    out.append("")
+    out.append("## Phase waterfall")
+    out.append("")
+    phases = report["phases"]
+    if phases:
+        longest = max(s["total_s"] for s in phases.values()) or 1.0
+        width = max(len(n) for n in phases)
+        out.append("```")
+        for name, s in sorted(
+                phases.items(), key=lambda kv: -kv[1]["total_s"]):
+            out.append(
+                f"{name:<{width}}  {_bar(s['total_s'] / longest)} "
+                f"{s['total_s']:9.3f}s  ×{s['count']:<5} "
+                f"mean {s['mean_s'] * 1e3:8.2f}ms"
+            )
+        out.append("```")
+    else:
+        out.append("_no span events in the stream_")
+
+    out.append("")
+    out.append("## Serving heartbeats")
+    servers = report["heartbeats"]["servers"]
+    if not servers:
+        out.append("")
+        out.append("_no serving_heartbeat events in the stream_")
+    for server, sec in servers.items():
+        tps, itl = sec["tokens_per_s"], sec["itl_p99_ms"]
+        out.append("")
+        out.append(
+            f"### {server} — {sec['count']} heartbeats, tokens/s "
+            f"{tps['min']}/{tps['mean']}/{tps['max']} (min/mean/max), "
+            f"ITL p99 {itl['mean']}ms mean"
+        )
+        lp = sec.get("loop_phase_s") or {}
+        if lp:
+            total = sum(lp.values()) or 1.0
+            parts = ", ".join(
+                f"{k} {100 * v / total:.0f}%" for k, v in sorted(
+                    lp.items(), key=lambda kv: -kv[1]
+                )
+            )
+            out.append(f"loop time: {parts}")
+        out.append("")
+        out.append(
+            "| round | tok/s | ITL p99 ms | batch | pool | host | queued |"
+        )
+        out.append("|---:|---:|---:|---:|---:|---:|---:|")
+        for row in sec["timeline"]:
+            out.append(
+                f"| {row['round']} | {row['tokens_per_s']} "
+                f"| {row['itl_p99_ms']} | {row['batch_occupancy']} "
+                f"| {row['kv_pool_occupancy']} | {row['kv_host_occupancy']} "
+                f"| {row['queued']} |"
+            )
+
+    out.append("")
+    out.append("## Slowest requests")
+    out.append("")
+    slowest = report["requests"]["slowest"]
+    if slowest:
+        out.append(
+            f"{report['requests']['total_traces']} request traces; "
+            f"top {len(slowest)} by wall time:"
+        )
+        out.append("```")
+        longest = max(r["wall_s"] for r in slowest) or 1.0
+        for r in slowest:
+            ledger = " | ".join(
+                f"{k} {v:.3f}s" for k, v in sorted(
+                    r["phases"].items(), key=lambda kv: -kv[1]
+                )
+            )
+            tag = r["outcome"] + (
+                f"({r['reason']})" if r.get("reason") else ""
+            )
+            out.append(
+                f"rid {r['rid']:>5} {_bar(r['wall_s'] / longest, 20)} "
+                f"{r['wall_s']:8.3f}s {tag:<10} {r['tokens']:>5} tok  "
+                f"{ledger}"
+            )
+        out.append("```")
+    else:
+        out.append("_no request_trace events in the stream_")
+
+    out.append("")
+    out.append("## Watchdog incidents")
+    out.append("")
+    inc = report["incidents"]
+    if inc["alerts"]:
+        for a in inc["alerts"]:
+            out.append(
+                f"- **{a['alert']}** on `{a['server']}` at round "
+                f"{a['round']}: {a['reason']}"
+                + (f" — flight dump `{a['dump']}`" if a["dump"] else "")
+            )
+        for c in inc["clears"]:
+            out.append(
+                f"- cleared **{c['alert']}** on `{c['server']}` at round "
+                f"{c['round']}"
+            )
+    else:
+        out.append("_no watchdog alerts_")
+    if inc["event_counts"]:
+        counts = ", ".join(
+            f"{k}×{v}" for k, v in inc["event_counts"].items()
+        )
+        out.append("")
+        out.append(f"incident-adjacent events: {counts}")
+    out.append("")
+    return "\n".join(out)
+
+
+# ----- smoke-stream generation (the only jax-touching mode) -----------------
+
+
+def generate_smoke(path: str) -> str:
+    """Run a tiny instrumented serving burst on CPU and stream its
+    events to ``path`` — the ``make obs-report`` gate's input. Kept
+    inside the reporter so the smoke stream and the report it must parse
+    can never drift apart."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kata_xpu_device_plugin_tpu import obs
+    from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+    from kata_xpu_device_plugin_tpu.models import tiny_test_config
+    from kata_xpu_device_plugin_tpu.models.transformer import init_params
+
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    # Fresh means fresh: the sink appends, so a leftover stream from a
+    # previous run would make the schema gate validate mixed data.
+    if os.path.exists(path):
+        os.unlink(path)
+    sink = obs.EventSink(path)
+    prev = obs.set_default_sink(sink)
+    try:
+        srv = GenerationServer(
+            params, cfg, max_batch=2, max_len=64, chunk=2,
+            kv_quant=False, heartbeat_rounds=2,
+            kv_pool_tokens=2 * 64, prefix_cache_tokens=0,
+        )
+        key = jax.random.PRNGKey(7)
+        for i in range(6):
+            p = jax.random.randint(
+                jax.random.fold_in(key, i), (8 + 2 * (i % 3),), 0,
+                cfg.vocab_size,
+            )
+            srv.submit(np.asarray(p, np.int32), 10)
+        srv.run()
+    finally:
+        obs.set_default_sink(prev)
+        sink.close()
+    return path
+
+
+# ----- CLI ------------------------------------------------------------------
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.obs_report",
+        description="Render an events JSONL into a telemetry report "
+                    "(phase waterfall, heartbeat timelines, slowest "
+                    "requests, watchdog incidents).",
+    )
+    ap.add_argument("events", nargs="?", help="events JSONL to report on")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest requests to list (default 10)")
+    ap.add_argument("--md", help="write the markdown report here")
+    ap.add_argument("--json", dest="json_path",
+                    help="write the JSON report here")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the report schema (exit 2 on drift); "
+                         "also requires a non-empty waterfall + heartbeats")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the stdout markdown")
+    ap.add_argument("--generate", metavar="PATH",
+                    help="generate a smoke events file by running a tiny "
+                         "instrumented serving burst (CPU), then exit "
+                         "(combine with a second invocation to report)")
+    args = ap.parse_args(argv)
+
+    if args.generate:
+        path = generate_smoke(args.generate)
+        print(f"smoke events written: {path}")
+        return 0
+    if not args.events:
+        ap.error("events file required (or --generate PATH)")
+
+    try:
+        events = obs_events.read_events(args.events)
+    except OSError as e:
+        print(f"cannot read events file: {e}", file=sys.stderr)
+        return 2
+    report = build_report(events, source=args.events, top=args.top)
+    md = render_markdown(report)
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1)
+    if args.md:
+        with open(args.md, "w", encoding="utf-8") as fh:
+            fh.write(md)
+    if not args.quiet:
+        print(md)
+    if args.check:
+        errors = check_schema(report, require_data=True)
+        if errors:
+            for e in errors:
+                print(f"SCHEMA DRIFT: {e}", file=sys.stderr)
+            return 2
+        print(
+            f"schema ok: v{report['schema']}, {report['events']['count']} "
+            f"events, {len(report['phases'])} phases, "
+            f"{len(report['heartbeats']['servers'])} heartbeat server(s)",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint analyze verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused kv-layout eval eval-kv demo dryrun image clean deploy obs-check obs-report
+.PHONY: all build proto lint analyze race verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused kv-layout eval eval-kv demo dryrun image clean deploy obs-check obs-report
 
 all: build
 
@@ -38,14 +38,26 @@ lint:
 	  mypy; \
 	else echo "lint: mypy not installed — skipped (pip install mypy)"; fi
 
-# jaxguard (ISSUE 4): interprocedural dataflow analysis over the package
-# + bench/scripts — implicit host syncs on hot paths (JG101),
-# use-after-donation (JG102), tracer leaks (JG103), recompile hazards
-# (JG104). The JSON report is the CI artifact; exit 1 on any
-# unsuppressed finding. Pure-stdlib AST analysis: no jax import, runs
-# anywhere.
+# jaxguard (ISSUE 4, extended ISSUE 16): interprocedural dataflow
+# analysis over the package + bench/scripts — implicit host syncs on hot
+# paths (JG101), use-after-donation (JG102), tracer leaks (JG103),
+# recompile hazards (JG104), daemon lock discipline (JG201-JG203), and
+# the five-leg ENV_* knob contract (JG301-JG304). The JSON report is the
+# CI artifact; exit 1 on any unsuppressed finding. Pure-stdlib AST
+# analysis: no jax import, runs anywhere.
 analyze:
 	$(PY) -m tools.analyze --json jaxguard_report.json
+
+# Runtime race harness (ISSUE 16): the dynamic twin of the JG2xx pass —
+# barrier-driven N threads × M ops stress over the allocation journal,
+# the heartbeat aggregator, the flight ring, and the metrics registry,
+# asserting parse-back integrity and counter conservation across 200+
+# seeded iterations, then again under KATA_TPU_STRICT=1. jax-free (the
+# structures under stress are the host daemon's); event-stream artifacts
+# of the last iteration land in artifacts/ for CI upload.
+race:
+	RACE_ARTIFACTS=artifacts $(PY) tests/race_harness.py
+	KATA_TPU_STRICT=1 RACE_ITERS=50 RACE_ARTIFACTS= $(PY) tests/race_harness.py
 
 # The whole static gate in one target: lint rules, telemetry rules + obs
 # unit tests, and the jaxguard dataflow pass. CI runs the pieces

@@ -151,9 +151,12 @@ class FlightRecorder:
             d,
             f"katatpu_flight_{safe}_{os.getpid()}_{next(_DUMP_SEQ)}.jsonl",
         )
+        # Sanctioned lock-held IO: a postmortem must be a consistent
+        # snapshot — recording threads pausing behind the (rare) dump is
+        # the cost of a ring that is not torn mid-capture.
         if d and d != ".":
-            os.makedirs(d, exist_ok=True)
-        with open(path, "w", encoding="utf-8") as fh:
+            os.makedirs(d, exist_ok=True)  # jaxguard: allow(JG203) consistent postmortem snapshot
+        with open(path, "w", encoding="utf-8") as fh:  # jaxguard: allow(JG203) consistent postmortem snapshot
             for event in self._ring:
                 fh.write(json.dumps(event, default=str) + "\n")
         self.dumps.append(path)

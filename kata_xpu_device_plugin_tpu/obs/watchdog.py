@@ -49,6 +49,7 @@ replaying a recorded stream through :meth:`observe` — run anywhere.
 from __future__ import annotations
 
 import os
+import threading
 from collections import deque
 from dataclasses import dataclass, field, fields
 from typing import Callable, Optional
@@ -195,6 +196,12 @@ class SLOBurnWatchdog:
         self._emit_fn = emit
         self._dump_fn = dump
         self._trace = trace
+        # observe() runs on whatever thread feeds heartbeats (the serving
+        # loop, or an offline replay) while stats()/active serve the
+        # SIGUSR1 debug-dump thread — all rolling state below is guarded.
+        # Alert/clear emission and the flight dump happen OUTSIDE the
+        # held region (lock-held IO would stall every emitter).
+        self._lock = threading.Lock()
         self._burning: deque = deque(maxlen=self.config.window)
         self._rules = {k: _RuleState() for k in ALERT_KINDS}
         self._rate_ewma: Optional[float] = None
@@ -298,43 +305,57 @@ class SLOBurnWatchdog:
     def observe(self, hb: dict) -> list[str]:
         """Feed one heartbeat; returns the alert kinds that FIRED on this
         observation (usually empty). Never raises — the watchdog is
-        telemetry and must not add a failure mode to the serving loop."""
-        self._observed += 1
-        fired: list[str] = []
-        try:
-            breaches = self._breaches(hb)
-        except Exception:
-            return fired
-        for kind in ALERT_KINDS:
-            st = self._rules[kind]
-            if kind in breaches:
-                st.breach_streak += 1
-                st.healthy_streak = 0
-                if (not st.active
-                        and st.breach_streak >= self.config.sustain):
-                    st.active = True
-                    st.alerts += 1
-                    fired.append(kind)
-                    self._fire(kind, breaches[kind], hb)
-            else:
-                st.healthy_streak += 1
-                st.breach_streak = 0
-                if st.active and st.healthy_streak >= self.config.clear:
-                    st.active = False
-                    self._emit(
-                        "watchdog_clear", alert=kind,
-                        healthy_heartbeats=st.healthy_streak,
-                        round=hb.get("round"),
-                    )
+        telemetry and must not add a failure mode to the serving loop.
+
+        The rolling windows and rule streaks update under the lock; the
+        fire/clear decisions collected there turn into events and flight
+        dumps AFTER it is released."""
+        fired: list[tuple[str, str]] = []
+        cleared: list[tuple[str, int]] = []
+        with self._lock:
+            self._observed += 1
+            try:
+                breaches = self._breaches(hb)
+            except Exception:
+                return []
+            for kind in ALERT_KINDS:
+                st = self._rules[kind]
+                if kind in breaches:
+                    st.breach_streak += 1
+                    st.healthy_streak = 0
+                    if (not st.active
+                            and st.breach_streak >= self.config.sustain):
+                        st.active = True
+                        st.alerts += 1
+                        fired.append((kind, breaches[kind]))
+                else:
+                    st.healthy_streak += 1
+                    st.breach_streak = 0
+                    if st.active and st.healthy_streak >= self.config.clear:
+                        st.active = False
+                        cleared.append((kind, st.healthy_streak))
+        for kind, reason in fired:
+            self._fire(kind, reason, hb)
+        for kind, healthy in cleared:
+            self._emit(
+                "watchdog_clear", alert=kind,
+                healthy_heartbeats=healthy,
+                round=hb.get("round"),
+            )
         # Advance an open profiler window one heartbeat; the hook stops
         # itself (and emits profile/jax_trace) at the window end.
-        if self._prof is not None:
-            self._prof_step += 1
+        with self._lock:
+            prof = self._prof
+            if prof is not None:
+                self._prof_step += 1
+                step = self._prof_step
+        if prof is not None:
             try:
-                self._prof.on_step(self._prof_step)
+                prof.on_step(step)
             except Exception:
-                self._prof = None  # profiling must never hurt serving
-        return fired
+                with self._lock:
+                    self._prof = None  # profiling must never hurt serving
+        return [kind for kind, _reason in fired]
 
     def _fire(self, kind: str, reason: str, hb: dict) -> None:
         dump_path = None
@@ -342,7 +363,9 @@ class SLOBurnWatchdog:
             dump_path = self._dump(kind)
         except Exception:
             pass
-        self._last_dump = dump_path or self._last_dump
+        with self._lock:
+            self._last_dump = dump_path or self._last_dump
+            want_prof = bool(self.config.profile_dir) and self._prof is None
         self._emit(
             "watchdog_alert", alert=kind, reason=reason,
             round=hb.get("round"), dump=dump_path or "",
@@ -350,43 +373,54 @@ class SLOBurnWatchdog:
             itl_p99_ms=hb.get("itl_p99_ms"),
             slo_ms=self.config.slo_ms,
         )
-        if self.config.profile_dir and self._prof is None:
+        if want_prof:
             # One bounded window per watchdog lifetime, opened at the
             # FIRST alert: the next profile_steps heartbeats of device
             # time land in the xplane trace. (ProfilerHook._done keeps a
             # later alert from re-opening it.)
-            self._prof = ProfilerHook(
+            prof = ProfilerHook(
                 self.config.profile_dir, start_step=1,
                 num_steps=self.config.profile_steps,
             )
-            self._prof_step = 0
             try:
-                self._prof.on_step(0)  # opens the window now
+                prof.on_step(0)  # opens the window now
             except Exception:
-                self._prof = None
+                prof = None
+            if prof is not None:
+                with self._lock:
+                    self._prof = prof
+                    self._prof_step = 0
 
     # ----- introspection / lifecycle ---------------------------------------
 
     @property
     def active(self) -> tuple[str, ...]:
-        return tuple(k for k in ALERT_KINDS if self._rules[k].active)
+        with self._lock:
+            return tuple(k for k in ALERT_KINDS if self._rules[k].active)
 
     def stats(self) -> dict:
-        """Always-present aggregate for ``GenerationServer.stats()``."""
-        return {
-            "alerts": sum(st.alerts for st in self._rules.values()),
-            "active": list(self.active),
-            "observed": self._observed,
-            "last_dump": self._last_dump or "",
-        }
+        """Always-present aggregate for ``GenerationServer.stats()``;
+        reads the rolling state under the lock — this runs on the
+        SIGUSR1 debug-dump thread mid-serving."""
+        with self._lock:
+            return {
+                "alerts": sum(st.alerts for st in self._rules.values()),
+                "active": [
+                    k for k in ALERT_KINDS if self._rules[k].active
+                ],
+                "observed": self._observed,
+                "last_dump": self._last_dump or "",
+            }
 
     def close(self) -> None:
         """Stop an open profiler window (idempotent); the serving loop
         calls this when the server idles out so an alert near the end of
         a run can never leave ``jax.profiler`` running."""
-        if self._prof is not None:
+        with self._lock:
+            prof = self._prof
+            self._prof = None
+        if prof is not None:
             try:
-                self._prof.stop()
+                prof.stop()
             except Exception:
                 pass
-            self._prof = None

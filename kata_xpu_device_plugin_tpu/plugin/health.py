@@ -226,7 +226,14 @@ class HealthWatcher(threading.Thread):
         Both outcomes land on ``plugin_restarts_total{ok=...}`` and
         failures additionally emit a ``plugin_restart_failed`` obs
         event."""
-        fails, not_before = self._restart_state.get(id(plugin), (0, 0.0))
+        # Backoff state joins _plugins under this class's lock: the
+        # watcher thread is its only writer today, but add()/remove()
+        # callers share the instance and the map must not be one
+        # refactor away from a torn read.
+        with self._lock:
+            fails, not_before = self._restart_state.get(
+                id(plugin), (0, 0.0)
+            )
         now = self._clock()
         if now < not_before:
             return False  # backing off; a later pass re-offers
@@ -242,7 +249,8 @@ class HealthWatcher(threading.Thread):
                 self._restart_backoff_s * (2 ** (fails - 1)),
                 self._restart_backoff_max_s,
             )
-            self._restart_state[id(plugin)] = (fails, now + delay)
+            with self._lock:
+                self._restart_state[id(plugin)] = (fails, now + delay)
             metrics.plugin_restarts_total.labels(
                 resource=plugin.resource_name, ok="false"
             ).inc()
@@ -259,7 +267,8 @@ class HealthWatcher(threading.Thread):
                 ),
             )
             return False
-        self._restart_state.pop(id(plugin), None)
+        with self._lock:
+            self._restart_state.pop(id(plugin), None)
         metrics.plugin_restarts_total.labels(
             resource=plugin.resource_name, ok="true"
         ).inc()

@@ -238,12 +238,16 @@ class AllocationJournal:
     def _save_locked(self) -> None:
         tmp = self.path + ".tmp"
         try:
+            # Sanctioned lock-held IO: concurrent Allocate handlers must
+            # serialize the whole tmp+rename cycle or two writers tear
+            # the same tmp file — crash consistency IS the contract here
+            # (the journal is tiny; the write is bounded).
             d = os.path.dirname(self.path)
             if d:
-                os.makedirs(d, exist_ok=True)
-            with open(tmp, "w", encoding="utf-8") as fh:
-                json.dump({"version": 1, "devices": self._devices}, fh)
-            os.replace(tmp, self.path)
+                os.makedirs(d, exist_ok=True)  # jaxguard: allow(JG203) serialized journal checkpoint
+            with open(tmp, "w", encoding="utf-8") as fh:  # jaxguard: allow(JG203) serialized journal checkpoint
+                json.dump({"version": 1, "devices": self._devices}, fh)  # jaxguard: allow(JG203) serialized journal checkpoint
+            os.replace(tmp, self.path)  # jaxguard: allow(JG203) serialized journal checkpoint
         except OSError as e:
             # A read-only state dir must not fail Allocate — the journal
             # is a restart hint, never the allocation's source of truth.
@@ -391,10 +395,13 @@ class HeartbeatAggregator:
             if not name.endswith(".jsonl"):
                 continue
             path = os.path.join(self.events_dir, name)
+            # The offset map lives next to _last/_active_alerts under
+            # this class's lock discipline — touch it under the lock,
+            # with the tail-file IO outside the held region.
+            with self._lock:
+                last_offset = self._offsets.get(path, 0)
             try:
-                events, offset = obs.tail_events(
-                    path, self._offsets.get(path, 0)
-                )
+                events, offset = obs.tail_events(path, last_offset)
             except Exception:
                 continue
             if self.max_stream_bytes and offset > self.max_stream_bytes:
@@ -406,7 +413,8 @@ class HeartbeatAggregator:
                     offset = 0
                 except OSError:
                     pass
-            self._offsets[path] = offset
+            with self._lock:
+                self._offsets[path] = offset
             # Fallback allocation identity from the allocator's file
             # naming (guest_<chips>.jsonl) for events predating the
             # heartbeat's own "chips" field.
@@ -818,7 +826,9 @@ class PluginManager:
         self, key: tuple[str, str], groups: list[str], register: bool
     ) -> None:
         cfg = self.cfg
-        suffix = self._vfio_inv.model_suffix(key, self._db) if self._vfio_inv else key[1]
+        with self._lock:
+            vfio_inv = self._vfio_inv
+        suffix = vfio_inv.model_suffix(key, self._db) if vfio_inv else key[1]
         resource = f"{cfg.resource_namespace}/{suffix}"
         plugin = DevicePluginServer(
             resource_name=resource,
@@ -875,7 +885,12 @@ class PluginManager:
         """Snapshot of live manager state for observability (dumped on
         SIGUSR1 by the daemon — the pprof-handler equivalent the reference
         never registers, SURVEY §5 tracing row)."""
-        tpu_inv = self._tpu_inv
+        # Runs on the SIGUSR1 debug-dump thread while the rescan thread
+        # may be swapping inventories — snapshot the references under
+        # the lock, format outside it.
+        with self._lock:
+            tpu_inv = self._tpu_inv
+            vfio_inv = self._vfio_inv
         report: dict = {
             "plugins": [
                 {
@@ -910,10 +925,10 @@ class PluginManager:
                 "worker_id": topo.worker_id,
                 "worker_hostnames": list(topo.worker_hostnames),
             }
-        if self._vfio_inv is not None:
+        if vfio_inv is not None:
             report["vfio_models"] = {
                 f"{v}:{d}": groups
-                for (v, d), groups in sorted(self._vfio_inv.models.items())
+                for (v, d), groups in sorted(vfio_inv.models.items())
             }
         return report
 
@@ -936,16 +951,23 @@ class PluginManager:
             changed = True
         if vfio_inv.models != old_vfio.models:
             changed = True
+            # Runs on the rescan thread while gRPC handlers call
+            # plugins() — snapshot the fleet under the lock (spawns
+            # insert under the same lock; a key spawned below is in
+            # vfio_inv.models, so the retire loop's snapshot staleness
+            # is harmless).
+            with self._lock:
+                vfio_plugins = dict(self._vfio_plugins)
             for key, groups in vfio_inv.models.items():
-                if key in self._vfio_plugins:
-                    self._vfio_plugins[key].state.replace(
+                if key in vfio_plugins:
+                    vfio_plugins[key].state.replace(
                         vfio_watched_devices(vfio_inv, groups, self.cfg.dev_root)
                     )
                 elif not self._stop.is_set():
                     self._spawn_vfio_plugin(key, groups, register=True)
-            for key in list(self._vfio_plugins):
+            for key in list(vfio_plugins):
                 if key not in vfio_inv.models:
-                    self._vfio_plugins[key].state.replace([])
+                    vfio_plugins[key].state.replace([])
         if changed:
             self.write_specs()
         metrics.rescans_total.labels(changed=str(changed).lower()).inc()

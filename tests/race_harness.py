@@ -1,0 +1,362 @@
+"""Barrier-driven runtime race harness — the dynamic twin of the JG2xx
+static lock-discipline pass (``tools/analyze/concurrency.py``).
+
+The static pass proves the lock DISCIPLINE; this harness hammers the
+actual shared structures the daemon runs hot — the
+:class:`AllocationJournal` (concurrent Allocate handlers), the
+:class:`HeartbeatAggregator` (tail loop vs. the SIGUSR1 snapshot
+thread), the flight ring (every emitting thread vs. a mid-flight dump),
+and the :class:`MetricsRegistry` (idempotent factory under concurrent
+first-use) — and asserts the two properties a race would break first:
+
+- **parse-back integrity**: every on-disk artifact (journal JSON, flight
+  dump JSONL) re-reads as complete, well-formed records — no torn lines,
+  no interleaved writes;
+- **counter conservation**: N threads × M ops in, exactly N×M effects
+  out — no lost journal entries, no dropped heartbeats, no double- or
+  under-counted metric increments.
+
+All scheduling is deterministic-seeded: every worker gets its own
+``random.Random(seed, tid)`` and jitters between ops, so a failing
+iteration is re-runnable by seed. Not collected by pytest (the filename
+carries no ``test_`` prefix on purpose — 200 iterations belong in the
+``make race`` CI job, see ``tests/test_jaxguard_concurrency.py`` for
+the single-iteration smoke wrappers). Run directly::
+
+    RACE_ITERS=200 python tests/race_harness.py
+
+Environment:
+
+- ``RACE_ITERS``     — iterations (default 200; each varies the seed)
+- ``RACE_SEED``      — base seed (default 0); a failure prints its seed,
+  so ``RACE_SEED=<seed> RACE_ITERS=1`` replays that schedule alone
+- ``RACE_THREADS``   — workers per scenario (default 4)
+- ``RACE_OPS``       — ops per worker (default 16)
+- ``RACE_ARTIFACTS`` — dir for the event-stream artifacts of the LAST
+  iteration (default ``artifacts``; empty string disables)
+
+jax-free: the daemon-side structures under stress import no jax, so the
+harness runs in the no-jax CI lane.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from prometheus_client import CollectorRegistry  # noqa: E402
+
+from kata_xpu_device_plugin_tpu.obs.flight import FlightRecorder  # noqa: E402
+from kata_xpu_device_plugin_tpu.obs.metrics import MetricsRegistry  # noqa: E402
+from kata_xpu_device_plugin_tpu.plugin.manager import (  # noqa: E402
+    AllocationJournal,
+    HeartbeatAggregator,
+)
+
+DEFAULT_THREADS = 4
+DEFAULT_OPS = 16
+_JITTER_S = 0.0003
+
+
+def run_threads(n: int, worker, seed: int) -> None:
+    """Start ``n`` workers behind one barrier, join them, re-raise the
+    first failure. ``worker(tid, rng)`` gets a per-thread seeded RNG —
+    interleavings vary by seed, never by wall clock."""
+    barrier = threading.Barrier(n)
+    errors: list = []
+
+    def body(tid: int) -> None:
+        rng = random.Random(seed * 1009 + tid)
+        try:
+            barrier.wait(timeout=30)
+            worker(tid, rng)
+        except BaseException as exc:  # noqa: BLE001 — reported below
+            errors.append((tid, exc))
+
+    threads = [
+        threading.Thread(target=body, args=(tid,), name=f"race-{tid}")
+        for tid in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    alive = [t.name for t in threads if t.is_alive()]
+    if alive:
+        raise AssertionError(f"workers wedged (deadlock?): {alive}")
+    if errors:
+        tid, exc = errors[0]
+        raise AssertionError(
+            f"{len(errors)} worker(s) failed; first: thread {tid}: "
+            f"{type(exc).__name__}: {exc}"
+        ) from exc
+
+
+# ----- scenarios -----------------------------------------------------------
+
+
+def stress_journal(workdir: str, threads: int = DEFAULT_THREADS,
+                   ops: int = DEFAULT_OPS, seed: int = 0) -> dict:
+    """Concurrent ``record()`` (the Allocate-handler path): every entry
+    must survive, and the journal file must parse back whole."""
+    path = os.path.join(workdir, "journal.json")
+    journal = AllocationJournal(path)
+
+    def worker(tid: int, rng: random.Random) -> None:
+        for i in range(ops):
+            journal.record("google.com/tpu", [f"tpu-{tid}-{i}"])
+            time.sleep(rng.random() * _JITTER_S)
+
+    run_threads(threads, worker, seed)
+    expect = threads * ops
+    with open(path, encoding="utf-8") as fh:
+        on_disk = json.load(fh)  # raises on a torn/interleaved write
+    devices = on_disk["devices"]
+    assert len(devices) == expect, (
+        f"journal lost entries: {len(devices)}/{expect} on disk"
+    )
+    reread = AllocationJournal(path)
+    groups = reread.allocations("google.com/tpu")
+    assert len(groups) == expect, (
+        f"parse-back lost groups: {len(groups)}/{expect}"
+    )
+    return {"scenario": "journal", "entries": len(devices),
+            "expected": expect}
+
+
+def stress_aggregator(workdir: str, threads: int = DEFAULT_THREADS,
+                      ops: int = DEFAULT_OPS, seed: int = 0) -> dict:
+    """Writers append guest heartbeats (one stream file per allocation,
+    append-mode like the real sink) while the tail loop polls and a
+    debug thread snapshots CONCURRENTLY: every written heartbeat is
+    consumed exactly once, and snapshot() never observes a torn poll."""
+    events_dir = os.path.join(workdir, "guest-events")
+    os.makedirs(events_dir, exist_ok=True)
+    agg = HeartbeatAggregator(events_dir, poll_interval_s=0.001)
+    consumed = [0]
+    writers_left = [threads]
+    count_lock = threading.Lock()
+    writers_done = threading.Event()
+
+    def writer(tid: int, rng: random.Random) -> None:
+        path = os.path.join(events_dir, f"guest_{tid}.jsonl")
+        with open(path, "a", encoding="utf-8") as fh:
+            for i in range(ops):
+                fh.write(json.dumps({
+                    "ts": time.time(), "kind": "serving",
+                    "name": "serving_heartbeat", "server": f"s{tid}",
+                    "round": i, "tokens_per_s": 100.0 + i,
+                    "queued": 0, "interval_rounds": 1,
+                }) + "\n")
+                fh.flush()
+                time.sleep(rng.random() * _JITTER_S)
+        with count_lock:
+            writers_left[0] -= 1
+            if writers_left[0] == 0:
+                writers_done.set()
+
+    def worker(tid: int, rng: random.Random) -> None:
+        if tid < threads:
+            writer(tid, rng)
+        elif tid == threads:
+            # Poller races the writers live; sole poll_once caller, so
+            # the aggregator's offset map sees one consuming thread.
+            while not writers_done.is_set():
+                got = agg.poll_once()
+                with count_lock:
+                    consumed[0] += got
+                time.sleep(rng.random() * _JITTER_S)
+        else:
+            # Snapshotter: the SIGUSR1 debug-report path, mid-poll.
+            while not writers_done.is_set():
+                snap = agg.snapshot()  # must never raise mid-poll
+                assert isinstance(snap, dict)
+                time.sleep(rng.random() * _JITTER_S)
+
+    run_threads(threads + 2, worker, seed)
+    # Final single-threaded drain: whatever the racing poller missed
+    # between the last writers' flush and their done-signal.
+    consumed[0] += agg.poll_once()
+    expect = threads * ops
+    assert consumed[0] == expect, (
+        f"heartbeats lost or double-consumed: {consumed[0]}/{expect}"
+    )
+    snap = agg.snapshot()
+    assert len(snap) == threads, (
+        f"snapshot lost servers: {len(snap)}/{threads}"
+    )
+    return {"scenario": "aggregator", "consumed": consumed[0],
+            "expected": expect, "servers": len(snap)}
+
+
+def stress_flight(workdir: str, threads: int = DEFAULT_THREADS,
+                  ops: int = DEFAULT_OPS, seed: int = 0) -> dict:
+    """Concurrent ``record()`` against the bounded ring with dumps taken
+    MID-RACE: every dump file parses line-complete, and the final dump
+    holds exactly min(capacity, N×M) events."""
+    from kata_xpu_device_plugin_tpu.obs import flight
+
+    rec = FlightRecorder(capacity=threads * ops)
+    dump_paths: list = []
+
+    def worker(tid: int, rng: random.Random) -> None:
+        for i in range(ops):
+            rec.record({
+                "ts": time.time(), "kind": "serving", "name": "tok",
+                "tid": tid, "i": i,
+            })
+            if tid == 0 and i == ops // 2:
+                path = rec.dump("race_mid")
+                if path:
+                    dump_paths.append(path)
+            time.sleep(rng.random() * _JITTER_S)
+
+    prev_dir = os.environ.get(flight.ENV_DIR)
+    os.environ[flight.ENV_DIR] = workdir  # keep dumps in this iteration
+    try:
+        run_threads(threads, worker, seed)
+        final = rec.dump("race_final")
+    finally:
+        if prev_dir is None:
+            os.environ.pop(flight.ENV_DIR, None)
+        else:
+            os.environ[flight.ENV_DIR] = prev_dir
+    assert final is not None
+    dump_paths.append(final)
+    expect = threads * ops
+    final_count = 0
+    for path in dump_paths:
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+        events = [json.loads(line) for line in lines]  # torn line raises
+        assert all("name" in ev for ev in events)
+        if path == final:
+            final_count = len(events)
+    assert final_count == expect, (
+        f"flight ring lost events: final dump {final_count}/{expect}"
+    )
+    return {"scenario": "flight", "events": final_count,
+            "expected": expect, "dumps": list(dump_paths)}
+
+
+def stress_metrics(workdir: str, threads: int = DEFAULT_THREADS,
+                   ops: int = DEFAULT_OPS, seed: int = 0) -> dict:
+    """Concurrent factory use + increments on one fresh registry: the
+    idempotent ``counter()`` cache must hand every thread the SAME
+    collector, and no increment may be lost."""
+    reg = MetricsRegistry(CollectorRegistry())
+    collectors: list = []
+
+    def worker(tid: int, rng: random.Random) -> None:
+        for i in range(ops):
+            c = reg.counter("race_ops", "harness ops", ["tid"])
+            collectors.append(c)
+            c.labels(tid=str(tid)).inc()
+            time.sleep(rng.random() * _JITTER_S)
+
+    run_threads(threads, worker, seed)
+    assert len(set(map(id, collectors))) == 1, (
+        "factory returned distinct collectors for one name"
+    )
+    total = 0.0
+    for tid in range(threads):
+        total += collectors[0].labels(tid=str(tid))._value.get()
+    expect = threads * ops
+    assert total == expect, f"increments lost: {total}/{expect}"
+    return {"scenario": "metrics", "total": int(total), "expected": expect}
+
+
+SCENARIOS = (stress_journal, stress_aggregator, stress_flight,
+             stress_metrics)
+
+
+def run_iteration(seed: int, threads: int = DEFAULT_THREADS,
+                  ops: int = DEFAULT_OPS,
+                  keep_dir: str = "") -> list:
+    """One pass over every scenario in a throwaway workdir; returns the
+    per-scenario stats. ``keep_dir`` preserves the workdir's event
+    artifacts (journal, guest streams, flight dumps) there."""
+    results = []
+    workdir = tempfile.mkdtemp(prefix=f"race_{seed}_")
+    try:
+        for scenario in SCENARIOS:
+            sub = os.path.join(workdir, scenario.__name__)
+            os.makedirs(sub, exist_ok=True)
+            results.append(scenario(sub, threads=threads, ops=ops,
+                                     seed=seed))
+        if keep_dir:
+            os.makedirs(keep_dir, exist_ok=True)
+            for name in ("stress_journal/journal.json",):
+                src = os.path.join(workdir, name)
+                if os.path.exists(src):
+                    shutil.copy(src, os.path.join(
+                        keep_dir, "race_journal.json"
+                    ))
+            streams = os.path.join(workdir, "stress_aggregator",
+                                   "guest-events")
+            if os.path.isdir(streams):
+                for fname in sorted(os.listdir(streams)):
+                    shutil.copy(
+                        os.path.join(streams, fname),
+                        os.path.join(keep_dir, f"race_{fname}"),
+                    )
+            for res in results:
+                for dump in res.get("dumps", ()):
+                    if os.path.exists(dump):
+                        shutil.copy(dump, os.path.join(
+                            keep_dir, os.path.basename(dump)
+                        ))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return results
+
+
+def main() -> int:
+    iters = int(os.environ.get("RACE_ITERS", "200"))
+    threads = int(os.environ.get("RACE_THREADS", str(DEFAULT_THREADS)))
+    ops = int(os.environ.get("RACE_OPS", str(DEFAULT_OPS)))
+    seed0 = int(os.environ.get("RACE_SEED", "0"))  # replay a failure
+    artifacts = os.environ.get("RACE_ARTIFACTS", "artifacts")
+    t0 = time.time()
+    for it in range(iters):
+        seed = seed0 + it
+        keep = artifacts if it == iters - 1 else ""
+        try:
+            results = run_iteration(seed=seed, threads=threads, ops=ops,
+                                    keep_dir=keep)
+        except AssertionError as exc:
+            print(f"race harness FAILED at iteration {it} (seed={seed} — "
+                  f"replay with RACE_SEED={seed} RACE_ITERS=1): {exc}",
+                  file=sys.stderr)
+            return 1
+        if (it + 1) % 50 == 0 or it == iters - 1:
+            print(f"race harness: {it + 1}/{iters} iterations clean "
+                  f"({time.time() - t0:.1f}s)")
+    if artifacts:
+        os.makedirs(artifacts, exist_ok=True)
+        summary = {
+            "iterations": iters, "threads": threads, "ops": ops,
+            "strict": os.environ.get("KATA_TPU_STRICT", ""),
+            "elapsed_s": round(time.time() - t0, 2),
+            "last_iteration": results,
+        }
+        with open(os.path.join(artifacts, "race_summary.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(summary, fh, indent=2)
+            fh.write("\n")
+    print(f"race harness: {iters} iterations × {threads} threads × "
+          f"{ops} ops — zero lost/torn events or journal entries")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

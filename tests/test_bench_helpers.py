@@ -208,3 +208,54 @@ def test_bench_trend_numeric_metrics_filter():
         "phases": {"a": 1}, "ok": True, "serving_s": 2.5,
     })
     assert rows == {"value": 1.0, "serving_s": 2.5}
+
+
+def test_bench_trend_analyzer_footer_from_report(tmp_path, capsys):
+    # ISSUE 16 satellite: when a jaxguard_report.json sits next to the
+    # banks (make analyze writes one), the trend footer carries the
+    # findings count + by-rule breakdown — a pragma-heavy PR is visible
+    # in the same place the perf trajectory is.
+    import json as _json
+
+    from tools import bench_trend
+
+    _bank(tmp_path, "20260101T000000Z", value=100.0)
+    _bank(tmp_path, "20260102T000000Z", value=101.0)
+    (tmp_path / "jaxguard_report.json").write_text(_json.dumps({
+        "tool": "jaxguard",
+        "summary": {"total": 3, "by_rule": {"JG201": 2, "JG304": 1}},
+        "findings": [],
+    }))
+    rc = bench_trend.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "jaxguard: 3 finding(s) (JG201=2, JG304=1)" in out
+
+
+def test_bench_trend_analyzer_footer_absent_without_report(tmp_path, capsys):
+    from tools import bench_trend
+
+    _bank(tmp_path, "20260101T000000Z", value=100.0)
+    _bank(tmp_path, "20260102T000000Z", value=101.0)
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 0
+    assert "jaxguard" not in capsys.readouterr().out
+
+
+def test_bench_trend_analyzer_footer_in_json_and_corrupt_report(tmp_path,
+                                                                capsys):
+    import json as _json
+
+    from tools import bench_trend
+
+    _bank(tmp_path, "20260101T000000Z", value=100.0)
+    _bank(tmp_path, "20260102T000000Z", value=101.0)
+    (tmp_path / "jaxguard_report.json").write_text("{ truncated")
+    assert bench_trend.main(["--dir", str(tmp_path), "--json"]) == 0
+    data = _json.loads(capsys.readouterr().out)
+    assert data["analyzer"] is None  # unreadable report degrades to None
+    (tmp_path / "jaxguard_report.json").write_text(_json.dumps({
+        "summary": {"total": 0, "by_rule": {}},
+    }))
+    assert bench_trend.main(["--dir", str(tmp_path), "--json"]) == 0
+    data = _json.loads(capsys.readouterr().out)
+    assert data["analyzer"] == {"total": 0, "by_rule": {}}

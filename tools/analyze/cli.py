@@ -14,9 +14,11 @@ import sys
 from typing import Iterable, Optional
 
 from ..pragmas import allowed_lines, suppress
+from .concurrency import analyze_concurrency
+from .contracts import analyze_contracts
 from .dataflow import analyze_program
 from .graph import load_program
-from .model import ALL_RULES, Finding
+from .model import ALL_RULES, KNOB_DOC_PATH, Finding
 
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}
 _SKIP_SUFFIXES = ("_pb2.py", "_pb2_grpc.py")
@@ -57,6 +59,17 @@ def run(
     call graph can see, so CI runs the default surface.
     """
     root = root or os.getcwd()
+    doc_text: Optional[str] = None
+    if sources is None:
+        doc_path = os.path.join(root, KNOB_DOC_PATH)
+        if os.path.exists(doc_path):
+            with open(doc_path, encoding="utf-8") as fh:
+                doc_text = fh.read()
+    else:
+        # Tests deliver the doc leg through a pseudo-path in the sources
+        # mapping; it is text, not Python — pop it before the program load.
+        sources = dict(sources)
+        doc_text = sources.pop(KNOB_DOC_PATH, None)
     if sources is None:
         chosen = list(targets) if targets else [
             t for t in DEFAULT_TARGETS
@@ -90,6 +103,8 @@ def run(
         for msg in errors
     ]
     findings.extend(analyze_program(program))
+    findings.extend(analyze_concurrency(program))
+    findings.extend(analyze_contracts(program, doc_text))
     out: list[Finding] = []
     by_path: dict[str, list] = {}
     for f in findings:
@@ -140,7 +155,9 @@ def main(argv: Optional[list] = None) -> int:
         prog="python -m tools.analyze",
         description=(
             "jaxguard: interprocedural dataflow analysis for JAX "
-            "tracer/transfer/donation hazards (JG101-JG104)."
+            "tracer/transfer/donation hazards (JG101-JG104), daemon "
+            "lock discipline (JG201-JG203), and the ENV_* knob "
+            "contract (JG301-JG304)."
         ),
     )
     parser.add_argument(

@@ -1,0 +1,456 @@
+"""jaxguard pass: lock discipline for the daemon's thread surface (JG2xx).
+
+The daemon half of this repo is concurrent by construction — gRPC
+Allocate handlers share the :class:`AllocationJournal`, the health
+poller flips device state under ``ListAndWatch`` streams, the
+heartbeat aggregator tails guest event files on its own thread, and the
+flight ring inside ``obs.events.emit`` runs on EVERY emitting thread.
+This pass checks the lock discipline those components rely on:
+
+JG201 — a lock-guarded instance attribute is read or written without
+    the lock on a path reachable from a thread entry point. Two
+    triggers: (i) the attribute is written under ``with self._lock:``
+    somewhere (so the lock IS its guard) but accessed bare elsewhere;
+    (ii) the attribute is written bare in thread-reachable code of a
+    class that owns a lock at all — state of a lock-owning class is
+    either guarded or explicitly ``# jaxguard: allow(JG201)``-sanctioned
+    as thread-confined.
+JG202 — a lock is acquired while another lock is already held, in an
+    order that is inverted elsewhere in the program (classic AB/BA
+    deadlock), or re-acquired while already held (self-deadlock for a
+    non-reentrant ``threading.Lock``).
+JG203 — a blocking call (``time.sleep``, file IO, gRPC) happens while a
+    lock is held on a thread-reachable path: every other thread that
+    touches that lock stalls behind the IO. Sanctioned cases (the
+    journal's crash-consistent tmp+rename, the flight ring's postmortem
+    snapshot) carry reason pragmas.
+
+Thread entry points (the model is documented in docs/compat_and_lint.md):
+
+- any function passed as ``target=`` to ``threading.Thread(...)``;
+- ``run`` of a ``threading.Thread`` subclass;
+- the kubelet device-plugin gRPC methods on a ``*Servicer`` subclass
+  (:data:`model.GRPC_ENTRY_METHODS`);
+- the curated :data:`model.THREAD_ENTRY_REGISTRY` — hooks invoked on
+  other components' threads that no AST spelling reveals.
+
+Reachability follows the same name-based call resolution as the JG1xx
+dataflow pass, extended with the attribute-type map ``graph.py`` builds
+from ``self.x = Ctor(...)`` assignments (so ``self._aggregator
+.poll_once()`` resolves). Lock context is lexical (``with self._lock:``
+regions) plus one interprocedural refinement: a private method whose
+every call site holds a lock analyzes as lock-held (the
+``_save_locked`` convention).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .graph import (
+    FunctionInfo,
+    Module,
+    Program,
+    dotted,
+    held_lock_map,
+    self_attr,
+)
+from .model import (
+    BLOCKING_CALLS,
+    BLOCKING_PREFIXES,
+    Finding,
+    GRPC_ENTRY_METHODS,
+    THREAD_ENTRY_REGISTRY,
+)
+
+# Method names that mutate their receiver in place: a bare
+# ``self.attr.append(x)`` is a WRITE of ``self.attr`` for guard purposes.
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "sort",
+})
+
+_THREAD_CTORS = frozenset({"threading.Thread", "Thread"})
+_INIT_METHODS = frozenset({"__init__", "__new__", "__post_init__"})
+
+
+def _lock_id(modname: str, cls: str, attr: str) -> str:
+    return f"{modname}:{cls}.{attr}"
+
+
+@dataclass
+class _Access:
+    fn: FunctionInfo
+    node: ast.AST
+    attr: str
+    write: bool
+    held: frozenset
+
+
+@dataclass
+class _FnFacts:
+    fn: FunctionInfo
+    held: dict                      # id(node) → tuple of candidate lock attrs
+    calls: list = field(default_factory=list)   # (node, dotted callee)
+    inherited: frozenset = frozenset()          # locks held at every call site
+
+
+class _Pass:
+    def __init__(self, program: Program) -> None:
+        self.program = program
+        self.facts: dict[str, _FnFacts] = {}
+        self.entry: set[str] = set()
+        self.reachable: set[str] = set()
+        self._seen: set[tuple] = set()
+        self.findings: list[Finding] = []
+
+    # ----- fact collection --------------------------------------------------
+
+    def build(self) -> None:
+        for fn in self.program.functions.values():
+            held = held_lock_map(fn.node)
+            facts = _FnFacts(fn, held)
+            for node in ast.walk(fn.node):
+                if id(node) not in held:
+                    continue  # body of a nested def — its own FunctionInfo
+                if isinstance(node, ast.Call):
+                    name = dotted(node.func)
+                    if name:
+                        facts.calls.append((node, name))
+            self.facts[fn.qualname] = facts
+        self._find_entries()
+        self._propagate_inherited()
+        self._compute_reachable()
+
+    def _resolve(self, fn: FunctionInfo, callee: str) -> Optional[FunctionInfo]:
+        mod = self.program.modules[fn.modname]
+        info = self.program.resolve_call(mod, fn.cls, callee)
+        if info is not None:
+            return info
+        if callee.startswith("self.") and fn.cls is not None:
+            parts = callee[len("self."):].split(".")
+            if len(parts) == 2:
+                owner = self.program.attr_class(mod, fn.cls, parts[0])
+                if owner is not None:
+                    owner_mod = self.program.modules.get(owner.modname)
+                    if owner_mod is not None:
+                        return owner_mod.functions.get(
+                            f"{owner.name}.{parts[1]}"
+                        )
+        return None
+
+    def _find_entries(self) -> None:
+        for qual, facts in self.facts.items():
+            fn = facts.fn
+            mod = self.program.modules[fn.modname]
+            # (a) threading.Thread(target=...) spellings anywhere.
+            for node, name in facts.calls:
+                if name not in _THREAD_CTORS:
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    ref = dotted(kw.value)
+                    if ref is None:
+                        continue
+                    target = self._resolve(fn, ref)
+                    if target is not None:
+                        self.entry.add(target.qualname)
+            if fn.cls is None:
+                continue
+            cls_info = mod.classes.get(fn.cls)
+            bases = cls_info.bases if cls_info else ()
+            # (b) run() of a Thread subclass.
+            if fn.name == "run" and any(
+                b in _THREAD_CTORS for b in bases
+            ):
+                self.entry.add(qual)
+            # (c) gRPC servicer methods.
+            if fn.name in GRPC_ENTRY_METHODS and any(
+                b.split(".")[-1].endswith("Servicer") for b in bases
+            ):
+                self.entry.add(qual)
+            # (d) the curated registry.
+            if f"{fn.cls}.{fn.name}" in THREAD_ENTRY_REGISTRY:
+                self.entry.add(qual)
+
+    def _propagate_inherited(self) -> None:
+        """Private methods whose EVERY resolved intra-class call site
+        holds a lock analyze with that lock held (``_save_locked``); a
+        fixpoint so locked wrappers chain. Public methods never inherit
+        — anyone may call them bare."""
+        # callers[callee] = list of (caller facts, locks at call node)
+        callers: dict[str, list] = {}
+        for facts in self.facts.values():
+            fn = facts.fn
+            if fn.cls is None:
+                continue
+            cls_locks = self._class_locks(fn)
+            for node, name in facts.calls:
+                if not name.startswith("self."):
+                    continue
+                target = self._resolve(fn, name)
+                if target is None or target.cls != fn.cls:
+                    continue
+                site = frozenset(
+                    a for a in facts.held.get(id(node), ())
+                    if a in cls_locks
+                )
+                callers.setdefault(target.qualname, []).append((facts, site))
+        for _ in range(4):  # fixpoint: intersections only shrink
+            changed = False
+            for qual, sites in callers.items():
+                facts = self.facts.get(qual)
+                if facts is None or not facts.fn.name.startswith("_"):
+                    continue
+                if facts.fn.qualname in self.entry:
+                    continue  # entered bare by another thread
+                inherited = None
+                for caller, site in sites:
+                    eff = site | caller.inherited
+                    inherited = eff if inherited is None else (
+                        inherited & eff
+                    )
+                inherited = frozenset(inherited or ())
+                if inherited != facts.inherited:
+                    facts.inherited = inherited
+                    changed = True
+            if not changed:
+                break
+
+    def _compute_reachable(self) -> None:
+        todo = list(self.entry)
+        self.reachable = set(todo)
+        while todo:
+            qual = todo.pop()
+            facts = self.facts.get(qual)
+            if facts is None:
+                continue
+            for _node, name in facts.calls:
+                target = self._resolve(facts.fn, name)
+                if target is not None and target.qualname not in self.reachable:
+                    self.reachable.add(target.qualname)
+                    todo.append(target.qualname)
+
+    # ----- shared helpers ---------------------------------------------------
+
+    def _class_locks(self, fn: FunctionInfo) -> frozenset:
+        if fn.cls is None:
+            return frozenset()
+        cls = self.program.modules[fn.modname].classes.get(fn.cls)
+        return cls.lock_attrs if cls else frozenset()
+
+    def _held_at(self, facts: _FnFacts, node: ast.AST) -> frozenset:
+        cls_locks = self._class_locks(facts.fn)
+        local = frozenset(
+            a for a in facts.held.get(id(node), ()) if a in cls_locks
+        )
+        return local | facts.inherited
+
+    def _emit(self, fn: FunctionInfo, node: ast.AST, rule: str,
+              message: str, key: tuple = ()) -> None:
+        dedupe = (fn.path, getattr(node, "lineno", 0), rule) + key
+        if dedupe in self._seen:
+            return
+        self._seen.add(dedupe)
+        self.findings.append(Finding(
+            path=fn.path,
+            line=getattr(node, "lineno", 0),
+            rule=rule,
+            message=message,
+            function=fn.qualname,
+        ))
+
+    # ----- JG201 ------------------------------------------------------------
+
+    def _accesses(self, facts: _FnFacts) -> list:
+        """Every ``self.X`` load/store in the function's own body, with
+        the effective lock set. Stores cover plain/aug/ann assignment,
+        subscript stores (``self.x[k] = v``), ``del self.x[k]``, and
+        in-place mutator calls (``self.x.append(v)``)."""
+        out: list[_Access] = []
+        fn = facts.fn
+
+        def add(node: ast.AST, attr: str, write: bool) -> None:
+            out.append(_Access(
+                fn=fn, node=node, attr=attr, write=write,
+                held=self._held_at(facts, node),
+            ))
+
+        for node in ast.walk(fn.node):
+            if id(node) not in facts.held:
+                continue
+            if isinstance(node, ast.Attribute):
+                attr = self_attr(node)
+                if attr is None:
+                    continue
+                add(node, attr, isinstance(
+                    node.ctx, (ast.Store, ast.Del)
+                ))
+            elif isinstance(node, ast.Subscript):
+                attr = self_attr(node.value)
+                if attr is not None and isinstance(
+                    node.ctx, (ast.Store, ast.Del)
+                ):
+                    add(node, attr, True)
+            elif isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATORS
+                ):
+                    attr = self_attr(node.func.value)
+                    if attr is not None:
+                        add(node, attr, True)
+        return out
+
+    def jg201(self) -> None:
+        # First pass: learn each class's guarded attributes — attr →
+        # lock(s) it was ever written under, outside construction.
+        guards: dict[tuple, dict] = {}   # (modname, cls) → {attr: set(locks)}
+        per_fn: dict[str, list] = {}
+        for qual, facts in self.facts.items():
+            fn = facts.fn
+            if fn.cls is None or not self._class_locks(fn):
+                continue
+            accesses = self._accesses(facts)
+            per_fn[qual] = accesses
+            if fn.name in _INIT_METHODS:
+                continue
+            cls_guards = guards.setdefault((fn.modname, fn.cls), {})
+            for acc in accesses:
+                if acc.write and acc.held:
+                    cls_guards.setdefault(acc.attr, set()).update(acc.held)
+        # Second pass: flag bare accesses on thread-reachable paths.
+        for qual, accesses in per_fn.items():
+            fn = self.facts[qual].fn
+            if qual not in self.reachable or fn.name in _INIT_METHODS:
+                continue
+            cls_locks = self._class_locks(fn)
+            cls_guards = guards.get((fn.modname, fn.cls), {})
+            for acc in accesses:
+                if acc.held or acc.attr in cls_locks:
+                    continue
+                guard = cls_guards.get(acc.attr)
+                if guard:
+                    verb = "written" if acc.write else "read"
+                    lock = "/".join(sorted(guard))
+                    self._emit(
+                        fn, acc.node, "JG201",
+                        f"self.{acc.attr} {verb} without self.{lock} "
+                        f"(its guard elsewhere) on a thread-reachable "
+                        f"path",
+                        key=(acc.attr,),
+                    )
+                elif acc.write:
+                    lock = "/".join(sorted(cls_locks))
+                    self._emit(
+                        fn, acc.node, "JG201",
+                        f"self.{acc.attr} written without any lock on a "
+                        f"thread-reachable path (class {fn.cls} guards "
+                        f"its state with self.{lock})",
+                        key=(acc.attr,),
+                    )
+
+    # ----- JG202 ------------------------------------------------------------
+
+    def jg202(self) -> None:
+        edges: dict[tuple, list] = {}   # (outer id, inner id) → sites
+        for facts in self.facts.values():
+            fn = facts.fn
+            cls_locks = self._class_locks(fn)
+            if not cls_locks:
+                continue
+            for node in ast.walk(fn.node):
+                if id(node) not in facts.held or not isinstance(
+                    node, ast.With
+                ):
+                    continue
+                stack = tuple(
+                    a for a in facts.held[id(node)] if a in cls_locks
+                ) + tuple(sorted(facts.inherited - set(
+                    facts.held[id(node)]
+                )))
+                for item in node.items:
+                    attr = self_attr(item.context_expr)
+                    if attr is None or attr not in cls_locks:
+                        continue
+                    if attr in stack:
+                        self._emit(
+                            fn, node, "JG202",
+                            f"self.{attr} re-acquired while already "
+                            f"held — deadlock for a non-reentrant "
+                            f"threading.Lock",
+                            key=(attr,),
+                        )
+                        continue
+                    inner = _lock_id(fn.modname, fn.cls, attr)
+                    for outer_attr in stack:
+                        outer = _lock_id(fn.modname, fn.cls, outer_attr)
+                        edges.setdefault((outer, inner), []).append(
+                            (fn, node, outer_attr, attr)
+                        )
+        adj: dict[str, set] = {}
+        for (outer, inner) in edges:
+            adj.setdefault(outer, set()).add(inner)
+
+        def reaches(src: str, dst: str) -> bool:
+            todo, seen = [src], set()
+            while todo:
+                cur = todo.pop()
+                if cur == dst:
+                    return True
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                todo.extend(adj.get(cur, ()))
+            return False
+
+        for (outer, inner), sites in edges.items():
+            if not reaches(inner, outer):
+                continue
+            for fn, node, outer_attr, attr in sites:
+                self._emit(
+                    fn, node, "JG202",
+                    f"self.{attr} acquired while holding "
+                    f"self.{outer_attr}, but the opposite order exists "
+                    f"elsewhere — inconsistent global lock order",
+                    key=(outer_attr, attr),
+                )
+
+    # ----- JG203 ------------------------------------------------------------
+
+    def jg203(self) -> None:
+        for qual, facts in self.facts.items():
+            if qual not in self.reachable:
+                continue
+            fn = facts.fn
+            if fn.name in _INIT_METHODS:
+                continue
+            for node, name in facts.calls:
+                if not (name in BLOCKING_CALLS or name.startswith(
+                    BLOCKING_PREFIXES
+                )):
+                    continue
+                held = self._held_at(facts, node)
+                if not held:
+                    continue
+                lock = "/".join(sorted(held))
+                self._emit(
+                    fn, node, "JG203",
+                    f"blocking call {name}() while holding self.{lock} "
+                    f"on a thread-reachable path",
+                    key=(name,),
+                )
+
+
+def analyze_concurrency(program: Program) -> list:
+    """Run the JG2xx lock-discipline pass over an analyzed Program."""
+    p = _Pass(program)
+    p.build()
+    p.jg201()
+    p.jg202()
+    p.jg203()
+    p.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return p.findings

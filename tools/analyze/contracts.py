@@ -1,0 +1,306 @@
+"""jaxguard pass: the standard-knob contract (JG3xx).
+
+Every operator knob in this repo follows one path: an ``ENV_*`` constant
+in ``cdi/constants.py`` → a validated ``Config`` field → an allocator
+injection site (the daemon stamps the env into the container) → a guest
+parse site that DEGRADES on malformed input (emits a ``*_invalid`` /
+``*_disabled`` event and falls back, never raises on a node-wide env) →
+a documented row in ``docs/observability.md``. That contract has been
+re-implemented by hand in every PR since the knob path appeared; this
+pass makes it checkable:
+
+JG301 — no matching ``Config`` field (the daemon cannot set the knob).
+JG302 — no injection-surface reference (the env is never delivered).
+JG303 — a parse site converts the env with ``int()``/``float()``
+    outside a try/degrade guard (malformed env would crash the guest).
+JG304 — no row in ``docs/observability.md`` (operators cannot find it).
+
+Field matching is by convention — strip ``KATA_TPU_`` from the env
+VALUE and lowercase — with the explicit exceptions in
+:data:`model.KNOB_FIELD_OVERRIDES`. Identity/topology envs the daemon
+injects but which are not operator knobs are listed in
+:data:`model.KNOB_EXEMPT` and skipped entirely. Findings anchor at the
+constant's definition line (JG301/302/304) or the unsafe conversion
+(JG303).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .graph import Module, Program, dotted
+from .model import (
+    Finding,
+    KNOB_CONFIG_PATH,
+    KNOB_CONSTANTS_PATH,
+    KNOB_DOC_PATH,
+    KNOB_EXEMPT,
+    KNOB_FIELD_OVERRIDES,
+    KNOB_INJECTION_PREFIXES,
+)
+
+_ENV_GET = frozenset({
+    "os.environ.get", "environ.get", "os.getenv", "getenv",
+})
+_CONVERTERS = frozenset({"int", "float"})
+_FIELD_PREFIX = "KATA_TPU_"
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+def _module_constants(mod: Module) -> dict:
+    """Module-level ``NAME = "literal"`` string constants."""
+    out: dict = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ) and isinstance(node.value.value, str):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = (node.value.value, node.lineno)
+    return out
+
+
+def _in_try_map(root: ast.AST) -> set:
+    """ids of nodes lexically inside a ``try:`` body — the degrade
+    guard JG303 looks for."""
+    inside: set = set()
+
+    def visit(node: ast.AST, guarded: bool) -> None:
+        if guarded:
+            inside.add(id(node))
+        if isinstance(node, ast.Try):
+            for child in node.body:
+                visit(child, True)
+            for part in (node.handlers, node.orelse, node.finalbody):
+                for child in part:
+                    visit(child, guarded)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, guarded)
+
+    visit(root, False)
+    return inside
+
+
+def _env_arg_value(
+    node: ast.AST, local_consts: dict, env_values: dict
+) -> Optional[str]:
+    """The env-var NAME a ``environ.get(...)`` first argument denotes:
+    a string literal, a module-local constant, or an ``ENV_*`` spelling
+    (``C.ENV_X`` / imported name) matched by its leaf against the
+    constants catalogue."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    name = dotted(node)
+    if name is None:
+        return None
+    leaf = name.split(".")[-1]
+    if leaf in local_consts:
+        return local_consts[leaf][0]
+    if leaf in env_values:
+        return env_values[leaf]
+    return None
+
+
+class _ParseSite:
+    def __init__(self, mod: Module, fn_node: ast.AST, call: ast.AST,
+                 env_value: str) -> None:
+        self.mod = mod
+        self.fn_node = fn_node
+        self.call = call
+        self.env_value = env_value
+
+
+def _function_nodes(mod: Module):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _unsafe_conversions(fn_node: ast.AST, get_calls: list) -> list:
+    """``int()``/``float()`` calls applied to an env-get result (the
+    call itself, or a name bound from one) OUTSIDE any try body — the
+    raising conversions JG303 exists to catch. Returns the offending
+    conversion nodes."""
+    in_try = _in_try_map(fn_node)
+    get_ids = {id(c) for c in get_calls}
+    bound: set = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and id(node.value) in get_ids:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    bound.add(tgt.id)
+    out = []
+    for node in ast.walk(fn_node):
+        if not (isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Name
+        ) and node.func.id in _CONVERTERS):
+            continue
+        feeds = False
+        for sub in ast.walk(node):
+            if id(sub) in get_ids or (
+                isinstance(sub, ast.Name) and sub.id in bound
+            ):
+                feeds = True
+                break
+        if feeds and id(node) not in in_try:
+            out.append(node)
+    return out
+
+
+def analyze_contracts(
+    program: Program, doc_text: Optional[str] = None
+) -> list:
+    """Run the JG3xx knob-contract pass. ``doc_text`` is the content of
+    ``docs/observability.md`` (None → the JG304 leg is skipped, for
+    source subsets that do not carry docs)."""
+    findings: list = []
+    const_mod = None
+    config_mod = None
+    for mod in program.modules.values():
+        if _norm(mod.path) == KNOB_CONSTANTS_PATH:
+            const_mod = mod
+        elif _norm(mod.path) == KNOB_CONFIG_PATH:
+            config_mod = mod
+    if const_mod is None:
+        return findings
+    env_consts = {
+        name: (value, lineno)
+        for name, (value, lineno) in _module_constants(const_mod).items()
+        if name.startswith("ENV_")
+    }
+    env_values = {n: v for n, (v, _ln) in env_consts.items()}
+
+    # Leg (a): Config fields (AnnAssign names of the dataclass body).
+    config_fields: set = set()
+    if config_mod is not None:
+        for node in ast.walk(config_mod.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "Config":
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name
+                    ):
+                        config_fields.add(stmt.target.id)
+
+    # Leg (b): references on the injection surface.
+    injected: set = set()
+    for mod in program.modules.values():
+        path = _norm(mod.path)
+        if path == KNOB_CONSTANTS_PATH or not path.startswith(
+            KNOB_INJECTION_PREFIXES
+        ):
+            continue
+        for node in ast.walk(mod.tree):
+            leaf = None
+            if isinstance(node, ast.Attribute):
+                leaf = node.attr
+            elif isinstance(node, ast.Name):
+                leaf = node.id
+            if leaf in env_consts:
+                injected.add(leaf)
+
+    # Leg (c): parse sites and their conversion discipline, program-wide.
+    # Helpers that take the env NAME as a parameter (the watchdog's
+    # ``_f``/``_i`` pattern) count as parse sites at their call sites,
+    # with the helper body's discipline.
+    unsafe_values: dict = {}   # env value → first unsafe (mod, node)
+    helper_safety: dict = {}   # (modname, fn name) → is_unsafe
+    helper_param_pos: dict = {}
+    for mod in program.modules.values():
+        local_consts = _module_constants(mod)
+        for fn_node in _function_nodes(mod):
+            params = [a.arg for a in fn_node.args.args]
+            direct_gets: list = []
+            param_gets: list = []
+            for node in ast.walk(fn_node):
+                if not (isinstance(node, ast.Call) and dotted(
+                    node.func
+                ) in _ENV_GET and node.args):
+                    continue
+                arg = node.args[0]
+                value = _env_arg_value(arg, local_consts, env_values)
+                if value is not None:
+                    direct_gets.append((node, value))
+                elif isinstance(arg, ast.Name) and arg.id in params:
+                    param_gets.append((node, arg.id))
+            for conv in _unsafe_conversions(
+                fn_node, [c for c, _v in direct_gets]
+            ):
+                # Attribute the conversion to every env this function
+                # parses — the common case is exactly one.
+                for _call, value in direct_gets:
+                    unsafe_values.setdefault(value, (mod, conv))
+            if param_gets:
+                unsafe = bool(_unsafe_conversions(
+                    fn_node, [c for c, _p in param_gets]
+                ))
+                key = (mod.modname, fn_node.name)
+                helper_safety[key] = unsafe
+                helper_param_pos[key] = params.index(param_gets[0][1])
+    # Helper call sites: helper(ENV_X, ...) with a resolvable env name.
+    for mod in program.modules.values():
+        local_consts = _module_constants(mod)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            callee = dotted(node.func)
+            if callee is None:
+                continue
+            leaf = callee.split(".")[-1]
+            for (modname, fname), unsafe in helper_safety.items():
+                if fname != leaf or not unsafe:
+                    continue
+                pos = helper_param_pos[(modname, fname)]
+                if pos < len(node.args):
+                    value = _env_arg_value(
+                        node.args[pos], local_consts, env_values
+                    )
+                    if value is not None:
+                        unsafe_values.setdefault(value, (mod, node))
+
+    for name, (value, lineno) in sorted(
+        env_consts.items(), key=lambda kv: kv[1][1]
+    ):
+        if name in KNOB_EXEMPT:
+            continue
+        field = KNOB_FIELD_OVERRIDES.get(name)
+        if field is None:
+            stripped = value[len(_FIELD_PREFIX):] if value.startswith(
+                _FIELD_PREFIX
+            ) else value
+            field = stripped.lower()
+        if config_mod is not None and field not in config_fields:
+            findings.append(Finding(
+                path=const_mod.path, line=lineno, rule="JG301",
+                message=f"{name}={value} has no Config field "
+                        f"{field!r} backing it",
+                function=name,
+            ))
+        if name not in injected:
+            findings.append(Finding(
+                path=const_mod.path, line=lineno, rule="JG302",
+                message=f"{name}={value} is never referenced on the "
+                        f"allocator/plugin injection surface",
+                function=name,
+            ))
+        if value in unsafe_values:
+            mod, node = unsafe_values[value]
+            findings.append(Finding(
+                path=mod.path, line=getattr(node, "lineno", 0),
+                rule="JG303",
+                message=f"{value} parsed with int()/float() outside a "
+                        f"degrade guard — malformed env raises instead "
+                        f"of emitting *_invalid/*_disabled",
+                function=name,
+            ))
+        if doc_text is not None and value not in doc_text:
+            findings.append(Finding(
+                path=const_mod.path, line=lineno, rule="JG304",
+                message=f"{name}={value} has no row in {KNOB_DOC_PATH}",
+                function=name,
+            ))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -31,6 +31,9 @@ from .model import HOT_MARK
 
 _JIT_NAMES = frozenset({"jit", "jax.jit"})
 _PARTIAL_NAMES = frozenset({"partial", "functools.partial"})
+_LOCK_CTORS = frozenset({
+    "threading.Lock", "threading.RLock", "Lock", "RLock",
+})
 
 
 def dotted(node: ast.AST) -> Optional[str]:
@@ -94,6 +97,23 @@ class FunctionInfo:
 
 
 @dataclass
+class ClassInfo:
+    """One class definition, with the concurrency-relevant facts the
+    JG2xx pass needs: which attributes are ``threading.Lock``/``RLock``
+    instances, and which attributes are constructed from classes the
+    analyzer can see (``self._aggregator = HeartbeatAggregator(...)`` —
+    the attr-type map that lets ``self._aggregator.poll_once()``
+    resolve)."""
+
+    name: str
+    modname: str
+    node: ast.AST
+    bases: tuple = ()          # dotted base-class spellings
+    lock_attrs: frozenset = frozenset()
+    attr_ctors: dict = field(default_factory=dict)  # attr → dotted ctor name
+
+
+@dataclass
 class Module:
     modname: str
     path: str
@@ -101,6 +121,65 @@ class Module:
     tree: ast.AST
     imports: dict = field(default_factory=dict)   # alias → dotted target
     functions: dict = field(default_factory=dict)  # local name → FunctionInfo
+    classes: dict = field(default_factory=dict)    # class name → ClassInfo
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` → ``"X"`` (None for anything else, including deeper
+    chains — ``self.a.b`` is not a direct attribute of the instance)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _ctor_calls(value: ast.AST):
+    """Calls on the right-hand side of an attribute assignment, unwrapped
+    through conditional expressions (``X(...) if flag else None`` is how
+    the manager builds its optional aggregator/journal)."""
+    if isinstance(value, ast.Call):
+        yield value
+    elif isinstance(value, ast.IfExp):
+        yield from _ctor_calls(value.body)
+        yield from _ctor_calls(value.orelse)
+
+
+def held_lock_map(fn_node: ast.AST) -> dict:
+    """Map ``id(ast node)`` → tuple of ``self.<lock>`` attr names held at
+    that node, from lexical ``with self._lock:`` regions. The map records
+    every candidate ``with self.X:`` acquisition; the concurrency pass
+    intersects against the class's known lock attributes. Acquisition
+    order is preserved (JG202 needs the nesting order). Nested function
+    bodies are excluded — they run when called, not where defined."""
+    held: dict = {}
+
+    def visit(node: ast.AST, stack: tuple) -> None:
+        held[id(node)] = stack
+        if isinstance(node, ast.With):
+            acquired = []
+            for item in node.items:
+                attr = self_attr(item.context_expr)
+                if attr is not None:
+                    acquired.append(attr)
+                visit(item, stack)  # the acquisition expr runs unheld
+            inner = stack + tuple(a for a in acquired if a not in stack)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda))
+            and node is not fn_node
+        ):
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack)
+
+    visit(fn_node, ())
+    return held
 
 
 def _const_tuple(node: ast.AST) -> tuple:
@@ -181,6 +260,7 @@ class Program:
         self.modules: dict[str, Module] = {}
         self.functions: dict[str, FunctionInfo] = {}
         self._by_dotted: dict[str, str] = {}  # dotted name → qualname
+        self._classes_by_dotted: dict[str, ClassInfo] = {}
 
     # ----- construction -----------------------------------------------------
 
@@ -196,7 +276,49 @@ class Program:
         self.modules[modname] = mod
         self._index_imports(mod)
         self._index_functions(mod)
+        self._index_classes(mod)
         return None
+
+    def _index_classes(self, mod: Module) -> None:
+        """Record every class with its bases, its ``threading.Lock``/
+        ``RLock`` attributes, and its constructed-attribute types (any
+        ``self.X = Ctor(...)`` in any method, conditional ctors
+        unwrapped)."""
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            locks: set = set()
+            ctors: dict = {}
+            for sub in ast.walk(node):
+                targets: list = []
+                if isinstance(sub, ast.Assign):
+                    targets, value = sub.targets, sub.value
+                elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                    targets, value = [sub.target], sub.value
+                else:
+                    continue
+                for tgt in targets:
+                    attr = self_attr(tgt)
+                    if attr is None:
+                        continue
+                    for call in _ctor_calls(value):
+                        ctor = dotted(call.func)
+                        if ctor in _LOCK_CTORS:
+                            locks.add(attr)
+                        elif ctor is not None:
+                            ctors.setdefault(attr, ctor)
+            info = ClassInfo(
+                name=node.name,
+                modname=mod.modname,
+                node=node,
+                bases=tuple(
+                    d for d in (dotted(b) for b in node.bases) if d
+                ),
+                lock_attrs=frozenset(locks),
+                attr_ctors=ctors,
+            )
+            mod.classes[node.name] = info
+            self._classes_by_dotted[f"{mod.modname}.{node.name}"] = info
 
     def _index_imports(self, mod: Module) -> None:
         is_pkg = mod.path.replace("\\", "/").endswith("__init__.py")
@@ -332,6 +454,57 @@ class Program:
         if target is not None:
             return self.chase(f"{target}.{rest}")
         return None
+
+    def chase_class(self, dotted_name: str, depth: int = 0) -> Optional[ClassInfo]:
+        """Fully-dotted name → ClassInfo, following one re-export hop per
+        level (mirror of :meth:`chase` for classes)."""
+        if depth > 4:
+            return None
+        info = self._classes_by_dotted.get(dotted_name)
+        if info is not None:
+            return info
+        parts = dotted_name.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = self.modules.get(".".join(parts[:i]))
+            if mod is None:
+                continue
+            rest = parts[i:]
+            target = mod.imports.get(rest[0])
+            if target is None:
+                return None
+            return self.chase_class(".".join([target] + rest[1:]), depth + 1)
+        return None
+
+    def resolve_class(self, mod: Module, name: str) -> Optional[ClassInfo]:
+        """Resolve a class spelling (``HeartbeatAggregator`` or
+        ``manager.HeartbeatAggregator``) from inside ``mod``."""
+        head, _, rest = name.partition(".")
+        if not rest:
+            info = mod.classes.get(name)
+            if info is not None:
+                return info
+            target = mod.imports.get(name)
+            return self.chase_class(target) if target else None
+        target = mod.imports.get(head)
+        if target is not None:
+            return self.chase_class(f"{target}.{rest}")
+        return None
+
+    def attr_class(
+        self, mod: Module, cls: Optional[str], attr: str
+    ) -> Optional[ClassInfo]:
+        """The class an instance attribute was constructed from, if the
+        owning class assigned ``self.<attr> = Ctor(...)`` somewhere and
+        ``Ctor`` resolves to an analyzed class."""
+        if cls is None:
+            return None
+        owner = mod.classes.get(cls)
+        if owner is None:
+            return None
+        ctor = owner.attr_ctors.get(attr)
+        if ctor is None:
+            return None
+        return self.resolve_class(mod, ctor)
 
 
 def load_program(

@@ -31,6 +31,19 @@ ALL_RULES = {
              "state that outlives the traced call)",
     "JG104": "recompile hazard (unhashable or loop-varying static args; "
              "shape-dependent Python branching in a jitted body)",
+    # --- JG2xx: lock discipline (tools.analyze.concurrency) -------------
+    "JG201": "lock-guarded attribute accessed without the lock on a "
+             "thread-reachable path (data race)",
+    "JG202": "lock acquired while holding another lock against the "
+             "global lock order (deadlock hazard)",
+    "JG203": "blocking call (sleep/file-IO/gRPC) made while holding a "
+             "lock in a hot daemon path",
+    # --- JG3xx: knob contract (tools.analyze.contracts) -----------------
+    "JG301": "ENV_* knob has no matching validated Config field",
+    "JG302": "ENV_* knob is never injected by an allocator/plugin site",
+    "JG303": "ENV_* knob parse site converts (int/float) outside a "
+             "degrade-with-event guard — malformed env would raise",
+    "JG304": "ENV_* knob has no row in docs/observability.md",
 }
 
 # Callables whose RESULTS are device values regardless of whether the
@@ -99,6 +112,110 @@ HOT_ROOT_SUFFIXES = (
 # Inline marker that makes any function a hot root (same comment channel
 # as the allow() pragmas; see tools.pragmas for the suppression side).
 HOT_MARK = "# jaxguard: hot"
+
+# ---------------------------------------------------------------------------
+# JG2xx — lock discipline (tools.analyze.concurrency)
+# ---------------------------------------------------------------------------
+
+# Methods of a ``*Servicer`` subclass that the gRPC runtime invokes on its
+# own thread pool — the kubelet device-plugin v1beta1 surface. Any method
+# of a class whose base name ends in "Servicer" AND is named here is a
+# thread entry point.
+GRPC_ENTRY_METHODS = frozenset({
+    "GetDevicePluginOptions",
+    "ListAndWatch",
+    "GetPreferredAllocation",
+    "Allocate",
+    "PreStartContainer",
+})
+
+# Thread entry points the AST cannot see structurally (no ``Thread(target=
+# ...)`` spelling in reach): hooks invoked on OTHER components' threads.
+# Matched as "Class.method" (or bare "function") suffixes of qualnames.
+THREAD_ENTRY_REGISTRY = (
+    # obs.events.emit runs on EVERY emitting thread (serving loop, gRPC
+    # handlers, watcher) and fans into the sink + flight ring + watchdog.
+    "EventSink.emit",
+    "FlightRecorder.record",
+    "SLOBurnWatchdog.observe",
+    # SIGUSR1 debug-dump thread reads these while the daemon runs.
+    "SLOBurnWatchdog.stats",
+    "PluginManager.debug_report",
+    "HeartbeatAggregator.snapshot",
+    # Allocate handlers call the journal through the on_allocate hook
+    # (a lambda the resolver cannot chase).
+    "AllocationJournal.record",
+)
+
+# Dotted call spellings that block (scheduler-visible sleeps, file IO,
+# gRPC dials) — JG203 flags these while a lock is held on a hot daemon
+# path. Matched against the call's dotted name exactly, or by prefix for
+# the entries ending in ".".
+BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "open",
+    "os.makedirs",
+    "os.replace",
+    "os.rename",
+    "os.remove",
+    "os.unlink",
+    "os.truncate",
+    "os.listdir",
+    "os.stat",
+    "json.dump",
+    "json.load",
+    "shutil.rmtree",
+    "subprocess.run",
+})
+BLOCKING_PREFIXES = ("grpc.",)
+
+# ---------------------------------------------------------------------------
+# JG3xx — knob contract (tools.analyze.contracts)
+# ---------------------------------------------------------------------------
+
+# Module (relative path) holding the ENV_* catalogue the contract pass
+# cross-references, and the Config module that must back each knob.
+KNOB_CONSTANTS_PATH = "kata_xpu_device_plugin_tpu/cdi/constants.py"
+KNOB_CONFIG_PATH = "kata_xpu_device_plugin_tpu/config.py"
+KNOB_DOC_PATH = "docs/observability.md"
+
+# Injection surface: modules (path prefixes) where a reference to the
+# constant counts as "the daemon injects/consumes this env".
+KNOB_INJECTION_PREFIXES = (
+    "kata_xpu_device_plugin_tpu/plugin/",
+    "kata_xpu_device_plugin_tpu/topology",
+    "kata_xpu_device_plugin_tpu/runtime_env",
+)
+
+# Identity/topology envs the daemon injects but which are not operator
+# knobs: no Config field, no guest parse contract, documented in
+# docs/architecture.md rather than the observability knob table. Fully
+# exempt from JG301–JG304.
+KNOB_EXEMPT = frozenset({
+    "ENV_CDI_VENDOR_CLASS",
+    "ENV_TPU_ACCELERATOR_TYPE",
+    "ENV_TPU_CHIPS_PER_HOST_BOUNDS",
+    "ENV_TPU_HOST_BOUNDS",
+    "ENV_TPU_WORKER_ID",
+    "ENV_TPU_WORKER_HOSTNAMES",
+    "ENV_TPU_VISIBLE_CHIPS",
+    "ENV_TPU_SKIP_MDS_QUERY",
+})
+
+# Constants whose Config field does not follow the value-derived
+# convention (strip "KATA_TPU_", lowercase).
+KNOB_FIELD_OVERRIDES = {
+    "ENV_SERVING_TP": "serving_tp",          # value is KATA_TPU_TP
+    "ENV_SERVING_TP_MIN": "serving_tp_min",  # value is KATA_TPU_TP_MIN
+    "ENV_TRACE_CTX": "trace_context",        # value is KATA_TPU_TRACE_CTX
+    "ENV_FAULT_SCHEDULE": "faults",          # value is KATA_TPU_FAULTS
+    # The obs pair is switched by config.guest_events_dir, not a
+    # same-named field (KATATPU_OBS=1 + file path are what the allocator
+    # derives FROM guest_events_dir).
+    "ENV_OBS": "guest_events_dir",
+    "ENV_OBS_FILE": "guest_events_dir",
+    "ENV_HEARTBEAT_ROUNDS": "heartbeat_rounds",
+}
 
 
 @dataclass(frozen=True)

@@ -145,8 +145,30 @@ def compare(old: dict, new: dict,
     return rows
 
 
+def analyzer_findings(directory: str = ".") -> Optional[dict]:
+    """The jaxguard summary riding next to the banks, when a
+    ``jaxguard_report.json`` is present (``make analyze`` writes one).
+    The trend footer carries it so a PR that buys its green analyzer
+    run with a pile of new pragmas is visible in the same place the
+    perf trajectory is. Unreadable/absent report → None (the footer
+    line is simply omitted — the analyzer gate, not this tool, owns
+    failing on findings)."""
+    path = os.path.join(directory, "jaxguard_report.json")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            report = json.load(fh)
+        summary = report["summary"]
+        return {
+            "total": int(summary["total"]),
+            "by_rule": dict(summary.get("by_rule", {})),
+        }
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
 def render(rows: list[dict], old_path: str, new_path: str,
-           flips: Optional[dict] = None) -> str:
+           flips: Optional[dict] = None,
+           analyzer: Optional[dict] = None) -> str:
     lines = [
         f"bench trend: {os.path.basename(old_path)} -> "
         f"{os.path.basename(new_path)}",
@@ -167,6 +189,15 @@ def render(rows: list[dict], old_path: str, new_path: str,
         f"headline: {n_reg} regression(s), {n_flat} flat "
         f"(of {sum(r['metric'] in HEADLINE_METRICS for r in rows)} present)"
     )
+    if analyzer is not None:
+        note = f"jaxguard: {analyzer['total']} finding(s)"
+        if analyzer["by_rule"]:
+            note += " (" + ", ".join(
+                f"{rule}={n}" for rule, n in sorted(
+                    analyzer["by_rule"].items()
+                )
+            ) + ")"
+        lines.append(note)
     return "\n".join(lines)
 
 
@@ -215,16 +246,19 @@ def main(argv: Optional[list[str]] = None) -> int:
     (new_path, new), (old_path, old) = loaded[0], loaded[1]
     rows = compare(old, new, threshold=args.threshold)
     flips = layout_flips(old, new)
+    analyzer = analyzer_findings(args.dir)
     if args.json:
         print(json.dumps({
             "old": os.path.basename(old_path),
             "new": os.path.basename(new_path),
             "threshold": args.threshold,
             "layout_changes": {k: list(v) for k, v in flips.items()},
+            "analyzer": analyzer,
             "rows": rows,
         }, indent=2))
     else:
-        print(render(rows, old_path, new_path, flips=flips))
+        print(render(rows, old_path, new_path, flips=flips,
+                     analyzer=analyzer))
     return 1 if any(r["status"] == "regression" for r in rows) else 0
 
 

@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint analyze race verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused kv-layout eval eval-kv demo dryrun image clean deploy obs-check obs-report
+.PHONY: all build proto lint analyze race verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused kv-layout devledger eval eval-kv demo dryrun image clean deploy obs-check obs-report
 
 all: build
 
@@ -300,6 +300,25 @@ fused:
 	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/fused_events_strict.jsonl \
 	KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_fused_decode.py -q
+
+# Device-utilization & HBM ledger gate (ISSUE 17): the ledger suite on
+# the forced-8-device host — once-per-signature cost capture and MFU
+# math, dispatch-gap phase attribution summing exactly to the measured
+# gap, memory degrade-by-omission (`hbm_stats_unavailable` once, never
+# fake zeros), the device_idle / hbm_headroom_collapse watchdog rules
+# with their self-disarm matrix, the profiler double-start fix
+# (`profiler_busy` instead of a crash), greedy bit-identity ledger
+# on/off, and the aggregator's omission-preserving re-export — with and
+# without KATA_TPU_STRICT=1 (the ledger is host arithmetic only; the
+# instrumented dispatch window must stay transfer-guard-clean too).
+devledger:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/devledger_events.jsonl \
+	  $(PY) -m pytest tests/test_devledger.py -q
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/devledger_events_strict.jsonl \
+	KATA_TPU_STRICT=1 \
+	  $(PY) -m pytest tests/test_devledger.py -q
 
 # int8-KV promotion gate (ISSUE 12): pooled greedy agreement + first-
 # decode-step logit drift vs the bf16 oracle on a fixed prompt set —

@@ -2192,13 +2192,17 @@ def worker(args: argparse.Namespace) -> None:
                     t0 = time.perf_counter()
                     results = srv.run()
                     dt_s = time.perf_counter() - t0
+                    # Device-ledger snapshot (ISSUE 17): the ring side
+                    # runs the armed ledger, so the overhead ratio below
+                    # automatically covers its per-dispatch cost.
+                    st = srv.stats()
                 finally:
                     obs.set_default_sink(prev_sink)
                     obs_flight.set_default_recorder(prev_rec)
                     if sink is not None:
                         sink.close()
                 total = sum(len(results[r]) for r in rids)
-                return (total, dt_s, results, rec)
+                return (total, dt_s, results, rec, st)
 
             # INTERLEAVED trials (ring/off/sink per round, best-of-4 per
             # side): host drift — thermal, page cache, a background
@@ -2210,9 +2214,9 @@ def worker(args: argparse.Namespace) -> None:
                     r = one_trial(mode, trial)
                     if mode not in best or r[1] < best[mode][1]:
                         best[mode] = r
-            ring_total, ring_s, ring_results, ring_rec = best["ring"]
-            off_total, off_s, off_results, _r = best["off"]
-            sink_total, sink_s, sink_results, _r2 = best["sink"]
+            ring_total, ring_s, ring_results, ring_rec, ring_st = best["ring"]
+            off_total, off_s, off_results, _r, _st = best["off"]
+            sink_total, sink_s, sink_results, _r2, _st2 = best["sink"]
 
             def outputs_equal(a, b):
                 return float(
@@ -2266,6 +2270,21 @@ def worker(args: argparse.Namespace) -> None:
                 # and a healthy burst must fire zero watchdog alerts.
                 "serving_obs_heartbeats": len(heartbeats),
                 "serving_obs_watchdog_alerts": len(wd_alerts),
+                # Device ledger (ISSUE 17), from the armed ring side:
+                # last-interval MFU / busy fraction / mean dispatch gap
+                # — utilization trend lines (gap is lower-is-better:
+                # bench_trend renders it as an info row, direction-
+                # aware, never a regression gate).
+                "serving_mfu": float(ring_st.get("mfu", 0.0)),
+                "serving_device_busy_frac": float(
+                    ring_st.get("device_busy_frac", 0.0)
+                ),
+                "serving_dispatch_gap_ms": float(
+                    ring_st.get("dispatch_gap_ms", 0.0)
+                ),
+                "serving_devledger_armed": int(
+                    ring_st.get("devledger", {}).get("armed", 0)
+                ),
             }
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"obs_error": f"{type(exc).__name__}: {exc}"[:200]}

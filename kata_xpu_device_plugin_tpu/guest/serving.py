@@ -413,6 +413,20 @@ _PROM_STATS = (
                         "breaches; each dumped the flight ring)"),
     ("watchdog_active", "Watchdog alert kinds currently active (0 = "
                         "healthy)"),
+    # Device ledger (ISSUE 17): utilization over the last heartbeat
+    # interval — always-present stats() numbers (0.0 before the first
+    # heartbeat / with the ledger disarmed), so they ride the scrape
+    # loop; the memory side exports through the dedicated
+    # hbm_headroom_bytes gauge (NaN when the backend has no
+    # memory_stats — a missing poll must never scrape as 0 bytes free).
+    ("mfu", "Model FLOP/s utilization over the last heartbeat interval "
+            "(dispatched executable FLOPs / interval wall / public "
+            "per-chip peak x tp)"),
+    ("device_busy_frac", "Fraction of the last heartbeat interval covered "
+                         "by in-flight decode rounds (dispatch->retire)"),
+    ("dispatch_gap_ms", "Mean retire-fence -> next-dispatch host gap over "
+                        "the last heartbeat interval (ms; the "
+                        "device-idle signal)"),
     # fused_admissions is stats()-only here: its prometheus surface is
     # the TRUE counter kata_tpu_serving_fused_admissions_total (the
     # factory stores counters under their _total-stripped stem, so a
@@ -471,6 +485,20 @@ def _gauge_decode_backend():
         "Active decode-attention backend (1 on the server's backend "
         "label, 0 on the others; pallas_paged | xla_reference)",
         ["server", "backend"],
+    )
+
+
+# Device-memory headroom (ISSUE 17): a dedicated gauge rather than a
+# _PROM_STATS entry — the scrape loop's stats().get(name, 0.0) default
+# would fake "0 bytes free" on backends without memory_stats (CPU),
+# where the ledger's contract is omission. The set_function reads the
+# ledger directly and exports NaN for "unknown".
+def _gauge_hbm_headroom():
+    return obs.gauge(
+        "kata_tpu_serving_hbm_headroom_bytes",
+        "Device memory headroom (limit - used) at the last heartbeat "
+        "poll; NaN where the backend exposes no memory_stats",
+        ["server"],
     )
 
 
@@ -1875,6 +1903,17 @@ class GenerationServer:
         self._hb_prev: dict = {}    # counter snapshot the deltas diff against
         self._clock = _PhaseClock(armed=hb_every > 0)
         self._clock_prev: dict = {}
+        # Device-utilization & HBM ledger (ISSUE 17): armed whenever the
+        # heartbeat is (KATA_TPU_DEVLEDGER=0 disarms — the same
+        # kill-switch contract as the watchdog). Always constructed so
+        # stats() carries the ledger block without a schema branch;
+        # disarmed, every hook is one attribute test.
+        self._devledger = obs.DeviceLedger(
+            armed=hb_every > 0 and obs.devledger.enabled(),
+            emit=self._emit, clock=self._clock, tp=self._tp,
+            gap_phases=LOOP_PHASES,
+            components=self._hbm_components,
+        )
         # Watchdog resolution: an injected SLOBurnWatchdog wins (it must
         # have heartbeats to consume — explicit conflict raises); True
         # forces the default config on; False/env "0" disarms; None is
@@ -1917,6 +1956,7 @@ class GenerationServer:
             ),
             heartbeat_rounds=self._hb_every,
             watchdog=int(self._watchdog is not None),
+            devledger=int(self._devledger.armed),
         )
 
     def _emit(self, name: str, **fields) -> None:
@@ -2015,6 +2055,38 @@ class GenerationServer:
             "sched_defers": self._sched.defers,
         }
 
+    def _hbm_components(self) -> dict:
+        """Device-resident byte counts the server already knows, for the
+        ledger's HBM attribution (ISSUE 17). NON-OVERLAPPING by
+        construction so the attributed sum is honest: a paged prefix
+        tier's blocks live INSIDE the pool arena (shared budget, ISSUE
+        6) and report 0 here — only a standalone store owns a separate
+        arena. The host-RAM KV tier is host memory, not HBM, and stays
+        out entirely (its footprint already rides the heartbeat as
+        kv_host_blocks/tokens). Shard-aware via _hbm_bytes: replicated
+        leaves cost devices × nbytes, matching stats()["arena_bytes"]."""
+        comp = {
+            "params": sum(
+                _hbm_bytes(leaf)
+                for leaf in jax.tree_util.tree_leaves(self.params)
+            ),
+            "kv_arena": sum(
+                _hbm_bytes(leaf)
+                for leaf in jax.tree_util.tree_leaves(
+                    self.kv_pool.arena if self.paged else self.arena
+                )
+            ),
+        }
+        store = self.prefix_store
+        comp["prefix_store"] = (
+            0 if store is None or isinstance(store, PagedPrefixTier)
+            else sum(
+                _hbm_bytes(leaf)
+                for leaf in jax.tree_util.tree_leaves(store.arena)
+            )
+        )
+        return comp
+
     def _maybe_heartbeat(self, force: bool = False) -> None:
         """Emit the periodic ``serving_heartbeat`` when the cadence says
         so (``force`` flushes a partial interval — the end-of-run tail,
@@ -2104,6 +2176,11 @@ class GenerationServer:
         }
         hb.update(self._sched.heartbeat_fields())
         hb.update({f"phase_{p}_s": v for p, v in ph.items()})
+        # Device ledger (ISSUE 17): mfu / device_busy_frac /
+        # dispatch_gap_* (full set, zeros before any dispatch) plus the
+        # hbm_* poll — present only where the backend supplies
+        # memory_stats (omission, never fake zeros). {} disarmed.
+        hb.update(self._devledger.heartbeat_fields(interval_s))
         self._emit("serving_heartbeat", **hb)
         for p, v in ph.items():
             self._h_loop[p].observe(v)
@@ -2637,6 +2714,12 @@ class GenerationServer:
             "watchdog_active": len(wd["active"]),
             "watchdog": wd,
         })
+        # Device ledger (ISSUE 17): mfu / device_busy_frac /
+        # dispatch_gap_ms ALWAYS present (zeros disarmed or before the
+        # first heartbeat window) so they ride the scrape loop; the
+        # ``devledger`` dict carries the detail — hbm_* fields appear
+        # there only where the backend supplies memory_stats.
+        out.update(self._devledger.stats_fields())
         # Resilience fields (ISSUE 7): ALWAYS present — zeros on a server
         # that never failed — so dashboards need no schema branch.
         out.update({
@@ -2752,6 +2835,17 @@ class GenerationServer:
             backend_gauge.labels(
                 server=self._label, backend=be
             ).set_function(partial(_backend_active, self, be))
+        # HBM headroom (ISSUE 17): dedicated gauge, NOT the stats()
+        # scrape loop — its ``.get(name, 0.0)`` default would fake
+        # "0 bytes free" on backends without memory_stats. NaN is the
+        # Prometheus idiom for "no data".
+        def _headroom(self=self) -> float:
+            v = self._devledger.hbm_headroom()
+            return float(v) if v is not None else float("nan")
+
+        _gauge_hbm_headroom().labels(server=self._label).set_function(
+            _headroom
+        )
         if port:
             from ..utils.metrics import serve
 
@@ -4702,28 +4796,50 @@ class GenerationServer:
             # the overlapped dispatch this runs inside).
             with jaxapi.allow_transfer("fused admission slice upload"):
                 if self.paged:
-                    (toks, caches, new_last, new_pos, p_caches,
-                     p_logits) = _fused_serve_decode(
+                    # Device ledger (ISSUE 17): args/kwargs staged once so
+                    # on_dispatch can lower THIS dispatch's signature for
+                    # cost_analysis (lowering reads avals only — the
+                    # donated arena is untouched) and stamp the gap clock.
+                    fargs = (
                         self.params, self.kv_pool.arena, last, pos, budget,
                         p.caches, jnp.asarray(suffix)[None, :],
                         jnp.int32(offset), jnp.int32(take), self.cfg,
                         steps, self._do_sample, self.top_k, self._temp_dev,
-                        sub, top_p=self.top_p,
+                        sub,
+                    )
+                    fkw = dict(
+                        top_p=self.top_p,
                         block_tables=jnp.asarray(self._bt_host),
                         block_size=self.kv_block, paged_len=self.max_len,
                         decode_kernel_fn=self._decode_kernel, eos_id=eos,
                     )
+                    self._devledger.on_dispatch(
+                        ("fused", True, steps, width, eos is None,
+                         budget is None),
+                        _fused_serve_decode, fargs, fkw,
+                    )
+                    (toks, caches, new_last, new_pos, p_caches,
+                     p_logits) = _fused_serve_decode(*fargs, **fkw)
                     self.kv_pool.arena = caches
                 else:
-                    (toks, caches, new_last, new_pos, p_caches,
-                     p_logits) = _fused_serve_decode(
+                    fargs = (
                         self.params, self.arena, last, pos, budget,
                         p.caches, jnp.asarray(suffix)[None, :],  # jaxguard: allow(JG102) exclusive if/else branch — the paged call above never ran; p.caches rebinds right below
                         jnp.int32(offset), jnp.int32(take), self.cfg,
                         steps, self._do_sample, self.top_k, self._temp_dev,
-                        sub, top_p=self.top_p,
+                        sub,
+                    )
+                    fkw = dict(
+                        top_p=self.top_p,
                         decode_kernel_fn=self._decode_kernel, eos_id=eos,
                     )
+                    self._devledger.on_dispatch(
+                        ("fused", False, steps, width, eos is None,
+                         budget is None),
+                        _fused_serve_decode, fargs, fkw,
+                    )
+                    (toks, caches, new_last, new_pos, p_caches,
+                     p_logits) = _fused_serve_decode(*fargs, **fkw)
                     self.arena = caches
             p.caches = p_caches  # jaxguard: allow(JG102) this IS the rebind — the donated tree's successor replaces it, nothing reads the donated buffers
             self._fused_ret = _FusedChunk(
@@ -4739,24 +4855,38 @@ class GenerationServer:
             self._fused_blame = None
             return toks, new_last, new_pos
         if self.paged:
-            toks, caches, new_last, new_pos = _serve_decode(
+            fargs = (
                 self.params, self.kv_pool.arena, last, pos, self.cfg,
-                steps, self._do_sample, self.top_k, self._temp_dev,
-                sub, top_p=self.top_p, ring=False,
+                steps, self._do_sample, self.top_k, self._temp_dev, sub,
+            )
+            fkw = dict(
+                top_p=self.top_p, ring=False,
                 block_tables=jnp.asarray(self._bt_host),
                 block_size=self.kv_block, paged_len=self.max_len,
                 decode_kernel_fn=self._decode_kernel, eos_id=eos,
                 budget=budget,
             )
+            self._devledger.on_dispatch(
+                ("plain", True, steps, eos is None, budget is None),
+                _serve_decode, fargs, fkw,
+            )
+            toks, caches, new_last, new_pos = _serve_decode(*fargs, **fkw)
             self.kv_pool.arena = caches
         else:
-            toks, caches, new_last, new_pos = _serve_decode(
+            fargs = (
                 self.params, self.arena, last, pos, self.cfg, steps,
                 self._do_sample, self.top_k, self._temp_dev, sub,
+            )
+            fkw = dict(
                 top_p=self.top_p, ring=self.ring_kv,
                 decode_kernel_fn=self._decode_kernel, eos_id=eos,
                 budget=budget,
             )
+            self._devledger.on_dispatch(
+                ("plain", False, steps, eos is None, budget is None),
+                _serve_decode, fargs, fkw,
+            )
+            toks, caches, new_last, new_pos = _serve_decode(*fargs, **fkw)
             self.arena = caches
         return toks, new_last, new_pos
 
@@ -4827,6 +4957,10 @@ class GenerationServer:
                 toks = self._fence_wait(lambda: np.asarray(toks))  # jaxguard: allow(JG101) lock-step round fence — the transfer IS the chunk boundary
             finally:
                 self._clock.pop()
+        # Ledger retire stamp AFTER the span closed, so the RETIRE pop's
+        # fence time is already accrued and the clock snapshot taken here
+        # keeps it out of the next retire→dispatch gap window.
+        self._devledger.note_retire()
         # Per-token decode latency as a client sees it: dispatch wall
         # time over its delivered steps (each step yields one token per
         # slot) — STAYS per-token however large decode_steps is.
@@ -4995,6 +5129,10 @@ class GenerationServer:
         now = time.perf_counter()
         round_s = now - max(fl.t_dispatch, self._t_last_retire)
         self._t_last_retire = now
+        # Ledger retire stamp: same anchor as round_s (busy time is
+        # now − max(dispatch, previous retire) — pipelined chunks never
+        # double-count the overlapped window).
+        self._devledger.note_retire(now)
         n_tokens = len(fl.slots) * self._dispatch_steps
         fl.span.set(
             round_s=round(round_s, 6),

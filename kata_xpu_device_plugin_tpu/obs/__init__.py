@@ -26,9 +26,16 @@ plugin gRPC — one pipeline for the signals production traffic needs:
 - :mod:`.watchdog` — the SLO-burn WATCHDOG (ISSUE 15): consumes the
   serving loop's periodic heartbeats, and on a sustained ITL-budget
   burn or anomaly (preemption storm, host-tier hit collapse, tokens/s
-  regression) dumps the flight ring and opens a bounded profiler
-  window — "serving got slow" becomes an on-disk artifact with zero
-  operator action.
+  regression, device idle growth, HBM headroom collapse) dumps the
+  flight ring and opens a bounded profiler window — "serving got slow"
+  becomes an on-disk artifact with zero operator action.
+- :mod:`.devledger` — the DEVICE-UTILIZATION & HBM LEDGER (ISSUE 17):
+  per-dispatch executable cost (once per signature via ``jax.stages``
+  lowering) combined with the dispatch/retire stamps into rolling
+  ``mfu`` / ``device_busy_frac`` / phase-attributed ``dispatch_gap_*``,
+  plus heartbeat-cadence ``memory_stats()`` headroom with component
+  attribution — fields omitted (never faked 0) where the backend
+  supplies nothing.
 
 Import discipline: NOTHING here imports jax at module level — the host
 daemon (plugin/, utils/) imports this package and must stay jax-free;
@@ -37,6 +44,7 @@ the profiler starts.
 """
 from __future__ import annotations
 
+from .devledger import DeviceLedger
 from .events import (
     EventSink,
     configure_from_env,
@@ -76,6 +84,7 @@ from .trace import (
 )
 
 __all__ = [
+    "DeviceLedger",
     "EventSink",
     "configure_from_env",
     "default_sink",

@@ -1,0 +1,473 @@
+"""Device-utilization & HBM ledger (ISSUE 17).
+
+The telemetry stack through PR 15 measures the serving loop entirely
+from the host side: phase wall-times, ITL percentiles, occupancy
+*counts*. This module closes the device-side gap with a per-server
+ledger wired into the ONE decode dispatch site
+(``guest/serving.py::_dispatch_decode``) and its retire fence:
+
+- **Cost side** — each distinct dispatch signature (plain/fused, paged,
+  steps, fused suffix width, budget shape, tp) is lowered ONCE via
+  ``jax.stages`` (``fn.lower(...)``, shapes only — tracing never
+  executes, so the donated arenas are untouched) and its
+  ``cost_analysis()`` FLOPs/bytes-accessed cached. Where the backend
+  returns nothing usable, the signature degrades with one
+  ``cost_unavailable`` event and the MFU fields simply read 0 — never a
+  crash, never a fake number.
+- **Timing side** — per-dispatch host stamps at dispatch and at the
+  retire fence give ``device_busy_frac`` (fraction of the heartbeat
+  interval covered by in-flight decode rounds) and ``mfu`` (interval
+  FLOPs over interval wall × public per-chip peak × tp, the portable
+  utilization metric of "Exploration of TPUs for AI Applications" —
+  peak table shared with bench.py). The retire→next-dispatch host gap
+  is attributed to PR 15's ``_PhaseClock`` phases (admit / retire /
+  host_transfer / other), so the ``dispatch_gap_*`` waterfall names the
+  thief; the shares are clock-delta-derived and rescaled to sum to the
+  measured gap exactly.
+- **Memory side** — ``device.memory_stats()`` polled at heartbeat
+  cadence. None-safe (CPU included): the ``hbm_*`` fields are *omitted*
+  — never faked as 0 — with one ``hbm_stats_unavailable`` degrade event
+  per server. When present, the server's own component bytes (params
+  donor copy, KV arena/pool, standalone prefix store) attribute the
+  usage and the ``hbm_unattributed_bytes`` residual makes leaks
+  visible; the peak watermark feeds the watchdog's
+  ``hbm_headroom_collapse`` floor.
+
+The ledger is pure host arithmetic plus one trace per NEW executable
+signature: it never fences, never touches device values, so greedy
+outputs are bit-identical with it armed (tested, both strict modes) and
+the armed cost rides under bench.py's ≤1% ``measure_obs`` bar.
+
+``KATA_TPU_DEVLEDGER=0`` disarms the ledger without touching the
+heartbeat (same kill-switch contract as ``KATA_TPU_WATCHDOG``); it is
+armed by default whenever the heartbeat is. jax-free at import — jax
+(and bench's peak table) load lazily, only on an armed server's first
+dispatch/poll.
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from . import events
+
+# Kill switch: heartbeat-armed servers run the ledger by default ("what
+# were the chips doing" should not need configuration); "0" disarms it
+# without touching the heartbeat stream or the watchdog.
+ENV_DEVLEDGER = "KATA_TPU_DEVLEDGER"
+
+# Public per-chip peak MFLOP tables, mirroring bench.py's MXU_TFLOPS —
+# the ledger prefers bench's table (one source of truth when both are
+# importable) and falls back to this copy where bench.py is not on the
+# path (an installed guest without the repo checkout).
+_MXU_TFLOPS = {
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v4": 275.0,
+    "v6e": 918.0,
+    "cpu": 0.1,
+}
+
+# A dispatch that raised out of the serving loop (fault injection,
+# recovery replay) leaves its pending entry unretired; bound the FIFO so
+# one incident can never skew attribution for the rest of the run (the
+# healthy depth is 1 lock-step / 2 overlapped).
+_MAX_PENDING = 4
+
+
+def enabled() -> bool:
+    """Is the ledger armed (``KATA_TPU_DEVLEDGER`` != "0")?"""
+    return os.environ.get(ENV_DEVLEDGER, "1") != "0"
+
+
+def _cost_flops(cost) -> Optional[float]:
+    """Normalize a ``cost_analysis()`` result — jax returns a dict from
+    ``Lowered.cost_analysis()`` and a list of per-computation dicts from
+    ``Compiled.cost_analysis()`` — into total FLOPs, or None when the
+    backend reported nothing usable."""
+    entries = cost if isinstance(cost, (list, tuple)) else [cost]
+    total = 0.0
+    seen = False
+    for e in entries:
+        if not isinstance(e, dict):
+            continue
+        v = e.get("flops")
+        if isinstance(v, (int, float)) and v > 0:
+            total += float(v)
+            seen = True
+    return total if seen else None
+
+
+def _cost_bytes(cost) -> float:
+    entries = cost if isinstance(cost, (list, tuple)) else [cost]
+    total = 0.0
+    for e in entries:
+        if isinstance(e, dict):
+            v = e.get("bytes accessed")
+            if isinstance(v, (int, float)) and v > 0:
+                total += float(v)
+    return total
+
+
+class DeviceLedger:
+    """Per-server device-utilization and memory ledger.
+
+    The serving loop calls :meth:`on_dispatch` (cost capture + gap
+    note) right before each decode executable call and
+    :meth:`note_retire` at the retire fence; :meth:`heartbeat_fields`
+    turns the interval accumulators into the ``serving_heartbeat``'s
+    ``mfu`` / ``device_busy_frac`` / ``dispatch_gap_*`` / ``hbm_*``
+    fields (memory fields present only when the backend supplies
+    ``memory_stats``); :meth:`stats_fields` is the always-present
+    ``stats()`` block. Disarmed, every hook is one attribute test.
+
+    ``gap_phases`` fixes the heartbeat's gap-attribution field set (the
+    serving loop passes its LOOP_PHASES) so the event schema never
+    branches on what a particular interval happened to observe.
+    ``clock`` is the loop's ``_PhaseClock``; ``components`` a callable
+    returning the server's known device-resident byte counts (non-
+    overlapping); ``device`` overrides the polled device (tests)."""
+
+    def __init__(self, *, armed: bool = True,
+                 emit: Optional[Callable[..., None]] = None,
+                 clock=None, tp: int = 1,
+                 gap_phases: tuple = ("other",),
+                 components: Optional[Callable[[], dict]] = None,
+                 device=None):
+        self.armed = bool(armed)
+        self._emit_fn = emit
+        self._clock = clock
+        self._tp = max(1, int(tp))
+        self._gap_phases = tuple(gap_phases)
+        if "other" not in self._gap_phases:
+            self._gap_phases = self._gap_phases + ("other",)
+        self._components = components
+        self._device = device
+        self._device_resolved = device is not None
+        # Cost cache: signature key -> {"flops", "bytes_accessed"} | None
+        # (None = captured but unavailable; the key never re-lowers).
+        self._costs: dict = {}
+        self._cost_unavailable = 0
+        # In-flight dispatches (FIFO — depth 1 lock-step, 2 overlapped).
+        self._pending: deque = deque()
+        self._t_last_retire: Optional[float] = None
+        self._snap_retire: dict = {}
+        # Interval accumulators, drained by heartbeat_fields().
+        self._i = self._fresh_interval()
+        # Cumulative counters (stats()).
+        self._dispatches = 0
+        self._retired = 0
+        # Memory state.
+        self._peak_flops: Optional[float] = None
+        self._hbm_peak = 0
+        self._mem_unavailable = False
+        self._mem_unavailable_emitted = False
+        # Last heartbeat_fields() result — stats()' ledger snapshot.
+        self._last_fields: dict = {}
+
+    # ----- plumbing --------------------------------------------------------
+
+    def _fresh_interval(self) -> dict:
+        return {
+            "dispatches": 0, "retires": 0, "busy_s": 0.0, "flops": 0.0,
+            "gaps": 0, "gap_s": 0.0,
+            "gap_attr": {p: 0.0 for p in self._gap_phases},
+        }
+
+    def _do_emit(self, name: str, **fields) -> None:
+        try:
+            if self._emit_fn is not None:
+                self._emit_fn(name, **fields)
+            else:
+                events.emit("serving", name, **fields)
+        except Exception:
+            pass  # telemetry must never add a serving failure mode
+
+    def _poll_device(self):
+        if not self._device_resolved:
+            self._device_resolved = True
+            try:
+                import jax
+
+                devs = jax.local_devices()
+                self._device = devs[0] if devs else None
+            except Exception:
+                self._device = None
+        return self._device
+
+    def peak_flops(self) -> float:
+        """Public peak FLOP/s of the serving mesh: per-chip peak × tp.
+        bench.py's table is the source of truth when importable; the
+        local mirror (device_kind substring match, cpu fallback) covers
+        installed guests without the repo checkout."""
+        if self._peak_flops is None:
+            dev = self._poll_device()
+            tflops = None
+            try:
+                import bench
+
+                tflops = float(bench.detect_mxu_tflops(dev))
+            except Exception:
+                tflops = None
+            if tflops is None or tflops <= 0:
+                kind = str(getattr(dev, "device_kind", "") or "").lower()
+                for name, tf in _MXU_TFLOPS.items():
+                    if name in kind:
+                        tflops = tf
+                        break
+                else:
+                    plat = str(getattr(dev, "platform", "") or "").lower()
+                    tflops = (
+                        _MXU_TFLOPS["cpu"] if plat in ("", "cpu")
+                        else _MXU_TFLOPS["v5e"]
+                    )
+            self._peak_flops = tflops * 1e12 * self._tp
+        return self._peak_flops
+
+    # ----- cost capture (once per executable signature) --------------------
+
+    def _capture_cost(self, key: tuple, fn, args: tuple,
+                      kwargs: dict) -> None:
+        """Lower ``fn`` with the dispatch's own arguments (avals only —
+        tracing reads shapes/dtypes, never buffer contents, so donated
+        arenas are safe) and cache its cost analysis under ``key``.
+        ``Lowered.cost_analysis()`` answers without compiling on the
+        backends that support it; the ``compile()`` fallback pays one
+        extra compile for the signature where only the executable
+        carries cost. Any failure degrades to one ``cost_unavailable``
+        event for the signature — the key never re-lowers."""
+        cost = None
+        reason = ""
+        try:
+            lowered = fn.lower(*args, **kwargs)
+        except Exception as exc:
+            lowered = None
+            reason = f"lower_failed:{type(exc).__name__}"
+        if lowered is not None:
+            try:
+                cost = lowered.cost_analysis()
+            except Exception:
+                cost = None
+            if _cost_flops(cost) is None:
+                try:
+                    cost = lowered.compile().cost_analysis()
+                except Exception:
+                    cost = None
+            if _cost_flops(cost) is None:
+                reason = reason or "no_flops"
+        flops = _cost_flops(cost)
+        if flops is None:
+            self._costs[key] = None
+            self._cost_unavailable += 1
+            self._do_emit(
+                "cost_unavailable", reason=reason or "no_flops",
+                signature=repr(key),
+            )
+        else:
+            self._costs[key] = {
+                "flops": flops,
+                "bytes_accessed": _cost_bytes(cost),
+            }
+
+    # ----- the dispatch-site hooks -----------------------------------------
+
+    def on_dispatch(self, key: tuple, fn, args: tuple,
+                    kwargs: dict) -> None:
+        """Called by the ONE dispatch site right before the decode
+        executable call: captures the signature's cost on first sight,
+        then stamps the dispatch and attributes the retire→dispatch
+        host gap to the phase clock's deltas (residual → ``other``;
+        shares rescaled so they sum to the gap exactly)."""
+        if not self.armed:
+            return
+        if key not in self._costs:
+            self._capture_cost(key, fn, args, kwargs)
+        now = time.perf_counter()
+        if self._t_last_retire is not None:
+            gap = max(now - self._t_last_retire, 0.0)
+            attr: dict = {}
+            if self._clock is not None:
+                snap = self._clock.snapshot()
+                for p, v in snap.items():
+                    d = v - self._snap_retire.get(p, 0.0)
+                    if d > 0:
+                        attr[p] = d
+            total = sum(attr.values())
+            if total > gap > 0:
+                # Clock deltas can overrun the gap window (a phase pop
+                # lands fence time accrued outside it); rescale so the
+                # shares sum to the measured gap by construction.
+                scale = gap / total
+                attr = {p: v * scale for p, v in attr.items()}
+                total = gap
+            i = self._i
+            i["gaps"] += 1
+            i["gap_s"] += gap
+            ga = i["gap_attr"]
+            for p, v in attr.items():
+                ga[p if p in ga else "other"] = (
+                    ga.get(p if p in ga else "other", 0.0) + v
+                )
+            ga["other"] += max(gap - total, 0.0)
+        if len(self._pending) >= _MAX_PENDING:
+            self._pending.popleft()  # abandoned by a raising dispatch
+        self._pending.append((key, now))
+        self._dispatches += 1
+        self._i["dispatches"] += 1
+
+    def note_retire(self, now: Optional[float] = None) -> None:
+        """Called at the retire fence: accumulates the chunk's busy time
+        (retire→retire cadence at steady state — the same ``round_s``
+        convention the latency metrics use) and its signature's FLOPs,
+        and snapshots the phase clock as the next gap's baseline."""
+        if not self.armed or not self._pending:
+            return
+        if now is None:
+            now = time.perf_counter()
+        key, t_dispatch = self._pending.popleft()
+        anchor = (
+            t_dispatch if self._t_last_retire is None
+            else max(t_dispatch, self._t_last_retire)
+        )
+        busy = max(now - anchor, 0.0)
+        self._t_last_retire = now
+        if self._clock is not None:
+            self._snap_retire = self._clock.snapshot()
+        cost = self._costs.get(key)
+        if cost:
+            self._i["flops"] += cost["flops"]
+        self._i["busy_s"] += busy
+        self._retired += 1
+        self._i["retires"] += 1
+
+    # ----- memory poll (heartbeat cadence) ---------------------------------
+
+    def poll_memory(self) -> dict:
+        """One ``memory_stats()`` poll plus component attribution.
+        Returns the ``hbm_*`` field dict — EMPTY where the backend
+        exposes no stats (CPU): the fields are omitted, never faked as
+        0, and the degrade is announced once per server as
+        ``hbm_stats_unavailable``."""
+        if not self.armed:
+            return {}
+        dev = self._poll_device()
+        stats = None
+        try:
+            stats = dev.memory_stats() if dev is not None else None
+        except Exception:
+            stats = None
+        if not stats:
+            self._mem_unavailable = True
+            if not self._mem_unavailable_emitted:
+                self._mem_unavailable_emitted = True
+                self._do_emit(
+                    "hbm_stats_unavailable",
+                    reason="memory_stats_none",
+                    platform=str(getattr(dev, "platform", "") or ""),
+                )
+            return {}
+        self._mem_unavailable = False
+        used = int(stats.get("bytes_in_use", 0) or 0)
+        limit = int(
+            stats.get("bytes_limit")
+            or stats.get("bytes_reservable_limit")
+            or 0
+        )
+        peak = int(stats.get("peak_bytes_in_use", 0) or 0)
+        self._hbm_peak = max(self._hbm_peak, peak, used)
+        out = {
+            "hbm_used_bytes": used,
+            "hbm_peak_bytes": self._hbm_peak,
+        }
+        if limit > 0:
+            out["hbm_limit_bytes"] = limit
+            out["hbm_headroom_bytes"] = max(limit - used, 0)
+        comp: dict = {}
+        if self._components is not None:
+            try:
+                comp = dict(self._components())
+            except Exception:
+                comp = {}
+        attributed = 0
+        for name, v in comp.items():
+            v = int(v or 0)
+            out[f"hbm_{name}_bytes"] = v
+            attributed += v
+        if comp:
+            out["hbm_attributed_bytes"] = attributed
+            # Signed on purpose: a negative residual (attribution counts
+            # replicated copies the allocator shares) is as diagnostic
+            # as the positive leak the field exists to expose.
+            out["hbm_unattributed_bytes"] = used - attributed
+        return out
+
+    def hbm_headroom(self) -> Optional[int]:
+        """Last polled headroom, None where unavailable — the dedicated
+        gauge scrapes this and exports NaN rather than a fake 0."""
+        v = self._last_fields.get("hbm_headroom_bytes")
+        return int(v) if v is not None else None
+
+    # ----- surfacing -------------------------------------------------------
+
+    def heartbeat_fields(self, interval_s: float) -> dict:
+        """Drain the interval accumulators into the heartbeat's ledger
+        fields. Always returns the full utilization field set on an
+        armed ledger (zeros before any dispatch — no schema branch);
+        the ``hbm_*`` fields appear only when the backend supplies
+        memory stats. Disarmed → {} (the documented kill-switch
+        degrade)."""
+        if not self.armed:
+            return {}
+        i, self._i = self._i, self._fresh_interval()
+        interval_s = max(float(interval_s), 1e-9)
+        gap_ms = (i["gap_s"] / i["gaps"] * 1e3) if i["gaps"] else 0.0
+        fields = {
+            "mfu": round(i["flops"] / (interval_s * self.peak_flops()), 6),
+            "device_busy_frac": round(
+                min(i["busy_s"] / interval_s, 1.0), 4
+            ),
+            "dispatch_gap_ms": round(gap_ms, 4),
+            "dispatches_delta": i["dispatches"],
+        }
+        for p in self._gap_phases:
+            fields[f"dispatch_gap_{p}_ms"] = round(
+                (i["gap_attr"].get(p, 0.0) / i["gaps"] * 1e3)
+                if i["gaps"] else 0.0,
+                4,
+            )
+        fields.update(self.poll_memory())
+        self._last_fields = fields
+        return fields
+
+    def stats_fields(self) -> dict:
+        """The always-present ``stats()`` block: top-level
+        ``mfu`` / ``device_busy_frac`` / ``dispatch_gap_ms`` (last
+        heartbeat interval, 0.0 before the first or disarmed) plus the
+        ``devledger`` detail dict. Memory fields degrade by omission
+        inside the detail dict, mirroring the heartbeat."""
+        last = self._last_fields
+        detail = {
+            "armed": int(self.armed),
+            "dispatches": self._dispatches,
+            "retired": self._retired,
+            "cost_signatures": len(self._costs),
+            "cost_unavailable": self._cost_unavailable,
+            "peak_flops": self.peak_flops() if self.armed else 0.0,
+            "hbm_stats_available": int(
+                self.armed and not self._mem_unavailable and bool(
+                    [k for k in last if k.startswith("hbm_")]
+                )
+            ),
+        }
+        detail.update(
+            {k: v for k, v in last.items()
+             if k.startswith(("hbm_", "dispatch_gap_"))}
+        )
+        return {
+            "mfu": last.get("mfu", 0.0),
+            "device_busy_frac": last.get("device_busy_frac", 0.0),
+            "dispatch_gap_ms": last.get("dispatch_gap_ms", 0.0),
+            "devledger": detail,
+        }

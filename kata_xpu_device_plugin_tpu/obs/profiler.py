@@ -12,16 +12,31 @@ so an exception mid-window cannot leave the profiler running.
 
 jax is imported lazily at start time; a host-side process that never
 crosses the start step never loads it.
+
+``jax.profiler`` allows ONE trace per process — two hooks can
+legitimately race for it (an env-armed ``profiler_from_env`` window and
+the watchdog's auto-opened alert window, ISSUE 17's bug-risk fix). A
+hook that loses the race — the process-wide owner guard below, or
+``start_trace`` itself raising over a trace some other caller started
+raw — marks itself done and emits one ``profiler_busy`` event instead
+of raising out of the serving loop.
 """
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 from ..utils import log
 from . import events
 
 LOG = log.get("obs.profiler")
+
+# Process-wide trace ownership: jax.profiler.start_trace raises on a
+# second concurrent start, so hooks claim the slot under this lock
+# before touching jax at all.
+_trace_lock = threading.Lock()
+_trace_owner: Optional["ProfilerHook"] = None
 
 _ENV_DIR = ("KATATPU_OBS_PROFILE_DIR", "KATA_TPU_OBS_PROFILE_DIR")
 _ENV_START = ("KATATPU_OBS_PROFILE_START", "KATA_TPU_OBS_PROFILE_START")
@@ -72,11 +87,46 @@ class ProfilerHook:
         elif self._active and step >= self.stop_after:
             self.stop()
 
+    def _busy(self, reason: str) -> None:
+        """Lost the process-wide trace slot: give up this hook's window
+        for good (``_done`` — a later step must not retry into the same
+        running trace) and record why, instead of raising out of the
+        caller's loop."""
+        self._done = True
+        events.emit(
+            "profile", "profiler_busy",
+            dir=self.profile_dir, start_step=self.start_step,
+            stop_step=self.stop_after, reason=reason,
+        )
+        LOG.warning(
+            "profiler window skipped: trace already running",
+            extra=log.kv(dir=self.profile_dir, reason=reason),
+        )
+
     def _start(self) -> None:
+        global _trace_owner
+        with _trace_lock:
+            if _trace_owner is not None:
+                owner = _trace_owner
+            else:
+                owner, _trace_owner = None, self
+        if owner is not None:
+            self._busy(f"owned:{owner.profile_dir}")
+            return
         import jax
 
         os.makedirs(self.profile_dir, exist_ok=True)
-        jax.profiler.start_trace(self.profile_dir)
+        try:
+            jax.profiler.start_trace(self.profile_dir)
+        except Exception as exc:
+            # Someone started jax.profiler without a hook (bench
+            # --profile-dir, user code): same degrade, and the slot is
+            # released — this hook never owned a running trace.
+            with _trace_lock:
+                if _trace_owner is self:
+                    _trace_owner = None
+            self._busy(f"start_trace:{type(exc).__name__}")
+            return
         self._active = True
         LOG.info(
             "profiler trace started",
@@ -85,13 +135,19 @@ class ProfilerHook:
         )
 
     def stop(self) -> None:
+        global _trace_owner
         if not self._active:
             return
         import jax
 
-        jax.profiler.stop_trace()
-        self._active = False
-        self._done = True
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._active = False
+            self._done = True
+            with _trace_lock:
+                if _trace_owner is self:
+                    _trace_owner = None
         events.emit(
             "profile", "jax_trace",
             dir=self.profile_dir,

@@ -33,7 +33,18 @@ Alert kinds (``ALERT_KINDS``):
   interval prefix hit rate collapsed under real lookup traffic (the
   offload tier stopped earning its transfers);
 - ``tokens_regression``   — interval tokens/s fell below
-  ``regress_ratio`` × the watchdog's own healthy-period EWMA.
+  ``regress_ratio`` × the watchdog's own healthy-period EWMA;
+- ``device_idle``         — the device ledger's ``dispatch_gap_ms``
+  (retire→next-dispatch host gap, ISSUE 17) grew past ``gap_ratio`` ×
+  the watchdog's own healthy-period gap EWMA — the chips are waiting on
+  the host, and the heartbeat's ``dispatch_gap_*`` waterfall names the
+  thief;
+- ``hbm_headroom_collapse`` — sustained device-memory headroom below
+  ``headroom_floor_frac`` × the ledger's peak-usage watermark (the
+  workload has demonstrated it needs spikes the remaining headroom can
+  no longer absorb). Armed only on heartbeats that CARRY the ``hbm_*``
+  fields — the ledger omits them where the backend exposes no
+  ``memory_stats`` (CPU), so the rule self-disarms there.
 
 Each rule must breach ``sustain`` CONSECUTIVE heartbeats to fire (one
 slow round never pages anyone) and must be healthy ``clear`` consecutive
@@ -74,18 +85,25 @@ ENV_PREEMPT_STORM = "KATA_TPU_WATCHDOG_PREEMPT_STORM"
 ENV_RECOVERY_STORM = "KATA_TPU_WATCHDOG_RECOVERY_STORM"
 ENV_PROFILE_DIR = "KATA_TPU_WATCHDOG_PROFILE_DIR"
 ENV_PROFILE_STEPS = "KATA_TPU_WATCHDOG_PROFILE_STEPS"
+ENV_GAP_RATIO = "KATA_TPU_WATCHDOG_GAP_RATIO"
+ENV_GAP_MIN_MS = "KATA_TPU_WATCHDOG_GAP_MIN_MS"
+ENV_HEADROOM_FLOOR = "KATA_TPU_WATCHDOG_HEADROOM_FLOOR"
 
 ALERT_SLO_BURN = "slo_burn"
 ALERT_PREEMPT_STORM = "preempt_storm"
 ALERT_RECOVERY_STORM = "recovery_storm"
 ALERT_HOST_HIT_COLLAPSE = "host_hit_collapse"
 ALERT_TOKENS_REGRESSION = "tokens_regression"
+ALERT_DEVICE_IDLE = "device_idle"
+ALERT_HBM_HEADROOM_COLLAPSE = "hbm_headroom_collapse"
 ALERT_KINDS = (
     ALERT_SLO_BURN,
     ALERT_PREEMPT_STORM,
     ALERT_RECOVERY_STORM,
     ALERT_HOST_HIT_COLLAPSE,
     ALERT_TOKENS_REGRESSION,
+    ALERT_DEVICE_IDLE,
+    ALERT_HBM_HEADROOM_COLLAPSE,
 )
 
 
@@ -123,6 +141,17 @@ class WatchdogConfig:
     regress_ratio: float = 0.5
     ewma_alpha: float = 0.2
     min_samples: int = 4
+    # device_idle (ISSUE 17): the heartbeat's mean retire→next-dispatch
+    # gap over gap_ratio × the healthy-period gap EWMA (same
+    # ewma_alpha / min_samples discipline as tokens_regression, and the
+    # same fold-healthy-only rule — a sustained idle period must not
+    # become the baseline mid-incident). gap_min_ms floors the breach so
+    # ratios over microsecond-noise gaps never fire.
+    gap_ratio: float = 3.0
+    gap_min_ms: float = 1.0
+    # hbm_headroom_collapse (ISSUE 17): headroom below this fraction of
+    # the ledger's peak-usage watermark.
+    headroom_floor_frac: float = 0.1
     # Auto-profile window: "" disables; else a jax.profiler trace spans
     # the ``profile_steps`` heartbeats after the FIRST alert.
     profile_dir: str = ""
@@ -160,6 +189,10 @@ class WatchdogConfig:
             recovery_storm=max(1, _i(ENV_RECOVERY_STORM, d.recovery_storm)),
             profile_dir=os.environ.get(ENV_PROFILE_DIR, ""),
             profile_steps=max(1, _i(ENV_PROFILE_STEPS, d.profile_steps)),
+            gap_ratio=_f(ENV_GAP_RATIO, d.gap_ratio),
+            gap_min_ms=_f(ENV_GAP_MIN_MS, d.gap_min_ms),
+            headroom_floor_frac=_f(ENV_HEADROOM_FLOOR,
+                                   d.headroom_floor_frac),
         )
 
     def as_fields(self) -> dict:
@@ -206,6 +239,8 @@ class SLOBurnWatchdog:
         self._rules = {k: _RuleState() for k in ALERT_KINDS}
         self._rate_ewma: Optional[float] = None
         self._rate_samples = 0
+        self._gap_ewma: Optional[float] = None
+        self._gap_samples = 0
         self._observed = 0
         self._last_dump: Optional[str] = None
         self._prof: Optional[ProfilerHook] = None
@@ -298,6 +333,44 @@ class SLOBurnWatchdog:
                     + cfg.ewma_alpha * (rate - self._rate_ewma)
                 )
                 self._rate_samples += 1
+        # device_idle (ISSUE 17): heartbeats without the ledger's gap
+        # fields (kill switch, pre-ledger streams) leave the rule — and
+        # its baseline — untouched; intervals with no dispatches carry
+        # no gap signal either.
+        gap_v = hb.get("dispatch_gap_ms")
+        if gap_v is not None and int(hb.get("dispatches_delta") or 0) > 0:
+            gap = float(gap_v)
+            if (self._gap_samples >= cfg.min_samples
+                    and self._gap_ewma is not None
+                    and gap >= cfg.gap_min_ms
+                    and gap > cfg.gap_ratio * self._gap_ewma):
+                out[ALERT_DEVICE_IDLE] = (
+                    f"dispatch_gap_ms={gap:.2f} over {cfg.gap_ratio:g}x "
+                    f"ewma={self._gap_ewma:.2f}ms (floor "
+                    f"{cfg.gap_min_ms:g}ms)"
+                )
+            else:
+                # Same fold-healthy-only rule as tokens_regression: a
+                # sustained idle period must not become the baseline.
+                self._gap_ewma = (
+                    gap if self._gap_ewma is None
+                    else self._gap_ewma
+                    + cfg.ewma_alpha * (gap - self._gap_ewma)
+                )
+                self._gap_samples += 1
+        # hbm_headroom_collapse (ISSUE 17): armed only when the ledger
+        # supplied the memory fields — they degrade by OMISSION on
+        # backends without memory_stats, so absence self-disarms.
+        headroom = hb.get("hbm_headroom_bytes")
+        peak = hb.get("hbm_peak_bytes")
+        if headroom is not None and peak is not None and float(peak) > 0:
+            floor = cfg.headroom_floor_frac * float(peak)
+            if float(headroom) < floor:
+                out[ALERT_HBM_HEADROOM_COLLAPSE] = (
+                    f"headroom={int(headroom)}B under floor={int(floor)}B "
+                    f"({cfg.headroom_floor_frac:g} x peak="
+                    f"{int(float(peak))}B watermark)"
+                )
         return out
 
     # ----- the consumer API ------------------------------------------------

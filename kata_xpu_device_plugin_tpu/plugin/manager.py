@@ -465,6 +465,18 @@ class HeartbeatAggregator:
             metrics.guest_last_heartbeat_ts.labels(**labels).set(
                 float(ev.get("ts") or 0.0)
             )
+            # Device ledger (ISSUE 17): omission-preserving — a gauge
+            # child is created ONLY when the guest's heartbeat carries
+            # the field, so a CPU guest (no memory_stats) or a disarmed
+            # ledger exports nothing rather than a fake 0.
+            if "mfu" in ev:
+                metrics.guest_mfu.labels(**labels).set(
+                    float(ev.get("mfu") or 0.0)
+                )
+            if "hbm_headroom_bytes" in ev:
+                metrics.guest_hbm_headroom_bytes.labels(**labels).set(
+                    float(ev.get("hbm_headroom_bytes") or 0.0)
+                )
             if fresh:
                 metrics.guest_heartbeats_total.labels(**labels).inc()
             return 1

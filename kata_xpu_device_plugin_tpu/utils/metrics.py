@@ -108,6 +108,19 @@ guest_kv_host_occupancy = obs_metrics.gauge(
     "Guest host-RAM KV tier fill at the last heartbeat (0.0 tier off)",
     ["allocation", "server"],
 )
+guest_mfu = obs_metrics.gauge(
+    f"{NS}_guest_mfu",
+    "Guest model-FLOP utilization over the last heartbeat interval "
+    "(interval FLOPs / wall x public per-chip peak x tp)",
+    ["allocation", "server"],
+)
+guest_hbm_headroom_bytes = obs_metrics.gauge(
+    f"{NS}_guest_hbm_headroom_bytes",
+    "Guest device memory headroom (limit - in-use) at the last "
+    "heartbeat; NO child is created for guests whose backend exposes "
+    "no memory_stats (omission, never a fake 0)",
+    ["allocation", "server"],
+)
 guest_last_heartbeat_ts = obs_metrics.gauge(
     f"{NS}_guest_last_heartbeat_ts",
     "Unix timestamp of the guest's last heartbeat (alert on "

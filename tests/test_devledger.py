@@ -1,0 +1,610 @@
+"""Device-utilization & HBM ledger (ISSUE 17).
+
+Three layers under test. UNIT: the ledger's cost capture (once per
+executable signature, degrade-by-event when the backend reports no
+FLOPs), the gap attribution (phase shares sum to the measured gap BY
+CONSTRUCTION), the memory poll (omission — never fake zeros — on
+backends without ``memory_stats``; component attribution + signed
+residual when present), and the two new watchdog rules
+(``device_idle`` / ``hbm_headroom_collapse``) on the existing
+sustain/clear machinery. SERVER: the heartbeat carries the full
+utilization field set on CPU with the ``hbm_*`` fields omitted and the
+degrade announced, the ``KATA_TPU_DEVLEDGER=0`` kill switch, and greedy
+outputs BIT-IDENTICAL ledger on/off (``make devledger`` runs this file
+under both strict modes). HOST: the daemon aggregator re-exports
+``guest_mfu`` / ``guest_hbm_headroom_bytes`` omission-preserving (no
+gauge child for guests whose heartbeats lack the fields) and restart
+replay restores state without re-announcing history. Plus the ISSUE 17
+bug-risk fix: a second profiler hook racing an armed window degrades to
+one ``profiler_busy`` event instead of raising out of the loop."""
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu import obs
+from kata_xpu_device_plugin_tpu.guest.serving import (
+    LOOP_PHASES,
+    GenerationServer,
+    _PhaseClock,
+)
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import init_params
+from kata_xpu_device_plugin_tpu.obs import devledger as dl_mod
+from kata_xpu_device_plugin_tpu.obs import profiler as prof_mod
+from kata_xpu_device_plugin_tpu.obs.devledger import DeviceLedger
+from kata_xpu_device_plugin_tpu.obs.watchdog import (
+    ALERT_DEVICE_IDLE,
+    ALERT_HBM_HEADROOM_COLLAPSE,
+    SLOBurnWatchdog,
+    WatchdogConfig,
+)
+
+UTIL_FIELDS = (
+    {"mfu", "device_busy_frac", "dispatch_gap_ms", "dispatches_delta"}
+    | {f"dispatch_gap_{p}_ms" for p in LOOP_PHASES}
+)
+
+
+# ----- unit: ledger mechanics ------------------------------------------------
+
+
+class _FakeLowered:
+    def __init__(self, cost):
+        self._cost = cost
+
+    def cost_analysis(self):
+        return self._cost
+
+    def compile(self):
+        raise RuntimeError("unit ledger must not compile")
+
+
+class _FakeFn:
+    """Stands in for a jitted executable: counts lowerings."""
+
+    def __init__(self, cost):
+        self.cost = cost
+        self.lowered = 0
+
+    def lower(self, *args, **kwargs):
+        self.lowered += 1
+        return _FakeLowered(self.cost)
+
+
+class _FakeDevice:
+    platform = "cpu"
+    device_kind = "cpu"
+
+    def __init__(self, stats=None):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def _ledger(evs, **kw):
+    kw.setdefault("device", _FakeDevice())
+    kw.setdefault("gap_phases", LOOP_PHASES)
+    return DeviceLedger(
+        armed=True,
+        emit=lambda name, **f: evs.append({"name": name, **f}),
+        **kw,
+    )
+
+
+def test_cost_captured_once_per_signature():
+    evs = []
+    led = _ledger(evs)
+    fn = _FakeFn({"flops": 2.0e9, "bytes accessed": 1.0e6})
+    for _ in range(3):
+        led.on_dispatch(("plain", True, 2), fn, (), {})
+        led.note_retire()
+    assert fn.lowered == 1
+    led.on_dispatch(("plain", True, 4), fn, (), {})
+    led.note_retire()
+    assert fn.lowered == 2
+    st = led.stats_fields()["devledger"]
+    assert st["cost_signatures"] == 2
+    assert st["cost_unavailable"] == 0
+    assert st["dispatches"] == 4 and st["retired"] == 4
+    # MFU math: interval FLOPs over wall × peak (cpu 0.1 TFLOP/s × tp=1).
+    fields = led.heartbeat_fields(interval_s=2.0)
+    assert fields["mfu"] == round(4 * 2.0e9 / (2.0 * 0.1e12), 6)
+    assert fields["dispatches_delta"] == 4
+    assert not [e for e in evs if e["name"] == "cost_unavailable"]
+
+
+def test_cost_unavailable_degrades_once_per_signature():
+    evs = []
+    led = _ledger(evs)
+
+    class _Raising:
+        def lower(self, *a, **kw):
+            raise TypeError("no lowering for you")
+
+    fn = _Raising()
+    led.on_dispatch(("k1",), fn, (), {})
+    led.note_retire()
+    led.on_dispatch(("k1",), fn, (), {})  # cached None: never re-lowers
+    led.note_retire()
+    unavail = [e for e in evs if e["name"] == "cost_unavailable"]
+    assert len(unavail) == 1
+    assert unavail[0]["reason"].startswith("lower_failed:TypeError")
+    assert unavail[0]["signature"] == repr(("k1",))
+    fields = led.heartbeat_fields(interval_s=1.0)
+    assert fields["mfu"] == 0.0  # degraded, not faked
+    assert fields["device_busy_frac"] >= 0.0
+    assert led.stats_fields()["devledger"]["cost_unavailable"] == 1
+
+
+def test_no_flops_cost_degrades():
+    evs = []
+    led = _ledger(evs)
+
+    class _Lowered:
+        def cost_analysis(self):
+            return {"bytes accessed": 5.0}  # no flops key
+
+        def compile(self):
+            raise RuntimeError("backend refuses")
+
+    class _Fn:
+        def lower(self, *a, **kw):
+            return _Lowered()
+
+    led.on_dispatch(("k",), _Fn(), (), {})
+    assert [e["reason"] for e in evs if e["name"] == "cost_unavailable"] \
+        == ["no_flops"]
+
+
+def test_gap_attribution_sums_to_gap_exactly():
+    clock = _PhaseClock(armed=True)
+    evs = []
+    led = _ledger(evs, clock=clock, gap_phases=LOOP_PHASES)
+    fn = _FakeFn({"flops": 1.0e6})
+    led.on_dispatch(("k",), fn, (), {})
+    led.note_retire()
+    # Host work between retire and the next dispatch, split across
+    # phases the clock knows plus untracked time (→ "other").
+    clock.push("admit")
+    time.sleep(0.004)
+    clock.pop()
+    time.sleep(0.002)  # untracked
+    clock.push("host_transfer")
+    time.sleep(0.003)
+    clock.pop()
+    led.on_dispatch(("k",), fn, (), {})
+    led.note_retire()
+    fields = led.heartbeat_fields(interval_s=1.0)
+    gap = fields["dispatch_gap_ms"]
+    assert gap > 0
+    parts = {p: fields[f"dispatch_gap_{p}_ms"] for p in LOOP_PHASES}
+    # Shares sum to the measured gap by construction (rescale +
+    # residual→other); tolerance is the 4-decimal field rounding only.
+    assert abs(sum(parts.values()) - gap) <= 1e-3 * len(parts)
+    assert parts["admit"] > 0
+    assert parts["host_transfer"] > 0
+    assert parts["other"] > 0  # the untracked sleep
+    assert parts["dispatch"] == 0.0
+
+
+def test_first_dispatch_has_no_gap():
+    evs = []
+    led = _ledger(evs, clock=_PhaseClock(armed=True),
+                  gap_phases=LOOP_PHASES)
+    led.on_dispatch(("k",), _FakeFn({"flops": 1.0}), (), {})
+    fields = led.heartbeat_fields(interval_s=1.0)
+    assert fields["dispatch_gap_ms"] == 0.0  # no retire→dispatch window yet
+
+
+def test_memory_poll_omits_fields_and_announces_once():
+    evs = []
+    led = _ledger(evs, device=_FakeDevice(stats=None))
+    assert led.poll_memory() == {}
+    assert led.poll_memory() == {}
+    unavail = [e for e in evs if e["name"] == "hbm_stats_unavailable"]
+    assert len(unavail) == 1
+    assert unavail[0]["reason"] == "memory_stats_none"
+    fields = led.heartbeat_fields(interval_s=1.0)
+    assert UTIL_FIELDS <= set(fields)  # full util set, zeros included
+    assert not [k for k in fields if k.startswith("hbm_")]
+    assert led.hbm_headroom() is None
+    assert led.stats_fields()["devledger"]["hbm_stats_available"] == 0
+
+
+def test_memory_poll_attributes_components_and_tracks_watermark():
+    evs = []
+    dev = _FakeDevice(stats={
+        "bytes_in_use": 1000, "bytes_limit": 4000,
+        "peak_bytes_in_use": 1200,
+    })
+    led = _ledger(
+        evs, device=dev,
+        components=lambda: {"params": 600, "kv_arena": 300,
+                            "prefix_store": 0},
+    )
+    out = led.poll_memory()
+    assert out["hbm_used_bytes"] == 1000
+    assert out["hbm_limit_bytes"] == 4000
+    assert out["hbm_headroom_bytes"] == 3000
+    assert out["hbm_peak_bytes"] == 1200
+    assert out["hbm_params_bytes"] == 600
+    assert out["hbm_kv_arena_bytes"] == 300
+    assert out["hbm_attributed_bytes"] == 900
+    assert out["hbm_unattributed_bytes"] == 100  # the visible residual
+    # Watermark is cumulative across polls, even when the backend's own
+    # peak resets.
+    dev._stats = {"bytes_in_use": 3500, "bytes_limit": 4000,
+                  "peak_bytes_in_use": 0}
+    out = led.poll_memory()
+    assert out["hbm_peak_bytes"] == 3500
+    assert out["hbm_headroom_bytes"] == 500
+    fields = led.heartbeat_fields(interval_s=1.0)
+    assert led.hbm_headroom() == fields["hbm_headroom_bytes"]
+    assert not [e for e in evs if e["name"] == "hbm_stats_unavailable"]
+
+
+def test_disarmed_ledger_is_inert():
+    evs = []
+    led = DeviceLedger(armed=False,
+                       emit=lambda name, **f: evs.append(name))
+    led.on_dispatch(("k",), _FakeFn({"flops": 1.0}), (), {})
+    led.note_retire()
+    assert led.heartbeat_fields(interval_s=1.0) == {}
+    assert led.poll_memory() == {}
+    st = led.stats_fields()
+    assert st["mfu"] == 0.0 and st["devledger"]["armed"] == 0
+    assert evs == []
+
+
+# ----- unit: watchdog rules --------------------------------------------------
+
+
+def _hb(**kw):
+    base = dict(
+        round=1, interval_rounds=4, interval_s=1.0, tokens_per_s=100.0,
+        itl_p99_ms=10.0, preemptions_delta=0, recoveries_delta=0,
+        prefix_hits_delta=0, prefix_misses_delta=0, kv_host_tokens=0,
+    )
+    base.update(kw)
+    return base
+
+
+def _watchdog(cfg, evs, dumps=None):
+    dump = (
+        (lambda reason: dumps.append(reason) or f"/dev/null/{reason}")
+        if dumps is not None else None
+    )
+    return SLOBurnWatchdog(
+        cfg,
+        emit=lambda name, **f: evs.append({"name": name, **f}),
+        dump=dump,
+    )
+
+
+def test_device_idle_sustain_clear_no_refire():
+    evs, dumps = [], []
+    wd = _watchdog(
+        WatchdogConfig(slo_ms=1000.0, sustain=2, clear=2,
+                       min_samples=2, gap_ratio=3.0, gap_min_ms=1.0),
+        evs, dumps,
+    )
+    healthy = _hb(dispatch_gap_ms=2.0, dispatches_delta=4)
+    idle = _hb(dispatch_gap_ms=50.0, dispatches_delta=4)
+    # Baseline builds on healthy samples only.
+    assert wd.observe(healthy) == []
+    assert wd.observe(healthy) == []
+    assert wd.observe(idle) == []                  # streak 1 < sustain
+    assert wd.observe(idle) == [ALERT_DEVICE_IDLE]
+    assert wd.observe(idle) == []                  # active: no refire
+    assert wd.active == (ALERT_DEVICE_IDLE,)
+    # The sustained idle period must NOT have been folded into the
+    # baseline: one healthy streak clears at the original EWMA.
+    wd.observe(healthy)
+    assert wd.observe(healthy) == []
+    assert wd.active == ()
+    clears = [e for e in evs if e["name"] == "watchdog_clear"]
+    assert [e["alert"] for e in clears] == [ALERT_DEVICE_IDLE]
+    assert dumps  # the alert dumped the flight ring
+
+
+def test_device_idle_self_disarms_without_ledger_fields():
+    evs = []
+    wd = _watchdog(
+        WatchdogConfig(slo_ms=1000.0, sustain=1, min_samples=1,
+                       gap_ratio=2.0, gap_min_ms=0.5),
+        evs,
+    )
+    wd.observe(_hb(dispatch_gap_ms=1.0, dispatches_delta=2))
+    # Kill switch / pre-ledger stream: no gap fields → rule untouched.
+    assert wd.observe(_hb()) == []
+    # An interval with zero dispatches carries no gap signal either.
+    assert wd.observe(_hb(dispatch_gap_ms=500.0, dispatches_delta=0)) == []
+    # Sub-floor gaps never fire however large the ratio.
+    assert wd.observe(_hb(dispatch_gap_ms=0.4, dispatches_delta=2)) == []
+
+
+def test_hbm_headroom_collapse_rule():
+    evs, dumps = [], []
+    wd = _watchdog(
+        WatchdogConfig(slo_ms=1000.0, sustain=2, clear=1,
+                       headroom_floor_frac=0.1),
+        evs, dumps,
+    )
+    low = _hb(hbm_headroom_bytes=50, hbm_peak_bytes=1000)
+    ok = _hb(hbm_headroom_bytes=500, hbm_peak_bytes=1000)
+    assert wd.observe(low) == []
+    assert wd.observe(low) == [ALERT_HBM_HEADROOM_COLLAPSE]
+    alert = [e for e in evs if e["name"] == "watchdog_alert"][-1]
+    assert "floor=100B" in alert["reason"]
+    assert wd.observe(ok) == []
+    assert wd.active == ()
+    # Omission self-disarms (CPU guests): the active alert would heal,
+    # and a fresh watchdog never arms on field-less heartbeats.
+    wd2 = _watchdog(
+        WatchdogConfig(slo_ms=1000.0, sustain=1, headroom_floor_frac=0.9),
+        [],
+    )
+    assert wd2.observe(_hb()) == []
+    assert wd2.observe(_hb(hbm_headroom_bytes=0, hbm_peak_bytes=0)) == []
+
+
+# ----- unit: profiler double-start fix (ISSUE 17 bug-risk) -------------------
+
+
+def test_profiler_second_hook_degrades_to_busy_event(tmp_path,
+                                                     capture_events):
+    d1, d2, d3 = (str(tmp_path / n) for n in ("t1", "t2", "t3"))
+
+    def run():
+        h1 = prof_mod.ProfilerHook(d1, start_step=1, num_steps=2)
+        h2 = prof_mod.ProfilerHook(d2, start_step=1, num_steps=2)
+        h1.on_step(1)          # wins the process-wide trace slot
+        h2.on_step(1)          # loses: degrade, NOT a raise
+        assert h2._done and not h2._active
+        h2.on_step(2)          # done: never retries into the live trace
+        h1.on_step(2)          # window closes, slot released
+        assert not h1._active
+        h3 = prof_mod.ProfilerHook(d3, start_step=1, num_steps=2)
+        h3.on_step(1)          # slot free again
+        h3.stop()
+
+    _, events = capture_events(run)
+    busy = [e for e in events if e.get("name") == "profiler_busy"]
+    assert len(busy) == 1
+    assert busy[0]["dir"] == d2
+    assert busy[0]["reason"] == f"owned:{d1}"
+    traces = [e for e in events if e.get("name") == "jax_trace"]
+    assert [t["dir"] for t in traces] == [d1, d3]
+
+
+def test_profiler_raw_trace_collision_degrades(tmp_path, capture_events):
+    # Someone started jax.profiler WITHOUT a hook (bench --profile-dir):
+    # start_trace itself raises; the hook releases the slot and degrades.
+    jax.profiler.start_trace(str(tmp_path / "raw"))
+    try:
+        def run():
+            h = prof_mod.ProfilerHook(str(tmp_path / "hook"),
+                                      start_step=1, num_steps=2)
+            h.on_step(1)
+            assert h._done and not h._active
+        _, events = capture_events(run)
+    finally:
+        jax.profiler.stop_trace()
+    busy = [e for e in events if e.get("name") == "profiler_busy"]
+    assert len(busy) == 1
+    assert busy[0]["reason"].startswith("start_trace:")
+    assert prof_mod._trace_owner is None  # slot not leaked
+
+
+# ----- server: heartbeat + stats + bit-identity ------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _prompts(cfg, lengths, seed=5):
+    key = jax.random.PRNGKey(seed)
+    return [
+        np.asarray(
+            jax.random.randint(jax.random.fold_in(key, i), (n,), 0,
+                               cfg.vocab_size),
+            np.int32,
+        )
+        for i, n in enumerate(lengths)
+    ]
+
+
+def _server(params, cfg, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 48)
+    kw.setdefault("chunk", 2)
+    kw.setdefault("kv_quant", False)
+    return GenerationServer(params, cfg, **kw)
+
+
+def test_server_heartbeat_carries_ledger_fields(model, capture_events):
+    cfg, params = model
+
+    def run():
+        srv = _server(params, cfg, heartbeat_rounds=2)
+        for p in _prompts(cfg, [6, 8, 6, 8]):
+            srv.submit(p, 8)
+        srv.run()
+        return srv
+
+    srv, events = capture_events(run)
+    hbs = [e for e in events if e.get("name") == "serving_heartbeat"]
+    assert hbs
+    for hb in hbs:
+        # Full utilization field set on every heartbeat — no schema
+        # branch on what the interval happened to observe.
+        assert UTIL_FIELDS <= set(hb)
+        # CPU: memory fields degrade by OMISSION, never fake zeros.
+        assert not [k for k in hb if k.startswith("hbm_")]
+        # ACCEPTANCE: the phase-attributed gap shares sum to the mean
+        # inter-dispatch gap within 5% (the residual→other +
+        # rescale-to-gap construction makes this exact up to field
+        # rounding).
+        parts = sum(hb[f"dispatch_gap_{p}_ms"] for p in LOOP_PHASES)
+        gap = hb["dispatch_gap_ms"]
+        assert abs(parts - gap) <= max(0.05 * gap, 1e-3 * len(LOOP_PHASES))
+    assert any(hb["dispatches_delta"] > 0 for hb in hbs)
+    assert any(hb["device_busy_frac"] > 0 for hb in hbs)
+    # The degrade is announced exactly once per server.
+    unavail = [e for e in events if e.get("name") == "hbm_stats_unavailable"]
+    assert len(unavail) == 1
+    # serving_config carries the armed flag.
+    scfg = [e for e in events if e.get("name") == "serving_config"]
+    assert scfg and scfg[0]["devledger"] == 1
+    # stats(): always-present top-level numerics + the detail dict.
+    st = srv.stats()
+    assert st["mfu"] >= 0.0
+    assert 0.0 <= st["device_busy_frac"] <= 1.0
+    assert st["dispatch_gap_ms"] >= 0.0
+    led = st["devledger"]
+    assert led["armed"] == 1
+    assert led["dispatches"] > 0 and led["retired"] == led["dispatches"]
+    assert led["cost_signatures"] >= 1
+    assert led["peak_flops"] > 0
+    assert led["hbm_stats_available"] == 0  # CPU
+
+
+def test_server_overlapped_rounds_feed_ledger(model, capture_events):
+    cfg, params = model
+
+    def run():
+        srv = _server(params, cfg, heartbeat_rounds=2, overlap=True)
+        for p in _prompts(cfg, [6, 8, 6]):
+            srv.submit(p, 8)
+        srv.run()
+        return srv
+
+    srv, _events = capture_events(run)
+    led = srv.stats()["devledger"]
+    assert led["dispatches"] > 0
+    # Pipelined retires drain the pending FIFO completely on a clean run.
+    assert led["retired"] == led["dispatches"]
+
+
+def test_devledger_kill_switch(model, capture_events, monkeypatch):
+    cfg, params = model
+    monkeypatch.setenv(dl_mod.ENV_DEVLEDGER, "0")
+
+    def run():
+        srv = _server(params, cfg, heartbeat_rounds=2)
+        for p in _prompts(cfg, [6, 8]):
+            srv.submit(p, 6)
+        srv.run()
+        return srv
+
+    srv, events = capture_events(run)
+    hbs = [e for e in events if e.get("name") == "serving_heartbeat"]
+    assert hbs
+    assert all("mfu" not in hb for hb in hbs)  # disarmed: fields absent
+    scfg = [e for e in events if e.get("name") == "serving_config"]
+    assert scfg and scfg[0]["devledger"] == 0
+    st = srv.stats()
+    assert st["mfu"] == 0.0 and st["devledger"]["armed"] == 0
+    assert not [e for e in events if e.get("name") == "cost_unavailable"]
+
+
+def test_greedy_bit_identical_ledger_on_off(model, monkeypatch):
+    # The ledger is pure host arithmetic + aval-only lowering: greedy
+    # outputs must be bit-identical armed vs disarmed (run under both
+    # strict modes by `make devledger`).
+    cfg, params = model
+
+    def serve(env: str):
+        monkeypatch.setenv(dl_mod.ENV_DEVLEDGER, env)
+        srv = _server(params, cfg, heartbeat_rounds=2)
+        rids = [srv.submit(p, 8) for p in _prompts(cfg, [6, 8, 6, 8])]
+        out = srv.run()
+        return [list(map(int, out[r])) for r in rids]
+
+    assert serve("1") == serve("0")
+
+
+# ----- host: aggregator re-export -------------------------------------------
+
+
+def _write_events(path, events):
+    with open(path, "a", encoding="utf-8") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+
+
+def _guest_hb(ts, server="server0", chips="0,1", **kw):
+    base = {
+        "ts": ts, "kind": "serving", "name": "serving_heartbeat",
+        "server": server, "chips": chips, "tokens_per_s": 10.0,
+        "itl_p99_ms": 5.0, "queued": 0, "batch_occupancy": 0.5,
+        "kv_pool_occupancy": 0.0, "kv_host_occupancy": 0.0,
+    }
+    base.update(kw)
+    return base
+
+
+def test_aggregator_reexports_ledger_gauges_omission_preserving(tmp_path):
+    from kata_xpu_device_plugin_tpu.plugin.manager import (
+        HeartbeatAggregator,
+    )
+    from kata_xpu_device_plugin_tpu.utils import metrics
+
+    d = str(tmp_path)
+    now = time.time()
+    _write_events(os.path.join(d, "guest_0-1.jsonl"), [
+        _guest_hb(now, mfu=0.37, hbm_headroom_bytes=123456,
+                  device_busy_frac=0.9),
+    ])
+    # A CPU guest (or pre-ledger stream): NO ledger fields at all.
+    _write_events(os.path.join(d, "guest_2.jsonl"), [
+        _guest_hb(now, server="cpu0", chips="2"),
+    ])
+    agg = HeartbeatAggregator(d, poll_interval_s=0.01)
+    assert agg.poll_once() == 2
+    assert metrics.guest_mfu.labels(
+        allocation="0,1", server="server0"
+    )._value.get() == 0.37
+    assert metrics.guest_hbm_headroom_bytes.labels(
+        allocation="0,1", server="server0"
+    )._value.get() == 123456
+    # Omission-preserving: the field-less guest got NO child — a fake 0
+    # would read as "out of memory" on the mfu-style dashboards.
+    assert ("2", "cpu0") not in metrics.guest_mfu._metrics
+    assert ("2", "cpu0") not in metrics.guest_hbm_headroom_bytes._metrics
+
+
+def test_aggregator_restart_replay_restores_ledger_state(tmp_path):
+    from kata_xpu_device_plugin_tpu.plugin.manager import (
+        HeartbeatAggregator,
+    )
+    from kata_xpu_device_plugin_tpu.utils import metrics
+
+    d = str(tmp_path)
+    stale_ts = time.time() - 3600.0
+    path = os.path.join(d, "guest_4-5.jsonl")
+    _write_events(path, [
+        _guest_hb(stale_ts, server="s1", chips="4,5", mfu=0.11,
+                  hbm_headroom_bytes=777),
+    ])
+    labels = {"allocation": "4,5", "server": "s1"}
+    before = metrics.guest_heartbeats_total.labels(**labels)._value.get()
+    agg = HeartbeatAggregator(d)  # "restarted" daemon: t0 > event ts
+    assert agg.poll_once() == 1
+    # Replay restored STATE (the gauges) ...
+    assert metrics.guest_mfu.labels(**labels)._value.get() == 0.11
+    assert metrics.guest_hbm_headroom_bytes.labels(
+        **labels)._value.get() == 777
+    # ... without re-announcing history (no counter increment).
+    assert metrics.guest_heartbeats_total.labels(
+        **labels)._value.get() == before

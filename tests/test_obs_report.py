@@ -26,12 +26,20 @@ def _events():
          "server": "server0", "round": 4, "tokens_per_s": 100.0,
          "itl_p99_ms": 9.0, "batch_occupancy": 1.0,
          "kv_pool_occupancy": 0.5, "kv_host_occupancy": 0.0, "queued": 2,
-         "phase_admit_s": 0.2, "phase_dispatch_s": 0.5},
+         "phase_admit_s": 0.2, "phase_dispatch_s": 0.5,
+         # Device ledger fields (ISSUE 17) — schema v2 requires at
+         # least one server whose heartbeats carry them.
+         "mfu": 0.1, "device_busy_frac": 0.8, "dispatch_gap_ms": 1.0,
+         "dispatches_delta": 4, "dispatch_gap_admit_ms": 0.6,
+         "dispatch_gap_other_ms": 0.4, "hbm_headroom_bytes": 1000},
         {"ts": 12.0, "kind": "serving", "name": "serving_heartbeat",
          "server": "server0", "round": 8, "tokens_per_s": 200.0,
          "itl_p99_ms": 7.0, "batch_occupancy": 0.5,
          "kv_pool_occupancy": 0.25, "kv_host_occupancy": 0.0, "queued": 0,
-         "phase_admit_s": 0.1, "phase_dispatch_s": 0.6},
+         "phase_admit_s": 0.1, "phase_dispatch_s": 0.6,
+         "mfu": 0.3, "device_busy_frac": 1.0, "dispatch_gap_ms": 0.5,
+         "dispatches_delta": 8, "dispatch_gap_admit_ms": 0.3,
+         "dispatch_gap_other_ms": 0.2, "hbm_headroom_bytes": 500},
         {"ts": 12.5, "kind": "serving", "name": "request_trace",
          "server": "server0", "rid": 7, "outcome": "completed",
          "wall_s": 2.5, "tokens": 64, "prompt_len": 128, "replays": 0,
@@ -63,6 +71,18 @@ def test_build_report_sections():
     assert hb["tokens_per_s"] == {"min": 100.0, "mean": 150.0, "max": 200.0}
     assert hb["loop_phase_s"] == {"admit": 0.3, "dispatch": 1.1}
     assert len(hb["timeline"]) == 2
+    # Utilization summary (ISSUE 17): min/mean/max over the carrying
+    # heartbeats, gap-phase means weighted by dispatches_delta
+    # ((4*0.6 + 8*0.3)/12 = 0.4 for admit), headroom present because
+    # the stream carried it.
+    util = hb["utilization"]
+    assert util["count"] == 2
+    assert util["mfu"] == {"min": 0.1, "mean": 0.2, "max": 0.3}
+    assert util["dispatch_gap_ms"]["max"] == 1.0
+    assert util["gap_phase_ms"] == {
+        "admit": 0.4, "other": round((4 * 0.4 + 8 * 0.2) / 12, 4)
+    }
+    assert util["hbm_headroom_bytes"]["min"] == 500
     # top=1 keeps only the SLOWEST request; the failed 4.0s one wins.
     assert rep["requests"]["total_traces"] == 2
     (slow,) = rep["requests"]["slowest"]

@@ -60,6 +60,19 @@ HEADLINE_METRICS = (
     # fixed per-chip budget (ISSUE 14): the sessions-per-chip capacity
     # number the blocks layout + host tier exist to move.
     "serving_kv_sessions",
+    # Fraction of the heartbeat interval covered by in-flight decode
+    # rounds (ISSUE 17): the device-side "are the chips actually
+    # working" headline the ledger exists to move.
+    "serving_device_busy_frac",
+)
+
+# Lower-is-better INFO metrics (ISSUE 17): direction-aware statuses
+# ("info-better" when the value DROPPED past the threshold,
+# "info-worse" when it rose, "info" otherwise) — trend context, never a
+# regression gate and never counted in the headline summary line
+# (host-gap means at smoke-tiny round times are too noisy to block on).
+INFO_LOWER_IS_BETTER = (
+    "serving_dispatch_gap_ms",
 )
 
 DEFAULT_THRESHOLD = 0.10  # 10%
@@ -111,7 +124,9 @@ def compare(old: dict, new: dict,
     beyond threshold), ``improved`` (headline, rose beyond threshold),
     ``flat`` (headline, bit-identical), ``layout`` (the metric's family
     flipped a ``*_layout`` config field between the banks — an
-    intentional A/B, never a regression), or ``""`` (context)."""
+    intentional A/B, never a regression), ``info-better`` /
+    ``info-worse`` / ``info`` (lower-is-better info metrics — direction
+    flipped, never gating), or ``""`` (context)."""
     om, nm = numeric_metrics(old), numeric_metrics(new)
     flip_prefixes = tuple(
         k[: -len("layout")] for k in layout_flips(old, new)
@@ -131,6 +146,13 @@ def compare(old: dict, new: dict,
             if status in ("regression", "improved") and any(
                     k.startswith(p) for p in flip_prefixes):
                 status = "layout"
+        elif k in INFO_LOWER_IS_BETTER:
+            if delta < -threshold:
+                status = "info-better"
+            elif delta > threshold:
+                status = "info-worse"
+            else:
+                status = "info"
         rows.append({
             "metric": k,
             "old": a,

@@ -10,6 +10,10 @@ readable report with four sections:
 - **heartbeat timelines** — per-server tokens/s, occupancy, queue depth
   and ITL over the ``serving_heartbeat`` stream, with interval summaries
   and a downsampled timeline table;
+- **utilization** — the device ledger's heartbeat fields (ISSUE 17):
+  MFU / device-busy summaries, the dispatch-gap waterfall (which loop
+  phase owns the retire→dispatch host gap), and HBM headroom where the
+  stream carries memory fields;
 - **top-N slowest requests** — ``request_trace`` events ranked by wall
   time, each with its PR 11 phase ledger (queue/prefill/decode/...)
   spelled out;
@@ -36,7 +40,7 @@ from typing import Iterable, Optional
 
 from kata_xpu_device_plugin_tpu.obs import events as obs_events
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 # Required report shape: top-level keys and the per-section fields the
 # --check gate pins. Adding a field is fine; REMOVING or renaming one of
@@ -48,7 +52,7 @@ REQUIRED_TOP = (
 )
 REQUIRED_HEARTBEAT_FIELDS = (
     "count", "tokens_per_s", "itl_p99_ms", "batch_occupancy",
-    "kv_pool_occupancy", "queued", "timeline",
+    "kv_pool_occupancy", "queued", "timeline", "utilization",
 )
 REQUIRED_REQUEST_FIELDS = ("rid", "outcome", "wall_s", "tokens", "phases")
 REQUIRED_INCIDENT_FIELDS = ("alerts", "clears", "event_counts")
@@ -78,14 +82,14 @@ def _downsample(rows: list, limit: int = 48) -> list:
     return [rows[round(i * step)] for i in range(limit)]
 
 
-def _minmeanmax(vals: Iterable[float]) -> dict:
+def _minmeanmax(vals: Iterable[float], digits: int = 3) -> dict:
     vals = [float(v) for v in vals]
     if not vals:
         return {"min": 0.0, "mean": 0.0, "max": 0.0}
     return {
-        "min": round(min(vals), 3),
-        "mean": round(sum(vals) / len(vals), 3),
-        "max": round(max(vals), 3),
+        "min": round(min(vals), digits),
+        "mean": round(sum(vals) / len(vals), digits),
+        "max": round(max(vals), digits),
     }
 
 
@@ -146,6 +150,7 @@ def build_report(events: list[dict], source: str = "",
                 "kv_pool_occupancy": hb.get("kv_pool_occupancy", 0.0),
                 "kv_host_occupancy": hb.get("kv_host_occupancy", 0.0),
                 "queued": hb.get("queued", 0),
+                "mfu": hb.get("mfu", 0.0),
             }
             for hb in hbs
         ])
@@ -157,8 +162,50 @@ def build_report(events: list[dict], source: str = "",
                     phase_totals[phase] = (
                         phase_totals.get(phase, 0.0) + float(v or 0.0)
                     )
+        # Device ledger fields (ISSUE 17). Omission-honest: the summaries
+        # cover only heartbeats that CARRY the fields (disarmed ledgers
+        # and pre-PR streams fold to count 0, not fake zeros), and
+        # hbm_headroom_bytes appears only when the stream did. The
+        # per-phase gap waterfall weights each interval's per-gap means
+        # by its dispatch count so busy intervals dominate.
+        util_hbs = [hb for hb in hbs if "mfu" in hb]
+        gap_phase: dict[str, float] = {}
+        gap_w = 0.0
+        for hb in util_hbs:
+            w = float(hb.get("dispatches_delta") or 0.0)
+            if w <= 0:
+                continue
+            gap_w += w
+            for k, v in hb.items():
+                if (k.startswith("dispatch_gap_") and k.endswith("_ms")
+                        and k != "dispatch_gap_ms"):
+                    p = k[len("dispatch_gap_"):-len("_ms")]
+                    gap_phase[p] = gap_phase.get(p, 0.0) + float(v or 0.0) * w
+        utilization = {
+            "count": len(util_hbs),
+            "mfu": _minmeanmax(
+                (hb.get("mfu", 0.0) for hb in util_hbs), digits=6
+            ),
+            "device_busy_frac": _minmeanmax(
+                hb.get("device_busy_frac", 0.0) for hb in util_hbs
+            ),
+            "dispatch_gap_ms": _minmeanmax(
+                hb.get("dispatch_gap_ms", 0.0) for hb in util_hbs
+            ),
+            "gap_phase_ms": {
+                p: round(v / gap_w, 4)
+                for p, v in sorted(gap_phase.items())
+            } if gap_w else {},
+        }
+        headroom = [
+            hb["hbm_headroom_bytes"] for hb in hbs
+            if "hbm_headroom_bytes" in hb
+        ]
+        if headroom:
+            utilization["hbm_headroom_bytes"] = _minmeanmax(headroom)
         hb_sections[server] = {
             "count": len(hbs),
+            "utilization": utilization,
             "tokens_per_s": _minmeanmax(
                 hb.get("tokens_per_s", 0.0) for hb in hbs
             ),
@@ -259,8 +306,17 @@ def check_schema(report: dict, require_data: bool = False) -> list[str]:
     if require_data:
         if not report["phases"]:
             errors.append("empty phase waterfall (no span events parsed)")
-        if not report["heartbeats"].get("servers"):
+        servers = report["heartbeats"].get("servers")
+        if not servers:
             errors.append("no serving_heartbeat events parsed")
+        elif not any(
+            sec.get("utilization", {}).get("count")
+            for sec in servers.values()
+        ):
+            errors.append(
+                "no utilization fields in any heartbeat (device ledger "
+                "disarmed or absent from the smoke stream)"
+            )
     return errors
 
 
@@ -321,16 +377,62 @@ def render_markdown(report: dict) -> str:
             out.append(f"loop time: {parts}")
         out.append("")
         out.append(
-            "| round | tok/s | ITL p99 ms | batch | pool | host | queued |"
+            "| round | tok/s | ITL p99 ms | batch | pool | host | queued "
+            "| mfu |"
         )
-        out.append("|---:|---:|---:|---:|---:|---:|---:|")
+        out.append("|---:|---:|---:|---:|---:|---:|---:|---:|")
         for row in sec["timeline"]:
             out.append(
                 f"| {row['round']} | {row['tokens_per_s']} "
                 f"| {row['itl_p99_ms']} | {row['batch_occupancy']} "
                 f"| {row['kv_pool_occupancy']} | {row['kv_host_occupancy']} "
-                f"| {row['queued']} |"
+                f"| {row['queued']} | {row.get('mfu', 0.0)} |"
             )
+
+    out.append("")
+    out.append("## Utilization")
+    any_util = False
+    for server, sec in servers.items():
+        util = sec.get("utilization") or {}
+        if not util.get("count"):
+            continue
+        any_util = True
+        mfu = util["mfu"]
+        busy = util["device_busy_frac"]
+        gap = util["dispatch_gap_ms"]
+        out.append("")
+        out.append(
+            f"### {server} — MFU {mfu['mean']} mean / {mfu['max']} peak, "
+            f"device busy {busy['mean']} mean, dispatch gap "
+            f"{gap['mean']}ms mean"
+        )
+        gp = util.get("gap_phase_ms") or {}
+        shown = {p: v for p, v in gp.items() if v > 0}
+        if shown:
+            out.append("")
+            out.append("dispatch-gap waterfall (ms per gap, by loop phase):")
+            out.append("```")
+            longest = max(shown.values()) or 1.0
+            width = max(len(p) for p in shown)
+            for p, v in sorted(shown.items(), key=lambda kv: -kv[1]):
+                out.append(
+                    f"{p:<{width}}  {_bar(v / longest, 24)} {v:9.4f}ms"
+                )
+            out.append("```")
+        hr = util.get("hbm_headroom_bytes")
+        if hr:
+            out.append(
+                f"HBM headroom bytes {hr['min']}/{hr['mean']}/{hr['max']} "
+                f"(min/mean/max)"
+            )
+        else:
+            out.append(
+                "_no hbm_* fields in the stream (backend exposes no "
+                "memory_stats)_"
+            )
+    if not any_util:
+        out.append("")
+        out.append("_no utilization fields in the heartbeat stream_")
 
     out.append("")
     out.append("## Slowest requests")

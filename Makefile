@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint analyze race verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused kv-layout devledger eval eval-kv demo dryrun image clean deploy obs-check obs-report
+.PHONY: all build proto lint analyze census race verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused kv-layout devledger eval eval-kv demo dryrun image clean deploy obs-check obs-report
 
 all: build
 
@@ -38,15 +38,34 @@ lint:
 	  mypy; \
 	else echo "lint: mypy not installed — skipped (pip install mypy)"; fi
 
-# jaxguard (ISSUE 4, extended ISSUE 16): interprocedural dataflow
+# jaxguard (ISSUE 4, extended ISSUE 16/19): interprocedural dataflow
 # analysis over the package + bench/scripts — implicit host syncs on hot
 # paths (JG101), use-after-donation (JG102), tracer leaks (JG103),
-# recompile hazards (JG104), daemon lock discipline (JG201-JG203), and
-# the five-leg ENV_* knob contract (JG301-JG304). The JSON report is the
-# CI artifact; exit 1 on any unsuppressed finding. Pure-stdlib AST
-# analysis: no jax import, runs anywhere.
+# recompile hazards (JG104), daemon lock discipline (JG201-JG203), the
+# five-leg ENV_* knob contract (JG301-JG304), and the dispatch-surface
+# contract (JG401 census, JG402 donation completeness, JG403 sharding
+# coverage, JG404 stale pragmas). The JSON report is the CI artifact
+# (and the --baseline ratchet input); exit 1 on any unsuppressed
+# finding. Pure-stdlib AST analysis: no jax import, runs anywhere.
 analyze:
 	$(PY) -m tools.analyze --json jaxguard_report.json
+
+# Steady-state compile/reshard tripwire gate (ISSUE 19): the runtime
+# twin of the JG4xx census — the tripwire suite on the forced-8-device
+# host (compile_tripwire units, warmup-then-steady drains across
+# slotted/strict-fused/paged-tp2 servers asserting ZERO new XLA
+# compiles and ZERO unsanctioned reshards, the exact-mode negative
+# control proving the counter counts, greedy bit-identity tripwire
+# on/off), with and without KATA_TPU_STRICT=1; obs JSONL artifacts
+# (serving heartbeats carry tripwire_warmed / steady_state_*) uploaded.
+census:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/census_events.jsonl \
+	  $(PY) -m pytest tests/test_census.py -q
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/census_events_strict.jsonl \
+	KATA_TPU_STRICT=1 \
+	  $(PY) -m pytest tests/test_census.py -q
 
 # Runtime race harness (ISSUE 16): the dynamic twin of the JG2xx pass —
 # barrier-driven N threads × M ops stress over the allocation journal,

@@ -585,7 +585,7 @@ def worker(args: argparse.Namespace) -> None:
             )
             np.asarray(toks)  # jaxguard: allow(JG101) pre-materialize the input OUTSIDE the timed window
             t0 = time.perf_counter()
-            np.asarray(fn(params, toks))  # jaxguard: allow(JG101) the transfer IS the timing fence (JX004)
+            np.asarray(fn(params, toks))  # jaxguard: allow(JG101, JG404) defensive: fn is an opaque jitted closure the dataflow cannot taint; the transfer IS the timing fence (JX004)
             elapsed = time.perf_counter() - t0
             if seed > 0:  # first run includes compile
                 best = min(best, elapsed)
@@ -2248,7 +2248,29 @@ def worker(args: argparse.Namespace) -> None:
             ring_rate = ring_total / ring_s
             off_rate = off_total / off_s
             sink_rate = sink_total / sink_s
+            # Steady-state tripwire probe (ISSUE 19): the trial servers
+            # above are fresh per trial, so each run() is its own warmup
+            # and their tripwires never arm — a DEDICATED two-drain
+            # server banks the census contract instead: drain once
+            # (warmup compiles the bucketed surface), resubmit the same
+            # shape of work, drain again, and read the steady-state
+            # counters. ZERO is the only passing value —
+            # tools/bench_trend.py lists serving_steady_state_compiles
+            # in ZERO_REQUIRED_METRICS (nonzero is a regression by
+            # definition, never "flat").
+            tw_srv = make_server()
+            reqs(tw_srv, salt=0)
+            tw_srv.run()
+            reqs(tw_srv, salt=0)
+            tw_srv.run()
+            tw_st = tw_srv.stats()
             return {
+                "serving_steady_state_compiles": int(
+                    tw_st["steady_state_compiles"]
+                ),
+                "serving_steady_state_reshards": int(
+                    tw_st["steady_state_reshards"]
+                ),
                 "serving_obs_tok_per_s": round(ring_rate, 1),
                 "serving_obs_off_tok_per_s": round(off_rate, 1),
                 # >= 0.99 is the acceptance bar (<= 1% tok/s overhead

@@ -39,6 +39,8 @@ import importlib
 import inspect
 import os
 import re
+import sys
+import threading
 import warnings
 from contextlib import ExitStack, contextmanager
 from typing import Any, Callable, Optional, Sequence
@@ -430,12 +432,25 @@ def enable_compilation_cache(
 # Python scalars silently uploaded into a jitted dispatch — exactly the
 # host round-trip the overlapped serving loop exists to avoid), while
 # EXPLICIT transfers (jax.device_put / jnp.asarray / jax.device_get) stay
-# legal, so the two sanctioned sync points — DeviceFence retire and the
-# admission host read — pass through `allow_transfer()` hatches instead
-# of weakening the whole guard.
+# legal, so the sanctioned sync regions — DeviceFence retire, the
+# admission host read, kv resume staging/prefetch-miss re-land, and
+# arena (re)placement on mesh changes — pass through `allow_transfer()`
+# hatches instead of weakening the whole guard. The same hatch feeds the
+# compile/reshard tripwire below: jaxguard JG403 proves the static dual
+# (every serving-reachable device_put is lexically or transitively
+# inside a hatch), and `compile_tripwire` proves the runtime one.
 
 _STRICT_ENV = "KATA_TPU_STRICT"
 _strict_warned = False
+
+# Sanction depth for the compile/reshard tripwire: >0 while the current
+# thread is inside at least one `allow_transfer` region. Thread-local so
+# a daemon thread's sanctioned spill never masks a serving-thread reshard.
+_tw_local = threading.local()
+
+
+def _allow_depth() -> int:
+    return getattr(_tw_local, "allow_depth", 0)
 
 
 def strict_enabled(env: Optional[dict] = None) -> bool:
@@ -466,15 +481,25 @@ def allow_transfer(reason: str = "", jax_mod: Any = None):
     the call site (it is not recorded — the point is the code reads like
     the jaxguard pragma grammar). No-op when the guard is unsupported or
     no strict scope is active (``transfer_guard("allow")`` is the
-    default level)."""
+    default level).
+
+    Also maintains the thread-local sanction depth the
+    :func:`compile_tripwire` reads: a ``device_put`` issued outside any
+    ``allow_transfer`` region counts as a reshard near-miss even when
+    strict mode is off — the tripwire is the guard's always-on
+    observability twin."""
     del reason
     jm = jax_mod if jax_mod is not None else _jax
     guard = getattr(jm, "transfer_guard", None)
-    if guard is None:
-        yield
-        return
-    with guard("allow"):
-        yield
+    _tw_local.allow_depth = _allow_depth() + 1
+    try:
+        if guard is None:
+            yield
+        else:
+            with guard("allow"):
+                yield
+    finally:
+        _tw_local.allow_depth = _allow_depth() - 1
 
 
 def _looks_like_guard_trip(err: BaseException) -> bool:
@@ -560,6 +585,127 @@ def strict_mode(
             raise
 
 
+# ----- compile/reshard tripwire ---------------------------------------------
+#
+# The runtime twin of jaxguard's JG401/JG403 census: once the serving loop
+# is warm, EVERY decode round must hit the executable cache (zero new XLA
+# compilations) and issue zero unsanctioned explicit transfers. The census
+# proves the dispatch surface is finite statically; the tripwire proves
+# the process actually stays on it — a nonzero steady-state count means a
+# static arg is varying per round (bucket churn, knob flip, layout flip)
+# and the contract broke at runtime even though lint passed.
+
+_compile_count = 0
+_compile_listener = {"registered": False, "available": False}
+
+
+def _on_event_duration(event: str, *args: Any, **kw: Any) -> None:
+    # jax.monitoring fires `/jax/core/compile/backend_compile_duration`
+    # exactly once per XLA backend compile and never on cache hits —
+    # validated against the installed line; other duration events
+    # (tracing, whole-program) pass through uncounted.
+    if "backend_compile" in event:
+        global _compile_count
+        _compile_count += 1
+
+
+def compile_counter(jax_mod: Any = None) -> int:
+    """Monotonic count of XLA backend compilations in this process.
+
+    Lazily registers a ``jax.monitoring`` duration listener on first call
+    (so merely importing this module never touches jax internals). On a
+    line without ``jax.monitoring`` the counter degrades to a constant 0:
+    the tripwire then cannot see compiles, only reshards — callers treat
+    0 as "clean or unobservable", never as proof.
+    """
+    jm = jax_mod if jax_mod is not None else _jax
+    if not _compile_listener["registered"]:
+        _compile_listener["registered"] = True
+        mon = getattr(jm, "monitoring", None)
+        reg = getattr(
+            mon, "register_event_duration_secs_listener", None
+        )
+        if reg is not None:
+            try:
+                reg(_on_event_duration)
+                _compile_listener["available"] = True
+            except Exception:  # pragma: no cover - exotic jax lines
+                pass
+    return _compile_count
+
+
+class TripwireCounts:
+    """Result of one :func:`compile_tripwire` scope. ``compiles`` and
+    ``transfers`` are finalized when the context exits; ``armed`` records
+    whether the compile side could observe anything at all."""
+
+    __slots__ = ("compiles", "transfers", "armed")
+
+    def __init__(self) -> None:
+        self.compiles = 0
+        self.transfers = 0
+        self.armed = False
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (
+            f"TripwireCounts(compiles={self.compiles}, "
+            f"transfers={self.transfers}, armed={self.armed})"
+        )
+
+
+@contextmanager
+def compile_tripwire(jax_mod: Any = None, enabled: bool = True):
+    """Count XLA compilations and unsanctioned explicit transfers within
+    this scope.
+
+    Yields a :class:`TripwireCounts`; on exit ``counts.compiles`` is the
+    number of backend compiles the scope triggered and
+    ``counts.transfers`` the number of ``jax.device_put`` calls issued
+    outside any :func:`allow_transfer` region (reshard near-misses — the
+    transfer guard only trips IMPLICIT transfers, so an explicit
+    ``device_put`` snuck into the decode round would otherwise sail
+    through strict mode silently).
+
+    With ``enabled=False`` the scope is a zero-overhead no-op that still
+    yields a counts object — callers never branch on the knob.
+    """
+    counts = TripwireCounts()
+    if not enabled:
+        yield counts
+        return
+    jm = jax_mod if jax_mod is not None else _jax
+    start = compile_counter(jm)
+    counts.armed = _compile_listener["available"]
+    orig_put = getattr(jm, "device_put", None)
+    patched = False
+    if orig_put is not None:
+        def _counting_put(*args: Any, **kw: Any):
+            if _allow_depth() == 0:
+                # Count LEXICAL device_put calls only — the runtime
+                # mirror of jaxguard JG403, which flags `device_put`
+                # leaves but never `jnp.asarray`. On current lines
+                # jnp.asarray routes through jax.device_put internally,
+                # so a caller inside jax's own modules is the sanctioned
+                # explicit-upload path (round-boundary token/pos
+                # uploads), not a reshard near-miss.
+                caller = sys._getframe(1).f_globals.get("__name__", "")
+                if not caller.startswith("jax"):
+                    counts.transfers += 1
+            return orig_put(*args, **kw)
+
+        try:
+            jm.device_put = _counting_put
+            patched = True
+        except Exception:  # pragma: no cover - frozen module surface
+            pass
+    try:
+        yield counts
+    finally:
+        counts.compiles = compile_counter(jm) - start
+        if patched:
+            jm.device_put = orig_put
+
+
 # ----- tree utilities -------------------------------------------------------
 
 
@@ -629,8 +775,11 @@ __all__ = [
     "NamedSharding",
     "P",
     "PartitionSpec",
+    "TripwireCounts",
     "allow_transfer",
     "axis_size",
+    "compile_counter",
+    "compile_tripwire",
     "strict_enabled",
     "strict_mode",
     "build_make_mesh",

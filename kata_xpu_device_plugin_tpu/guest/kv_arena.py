@@ -733,7 +733,7 @@ class PagedPrefixTier:
         with jaxapi.allow_transfer(
                 "kv host tier demotion (D2H spill of cold prefix blocks)"):
             rows = jax.tree.map(
-                np.asarray,  # jaxguard: allow(JG101) demotion spill — sanctioned slow-path sync under pool pressure (guarded by allow_transfer)
+                np.asarray,  # demotion spill — sanctioned slow-path sync under pool pressure (guarded by allow_transfer)
                 pool_gather_rows(
                     self.pool.arena,
                     jnp.asarray(np.asarray(seg.blocks, np.int32)),

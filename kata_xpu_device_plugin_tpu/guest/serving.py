@@ -1085,6 +1085,7 @@ class GenerationServer:
                  speculative_k: int = 0, ring_kv: bool = False,
                  draft: Optional[tuple] = None, overlap: bool = True,
                  strict: Optional[bool] = None,
+                 tripwire: Optional[bool] = None,
                  prefix_cache_tokens: Optional[int] = None,
                  prefix_store: Optional[PrefixStore] = None,
                  kv_pool_tokens: Optional[int] = None,
@@ -1220,6 +1221,21 @@ class GenerationServer:
         # implicit host round-trip sneaking back into the dispatch path
         # then raises instead of silently serializing the pipeline.
         self.strict = jaxapi.strict_enabled() if strict is None else bool(strict)
+        # Steady-state compile/reshard tripwire (jaxguard JG4xx runtime
+        # twin): the FIRST run() is the warmup drain — it traces and
+        # compiles the bucketed dispatch surface the JG401 census proved
+        # finite. Every run() after it is steady state: zero new XLA
+        # compilations and zero unsanctioned device_put calls, counted by
+        # compat.jaxapi.compile_tripwire and surfaced as
+        # ``steady_state_compiles``/``steady_state_reshards`` in stats()
+        # and the heartbeat. A deliberate ctor argument, not an env knob:
+        # it gates telemetry, not behavior (greedy outputs are
+        # bit-identical either way), so it sits outside the five-leg
+        # ENV_* contract jaxguard JG3xx audits.
+        self.tripwire = True if tripwire is None else bool(tripwire)
+        self._tw_warmed = False
+        self._steady_compiles = 0
+        self._steady_reshards = 0
         # Device-resident temperature, hoisted once: jnp.float32(x) per
         # dispatch is an implicit scalar upload — a per-round H2D the
         # transfer guard rightly rejects.
@@ -1951,6 +1967,8 @@ class GenerationServer:
             fused=int(self._fused_ok), overlap=int(bool(overlap)),
             paged=int(self.paged), tp=self._tp,
             kv_layout=self._kv_layout,
+            prefill_buckets=list(self.prefill_buckets),
+            tripwire=int(self.tripwire),
             kv_host_tokens=(
                 self._kv_host.capacity_tokens if self._kv_host else 0
             ),
@@ -2170,6 +2188,12 @@ class GenerationServer:
             "tp": self._tp,
             "tp_degraded": int(self._tp < self._tp_initial),
             "decode_steps": self._decode_steps,
+            # Steady-state tripwire (ISSUE 19): cumulative, like the
+            # stats() fields — any nonzero steady_state_compiles here is
+            # a census breach (warm dispatch surface recompiled).
+            "tripwire_warmed": int(self._tw_warmed),
+            "steady_state_compiles": self._steady_compiles,
+            "steady_state_reshards": self._steady_reshards,
             # The daemon-granted chip set (the per-allocation join key
             # the host-side aggregator labels its gauges with).
             "chips": tp_serving.allocation_chips(),
@@ -2459,25 +2483,30 @@ class GenerationServer:
                 "kv_replicated", tp=tp, n_kv_heads=self.cfg.n_kv_heads,
                 extra_bytes=(tp - 1) * logical,
             )
-        if self.paged:
-            # The pool IS the arena ([L, 1, NT, KV, D] leaves — the same
-            # head-axis position as the slot grid), so paged × tp shards
-            # the one structure every lane's table points into.
-            self.kv_pool.arena = jax.tree.map(
-                lambda c: jax.device_put(c, sh), self.kv_pool.arena
-            )
-        else:
-            self.arena = jax.tree.map(
-                lambda c: jax.device_put(c, sh), self.arena
-            )
-        if self.draft is not None:
-            _d_params, d_cfg = self.draft
-            d_sh = NamedSharding(
-                mesh, tp_serving.kv_cache_spec(d_cfg, tp)  # jaxguard: allow(JG101) d_cfg is the host-side DecoderConfig (attr-taint false positive); reachable from step only via crash recovery — a scheduling slow path
-            )
-            self.draft_arena = jax.tree.map(
-                lambda c: jax.device_put(c, d_sh), self.draft_arena
-            )
+        with jaxapi.allow_transfer(
+                "arena placement onto the serving mesh (init, crash "
+                "recovery, degraded shrink — a mesh change, never a "
+                "per-round path)"):
+            if self.paged:
+                # The pool IS the arena ([L, 1, NT, KV, D] leaves — the
+                # same head-axis position as the slot grid), so paged ×
+                # tp shards the one structure every lane's table points
+                # into.
+                self.kv_pool.arena = jax.tree.map(
+                    lambda c: jax.device_put(c, sh), self.kv_pool.arena
+                )
+            else:
+                self.arena = jax.tree.map(
+                    lambda c: jax.device_put(c, sh), self.arena
+                )
+            if self.draft is not None:
+                _d_params, d_cfg = self.draft
+                d_sh = NamedSharding(
+                    mesh, tp_serving.kv_cache_spec(d_cfg, tp)
+                )
+                self.draft_arena = jax.tree.map(
+                    lambda c: jax.device_put(c, d_sh), self.draft_arena
+                )
         # The decode kernel wrapper is mesh-specific (ISSUE 12): rebuild
         # it wherever the arena lands — including the degraded shrink's
         # smaller mesh (attribute-guarded: __init__ places the arena
@@ -2513,9 +2542,31 @@ class GenerationServer:
         """Drain queue + slots to completion; returns {rid: tokens[new]}.
         Requests that were quarantined or drained are NOT in the result —
         they surface in :meth:`failures` (every submitted rid appears in
-        exactly one of the two; none vanish)."""
-        while self.step():
-            pass
+        exactly one of the two; none vanish).
+
+        The FIRST drain is the tripwire warmup (it compiles the bucketed
+        dispatch surface); every later drain runs inside
+        ``compat.jaxapi.compile_tripwire`` and banks any new XLA compile
+        or unsanctioned ``device_put`` into ``steady_state_compiles`` /
+        ``steady_state_reshards`` — nonzero means a static arg varied
+        per round and the JG401 census contract broke at runtime (see
+        docs/observability.md for the breach runbook)."""
+        tw_armed = self.tripwire and self._tw_warmed
+        try:
+            with jaxapi.compile_tripwire(enabled=tw_armed) as tw:
+                while self.step():
+                    pass
+        finally:
+            self._tw_warmed = True
+            if tw_armed:
+                self._steady_compiles += tw.compiles
+                self._steady_reshards += tw.transfers
+                if tw.compiles or tw.transfers:
+                    self._emit(
+                        "tripwire_breach",
+                        compiles=tw.compiles,
+                        reshards=tw.transfers,
+                    )
         out, self._results = self._results, {}
         return out
 
@@ -2692,6 +2743,19 @@ class GenerationServer:
             "decode_steps": self._decode_steps,
             "fused_enabled": int(self._fused_ok),
             "fused_admissions": self._fused_admissions,
+        })
+        # Steady-state tripwire (ISSUE 19): ALWAYS present — zeros with
+        # the tripwire off or before the second run() — same
+        # no-schema-branch contract. Nonzero steady_state_compiles is a
+        # REGRESSION by definition (bench_trend never calls it flat):
+        # the warm dispatch surface recompiled, i.e. a jit static arg
+        # varied per round. steady_state_reshards counts device_put
+        # calls outside any allow_transfer sanction in warm drains.
+        out.update({
+            "tripwire_enabled": int(self.tripwire),
+            "tripwire_warmed": int(self._tw_warmed),
+            "steady_state_compiles": self._steady_compiles,
+            "steady_state_reshards": self._steady_reshards,
         })
         # Heartbeat + watchdog (ISSUE 15): ALWAYS present — zeros with
         # the heartbeat disabled — same no-schema-branch contract. The
@@ -2928,7 +2992,7 @@ class GenerationServer:
             trace_id=self._trace, server=self._label, rid=req.rid, slot=b,
             prompt_len=true_len, padded_len=len(prompt), tokens=true_len,
         ) as sp:
-            caches, last_logits, pos = prefill(
+            caches, last_logits, pos = prefill(  # jaxguard: allow(JG401) cache_len is bucket-quantized by _admit (one executable per bucket); exact/ring mode deliberately trades one compile per distinct prompt length for ring-W memory
                 self.params, jnp.asarray(prompt)[None, :], self.cfg,
                 cache_len, return_logits=True, kv_quantized=self.kv_quant,
                 true_len=jnp.int32(true_len) if bucket is not None else None,
@@ -3846,10 +3910,16 @@ class GenerationServer:
         # decode chunk was still in flight, so the restore scatter lands
         # an already-overlapped transfer instead of serializing one here.
         staged = self._resume_stage_rid == pre.req.rid
-        rows = (
-            self._resume_stage_rows if staged
-            else self._kv_host_upload(pre.kv, paged_rows=True)
-        )
+        if staged:
+            rows = self._resume_stage_rows
+        else:
+            # Prefetch MISS: the staged overlap targeted another rid (or
+            # never ran), so this upload serializes inside the decode
+            # round — sanctioned as the slow path the staging exists to
+            # make rare (JG403 counts any unsanctioned sibling).
+            with jaxapi.allow_transfer(
+                    "kv resume prefetch miss (serialized H2D re-land)"):
+                rows = self._kv_host_upload(pre.kv, paged_rows=True)
         self._resume_stage_rid = None
         self._resume_stage_rows = None
         self.kv_pool.arena = pool_scatter_rows(
@@ -4086,7 +4156,7 @@ class GenerationServer:
                 if self.paged:
                     kv = self._fence_wait(
                         lambda b=b: jax.tree.map(
-                            np.asarray,  # jaxguard: allow(JG101) checkpoint spill — sanctioned slow-path sync (guarded by allow_transfer)
+                            np.asarray,  # checkpoint spill — sanctioned fence-wrapped sync (guarded by allow_transfer)
                             pool_gather_rows(
                                 self.kv_pool.arena,
                                 jnp.asarray(self._full_table(b)),
@@ -4098,7 +4168,7 @@ class GenerationServer:
                 else:
                     kv = self._fence_wait(
                         lambda b=b: jax.tree.map(
-                            lambda a: np.asarray(a[:, b:b + 1]),  # jaxguard: allow(JG101) checkpoint spill — sanctioned slow-path sync (guarded by allow_transfer)
+                            lambda a: np.asarray(a[:, b:b + 1]),  # checkpoint spill — sanctioned fence-wrapped sync (guarded by allow_transfer)
                             self.arena,
                         ),
                         seam="checkpoint", inject=False,
@@ -4841,7 +4911,7 @@ class GenerationServer:
                     (toks, caches, new_last, new_pos, p_caches,
                      p_logits) = _fused_serve_decode(*fargs, **fkw)
                     self.arena = caches
-            p.caches = p_caches  # jaxguard: allow(JG102) this IS the rebind — the donated tree's successor replaces it, nothing reads the donated buffers
+            p.caches = p_caches  # this IS the rebind — the donated tree's successor replaces it
             self._fused_ret = _FusedChunk(
                 partial=p, take=take, width=width, last=is_last,
                 logits=p_logits,
@@ -4954,7 +5024,7 @@ class GenerationServer:
             # Watchdog-fenced chunk boundary: [max_batch, steps] tokens.
             self._clock.push(LOOP_PHASE_RETIRE)
             try:
-                toks = self._fence_wait(lambda: np.asarray(toks))  # jaxguard: allow(JG101) lock-step round fence — the transfer IS the chunk boundary
+                toks = self._fence_wait(lambda: np.asarray(toks))  # lock-step round fence — the transfer IS the chunk boundary
             finally:
                 self._clock.pop()
         # Ledger retire stamp AFTER the span closed, so the RETIRE pop's

@@ -51,7 +51,7 @@ def time_prefill(attn_fn) -> float:  # jaxguard: hot
         )
         np.asarray(toks)  # jaxguard: allow(JG101) pre-materialize the input OUTSIDE the timed window
         t0 = time.perf_counter()
-        np.asarray(fn(params, toks))  # jaxguard: allow(JG101) the transfer IS the timing fence (JX004)
+        np.asarray(fn(params, toks))  # jaxguard: allow(JG101, JG404) defensive: fn is an opaque jitted closure the dataflow cannot taint; the transfer IS the timing fence (JX004)
         elapsed = time.perf_counter() - t0
         if seed > 0:  # first run includes compile
             best = min(best, elapsed)

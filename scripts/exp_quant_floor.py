@@ -75,13 +75,13 @@ def matmuls_only(fp, tok, pos):
 
 
 def timeit(name, fn):  # jaxguard: hot
-    np.asarray(fn(qparams, jnp.zeros((B,), jnp.int32), jnp.int32(PROMPT)))  # compile  # jaxguard: allow(JG101) warm-up fence, outside the timed window
+    np.asarray(fn(qparams, jnp.zeros((B,), jnp.int32), jnp.int32(PROMPT)))  # compile  # jaxguard: allow(JG101, JG404) defensive: fn is an opaque jitted closure the dataflow cannot taint; warm-up fence, outside the timed window
     best = float("inf")
     for s in range(3):
         tok2 = jax.random.randint(jax.random.PRNGKey(s), (B,), 0, cfg.vocab_size)
         np.asarray(tok2)  # jaxguard: allow(JG101) pre-materialize the input OUTSIDE the timed window
         t0 = time.perf_counter()
-        np.asarray(fn(qparams, tok2, jnp.int32(PROMPT)))  # jaxguard: allow(JG101) the transfer IS the timing fence (JX004)
+        np.asarray(fn(qparams, tok2, jnp.int32(PROMPT)))  # jaxguard: allow(JG101, JG404) defensive: fn is an opaque jitted closure the dataflow cannot taint; the transfer IS the timing fence (JX004)
         best = min(best, time.perf_counter() - t0)
     ms = best / STEPS * 1e3
     print(f"{name:16s} {ms:7.3f} ms/step  int8_roofline_frac={ideal_ms/ms:.3f}")

@@ -102,6 +102,39 @@ def test_bench_trend_flat_and_clean(tmp_path, capsys):
     assert rc == 0 and "flat" in out
 
 
+def test_bench_trend_tripwire_nonzero_never_ages_into_baseline(tmp_path,
+                                                               capsys):
+    # ISSUE 19: the steady-state tripwire metrics gate on the NEW value
+    # alone — two equal nonzero banks are still a regression, never
+    # "flat", and the 10% threshold does not apply.
+    from tools import bench_trend
+
+    _bank(tmp_path, "20260101T000000Z", value=1000.0,
+          serving_steady_state_compiles=2.0)
+    _bank(tmp_path, "20260102T000000Z", value=1000.0,
+          serving_steady_state_compiles=2.0)
+    rc = bench_trend.main(["--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "serving_steady_state_compiles" in out
+
+
+def test_bench_trend_tripwire_zero_ladder():
+    # new==0 is the only passing value: recovery (nonzero → 0) reads
+    # "improved", holding at zero reads "flat".
+    from tools import bench_trend
+
+    rows = bench_trend.compare(
+        {"serving_steady_state_compiles": 3.0,
+         "serving_steady_state_reshards": 0.0},
+        {"serving_steady_state_compiles": 0.0,
+         "serving_steady_state_reshards": 0.0},
+    )
+    by = {r["metric"]: r["status"] for r in rows}
+    assert by["serving_steady_state_compiles"] == "improved"
+    assert by["serving_steady_state_reshards"] == "flat"
+
+
 def test_bench_trend_newest_two_and_sparse_banks(tmp_path, capsys):
     from tools import bench_trend
 

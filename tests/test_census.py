@@ -1,0 +1,227 @@
+"""Steady-state compile/reshard tripwire (the JG4xx runtime twin).
+
+jaxguard's JG401 census proves STATICALLY that the serving dispatch
+surface is finite — every jit static arg draws from a bounded source, so
+the executable count is ``buckets × K × forms``. This suite proves the
+process actually STAYS on that surface: after the warmup drain compiles
+it, every further drain must trigger ZERO new XLA compilations and ZERO
+unsanctioned ``device_put`` calls, across strict on/off × tp × K ×
+kv-layout. The tripwire is telemetry, never numerics: greedy outputs are
+bit-identical with it on or off.
+
+The compile side rides ``jax.monitoring``'s backend-compile duration
+event (fires once per XLA compile, never on an executable-cache hit);
+the reshard side counts lexical ``jax.device_put`` calls outside any
+``allow_transfer`` sanction — the same two duals JG401/JG403 check
+statically.
+"""
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu.compat import jaxapi
+from kata_xpu_device_plugin_tpu.guest import tp_serving
+from kata_xpu_device_plugin_tpu.guest.serving import GenerationServer
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import init_params
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _prompts(cfg, lengths, seed=1):
+    key = jax.random.PRNGKey(seed)
+    return [
+        np.asarray(jax.random.randint(
+            jax.random.fold_in(key, i), (n,), 0, cfg.vocab_size
+        ), np.int32)
+        for i, n in enumerate(lengths)
+    ]
+
+
+def _drain(srv, cfg, lengths, seed, new_tokens=6):
+    for p in _prompts(cfg, lengths, seed=seed):
+        srv.submit(p, max_new_tokens=new_tokens)
+    return srv.run()
+
+
+# ----- compile_tripwire / allow_transfer units -------------------------------
+
+
+def test_tripwire_counts_compiles_and_lexical_puts():
+    @jax.jit
+    def f(x):
+        return x * 3
+
+    x = jnp.ones((5,))
+    with jaxapi.compile_tripwire() as cold:
+        f(x)                      # first call: at least one XLA compile
+        jax.device_put(jnp.ones(3))   # lexical, unsanctioned
+        jnp.asarray(np.ones(3))       # explicit-upload path, NOT counted
+        with jaxapi.allow_transfer("unit-test sanction"):
+            jax.device_put(jnp.ones(3))
+    assert cold.compiles >= 1
+    assert cold.transfers == 1
+    with jaxapi.compile_tripwire() as warm:
+        f(x)                      # executable-cache hit
+    assert warm.compiles == 0
+    assert warm.transfers == 0
+
+
+def test_tripwire_disabled_is_noop_and_restores_device_put():
+    orig = jax.device_put
+    with jaxapi.compile_tripwire(enabled=False) as c:
+        jax.device_put(jnp.ones(2))
+    assert (c.compiles, c.transfers, c.armed) == (0, 0, False)
+    assert jax.device_put is orig
+
+
+def test_tripwire_restores_device_put_on_error():
+    orig = jax.device_put
+    with pytest.raises(RuntimeError, match="boom"):
+        with jaxapi.compile_tripwire():
+            raise RuntimeError("boom")
+    assert jax.device_put is orig
+
+
+def test_allow_transfer_depth_nests_without_guard():
+    # The sanction depth must track on the guard-less (old-JAX) path
+    # too — the tripwire works even where transfer_guard does not.
+    guardless = types.SimpleNamespace()  # no transfer_guard attribute
+    assert jaxapi._allow_depth() == 0
+    with jaxapi.allow_transfer("outer", jax_mod=guardless):
+        assert jaxapi._allow_depth() == 1
+        with jaxapi.allow_transfer("inner", jax_mod=guardless):
+            assert jaxapi._allow_depth() == 2
+        assert jaxapi._allow_depth() == 1
+    assert jaxapi._allow_depth() == 0
+
+
+def test_compile_counter_monotonic_and_fires_on_new_shape():
+    before = jaxapi.compile_counter()
+
+    @jax.jit
+    def g(x):
+        return x + 7
+
+    g(jnp.ones((3,)))
+    mid = jaxapi.compile_counter()
+    assert mid > before
+    g(jnp.ones((3,)))  # cache hit — counter must not move
+    assert jaxapi.compile_counter() == mid
+
+
+# ----- steady state is compile- and reshard-free -----------------------------
+
+# (kwargs, id): tier-1 spans the axes without crossing all of them —
+# the full strict × tp × K × layout cross lives in the slow matrix.
+_TIER1_CONFIGS = [
+    (dict(), "slotted-tp1-k1"),
+    (dict(strict=True, decode_steps=4, sched_policy="slo_chunked"),
+     "strict-fused-k4"),
+    (dict(tp=2, kv_pool_tokens=256, kv_block_size=8, kv_layout="blocks"),
+     "paged-tp2-blocks"),
+]
+
+
+def _make_server(params, cfg, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("prefill_buckets", (8, 16))
+    if kw.pop("tp", 1) > 1:
+        kw["mesh"] = tp_serving.serving_mesh(2)
+    return GenerationServer(params, cfg, **kw)
+
+
+@pytest.mark.parametrize(
+    "kw", [c for c, _ in _TIER1_CONFIGS],
+    ids=[i for _, i in _TIER1_CONFIGS],
+)
+def test_steady_state_zero_compiles_zero_reshards(model, kw):
+    cfg, params = model
+    srv = _make_server(params, cfg, **kw)
+    _drain(srv, cfg, [4, 6], seed=3)           # warmup: compiles the surface
+    st = srv.stats()
+    assert st["tripwire_enabled"] == 1
+    assert st["tripwire_warmed"] == 1
+    assert st["steady_state_compiles"] == 0    # warmup is never counted
+    _drain(srv, cfg, [5, 7], seed=9)           # steady: same buckets
+    st = srv.stats()
+    assert st["steady_state_compiles"] == 0, st["steady_state_compiles"]
+    assert st["steady_state_reshards"] == 0, st["steady_state_reshards"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("strict", [False, True])
+@pytest.mark.parametrize("tp", [1, 2])
+@pytest.mark.parametrize("k_steps", [1, 4])
+@pytest.mark.parametrize("layout", ["heads", "blocks"])
+def test_steady_state_full_matrix(model, strict, tp, k_steps, layout):
+    cfg, params = model
+    kw = dict(strict=strict, tp=tp, decode_steps=k_steps)
+    if layout == "blocks":
+        kw.update(kv_pool_tokens=256, kv_block_size=8, kv_layout="blocks")
+    srv = _make_server(params, cfg, **kw)
+    _drain(srv, cfg, [4, 6], seed=3)
+    _drain(srv, cfg, [5, 7], seed=9)
+    st = srv.stats()
+    assert st["steady_state_compiles"] == 0, (strict, tp, k_steps, layout)
+    assert st["steady_state_reshards"] == 0, (strict, tp, k_steps, layout)
+
+
+def test_tripwire_detects_exact_mode_recompile(model):
+    # Negative control: the counter actually counts. A bucket-less
+    # server compiles one prefill per DISTINCT prompt length (the
+    # documented exact-mode trade, serving.py's reasoned JG401 pragma),
+    # so a new length in the steady drain must register.
+    cfg, params = model
+    srv = GenerationServer(params, cfg, max_batch=2, max_len=32, chunk=4)
+    _drain(srv, cfg, [4], seed=3)
+    _drain(srv, cfg, [9], seed=5)              # new length → new executable
+    assert srv.stats()["steady_state_compiles"] > 0
+
+
+def test_greedy_outputs_bit_identical_tripwire_on_off(model):
+    # The acceptance bar: the tripwire is pure observation — greedy
+    # outputs across warmup AND steady drains are bit-identical with the
+    # counters armed or off.
+    cfg, params = model
+    outs = {}
+    for on in (True, False):
+        srv = _make_server(params, cfg, tripwire=on)
+        r1 = _drain(srv, cfg, [4, 6], seed=3)
+        r2 = _drain(srv, cfg, [5, 7], seed=9)
+        st = srv.stats()
+        assert st["tripwire_enabled"] == int(on)
+        if not on:
+            assert st["steady_state_compiles"] == 0  # disarmed: stays 0
+        outs[on] = ([r1[r] for r in sorted(r1)], [r2[r] for r in sorted(r2)])
+    for a, b in zip(outs[True][0] + outs[True][1],
+                    outs[False][0] + outs[False][1]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_stats_and_heartbeat_carry_tripwire_fields(model):
+    cfg, params = model
+    srv = _make_server(params, cfg, heartbeat_rounds=1)
+    _drain(srv, cfg, [4], seed=3)
+    st = srv.stats()
+    for field in ("tripwire_enabled", "tripwire_warmed",
+                  "steady_state_compiles", "steady_state_reshards"):
+        assert field in st
+    hb = srv._hb_last
+    assert hb, "heartbeat never fired at 1-round cadence"
+    assert hb["tripwire_warmed"] == 0  # heartbeats DURING warmup say so
+    _drain(srv, cfg, [4], seed=5)
+    hb = srv._hb_last
+    assert "steady_state_compiles" in hb
+    assert "steady_state_reshards" in hb
+    assert hb["tripwire_warmed"] == 1

@@ -338,6 +338,377 @@ def f(x):
     assert analyze_source(src, OPS) == []
 
 
+# ----- JG401: dispatch census ------------------------------------------------
+
+_CENSUS = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("k",))
+def decode(caches, k):
+    return caches
+
+@jax.jit
+def probe(x):
+    return x + 1
+
+class GenerationServer:
+    def step(self):
+        k = probe(self.last)
+        return decode(self.arena, k=k)
+'''
+
+
+def test_jg401_traced_value_feeds_static():
+    findings = analyze_source(_CENSUS, GUEST, rules=["JG401"])
+    assert rules_of(findings) == ["JG401"]
+    assert "traced" in findings[0].message
+
+
+def test_jg401_near_miss_bounded_sources():
+    # Config attrs, constants, pure-host folds of them, and IfExps over
+    # them are all BOUNDED: one executable per (bucket, form) — a closed
+    # census, no finding.
+    src = '''
+import jax
+from functools import partial
+
+FORMS = ("plain", "fused")
+
+@partial(jax.jit, static_argnames=("k", "form"))
+def decode(caches, k, form):
+    return caches
+
+class GenerationServer:
+    def step(self):
+        k = min(self.k, 4)
+        form = FORMS[0] if self.fused else FORMS[1]
+        return decode(self.arena, k=k, form=form)
+'''
+    assert analyze_source(src, GUEST, rules=["JG401"]) == []
+
+
+def test_jg401_loop_varying_static():
+    src = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("k",))
+def decode(caches, k):
+    return caches
+
+class GenerationServer:
+    def step(self):
+        for b in self.buckets:
+            out = decode(self.arena, k=b)
+        return out
+'''
+    findings = analyze_source(src, GUEST, rules=["JG401"])
+    assert rules_of(findings) == ["JG401"]
+    assert "loop variable 'b'" in findings[0].message
+
+
+def test_jg401_unbounded_host_source():
+    src = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("k",))
+def decode(caches, k):
+    return caches
+
+class GenerationServer:
+    def step(self, prompt):
+        return decode(self.arena, k=len(prompt))
+'''
+    findings = analyze_source(src, GUEST, rules=["JG401"])
+    assert rules_of(findings) == ["JG401"]
+    assert "unbounded" in findings[0].message
+
+
+def test_jg401_only_fires_on_serving_reachable():
+    # The same unbounded static OUTSIDE the serving roots is JG104's
+    # jurisdiction at most — the census is a serving contract.
+    src = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("k",))
+def decode(caches, k):
+    return caches
+
+def offline_sweep(caches, prompt):
+    return decode(caches, k=len(prompt))
+'''
+    assert analyze_source(src, GUEST, rules=["JG401"]) == []
+
+
+# ----- JG402: donation completeness ------------------------------------------
+
+_DONATE_BRANCH = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def fused(arena, tok):
+    return arena, tok
+
+@partial(jax.jit, donate_argnums=(0,))
+def plain(arena, tok):
+    return arena, tok
+
+class GenerationServer:
+    def step(self):
+        if self.fused:
+            self.arena, tok = fused(self.arena, self.last)
+        else:
+            out = plain(self.arena, self.last)
+            tok = out[1]
+        return tok
+'''
+
+
+def test_jg402_per_branch_donation_asymmetry():
+    # The exact hazard class the pass exists for: one dispatch branch
+    # rebinds the donated tree, its sibling leaves it dangling.
+    findings = analyze_source(_DONATE_BRANCH, GUEST, rules=["JG402"])
+    assert rules_of(findings) == ["JG402"]
+    assert "self.arena" in findings[0].message
+    assert "plain" in findings[0].message
+
+
+def test_jg402_near_miss_both_branches_rebind():
+    src = _DONATE_BRANCH.replace(
+        "out = plain(self.arena, self.last)\n            tok = out[1]",
+        "self.arena, tok = plain(self.arena, self.last)",
+    )
+    assert analyze_source(src, GUEST, rules=["JG402"]) == []
+
+
+def test_jg402_donate_argnames_on_bound_method():
+    # donate_argnames on a jitted METHOD: the self offset shifts the
+    # positional map; run() leaves the donated attribute dangling while
+    # step() rebinds it.
+    src = '''
+import jax
+from functools import partial
+
+class GenerationServer:
+    @partial(jax.jit, donate_argnames=("arena",))
+    def _upd(self, arena, tok):
+        return arena, tok
+
+    def step(self):
+        self.arena, tok = self._upd(self.arena, self.last)
+        return tok
+
+    def run(self):
+        out = self._upd(self.arena, self.last)
+        return out[1]
+'''
+    findings = analyze_source(src, GUEST, rules=["JG402"])
+    assert rules_of(findings) == ["JG402"]
+    assert findings[0].function.endswith("run")
+
+
+def test_jg402_near_miss_donated_local_dies_with_frame():
+    # A donated LOCAL that is never read again is fine — nothing
+    # persistent dangles (the JG102 dual stays intra-frame).
+    src = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def upd(arena, x):
+    return arena + x
+
+class GenerationServer:
+    def step(self, arena):
+        return upd(arena, self.last)
+'''
+    assert analyze_source(src, GUEST, rules=["JG402"]) == []
+
+
+# ----- JG403: sharding-spec coverage -----------------------------------------
+
+
+def test_jg403_shard_map_nested_in_jit_missing_specs():
+    src = '''
+import jax
+from kata_xpu_device_plugin_tpu.compat.jaxapi import shard_map
+
+@jax.jit
+def dispatch(x, mesh, spec):
+    f = shard_map(lambda a: a * 2, mesh, in_specs=spec)
+    return f(x)
+'''
+    findings = analyze_source(src, GUEST, rules=["JG403"])
+    assert rules_of(findings) == ["JG403"]
+    assert "out_specs" in findings[0].message
+
+
+def test_jg403_near_miss_explicit_specs():
+    src = '''
+import jax
+from kata_xpu_device_plugin_tpu.compat.jaxapi import shard_map
+
+@jax.jit
+def dispatch(x, mesh, spec):
+    f = shard_map(lambda a: a * 2, mesh, in_specs=spec, out_specs=spec)
+    return f(x)
+'''
+    assert analyze_source(src, GUEST, rules=["JG403"]) == []
+
+
+_KNOBS = '''
+ENV_DECODE_STEPS = "KATA_TPU_DECODE_STEPS"
+ENV_KV_LAYOUT = "KATA_TPU_KV_LAYOUT"
+KV_LAYOUT_HEADS = "heads"
+KV_LAYOUT_BLOCKS = "blocks"
+KV_LAYOUTS = (KV_LAYOUT_HEADS, KV_LAYOUT_BLOCKS)
+'''
+
+_SPEC_PATH = "kata_xpu_device_plugin_tpu/parallel/sharding.py"
+
+
+def test_jg403_layout_falls_off_the_end():
+    spec = '''
+def kv_spec(layout):
+    if layout == "heads":
+        return 1
+'''
+    findings = analyze_sources({GUEST: _KNOBS, _SPEC_PATH: spec},
+                               rules=["JG403"])
+    assert rules_of(findings) == ["JG403"]
+    assert "blocks" in findings[0].message
+
+
+def test_jg403_layout_near_miss_terminal_default():
+    spec = '''
+def kv_spec(layout):
+    if layout == "heads":
+        return 1
+    return 0
+'''
+    assert analyze_sources({GUEST: _KNOBS, _SPEC_PATH: spec},
+                           rules=["JG403"]) == []
+
+
+def test_jg403_layout_outside_lattice():
+    spec = '''
+def kv_spec(layout):
+    if layout == "rows":
+        return 1
+    return 0
+'''
+    findings = analyze_sources({GUEST: _KNOBS, _SPEC_PATH: spec},
+                               rules=["JG403"])
+    assert rules_of(findings) == ["JG403"]
+    assert "'rows'" in findings[0].message
+
+
+_RESHARD = '''
+import jax
+from kata_xpu_device_plugin_tpu.compat import jaxapi
+
+class GenerationServer:
+    def step(self):
+        rows = jax.device_put(self.pending)
+        return rows
+'''
+
+
+def test_jg403_unsanctioned_device_put_on_serving_path():
+    findings = analyze_source(_RESHARD, GUEST, rules=["JG403"])
+    assert rules_of(findings) == ["JG403"]
+    assert "allow_transfer" in findings[0].message
+
+
+def test_jg403_near_miss_lexical_sanction():
+    src = _RESHARD.replace(
+        "rows = jax.device_put(self.pending)",
+        "with jaxapi.allow_transfer(\"staging\"):\n"
+        "            rows = jax.device_put(self.pending)",
+    )
+    assert analyze_source(src, GUEST, rules=["JG403"]) == []
+
+
+def test_jg403_sanction_inheritance_is_depth_limited():
+    # A helper called INSIDE an allow region inherits the sanction up to
+    # 2 levels down; a third level must carry its own reasoned
+    # allow_transfer (the prefetch-miss class the rule exists for).
+    deep = '''
+import jax
+from kata_xpu_device_plugin_tpu.compat import jaxapi
+
+class GenerationServer:
+    def step(self):
+        with jaxapi.allow_transfer("admission"):
+            self._admit()
+
+    def _admit(self):
+        return self._resume()
+
+    def _resume(self):
+        return self._upload()
+
+    def _upload(self):
+        return jax.device_put(self.kv)
+'''
+    findings = analyze_source(deep, GUEST, rules=["JG403"])
+    assert rules_of(findings) == ["JG403"]
+    shallow = deep.replace(
+        "    def _admit(self):\n        return self._resume()\n\n", ""
+    ).replace("self._admit()", "self._resume()")
+    assert analyze_source(shallow, GUEST, rules=["JG403"]) == []
+
+
+# ----- JG404: stale-pragma audit ---------------------------------------------
+
+
+def test_jg404_stale_pragma_is_a_finding():
+    findings = analyze_source(
+        "x = 1  # jaxguard: allow(JG101) fence that no longer exists\n",
+        GUEST,
+    )
+    assert rules_of(findings) == ["JG404"]
+    assert "JG101" in findings[0].message
+
+
+def test_jg404_near_miss_live_pragma():
+    # A pragma whose rule STILL fires on its line is doing its job —
+    # the finding is suppressed and no staleness is reported.
+    src = _HOT_SYNC.replace(
+        "acc += float(compute(x))",
+        "acc += float(compute(x))  # jaxguard: allow(JG101) demo fence",
+    )
+    assert analyze_source(src, GUEST) == []
+
+
+def test_jg404_escape_hatch_allows_defensive_pragma():
+    findings = analyze_source(
+        "x = 1  # jaxguard: allow(JG101, JG404) defensive: kept on purpose\n",
+        GUEST,
+    )
+    assert findings == []
+
+
+# ----- knob lattice ----------------------------------------------------------
+
+
+def test_knob_lattice_derivation():
+    from tools.analyze.dispatch import knob_lattice
+    from tools.analyze.graph import load_program
+
+    program, errors = load_program([], _REPO_ROOT, sources={GUEST: _KNOBS})
+    assert errors == []
+    lattice = knob_lattice(program)
+    # A choice-tuple knob closes over its choices; a bare env constant is
+    # read once per process ("per-process" marker, one census value).
+    assert lattice["KATA_TPU_KV_LAYOUT"] == ("heads", "blocks")
+    assert lattice["KATA_TPU_DECODE_STEPS"] == "per-process"
+
+
 # ----- pragmas ---------------------------------------------------------------
 
 
@@ -350,19 +721,24 @@ def test_pragma_suppresses_on_finding_line():
 
 
 def test_pragma_multi_rule_grammar():
+    # Comma-list grammar: JG102 fires and is suppressed; the JG404 leg
+    # sanctions keeping the list even though only one rule is live (the
+    # stale-pragma audit would otherwise flag the dead half).
     src = _DONATED.replace(
         "return arena.sum()",
-        "return arena.sum()  # jaxguard: allow(JG101, JG102) teardown read",
+        "return arena.sum()  # jaxguard: allow(JG102, JG404) teardown read",
     )
     assert analyze_source(src, GUEST) == []
 
 
 def test_pragma_wrong_rule_does_not_suppress():
+    # The wrong rule both fails to suppress AND is itself reported as
+    # stale sanction debt (JG404) — two findings, one bad pragma.
     src = _DONATED.replace(
         "return arena.sum()",
         "return arena.sum()  # jaxguard: allow(JG103) wrong rule",
     )
-    assert rules_of(analyze_source(src, GUEST)) == ["JG102"]
+    assert rules_of(analyze_source(src, GUEST)) == ["JG102", "JG404"]
 
 
 # ----- acceptance: the real tree ---------------------------------------------
@@ -373,6 +749,24 @@ def test_repo_is_jaxguard_clean():
     analyzer exits clean on the default surface — package + bench +
     scripts — with only the documented pragma sanctions."""
     assert run(root=None) == []
+
+
+def test_repo_is_jg4xx_clean():
+    """ISSUE 19 acceptance: the dispatch-surface passes specifically
+    report nothing on the real tree — the census is closed, donations
+    complete, specs covered, and no pragma is stale."""
+    assert run(root=None, rules=["JG401", "JG402", "JG403", "JG404"]) == []
+
+
+def test_multipass_graph_built_once():
+    """Perf pin: one ``run()`` builds the interprocedural fixpoint
+    exactly once — the dispatch pass REUSES the dataflow engine's call
+    graph instead of re-running it."""
+    from tools.analyze import dataflow
+
+    before = dataflow.FIXPOINT_RUNS
+    run(root=None)
+    assert dataflow.FIXPOINT_RUNS == before + 1
 
 
 # ----- CLI -------------------------------------------------------------------
@@ -422,8 +816,86 @@ def test_cli_list_rules():
         capture_output=True, text=True, cwd=_REPO_ROOT,
     )
     assert proc.returncode == 0
-    for rule in ("JG101", "JG102", "JG103", "JG104"):
+    for rule in ("JG101", "JG102", "JG103", "JG104",
+                 "JG401", "JG402", "JG403", "JG404"):
         assert rule in proc.stdout
+
+
+def test_cli_rule_family_filter(tmp_path):
+    # --rule JG4xx expands to the whole dispatch family: the JG101 sync
+    # in hot.py is out of selection, the stale pragma in stale.py is in.
+    pkg = tmp_path / "kata_xpu_device_plugin_tpu"
+    pkg.mkdir()
+    (pkg / "hot.py").write_text(_HOT_SYNC)
+    (pkg / "stale.py").write_text(
+        "x = 1  # jaxguard: allow(JG102) long-gone donation read\n"
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.analyze",
+            "kata_xpu_device_plugin_tpu", "--root", str(tmp_path),
+            "--rule", "JG4xx",
+        ],
+        capture_output=True, text=True, cwd=_REPO_ROOT,
+    )
+    assert proc.returncode == 1
+    assert "JG404" in proc.stdout
+    assert "JG101" not in proc.stdout
+
+
+def test_cli_rule_family_unknown_digit_is_usage_error():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--rule", "JG9xx"],
+        capture_output=True, text=True, cwd=_REPO_ROOT,
+    )
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_cli_baseline_diff_mode(tmp_path):
+    # Diff mode fails ONLY on findings new versus the committed report:
+    # the pre-existing JG101 rides, a second one introduced after the
+    # baseline was banked is flagged as new.
+    pkg = tmp_path / "kata_xpu_device_plugin_tpu"
+    pkg.mkdir()
+    (pkg / "hot.py").write_text(_HOT_SYNC)
+    baseline = tmp_path / "jaxguard_report.json"
+    cmd = [
+        sys.executable, "-m", "tools.analyze",
+        "kata_xpu_device_plugin_tpu", "--root", str(tmp_path),
+    ]
+    proc = subprocess.run(
+        cmd + ["--json", str(baseline)],
+        capture_output=True, text=True, cwd=_REPO_ROOT,
+    )
+    assert proc.returncode == 1
+    proc = subprocess.run(
+        cmd + ["--baseline", str(baseline)],
+        capture_output=True, text=True, cwd=_REPO_ROOT,
+    )
+    assert proc.returncode == 0
+    assert "0 new vs baseline" in proc.stderr
+    (pkg / "hot2.py").write_text(_HOT_SYNC)
+    proc = subprocess.run(
+        cmd + ["--baseline", str(baseline)],
+        capture_output=True, text=True, cwd=_REPO_ROOT,
+    )
+    assert proc.returncode == 1
+    assert "[new vs baseline]" in proc.stdout
+    assert "hot2.py" in proc.stdout
+    assert "hot.py:" not in proc.stdout.replace("hot2.py:", "")
+
+
+def test_cli_baseline_unreadable_is_usage_error(tmp_path):
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.analyze",
+            "--baseline", str(tmp_path / "missing.json"),
+        ],
+        capture_output=True, text=True, cwd=_REPO_ROOT,
+    )
+    assert proc.returncode == 2
+    assert "unreadable baseline" in proc.stderr
 
 
 def test_syntax_error_reported_not_raised():

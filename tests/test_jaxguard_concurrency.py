@@ -270,17 +270,23 @@ def test_pragma_suppresses_jg201():
 
 
 def test_pragma_suppresses_jg203_but_not_other_rules():
+    # A wrong-family pragma suppresses nothing — JG203 still fires, and
+    # since ISSUE 19 the unused allow(JG201) is itself a JG404 stale-
+    # pragma finding (a dead sanction hides the next real finding).
     src = _BLOCKING.replace(
         "time.sleep(1.0)",
         "time.sleep(1.0)  # jaxguard: allow(JG201) wrong family",
     )
-    assert rules_of(analyze_source(src, OBSMOD)) == ["JG203"]
+    assert rules_of(analyze_source(src, OBSMOD)) == ["JG203", "JG404"]
 
 
 def test_pragma_multi_rule_covers_new_families():
+    # Comma-list grammar across families: JG203 fires and is suppressed;
+    # listing JG404 rides the stale-audit escape hatch (ISSUE 19), so a
+    # list that would otherwise carry a never-firing id stays clean.
     src = _BLOCKING.replace(
         "time.sleep(1.0)",
-        "time.sleep(1.0)  # jaxguard: allow(JG201, JG203) startup only",
+        "time.sleep(1.0)  # jaxguard: allow(JG203, JG404) startup only",
     )
     assert analyze_source(src, OBSMOD) == []
 

@@ -13,12 +13,19 @@ import os
 import sys
 from typing import Iterable, Optional
 
+import re
+
 from ..pragmas import allowed_lines, suppress
 from .concurrency import analyze_concurrency
 from .contracts import analyze_contracts
-from .dataflow import analyze_program
+from .dataflow import Analyzer
+from .dispatch import analyze_dispatch, stale_pragmas
 from .graph import load_program
 from .model import ALL_RULES, KNOB_DOC_PATH, Finding
+
+# `--rule JG1xx` selects a whole pass family (every catalogue id sharing
+# the JG<digit> prefix) — the spelling the docs use for the families.
+_FAMILY_RE = re.compile(r"^JG(\d)[xX]{2}$")
 
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}
 _SKIP_SUFFIXES = ("_pb2.py", "_pb2_grpc.py")
@@ -102,9 +109,20 @@ def run(
                 msg.split(":", 2)[2].strip())
         for msg in errors
     ]
-    findings.extend(analyze_program(program))
+    # ONE engine for every pass family: the dataflow fixpoint builds the
+    # interprocedural call graph, and the dispatch pass reuses it (the
+    # FIXPOINT_RUNS perf pin in tests/test_jaxguard.py).
+    engine = Analyzer(program)
+    findings.extend(engine.run())
     findings.extend(analyze_concurrency(program))
     findings.extend(analyze_contracts(program, doc_text))
+    findings.extend(analyze_dispatch(program, engine))
+    # JG404 adjudicates pragmas against the RAW (pre-suppression) finding
+    # set of every pass above — then rides through suppression like any
+    # other rule (allow(JG404) is the keep-this-pragma escape hatch).
+    findings.extend(stale_pragmas(
+        program, [f for f in findings if f.rule != "E999"]
+    ))
     out: list[Finding] = []
     by_path: dict[str, list] = {}
     for f in findings:
@@ -156,8 +174,10 @@ def main(argv: Optional[list] = None) -> int:
         description=(
             "jaxguard: interprocedural dataflow analysis for JAX "
             "tracer/transfer/donation hazards (JG101-JG104), daemon "
-            "lock discipline (JG201-JG203), and the ENV_* knob "
-            "contract (JG301-JG304)."
+            "lock discipline (JG201-JG203), the ENV_* knob contract "
+            "(JG301-JG304), and the dispatch-surface contract — "
+            "executable census, donation completeness, sharding-spec "
+            "coverage, stale pragmas (JG401-JG404)."
         ),
     )
     parser.add_argument(
@@ -166,7 +186,13 @@ def main(argv: Optional[list] = None) -> int:
     )
     parser.add_argument(
         "--rule", action="append", dest="rules", metavar="ID",
-        help="restrict to one or more rule ids (repeatable)",
+        help="restrict to one or more rule ids (repeatable); a family "
+             "spelling like JG4xx selects every rule in that family",
+    )
+    parser.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="diff mode: fail only on findings NEW versus this committed "
+             "jaxguard report (by path+rule+function occurrence count)",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalogue"
@@ -187,9 +213,38 @@ def main(argv: Optional[list] = None) -> int:
         return 0
 
     if args.rules:
+        expanded: list = []
+        for rule in args.rules:
+            m = _FAMILY_RE.match(rule)
+            if m:
+                family = [
+                    r for r in sorted(ALL_RULES)
+                    if r.startswith(f"JG{m.group(1)}")
+                ]
+                if family:
+                    expanded.extend(family)
+                    continue
+            expanded.append(rule)
+        args.rules = expanded
         unknown = set(args.rules) - set(ALL_RULES)
         if unknown:
             print(f"unknown rule ids: {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    baseline_counts: Optional[dict] = None
+    if args.baseline:
+        try:
+            with open(args.baseline, encoding="utf-8") as fh:
+                report = json.load(fh)
+            baseline_counts = {}
+            for f in report["findings"]:
+                key = (f["path"], f["rule"], f.get("function", ""))
+                baseline_counts[key] = baseline_counts.get(key, 0) + 1
+        except (OSError, ValueError, KeyError, TypeError) as err:
+            print(
+                f"unreadable baseline {args.baseline!r}: {err}",
+                file=sys.stderr,
+            )
             return 2
 
     try:
@@ -200,6 +255,27 @@ def main(argv: Optional[list] = None) -> int:
 
     if args.json:
         write_report(findings, args.json, args.root or os.getcwd())
+
+    if baseline_counts is not None:
+        # Diff mode: a finding is NEW when its occurrence index within
+        # its (path, rule, function) key exceeds the baseline's count —
+        # line numbers shift on every edit, so they don't key.
+        seen: dict = {}
+        new: list = []
+        for finding in findings:
+            key = (finding.path, finding.rule, finding.function)
+            seen[key] = seen.get(key, 0) + 1
+            if seen[key] > baseline_counts.get(key, 0):
+                new.append(finding)
+        for finding in new:
+            print(f"{finding}  [new vs baseline]")
+        print(
+            f"\n{len(findings)} finding(s), {len(new)} new vs baseline "
+            f"{os.path.basename(args.baseline)}.",
+            file=sys.stderr,
+        )
+        return 1 if new else 0
+
     for finding in findings:
         print(finding)
     if findings:

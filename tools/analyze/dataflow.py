@@ -55,6 +55,12 @@ _UNHASHABLE = (
 # name, and binding the result is the tell.
 _MUTATORS = frozenset({"append", "extend", "add", "insert", "update"})
 
+# How many times Analyzer.run() executed its interprocedural fixpoint.
+# The CLI builds ONE engine and threads it through every pass family;
+# tests/test_jaxguard.py pins this at 1 per CLI run so a refactor that
+# quietly rebuilds the graph per pass shows up as a perf regression.
+FIXPOINT_RUNS = 0
+
 
 def _any(t) -> bool:
     """Collapse a (possibly tuple-structured) taint to a plain bool."""
@@ -89,6 +95,8 @@ class Analyzer:
     # ----- driver -----------------------------------------------------------
 
     def run(self) -> list[Finding]:
+        global FIXPOINT_RUNS
+        FIXPOINT_RUNS += 1
         fns = self.prog.functions
         for q, fn in fns.items():
             if fn.jit is not None:
@@ -192,6 +200,12 @@ class _FnEval:
             else:
                 self.env[p] = p in an.tainted_params.get(fn.qualname, ())
         self.watches: dict[str, tuple] = {}  # dotted → (line, callee name)
+        # Staged-dispatch bindings: `fargs = (…)` / `fkw = dict(…)` later
+        # splatted into `fn(*fargs, **fkw)` (the _dispatch_decode idiom).
+        # The donation/static checks expand through them so the single
+        # dispatch site is as visible as a direct call.
+        self.tuple_stages: dict[str, list] = {}
+        self.dict_stages: dict[str, dict] = {}
         self.loop_vars: list[set] = []
         self.globals_decl: set = set()
         self.edges: set = set()
@@ -421,6 +435,21 @@ class _FnEval:
                 self._sync(node, f".{node.func.attr}() of a device value")
                 return False
 
+        # A host materializer passed INTO a tree mapper is the same sync
+        # one level up: jax.tree.map(np.asarray, <device tree>) transfers
+        # every leaf (the spill/demotion spelling).
+        if leaf in ("map", "tree_map") and d.startswith(
+            ("jax.tree", "tree.")
+        ) and node.args:
+            f0 = dotted(node.args[0])
+            if f0 in SYNC_NUMPY and (
+                any(arg_taints[1:]) or any(kw_taints.values())
+            ):
+                # Anchor on the materializer reference itself — that is
+                # the line the sanctioning pragma rides.
+                self._sync(node.args[0], f"{d}({f0}, ...) over a device tree")
+                return False
+
         # Explicit, sanctioned host reads / fences.
         if leaf == "device_get":
             return False
@@ -483,6 +512,29 @@ class _FnEval:
             and "." in d
         ) else 0
 
+    def _expanded_call(self, node: ast.Call) -> tuple:
+        """(positional exprs, (name, expr) keyword pairs) with staged
+        ``*fargs`` / ``**fkw`` spliced back in from their local
+        bindings."""
+        args: list = []
+        for a in node.args:
+            if isinstance(a, ast.Starred) and isinstance(a.value, ast.Name):
+                staged = self.tuple_stages.get(a.value.id)
+                if staged is not None:
+                    args.extend(staged)
+                    continue
+            args.append(a)
+        kws: list = []
+        for k in node.keywords:
+            if k.arg is None and isinstance(k.value, ast.Name):
+                staged_kw = self.dict_stages.get(k.value.id)
+                if staged_kw is not None:
+                    kws.extend(staged_kw.items())
+                    continue
+            if k.arg is not None:
+                kws.append((k.arg, k.value))
+        return args, kws
+
     def _record_param_taints(self, node, callee, arg_taints, kw_taints):
         if callee.jit is not None:
             return
@@ -502,16 +554,17 @@ class _FnEval:
         off = self._call_offset(callee, d)
         donated = set(callee.donated_positions())
         names = set(callee.jit.donate_argnames)
+        args, kws = self._expanded_call(node)
         exprs = []
-        for i, arg in enumerate(node.args):
+        for i, arg in enumerate(args):
             if i + off in donated:
                 exprs.append(arg)
-        for k in node.keywords:
-            if k.arg in names or (
-                k.arg in callee.params
-                and callee.params.index(k.arg) in donated
+        for kname, kval in kws:
+            if kname in names or (
+                kname in callee.params
+                and callee.params.index(kname) in donated
             ):
-                exprs.append(k.value)
+                exprs.append(kval)
         for expr in exprs:
             name = dotted(expr)
             if name is not None:
@@ -524,15 +577,16 @@ class _FnEval:
         if not statics:
             return
         off = self._call_offset(callee, dotted(node.func) or "")
+        args, kws = self._expanded_call(node)
         pairs = []
-        for i, arg in enumerate(node.args):
+        for i, arg in enumerate(args):
             if i + off < len(callee.params) and (
                 callee.params[i + off] in statics
             ):
                 pairs.append((callee.params[i + off], arg))
-        for k in node.keywords:
-            if k.arg in statics:
-                pairs.append((k.arg, k.value))
+        for kname, kval in kws:
+            if kname in statics:
+                pairs.append((kname, kval))
         for pname, arg in pairs:
             if isinstance(arg, _UNHASHABLE):
                 self._add(
@@ -631,6 +685,25 @@ class _FnEval:
         t = self.taint(node.value)
         for target in node.targets:
             self._assign_target(target, t, node.value)
+            if isinstance(target, ast.Name):
+                self._record_staging(target.id, node.value)
+
+    def _record_staging(self, name: str, value: ast.AST) -> None:
+        self.tuple_stages.pop(name, None)
+        self.dict_stages.pop(name, None)
+        if isinstance(value, ast.Tuple):
+            self.tuple_stages[name] = list(value.elts)
+        elif isinstance(value, ast.Call) and dotted(
+            value.func
+        ) == "dict" and not value.args:
+            self.dict_stages[name] = {
+                k.arg: k.value for k in value.keywords if k.arg is not None
+            }
+        elif isinstance(value, ast.Dict):
+            self.dict_stages[name] = {
+                k.value: v for k, v in zip(value.keys, value.values)
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
 
     def _s_AnnAssign(self, node) -> None:
         if node.value is not None:

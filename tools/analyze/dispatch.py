@@ -1,0 +1,832 @@
+"""jaxguard pass: the dispatch-surface contract (JG4xx).
+
+The serving hot path funnels every decode round through ONE dispatch
+site (``GenerationServer._dispatch_decode``) fanning into plain/fused ×
+slotted/paged × tp shard_map executable forms, and its performance story
+rests on three invariants nothing checked statically until this pass:
+
+JG401 — **dispatch census**: every jit-wrapped callable reachable from
+    the serving roots (``GenerationServer.step``/``run``) must draw each
+    STATIC argument from a bounded source — a literal, a config/self
+    attribute, a module constant, or a knob-lattice value — so the
+    executable count is ``buckets × K × forms``, a closed set. A static
+    fed by a traced/device value, a loop variable, or an unresolvable
+    host computation makes the census unbounded: each distinct value
+    compiles a fresh executable (the multi-second spikes the bucket
+    ladder exists to prevent).
+JG402 — **donation completeness** (the dual of JG102's use-after-
+    donation): a PERSISTENT buffer (an attribute chain — ``self.arena``,
+    ``self.kv_pool.arena``, ``p.caches``) donated to a jitted callable
+    must be REBOUND after the call at every call site, on every branch.
+    JG102 fires when the stale buffer is read in the same function;
+    JG402 fires when it is simply left dangling — the next reader (often
+    another method, beyond JG102's intra-procedural watch) gets a
+    deleted buffer.
+JG403 — **sharding-spec coverage**: every ``shard_map`` carries explicit
+    ``in_specs``/``out_specs``; every layout-switched spec helper in the
+    spec modules (``guest/tp_serving.py``, ``parallel/sharding.py``,
+    ``ops/decode_attn.py``) covers the WHOLE kv-layout lattice
+    (heads/blocks both) with no silent ``None`` fall-through; and no
+    ``device_put`` runs on the serving-reachable path outside a
+    sanctioned ``allow_transfer`` region (the implicit-reshard class the
+    runtime tripwire counts as near-misses).
+JG404 — **stale-pragma audit**: a ``# jaxguard: allow(RULE)`` whose rule
+    no longer fires anywhere on that line is itself a finding, so
+    sanctioned-sync debt cannot rot in place.
+
+The pass REUSES the dataflow engine's program graph (``Analyzer.run``'s
+``call_edges``) instead of rebuilding it — the CLI constructs one
+:class:`~.dataflow.Analyzer` and threads it through every pass (the
+multi-pass graph is built once; ``tests/test_jaxguard.py`` pins it).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .graph import FunctionInfo, Module, Program, dotted
+from .model import (
+    DEVICE_FN_NAMES,
+    DEVICE_PREFIXES,
+    DISPATCH_ROOT_SUFFIXES,
+    Finding,
+    LAYOUT_PARAM_NAMES,
+    SPEC_MODULE_PATHS,
+)
+
+# Source classes for a static argument's value lattice (JG401).
+_BOUNDED = "bounded"
+_DEVICE = "device"
+_UNBOUNDED = "unbounded"
+
+# Host builtins whose result is as bounded as their arguments.
+_PURE_HOST = frozenset({"min", "max", "abs", "round", "tuple", "str", "repr"})
+
+_ALLOW_LEAVES = frozenset({"allow_transfer"})
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+# ---------------------------------------------------------------------------
+# The knob lattice (JG401's value universe for knob-derived statics)
+# ---------------------------------------------------------------------------
+
+
+def knob_lattice(program: Program) -> dict:
+    """Map env-var NAME → its statically known value lattice, derived
+    from the knob constants the contract pass (JG3xx) already anchors
+    on: a module defining ``ENV_FOO = "KATA_TPU_FOO"`` next to a
+    same-stem choice tuple (``FOO + "S"`` — e.g. ``KV_LAYOUTS`` for
+    ``ENV_KV_LAYOUT``) yields that tuple as the closed lattice; an env
+    constant with no choice tuple (``KATA_TPU_DECODE_STEPS``) yields the
+    ``"per-process"`` marker — the knob is read once at server init, so
+    it contributes ONE value per process to the census, not an unbounded
+    family."""
+    out: dict = {}
+    for mod in program.modules.values():
+        env_names: dict = {}     # const name (sans ENV_) → env value
+        tuples: dict = {}        # const name → tuple of string choices
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if isinstance(node.value, ast.Constant) and isinstance(
+                    node.value.value, str
+                ) and tgt.id.startswith("ENV_"):
+                    env_names[tgt.id[len("ENV_"):]] = node.value.value
+                elif isinstance(node.value, ast.Tuple):
+                    elts = [
+                        e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    ]
+                    # Resolve Name elements through the module's own
+                    # string constants (KV_LAYOUTS = (KV_LAYOUT_HEADS,
+                    # KV_LAYOUT_BLOCKS) — the repo's actual spelling).
+                    consts = {
+                        t.id: n.value.value
+                        for n in mod.tree.body
+                        if isinstance(n, ast.Assign)
+                        and isinstance(n.value, ast.Constant)
+                        and isinstance(n.value.value, str)
+                        for t in n.targets if isinstance(t, ast.Name)
+                    }
+                    for e in node.value.elts:
+                        if isinstance(e, ast.Name) and e.id in consts:
+                            elts.append(consts[e.id])
+                    if elts and len(elts) == len(node.value.elts):
+                        tuples[tgt.id] = tuple(elts)
+        for stem, env_value in env_names.items():
+            choices = tuples.get(stem + "S")
+            out[env_value] = choices if choices else "per-process"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Serving reachability over the shared call graph
+# ---------------------------------------------------------------------------
+
+
+def serving_reachable(program: Program, call_edges: dict) -> set:
+    """Qualnames reachable from the SERVING roots (``GenerationServer.
+    step``/``run``) over the dataflow engine's resolved call graph —
+    crossing INTO jitted callees (the census wants the executables
+    themselves), unlike the JG101 hot set which stops at the jit
+    boundary."""
+    roots = set()
+    for q, fn in program.functions.items():
+        flat = q.replace(":", ".")
+        if any(flat.endswith(s) for s in DISPATCH_ROOT_SUFFIXES):
+            roots.add(q)
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        q = frontier.pop()
+        for callee in call_edges.get(q, ()):
+            if callee not in seen and callee in program.functions:
+                seen.add(callee)
+                frontier.append(callee)
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# The per-function walk: census sources, donation watches, transfer sites
+# ---------------------------------------------------------------------------
+
+
+class _SiteWalk(ast.NodeVisitor):
+    """One lexical pass over one HOST (non-traced) function reachable
+    from the serving roots: classifies every static argument fed to a
+    jitted callee (JG401), watches every donated persistent buffer for
+    its rebind (JG402), and records ``device_put`` sites with their
+    ``allow_transfer`` sanction state (JG403). Sees through the staged
+    dispatch idiom — ``fargs = (...)`` / ``fkw = dict(...)`` then
+    ``fn(*fargs, **fkw)`` — the same expansion the dataflow engine's
+    donation/static checks use."""
+
+    def __init__(self, prog: Program, fn: FunctionInfo) -> None:
+        self.prog = prog
+        self.fn = fn
+        self.mod = prog.modules[fn.modname]
+        self.locals: dict[str, str] = {}      # name → source class
+        self.tuple_stages: dict[str, list] = {}
+        self.dict_stages: dict[str, dict] = {}
+        self.loop_vars: list[set] = []
+        self.allow_depth = 0
+        self.in_return = 0
+        # dotted → (line, callee leaf name): donated persistent buffers
+        # awaiting their rebind.
+        self.donation_watches: dict[str, tuple] = {}
+        self.findings: list[Finding] = []
+        # (call node, sanctioned: bool) for every device_put reached.
+        self.transfer_sites: list[tuple] = []
+        # (caller-relative) call records: callee qualname → list of
+        # ``inside allow region`` bools, for the sanction fixpoint.
+        self.call_sanction: list[tuple] = []
+
+    # ----- helpers ----------------------------------------------------------
+
+    def _add(self, node: ast.AST, rule: str, message: str) -> None:
+        self.findings.append(Finding(
+            self.fn.path, getattr(node, "lineno", 1), rule, message,
+            function=self.fn.qualname,
+        ))
+
+    def _resolve(self, d: str) -> Optional[FunctionInfo]:
+        if not d:
+            return None
+        return self.prog.resolve_call(self.mod, self.fn.cls, d)
+
+    def _call_offset(self, callee: FunctionInfo, d: str) -> int:
+        return 1 if (
+            callee.cls is not None
+            and callee.params[:1] in (("self",), ("cls",))
+            and "." in d
+        ) else 0
+
+    def _expand_call(self, node: ast.Call) -> tuple:
+        """(positional exprs, keyword (name, expr) pairs) with staged
+        ``*fargs`` / ``**fkw`` spliced back in."""
+        args: list = []
+        for a in node.args:
+            if isinstance(a, ast.Starred) and isinstance(a.value, ast.Name):
+                staged = self.tuple_stages.get(a.value.id)
+                if staged is not None:
+                    args.extend(staged)
+                    continue
+            args.append(a)
+        kws: list = []
+        for k in node.keywords:
+            if k.arg is None and isinstance(k.value, ast.Name):
+                staged_kw = self.dict_stages.get(k.value.id)
+                if staged_kw is not None:
+                    kws.extend(staged_kw.items())
+                    continue
+            if k.arg is not None:
+                kws.append((k.arg, k.value))
+        return args, kws
+
+    def _in_loop_vars(self, expr: ast.AST) -> Optional[str]:
+        names = {n for scope in self.loop_vars for n in scope}
+        if not names:
+            return None
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in names:
+                return sub.id
+        return None
+
+    # ----- JG401: static-source classification ------------------------------
+
+    def classify(self, expr: ast.AST, depth: int = 0) -> str:
+        """The source class of a static argument's value: ``bounded``
+        (literal / self attribute / module constant / param — one value
+        per process or per server instance), ``device`` (a traced value
+        — can never be static), or ``unbounded`` (an unresolvable host
+        computation — the census cannot close over it)."""
+        if depth > 8:
+            return _UNBOUNDED
+        if isinstance(expr, ast.Constant):
+            return _BOUNDED
+        if isinstance(expr, ast.Name):
+            if expr.id in self.locals:
+                return self.locals[expr.id]
+            if expr.id in self.fn.params:
+                return _BOUNDED
+            if expr.id in self.mod.imports or expr.id in getattr(
+                self.mod, "functions", {}
+            ):
+                return _BOUNDED  # imported constant / module callable
+            # A module-level constant of this module.
+            for node in self.mod.tree.body:
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name) and tgt.id == expr.id:
+                            return _BOUNDED
+            return _UNBOUNDED
+        if isinstance(expr, ast.Attribute):
+            d = dotted(expr)
+            if d is not None:
+                head = d.split(".", 1)[0]
+                if head in ("self", "cls"):
+                    return _BOUNDED  # instance config, fixed per server
+                if head in self.mod.imports:
+                    return _BOUNDED  # module attr — a constant spelling
+                if head in self.fn.params:
+                    return _BOUNDED  # cfg.block_size and friends
+                if head in self.locals:
+                    return self.locals[head]
+            return self.classify(expr.value, depth + 1)
+        if isinstance(expr, ast.IfExp):
+            return self._join(
+                self.classify(expr.body, depth + 1),
+                self.classify(expr.orelse, depth + 1),
+            )
+        if isinstance(expr, (ast.BinOp,)):
+            return self._join(
+                self.classify(expr.left, depth + 1),
+                self.classify(expr.right, depth + 1),
+            )
+        if isinstance(expr, ast.UnaryOp):
+            return self.classify(expr.operand, depth + 1)
+        if isinstance(expr, ast.BoolOp):
+            out = _BOUNDED
+            for v in expr.values:
+                out = self._join(out, self.classify(v, depth + 1))
+            return out
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out = _BOUNDED
+            for e in expr.elts:
+                out = self._join(out, self.classify(e, depth + 1))
+            return out
+        if isinstance(expr, ast.Subscript):
+            return self.classify(expr.value, depth + 1)
+        if isinstance(expr, ast.Compare):
+            return _BOUNDED  # a bool of host values
+        if isinstance(expr, ast.Call):
+            return self._classify_call(expr, depth)
+        return _UNBOUNDED
+
+    @staticmethod
+    def _join(a: str, b: str) -> str:
+        order = (_DEVICE, _UNBOUNDED, _BOUNDED)
+        for cls in order:
+            if a == cls or b == cls:
+                return cls
+        return _BOUNDED
+
+    def _classify_call(self, expr: ast.Call, depth: int) -> str:
+        d = dotted(expr.func) or ""
+        leaf = d.rsplit(".", 1)[-1]
+        callee = self._resolve(d)
+        if callee is not None and callee.jit is not None:
+            return _DEVICE
+        if d.startswith(DEVICE_PREFIXES) or leaf in DEVICE_FN_NAMES:
+            return _DEVICE
+        if d in _PURE_HOST or leaf in _PURE_HOST:
+            out = _BOUNDED
+            for a in expr.args:
+                out = self._join(out, self.classify(a, depth + 1))
+            return out
+        return _UNBOUNDED
+
+    # ----- JG402: donation watches ------------------------------------------
+
+    def _watch_donations(self, node: ast.Call, callee: FunctionInfo,
+                         d: str, args: list, kws: list) -> None:
+        if callee.jit is None or not callee.jit.donates:
+            return
+        if self.in_return:
+            # The successor escapes to OUR caller — rebinding is its
+            # responsibility, not statically trackable from here.
+            return
+        off = self._call_offset(callee, d)
+        donated = set(callee.donated_positions())
+        names = set(callee.jit.donate_argnames)
+        exprs = []
+        for i, arg in enumerate(args):
+            if i + off in donated:
+                exprs.append(arg)
+        for kname, kval in kws:
+            if kname in names or (
+                kname in callee.params
+                and callee.params.index(kname) in donated
+            ):
+                exprs.append(kval)
+        for expr in exprs:
+            name = dotted(expr)
+            # Only PERSISTENT locations (attribute chains) are watched:
+            # a donated local that is never touched again simply dies
+            # with the frame — no dangling state survives the call.
+            if name is not None and "." in name:
+                self.donation_watches[name] = (node.lineno, callee.name)
+
+    def _clear_watch(self, name: Optional[str]) -> None:
+        if name is None:
+            return
+        for watched in list(self.donation_watches):
+            if (
+                watched == name
+                or watched.startswith(name + ".")
+                or name.startswith(watched + ".")
+            ):
+                del self.donation_watches[watched]
+
+    # ----- JG401/JG402/JG403 at the call site --------------------------------
+
+    def _check_call(self, node: ast.Call) -> None:
+        d = dotted(node.func) or ""
+        leaf = d.rsplit(".", 1)[-1]
+        if leaf == "device_put":
+            self.transfer_sites.append((node, self.allow_depth > 0))
+        callee = self._resolve(d)
+        if callee is None:
+            return
+        self.call_sanction.append((callee.qualname, self.allow_depth > 0))
+        if callee.jit is None:
+            return
+        args, kws = self._expand_call(node)
+        self._watch_donations(node, callee, d, args, kws)
+        statics = callee.static_param_names()
+        if not statics:
+            return
+        off = self._call_offset(callee, d)
+        pairs = []
+        for i, arg in enumerate(args):
+            if i + off < len(callee.params) and (
+                callee.params[i + off] in statics
+            ):
+                pairs.append((callee.params[i + off], arg))
+        for kname, kval in kws:
+            if kname in statics:
+                pairs.append((kname, kval))
+        for pname, arg in pairs:
+            cls = self.classify(arg)
+            if cls == _DEVICE:
+                self._add(
+                    node, "JG401",
+                    f"traced/device value feeds static arg '{pname}' of "
+                    f"jitted '{callee.name}' — a traced arg can never be "
+                    "static; pass it as a traced operand or hoist the "
+                    "decision to server config",
+                )
+                continue
+            var = self._in_loop_vars(arg)
+            if var is not None:
+                self._add(
+                    node, "JG401",
+                    f"static arg '{pname}' of jitted '{callee.name}' "
+                    f"varies with loop variable '{var}' — the executable "
+                    "census is unbounded (one compile per iteration)",
+                )
+                continue
+            if cls == _UNBOUNDED:
+                src = ast.dump(arg)[:60] if dotted(arg) is None else (
+                    dotted(arg)
+                )
+                self._add(
+                    node, "JG401",
+                    f"static arg '{pname}' of jitted '{callee.name}' "
+                    f"draws from an unbounded source '{src}' — bind it "
+                    "to a config attribute or knob constant so the "
+                    "dispatch census stays closed",
+                )
+
+    # ----- statement/expression traversal ------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._check_call(node)
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        is_allow = any(
+            isinstance(item.context_expr, ast.Call)
+            and (dotted(item.context_expr.func) or "").rsplit(".", 1)[-1]
+            in _ALLOW_LEAVES
+            for item in node.items
+        )
+        for item in node.items:
+            self.visit(item.context_expr)
+        if is_allow:
+            self.allow_depth += 1
+        for child in node.body:
+            self.visit(child)
+        if is_allow:
+            self.allow_depth -= 1
+
+    visit_AsyncWith = visit_With
+
+    def visit_Return(self, node: ast.Return) -> None:
+        self.in_return += 1
+        self.generic_visit(node)
+        self.in_return -= 1
+
+    def visit_If(self, node: ast.If) -> None:
+        # Branch-SENSITIVE donation watches: each arm starts from the
+        # pre-branch watch set and the arms' leftovers UNION afterwards —
+        # a donation rebound on one branch but dangling on its sibling
+        # (the per-branch asymmetry JG402 exists for) stays visible.
+        self.visit(node.test)
+        before = dict(self.donation_watches)
+        for child in node.body:
+            self.visit(child)
+        after_body = self.donation_watches
+        self.donation_watches = dict(before)
+        for child in node.orelse:
+            self.visit(child)
+        merged = dict(after_body)
+        merged.update(self.donation_watches)
+        self.donation_watches = merged
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        for tgt in node.targets:
+            self._assign(tgt, node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+            self._assign(node.target, node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        self._clear_watch(dotted(node.target))
+
+    def _assign(self, tgt: ast.AST, value: ast.AST) -> None:
+        if isinstance(tgt, ast.Name):
+            self._clear_watch(tgt.id)
+            if isinstance(value, ast.Tuple):
+                self.tuple_stages[tgt.id] = list(value.elts)
+            elif isinstance(value, ast.Call) and dotted(
+                value.func
+            ) == "dict" and not value.args:
+                self.dict_stages[tgt.id] = {
+                    k.arg: k.value for k in value.keywords
+                    if k.arg is not None
+                }
+            elif isinstance(value, ast.Dict):
+                self.dict_stages[tgt.id] = {
+                    k.value: v for k, v in zip(value.keys, value.values)
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                }
+            self.locals[tgt.id] = self.classify(value)
+        elif isinstance(tgt, ast.Attribute):
+            self._clear_watch(dotted(tgt))
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                if isinstance(e, ast.Starred):
+                    e = e.value
+                self._assign(e, value)
+        elif isinstance(tgt, ast.Starred):
+            self._assign(tgt.value, value)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit(node.iter)
+        self._assign(node.target, node.iter)
+        scope = {
+            n.id for n in ast.walk(node.target) if isinstance(n, ast.Name)
+        }
+        self.loop_vars.append(scope)
+        for child in node.body:
+            self.visit(child)
+        self.loop_vars.pop()
+        for child in node.orelse:
+            self.visit(child)
+
+    visit_AsyncFor = visit_For
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            self._clear_watch(dotted(tgt))
+
+    def visit_FunctionDef(self, node) -> None:
+        if node is not self.fn.node:
+            return  # nested defs are walked as their own functions
+        for child in node.body:
+            self.visit(child)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def run(self) -> None:
+        self.visit_FunctionDef(self.fn.node)
+        for name, (line, callee) in sorted(self.donation_watches.items()):
+            self.findings.append(Finding(
+                self.fn.path, line, "JG402",
+                f"'{name}' is donated to jitted '{callee}' but never "
+                "rebound in this function — XLA deleted the buffer, the "
+                "attribute now dangles; store the call's result back "
+                f"('{name} = ...')",
+                function=self.fn.qualname,
+            ))
+
+
+# ---------------------------------------------------------------------------
+# JG403 — shard_map spec completeness + layout-lattice coverage
+# ---------------------------------------------------------------------------
+
+
+def _shard_map_findings(program: Program) -> list:
+    findings: list = []
+    for mod in program.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func) or ""
+            if d.rsplit(".", 1)[-1] != "shard_map":
+                continue
+            kw = {k.arg for k in node.keywords if k.arg is not None}
+            # Positional spelling: shard_map(fn, mesh, in_specs, out_specs).
+            have = len(node.args)
+            for i, name in enumerate(("in_specs", "out_specs"), start=2):
+                explicit = name in kw or have > i
+                none_valued = any(
+                    k.arg == name and isinstance(k.value, ast.Constant)
+                    and k.value.value is None
+                    for k in node.keywords
+                )
+                if not explicit or none_valued:
+                    findings.append(Finding(
+                        mod.path, node.lineno, "JG403",
+                        f"shard_map call without an explicit '{name}' — "
+                        "every array crossing the manual-mesh boundary "
+                        "needs a declared PartitionSpec (implicit specs "
+                        "reshard silently)",
+                    ))
+    return findings
+
+
+def _layout_coverage_findings(program: Program, lattice: dict) -> list:
+    """In the SPEC modules, a function switching on a kv-layout param
+    must (a) only compare it against lattice members and (b) not let a
+    layout fall off the end of the function (an implicit ``None`` spec
+    is an implicit reshard at the dispatch)."""
+    layouts: tuple = ()
+    for value, choices in lattice.items():
+        if isinstance(choices, tuple) and "LAYOUT" in value.upper():
+            layouts = choices
+    findings: list = []
+    spec_paths = {p for p in SPEC_MODULE_PATHS}
+    # Leaf-named string constants across the WHOLE program, so a spec
+    # module comparing against an IMPORTED layout constant
+    # (`tp_serving.KV_LAYOUT_BLOCKS`) still resolves to its value.
+    global_consts: dict = {}
+    for m in program.modules.values():
+        for n in m.tree.body:
+            if isinstance(n, ast.Assign) and isinstance(
+                n.value, ast.Constant
+            ) and isinstance(n.value.value, str):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        global_consts.setdefault(t.id, n.value.value)
+    for mod in program.modules.values():
+        if _norm(mod.path) not in spec_paths:
+            continue
+        consts = dict(global_consts)
+        consts.update({
+            t.id: n.value.value
+            for n in mod.tree.body
+            if isinstance(n, ast.Assign)
+            and isinstance(n.value, ast.Constant)
+            and isinstance(n.value.value, str)
+            for t in n.targets if isinstance(t, ast.Name)
+        })
+        for fn in mod.functions.values():
+            if fn.nested:
+                continue
+            node = fn.node
+            lay_params = [
+                p for p in fn.params if p in LAYOUT_PARAM_NAMES
+            ]
+            if not lay_params:
+                continue
+            compared: set = set()
+            bad: list = []
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Compare):
+                    continue
+                sides = [sub.left] + list(sub.comparators)
+                if not any(
+                    isinstance(s, ast.Name) and s.id in lay_params
+                    for s in sides
+                ):
+                    continue
+                for s in sides:
+                    value = None
+                    if isinstance(s, ast.Constant) and isinstance(
+                        s.value, str
+                    ):
+                        value = s.value
+                    else:
+                        ds = dotted(s)
+                        if ds is not None:
+                            leaf = ds.split(".")[-1]
+                            value = consts.get(leaf)
+                            if value is None and leaf in mod.imports:
+                                tail = mod.imports[leaf].rsplit(".", 1)[-1]
+                                value = consts.get(tail)
+                    if value is None:
+                        continue
+                    compared.add(value)
+                    if layouts and value not in layouts:
+                        bad.append((sub, value))
+            for sub, value in bad:
+                findings.append(Finding(
+                    mod.path, sub.lineno, "JG403",
+                    f"'{fn.name}' compares its layout param against "
+                    f"{value!r}, which is not in the kv-layout lattice "
+                    f"{layouts} — a stale/typo'd layout name can never "
+                    "match",
+                    function=fn.qualname,
+                ))
+            if not compared:
+                continue
+            terminal = any(
+                isinstance(stmt, ast.Return) for stmt in node.body
+            )
+            missing = [v for v in layouts if v not in compared]
+            if missing and not terminal:
+                findings.append(Finding(
+                    mod.path, node.lineno, "JG403",
+                    f"'{fn.name}' switches on a kv-layout param but "
+                    f"layout(s) {missing} fall off the end of the "
+                    "function — an implicit None spec reshards at "
+                    "dispatch; add the branch or a terminal default "
+                    "return",
+                    function=fn.qualname,
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JG404 — stale-pragma audit
+# ---------------------------------------------------------------------------
+
+
+def stale_pragmas(program: Program, raw_findings: list) -> list:
+    """A ``# jaxguard: allow(JGxxx)`` pragma whose rule did not fire on
+    its own line in THIS analysis run is dead sanction debt: either the
+    hazard was fixed (delete the pragma) or the analyzer stopped seeing
+    it (the pragma hides nothing — audit why). ``raw_findings`` must be
+    the PRE-suppression finding set of every other pass; JG404 findings
+    are themselves suppressible (``allow(JG404) <why this pragma is
+    intentionally defensive>``)."""
+    from ..pragmas import allowed_lines
+
+    fired: dict = {}
+    for f in raw_findings:
+        fired.setdefault((f.path, f.line), set()).add(f.rule)
+    findings: list = []
+    for mod in program.modules.values():
+        for line, rules in sorted(allowed_lines(mod.src).items()):
+            for rule in sorted(rules):
+                if not rule.startswith("JG") or rule == "JG404":
+                    continue
+                if rule not in fired.get((mod.path, line), ()):
+                    findings.append(Finding(
+                        mod.path, line, "JG404",
+                        f"stale pragma: allow({rule}) but {rule} no "
+                        "longer fires on this line — delete the pragma, "
+                        "or annotate allow(JG404) with why it must stay",
+                    ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Pass driver
+# ---------------------------------------------------------------------------
+
+
+def analyze_dispatch(program: Program, analyzer=None) -> list:
+    """Run JG401–JG403 over ``program``. ``analyzer`` is the already-run
+    :class:`~.dataflow.Analyzer` whose ``call_edges`` this pass reuses —
+    pass it from the CLI so the interprocedural graph is built once;
+    ``None`` builds one standalone (test convenience)."""
+    if analyzer is None:
+        from .dataflow import Analyzer
+
+        analyzer = Analyzer(program)
+        analyzer.run()
+    reach = serving_reachable(program, analyzer.call_edges)
+    lattice = knob_lattice(program)
+    findings: list = []
+    transfer_fns: dict = {}  # qualname → list of (node, lexically sanctioned)
+    sites: list = []         # (caller qualname, callee qualname, in allow)
+    for q in sorted(reach):
+        fn = program.functions[q]
+        if analyzer.traced(fn):
+            continue  # traced bodies dispatch nothing themselves
+        walk = _SiteWalk(program, fn)
+        walk.run()
+        findings.extend(walk.findings)
+        if walk.transfer_sites:
+            transfer_fns[q] = walk.transfer_sites
+        for callee_q, in_allow in walk.call_sanction:
+            sites.append((q, callee_q, in_allow))
+    # JG403(c): a device_put on the serving-reachable path is sanctioned
+    # when it sits lexically inside allow_transfer, or when EVERY serving
+    # call site of its enclosing function does — directly or through a
+    # sanctioned caller (the _restore_lane → _kv_host_upload inheritance
+    # pattern). Inheritance is DEPTH-LIMITED to 2 call levels below the
+    # lexical `with`: an allow region wrapping a broad phase (the
+    # admission wrap) must not silently sanction a serialized upload
+    # three helpers down — that is exactly the prefetch-miss slow path
+    # this rule exists to surface; a deep slow path earns its own
+    # reasoned allow_transfer at the transfer itself.
+    _SANCTION_DEPTH = 2
+    by_callee: dict = {}
+    for caller_q, callee_q, in_allow in sites:
+        by_callee.setdefault(callee_q, []).append((caller_q, in_allow))
+    depth: dict = {}  # qualname → levels below the nearest lexical with
+    for _ in range(_SANCTION_DEPTH + 1):
+        changed = False
+        for q, callers in by_callee.items():
+            if q in depth:
+                continue
+            contrib: list = []
+            for caller_q, in_allow in callers:
+                if in_allow:
+                    contrib.append(0)
+                elif caller_q in depth:
+                    contrib.append(depth[caller_q])
+                else:
+                    contrib = None
+                    break
+            if contrib is None:
+                continue
+            d = 1 + max(contrib, default=0)
+            if d <= _SANCTION_DEPTH:
+                depth[q] = d
+                changed = True
+        if not changed:
+            break
+    sanctioned_fns = set(depth)
+    for q, put_sites in sorted(transfer_fns.items()):
+        fn = program.functions[q]
+        if q in sanctioned_fns:
+            continue
+        for node, lexical in put_sites:
+            if lexical:
+                continue
+            findings.append(Finding(
+                fn.path, node.lineno, "JG403",
+                "device_put on the serving-reachable path outside an "
+                "allow_transfer region — an implicit reshard/upload "
+                "serializes the decode round (wrap the sanctioned slow "
+                "path in jaxapi.allow_transfer(<reason>))",
+                function=fn.qualname,
+            ))
+    findings.extend(_shard_map_findings(program))
+    findings.extend(_layout_coverage_findings(program, lattice))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+__all__ = [
+    "analyze_dispatch",
+    "knob_lattice",
+    "serving_reachable",
+    "stale_pragmas",
+]

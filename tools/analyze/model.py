@@ -44,6 +44,20 @@ ALL_RULES = {
     "JG303": "ENV_* knob parse site converts (int/float) outside a "
              "degrade-with-event guard — malformed env would raise",
     "JG304": "ENV_* knob has no row in docs/observability.md",
+    # --- JG4xx: dispatch-surface contract (tools.analyze.dispatch) ------
+    "JG401": "dispatch census violation (a static arg of a serving-"
+             "reachable jitted callable draws from a traced, loop-"
+             "varying, or unbounded source — the executable set is not "
+             "closed)",
+    "JG402": "donation incompleteness (a persistent buffer donated to a "
+             "jitted call is never rebound at the call site — the "
+             "attribute dangles on a deleted buffer)",
+    "JG403": "sharding-spec coverage gap (shard_map without explicit "
+             "in/out specs, a kv-layout branch outside the lattice or "
+             "falling through to None, or device_put on the serving "
+             "path outside allow_transfer)",
+    "JG404": "stale pragma (an allow(RULE) whose rule no longer fires "
+             "on that line — dead sanction debt)",
 }
 
 # Callables whose RESULTS are device values regardless of whether the
@@ -112,6 +126,31 @@ HOT_ROOT_SUFFIXES = (
 # Inline marker that makes any function a hot root (same comment channel
 # as the allow() pragmas; see tools.pragmas for the suppression side).
 HOT_MARK = "# jaxguard: hot"
+
+# ---------------------------------------------------------------------------
+# JG4xx — dispatch-surface contract (tools.analyze.dispatch)
+# ---------------------------------------------------------------------------
+
+# The SERVING roots of the dispatch census: unlike HOT_ROOT_SUFFIXES this
+# deliberately excludes the trainer — the census/reshard contract is a
+# serving-loop property (training legitimately device_puts batches and
+# compiles per shape bucket on its own schedule).
+DISPATCH_ROOT_SUFFIXES = (
+    "GenerationServer.step",
+    "GenerationServer.run",
+)
+
+# Modules whose spec helpers must cover the whole kv-layout lattice
+# (JG403): every layout comparison resolves to a lattice member and no
+# layout falls off the end of a spec function.
+SPEC_MODULE_PATHS = (
+    "kata_xpu_device_plugin_tpu/guest/tp_serving.py",
+    "kata_xpu_device_plugin_tpu/parallel/sharding.py",
+    "kata_xpu_device_plugin_tpu/ops/decode_attn.py",
+)
+
+# Parameter names that carry a kv-layout selector into a spec helper.
+LAYOUT_PARAM_NAMES = frozenset({"layout", "kv_layout"})
 
 # ---------------------------------------------------------------------------
 # JG2xx — lock discipline (tools.analyze.concurrency)

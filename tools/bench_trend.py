@@ -75,6 +75,17 @@ INFO_LOWER_IS_BETTER = (
     "serving_dispatch_gap_ms",
 )
 
+# Zero-is-the-only-passing-value metrics (ISSUE 19): the steady-state
+# compile/reshard tripwire. A nonzero NEW value is a regression by
+# definition — the warm dispatch surface recompiled (a jit static arg
+# varied per round) — regardless of threshold, and two equal nonzero
+# banks are still a regression, never "flat": the breach does not age
+# into a baseline.
+ZERO_REQUIRED_METRICS = (
+    "serving_steady_state_compiles",
+    "serving_steady_state_reshards",
+)
+
 DEFAULT_THRESHOLD = 0.10  # 10%
 
 # Non-measurement fields a bank carries that must not enter the table.
@@ -126,7 +137,9 @@ def compare(old: dict, new: dict,
     flipped a ``*_layout`` config field between the banks — an
     intentional A/B, never a regression), ``info-better`` /
     ``info-worse`` / ``info`` (lower-is-better info metrics — direction
-    flipped, never gating), or ``""`` (context)."""
+    flipped, never gating), or ``""`` (context). Tripwire metrics
+    (``ZERO_REQUIRED_METRICS``) gate on the NEW value alone: nonzero is
+    ``regression`` even when both banks match — never ``flat``."""
     om, nm = numeric_metrics(old), numeric_metrics(new)
     flip_prefixes = tuple(
         k[: -len("layout")] for k in layout_flips(old, new)
@@ -136,7 +149,11 @@ def compare(old: dict, new: dict,
         a, b = om[k], nm[k]
         delta = (b - a) / a if a else (0.0 if b == a else float("inf"))
         status = ""
-        if k in HEADLINE_METRICS:
+        if k in ZERO_REQUIRED_METRICS:
+            status = "regression" if b != 0 else (
+                "improved" if a != 0 else "flat"
+            )
+        elif k in HEADLINE_METRICS:
             if b == a:
                 status = "flat"
             elif delta < -threshold:

@@ -6,7 +6,7 @@ VERSION := 0.1.0
 IMAGE   := $(NAME):v$(VERSION)
 PY      := python3
 
-.PHONY: all build proto lint analyze census race verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused kv-layout devledger eval eval-kv demo dryrun image clean deploy obs-check obs-report
+.PHONY: all build proto lint analyze census race verify-static test test-fast bench bench-smoke bench-load bench-trend bench-watch chaos tp decode-attn fused persistent kv-layout devledger eval eval-kv demo dryrun image clean deploy obs-check obs-report
 
 all: build
 
@@ -220,6 +220,26 @@ chaos:
 	KATA_TPU_FAULTS="decode_dispatch:4,sched_tick:3" KATA_TPU_FAULTS_SEED=13 \
 	KATA_TPU_DECODE_STEPS=2 KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_fused_decode.py -q
+	# Persistent-decode chaos (ISSUE 20): decode_dispatch faults land
+	# MID-WHILE_LOOP — under the node-injected KATA_TPU_PERSISTENT=1
+	# every eligible server in the persistent suite runs its decode
+	# rounds as one while_loop dispatch (explicit-knob tests override
+	# it), so the fault discards a round whose delivered count was never
+	# fenced and recovery must replay from the prompt bit-identically —
+	# both strict modes. sched_tick:3 fires at a fused slice boundary in
+	# the fused × persistent composition test.
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/chaos_persistent_events.jsonl \
+	KATATPU_FLIGHT_DIR=artifacts/chaos_flight_dumps \
+	KATA_TPU_FAULTS="decode_dispatch:4,sched_tick:3" KATA_TPU_FAULTS_SEED=13 \
+	KATA_TPU_PERSISTENT=1 \
+	  $(PY) -m pytest tests/test_persistent_decode.py -q
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/chaos_persistent_events_strict.jsonl \
+	KATATPU_FLIGHT_DIR=artifacts/chaos_flight_dumps \
+	KATA_TPU_FAULTS="decode_dispatch:4,sched_tick:3" KATA_TPU_FAULTS_SEED=13 \
+	KATA_TPU_PERSISTENT=1 KATA_TPU_STRICT=1 \
+	  $(PY) -m pytest tests/test_persistent_decode.py -q
 	# KV layout chaos (ISSUE 14): pool_alloc faults land MID-DEMOTION —
 	# the pool_alloc seam fires inside the allocation pressure path that
 	# drives host-tier demotions — under the node-injected blocks layout,
@@ -319,6 +339,25 @@ fused:
 	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/fused_events_strict.jsonl \
 	KATA_TPU_STRICT=1 \
 	  $(PY) -m pytest tests/test_fused_decode.py -q
+
+# Persistent decode gate (ISSUE 20): the while_loop-round suite on the
+# forced-2-device host — the persistent ≡ lockstep-K=1 greedy-identity
+# matrix (paged/slotted × tp{1,2} × fused admissions × tp-overlap),
+# executable-level cap/window early-exit bounds, the exit-reason
+# partition (cap/done/window ↔ persistent_exit events), seeded
+# mid-while_loop fault replay, the knob degrade/raise contract for
+# KATA_TPU_PERSISTENT and KATA_TPU_TP_OVERLAP, and the always-present
+# stats/heartbeat schema — with and without KATA_TPU_STRICT=1 (the
+# persistent fence reads only the delivered count and the trimmed
+# tokens; the dispatch window must stay transfer-guard-clean too).
+persistent:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/persistent_events.jsonl \
+	  $(PY) -m pytest tests/test_persistent_decode.py -q
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+	KATATPU_OBS=1 KATATPU_OBS_FILE=artifacts/persistent_events_strict.jsonl \
+	KATA_TPU_STRICT=1 \
+	  $(PY) -m pytest tests/test_persistent_decode.py -q
 
 # Device-utilization & HBM ledger gate (ISSUE 17): the ledger suite on
 # the forced-8-device host — once-per-signature cost capture and MFU

@@ -62,6 +62,11 @@ a fused-dispatch A/B (ISSUE 13: ``serving_fused_*`` — slo_chunked
 unfused K=1 baseline vs fused K∈{1,4} closed-loop tok/s plus ITL p99 at
 3× capacity over identical arrivals; ``serving_fused_tok_per_s`` joins
 the bench-trend headline set, ``KATA_TPU_BENCH_FUSED=0`` skips it),
+a persistent-decode A/B (ISSUE 20: ``serving_persistent_*`` — greedy K=1
+baseline vs multi-step K=8 vs the ``lax.while_loop`` persistent
+executable, closed-loop tok/s + delivered steps per dispatch + devledger
+dispatch-gap + ITL p99 ratio; ``serving_persistent_tok_per_s`` joins the
+bench-trend headline set, ``KATA_TPU_BENCH_PERSISTENT=0`` skips it),
 a KV layout + host-tier capacity A/B (ISSUE 14: ``serving_kv_*`` —
 heads-vs-blocks pool placement at forced tp on a GQA/MQA config where
 heads replicates, per-shard pool bytes + peak concurrent sessions +
@@ -307,6 +312,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
             env["KATA_TPU_BENCH_FAULTS"] = "0"
             env["KATA_TPU_BENCH_LOAD"] = "0"
             env["KATA_TPU_BENCH_FUSED"] = "0"
+            env["KATA_TPU_BENCH_PERSISTENT"] = "0"
             env["KATA_TPU_BENCH_TP"] = "0"
             env["KATA_TPU_BENCH_DEGRADED"] = "0"
             env["KATA_TPU_BENCH_OBS"] = "0"
@@ -354,6 +360,7 @@ def supervise(args: argparse.Namespace) -> int:  # lint: allow(JX004) wall-clock
         env["KATA_TPU_BENCH_FAULTS"] = "0"
         env["KATA_TPU_BENCH_LOAD"] = "0"
         env["KATA_TPU_BENCH_FUSED"] = "0"
+        env["KATA_TPU_BENCH_PERSISTENT"] = "0"
         env["KATA_TPU_BENCH_TP"] = "0"
         env["KATA_TPU_BENCH_DEGRADED"] = "0"
         env["KATA_TPU_BENCH_OBS"] = "0"
@@ -1839,6 +1846,127 @@ def worker(args: argparse.Namespace) -> None:
         except Exception as exc:  # noqa: BLE001 — headline must survive
             return {"fused_error": f"{type(exc).__name__}: {exc}"[:200]}
 
+    def measure_persistent() -> dict:  # lint: allow(JX004) srv.step()/run() return host numpy tokens each round — inherently fenced
+        # Persistent on-device decode rounds A/B (ISSUE 20): the
+        # while_loop executable decodes until the heartbeat-cadence cap
+        # or a lane freeze, so the host round-trips the K=1 baseline
+        # pays per token — and the multi-step K=8 plan still pays per
+        # K tokens — collapse to one per DELIVERED round. Three sides,
+        # closed-loop, same burst, greedy everywhere (the loop is
+        # greedy-only): (a) THROUGHPUT — K1 baseline vs multi-step K8 vs
+        # persistent; acceptance: persistent strictly above K1.
+        # (b) delivered steps per dispatch + the PR 18 devledger
+        # dispatch-gap per side. (c) ITL p99 ratio at capacity:
+        # persistent vs K1 over identical closed-loop bursts — <= 1
+        # means no client-visible latency regression. SIDE measurement
+        # with the usual protections: after the banked headline,
+        # crash-guarded, KATA_TPU_BENCH_PERSISTENT=0 disables.
+        if os.environ.get("KATA_TPU_BENCH_PERSISTENT", "1") == "0":
+            return {}
+        try:
+            from kata_xpu_device_plugin_tpu.guest.serving import (
+                GenerationServer,
+            )
+
+            p_prompt = 2 * PROMPT_LEN
+            p_chunk = 2 if args.smoke else 8
+            new_per_req = 24 if args.smoke else 48
+            budgets = [new_per_req + 4 * (i % 4) for i in range(64)]
+            p_max_len = p_prompt + max(budgets)
+            n_req = 4 * BATCH
+            key = jax.random.PRNGKey(73)
+
+            def make_prompts(salt):
+                return [
+                    np.asarray(jax.random.randint(
+                        jax.random.fold_in(key, salt + i), (p_prompt,),
+                        0, cfg.vocab_size, dtype=jnp.int32,
+                    ))
+                    for i in range(n_req)
+                ]
+
+            def make_server(k_steps, persistent):
+                return GenerationServer(
+                    params, cfg, max_batch=BATCH, max_len=p_max_len,
+                    chunk=p_chunk, prefill_buckets=(p_prompt,),
+                    # Explicit args on EVERY side: daemon-injected
+                    # KATA_TPU_PERSISTENT / DECODE_STEPS envs must not
+                    # contaminate the A/B. Greedy (temperature=0) on
+                    # every side — the persistent loop is greedy-only,
+                    # so the baselines must be too for a fair ITL bar.
+                    temperature=0.0, decode_steps=k_steps,
+                    persistent=persistent, overlap=False,
+                    heartbeat_rounds=8,
+                    prefix_cache_tokens=0, kv_pool_tokens=0,
+                )
+
+            def burst(srv, prompts):  # jaxguard: hot  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
+                rids = [srv.submit(p, budgets[i])
+                        for i, p in enumerate(prompts)]
+                t0 = time.perf_counter()
+                results = srv.run()
+                dt = time.perf_counter() - t0
+                total = sum(len(results[r]) for r in rids if r in results)
+                return total, dt
+
+            # Warm every executable family once per side.
+            for k_steps, persistent in ((1, False), (8, False), (1, True)):
+                w = make_server(k_steps, persistent)
+                for i, p in enumerate(make_prompts(9200)):
+                    w.submit(p, budgets[i])
+                w.run()
+
+            out = {
+                "serving_persistent_requests": n_req,
+                "serving_persistent_prompt_len": p_prompt,
+                "serving_persistent_chunk": p_chunk,
+            }
+            rates, itl = {}, {}
+            for tag, (k_steps, persistent) in (
+                ("k1", (1, False)), ("k8", (8, False)),
+                ("persistent", (1, True)),
+            ):
+                best, best_st = 0.0, {}
+                for trial in range(2):
+                    srv = make_server(k_steps, persistent)
+                    total, dt = burst(srv, make_prompts(320 + trial))
+                    if total / dt > best:
+                        # Stats must describe the SAME run the reported
+                        # tok/s came from.
+                        best, best_st = total / dt, srv.stats()
+                rates[tag] = best
+                pre = ("serving_persistent" if tag == "persistent"
+                       else f"serving_persistent_{tag}")
+                out[f"{pre}_tok_per_s"] = round(best, 1)
+                d = best_st.get("decode_token_s") or {}
+                itl[tag] = d.get("p99", 0.0)
+                out[f"{pre}_itl_p99_s"] = round(itl[tag], 5)
+                out[f"{pre}_dispatch_gap_ms"] = best_st.get(
+                    "dispatch_gap_ms", 0.0)
+                if tag == "persistent":
+                    # Delivered steps per dispatch: the host-round-trip
+                    # amortization the while_loop actually bought.
+                    rounds = best_st.get("persistent_rounds", 0)
+                    out["serving_persistent_delivered_per_dispatch"] = (
+                        round(best_st.get("delivered_steps_total", 0)
+                              / rounds, 2) if rounds else 0.0
+                    )
+                    out["serving_persistent_exits"] = best_st.get(
+                        "persistent_exits", {})
+            out["serving_persistent_speedup"] = round(
+                rates["persistent"] / rates["k1"], 3) if rates["k1"] else 0.0
+            out["serving_persistent_k8_speedup"] = round(
+                rates["persistent"] / rates["k8"], 3) if rates["k8"] else 0.0
+            if itl.get("k1"):
+                # <= 1 means the persistent plan held ITL p99 at least
+                # as well as the K=1 baseline (the acceptance bar:
+                # strictly-better tok/s at no-worse ITL p99).
+                out["serving_persistent_itl_p99_ratio"] = round(
+                    itl["persistent"] / itl["k1"], 3)
+            return out
+        except Exception as exc:  # noqa: BLE001 — headline must survive
+            return {"persistent_error": f"{type(exc).__name__}: {exc}"[:200]}
+
     def measure_tp() -> dict:  # lint: allow(JX004) srv.run() returns host numpy tokens each round — inherently fenced
         # Tensor-parallel serving A/B (ISSUE 9): the same burst served at
         # tp=1 (single chip) and tp=2/4 over the 1×N serving mesh
@@ -2489,6 +2617,10 @@ def worker(args: argparse.Namespace) -> None:
     fused_out = measure_fused()
     if fused_out:
         out.update(fused_out)
+        print(json.dumps(out), flush=True)
+    persistent_out = measure_persistent()
+    if persistent_out:
+        out.update(persistent_out)
         print(json.dumps(out), flush=True)
     tp_out = measure_tp()
     if tp_out:

@@ -122,6 +122,7 @@ from ..compat import jaxapi
 from ..models.transformer import (
     DecoderConfig,
     _decode_scan,
+    _decode_while,
     _next_token,
     _sampling_args,
     cycle_ring_caches_from_prefill,
@@ -214,6 +215,24 @@ ENV_DECODE_STEPS = "KATA_TPU_DECODE_STEPS"
 # ``fused_disabled`` event, and an explicit ``fused=True`` on a server
 # whose policy cannot chunk raises.
 ENV_FUSED = "KATA_TPU_FUSED"
+
+# Persistent on-device decode rounds (ISSUE 20): ``persistent=True`` /
+# ``KATA_TPU_PERSISTENT=1`` replaces the fixed ``chunk × decode_steps``
+# scan with a ``lax.while_loop`` executable
+# (transformer._decode_while) that keeps decoding ON DEVICE — greedy
+# sampling, per-lane EOS/budget freezing, block-table positions bumped
+# against a pre-reserved window — until the heartbeat-cadence step cap
+# is hit, a lane freezes (needs host service), or a live lane's window
+# is exhausted. The host is touched only at fence boundaries; ITL,
+# scheduler, ledger, and heartbeat accounting divide by the DELIVERED
+# step count read from the loop carry at the fence. Guest-side env-only
+# knob (like KATA_TPU_FUSED/KATA_TPU_DEGRADED — no daemon injection
+# surface): malformed values degrade with a ``persistent_disabled``
+# event; explicit ``persistent=True`` on an incompatible server
+# (speculative, ring_kv, sampling — the loop is greedy-only) raises,
+# the env degrades. Greedy outputs stay bit-identical to lock-step K=1
+# (tested across tp/paged/strict in tests/test_persistent_decode.py).
+ENV_PERSISTENT = "KATA_TPU_PERSISTENT"
 
 # Paged-pool placement layout + host-RAM KV offload tier (ISSUE 14):
 # KATA_TPU_KV_LAYOUT selects "heads" (the historical divide-or-replicate
@@ -845,13 +864,14 @@ def _merge_rows(dev_vals, host_vals, fresh):
 @partial(jax.jit, static_argnames=("cfg", "steps", "do_sample", "top_k",
                                    "top_p", "ring", "block_size",
                                    "paged_len", "decode_kernel_fn",
-                                   "eos_id"),
+                                   "eos_id", "reduce_fn"),
          donate_argnums=(1,))
 def _serve_decode(params, caches, tok, pos, cfg, steps: int, do_sample: bool,
                   top_k: int, temperature, key, top_p: float = 0.0,
                   ring: bool = False, block_tables=None,
                   block_size: int = 0, paged_len: int = 0,
-                  decode_kernel_fn=None, eos_id=None, budget=None):
+                  decode_kernel_fn=None, eos_id=None, budget=None,
+                  reduce_fn=None):
     """The server's one decode executable: a fixed-``steps`` ragged chunk
     with the KV arena DONATED — without donation XLA must copy every arena
     tensor each chunk (the first in-scan cache write would otherwise alias
@@ -875,19 +895,20 @@ def _serve_decode(params, caches, tok, pos, cfg, steps: int, do_sample: bool,
                         block_tables=block_tables, block_size=block_size,
                         paged_len=paged_len,
                         decode_kernel_fn=decode_kernel_fn, eos_id=eos_id,
-                        budget=budget)
+                        budget=budget, reduce_fn=reduce_fn)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "do_sample", "top_k",
                                    "top_p", "block_size", "paged_len",
-                                   "decode_kernel_fn", "eos_id"),
+                                   "decode_kernel_fn", "eos_id",
+                                   "reduce_fn"),
          donate_argnums=(1, 5))
 def _fused_serve_decode(params, caches, tok, pos, budget, p_caches, suffix,
                         offset, true_len, cfg, steps: int, do_sample: bool,
                         top_k: int, temperature, key, top_p: float = 0.0,
                         block_tables=None, block_size: int = 0,
                         paged_len: int = 0, decode_kernel_fn=None,
-                        eos_id=None):
+                        eos_id=None, reduce_fn=None):
     """The FUSED prefill+decode executable (ISSUE 13): ONE dispatch
     carries the decode lanes' ``steps``-token scan over the (donated)
     arena AND the pending admission's ``prefill_suffix`` slice over its
@@ -904,13 +925,42 @@ def _fused_serve_decode(params, caches, tok, pos, budget, p_caches, suffix,
         temperature, key, return_state=True, top_p=top_p, ring=False,
         block_tables=block_tables, block_size=block_size,
         paged_len=paged_len, decode_kernel_fn=decode_kernel_fn,
-        eos_id=eos_id, budget=budget,
+        eos_id=eos_id, budget=budget, reduce_fn=reduce_fn,
     )
     p_caches, p_logits, _pos = prefill_suffix(
         params, suffix, cfg, p_caches, offset, return_logits=True,
         true_len=true_len,
     )
     return toks, caches, last, new_pos, p_caches, p_logits
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_steps", "block_size",
+                                   "paged_len", "decode_kernel_fn",
+                                   "eos_id", "reduce_fn"),
+         donate_argnums=(1,))
+def _persistent_serve_decode(params, caches, tok, pos, budget, window_end,
+                             cfg, max_steps: int, block_tables=None,
+                             block_size: int = 0, paged_len: int = 0,
+                             decode_kernel_fn=None, eos_id=None,
+                             reduce_fn=None):
+    """The PERSISTENT decode executable (ISSUE 20): one
+    ``lax.while_loop`` round over the (donated) arena —
+    :func:`..models.transformer._decode_while` — that decodes greedily
+    on device until the static ``max_steps`` heartbeat-cadence cap, a
+    lane freeze (eos/budget — the lane needs host service), or a live
+    lane's pre-reserved ``window_end``. Statics mirror
+    :func:`_serve_decode` (minus the sampling knobs — the loop is
+    greedy-only) plus the cap; all are per-server constants, so the
+    persistent form is ONE dispatch signature in the JG401 census and
+    the steady-state compile tripwire stays zero across persistent
+    rounds. Returns ``(out [B, max_steps], caches, tok, pos,
+    delivered)`` — the caller slices and accounts by ``delivered``."""
+    return _decode_while(params, caches, tok, pos, budget, window_end,
+                         cfg, max_steps, None, ring=False,
+                         block_tables=block_tables, block_size=block_size,
+                         paged_len=paged_len,
+                         decode_kernel_fn=decode_kernel_fn, eos_id=eos_id,
+                         reduce_fn=reduce_fn)
 
 
 class GenerationServer:
@@ -1102,6 +1152,7 @@ class GenerationServer:
                  itl_slo_ms: Optional[float] = None,
                  decode_steps: Optional[int] = None,
                  fused: Optional[bool] = None,
+                 persistent: Optional[bool] = None,
                  spec_opt_in: Optional[bool] = None,
                  tp: Optional[int] = None,
                  tp_min: Optional[int] = None,
@@ -1397,6 +1448,53 @@ class GenerationServer:
         # dispatch — part of the recovery blame cohort (a fault in the
         # fused dispatch implicates it with the lanes; see _recover).
         self._fused_blame: Optional[_Request] = None
+        # Persistent on-device decode rounds (ISSUE 20): the standard
+        # guest-side env knob contract (KATA_TPU_PERSISTENT — env-only,
+        # like KATA_TPU_FUSED): malformed env degrades with
+        # persistent_disabled, incompatible modes (speculative rounds
+        # are host-driven lock-step, the ring fold cannot absorb a
+        # data-dependent step count, and the while_loop is greedy-only
+        # — a sampled round's key schedule depends on the step count)
+        # raise on an explicit persistent=True and degrade from env.
+        explicit_persistent = persistent is not None
+        if persistent is None:
+            raw_p = os.environ.get(ENV_PERSISTENT, "").strip()
+            if raw_p and raw_p not in ("0", "1"):
+                self._emit(
+                    "persistent_disabled", reason=f"bad_env:{raw_p[:32]}"
+                )
+                raw_p = ""
+            persistent_ok = raw_p == "1"
+        else:
+            persistent_ok = bool(persistent)
+        if persistent_ok:
+            reason = None
+            if self.speculative_k or self.draft is not None:
+                reason = "speculative"
+            elif ring_kv:
+                reason = "ring_kv"
+            elif self._do_sample:
+                reason = "sampling"
+            if reason is not None:
+                if explicit_persistent:
+                    raise ValueError(
+                        f"persistent=True is incompatible with this server "
+                        f"({reason}) — see 'Persistent decode' in "
+                        "docs/guest_guide.md"
+                    )
+                self._emit("persistent_disabled", reason=reason)
+                persistent_ok = False
+        self._persistent = persistent_ok
+        # Per-round persistent accounting: delivered steps of the LAST
+        # persistent round (stats/heartbeat "delivered_steps" — stays 0
+        # on non-persistent servers, the no-schema-branch contract),
+        # cumulative totals, and the per-exit-reason counters the
+        # persistent_exit events mirror.
+        self._persistent_rounds = 0
+        self._last_delivered = 0
+        self._delivered_total = 0
+        self._persistent_exits = {"cap": 0, "done": 0, "window": 0}
+        self._persistent_fut = None  # (delivered, window) of the round in flight
         self._sched = make_scheduler(
             sched_policy, chunk_tokens=chunk_tokens, slo_ms=slo_ms,
             # The round→per-token normalizer DEFAULT: slo_ms is a
@@ -1956,6 +2054,20 @@ class GenerationServer:
             self._watchdog = None
         if self._watchdog is not None:
             self._watchdog.bind(self._emit)
+        # Persistent step cap (ISSUE 20): the while_loop's max_steps — a
+        # static of the persistent executable. Heartbeat cadence bounds
+        # it (the host must surface telemetry at least once per
+        # heartbeat interval, so one persistent round may not span more
+        # rounds-worth of steps than one heartbeat covers); max_len
+        # bounds the dense [B, cap] token buffer the loop carries.
+        if self._persistent:
+            cap_rounds = self._hb_every or DEFAULT_HEARTBEAT_ROUNDS
+            self._persistent_cap = max(
+                min(self._dispatch_steps * cap_rounds, self.max_len),
+                self._dispatch_steps,
+            )
+        else:
+            self._persistent_cap = 0
         # One config event per server (ISSUE 13 observability satellite):
         # the resolved dispatch shape — scheduler policy, decode-steps
         # multiplier, fused flag — so fleet dashboards can segment every
@@ -1975,6 +2087,9 @@ class GenerationServer:
             heartbeat_rounds=self._hb_every,
             watchdog=int(self._watchdog is not None),
             devledger=int(self._devledger.armed),
+            persistent=int(self._persistent),
+            persistent_cap=self._persistent_cap,
+            tp_overlap=int(getattr(self, "_reduce_fn", None) is not None),
         )
 
     def _emit(self, name: str, **fields) -> None:
@@ -2188,6 +2303,12 @@ class GenerationServer:
             "tp": self._tp,
             "tp_degraded": int(self._tp < self._tp_initial),
             "decode_steps": self._decode_steps,
+            # Persistent decode (ISSUE 20): flag + the LAST round's
+            # delivered step count — always present (zeros when not
+            # persistent), so dashboards segment ITL by actual steps
+            # without a schema branch.
+            "persistent": int(self._persistent),
+            "delivered_steps": self._last_delivered,
             # Steady-state tripwire (ISSUE 19): cumulative, like the
             # stats() fields — any nonzero steady_state_compiles here is
             # a census breach (warm dispatch surface recompiled).
@@ -2392,6 +2513,14 @@ class GenerationServer:
         shard_map wrapper, and the fn's identity being the executable
         cache key makes the recompile explicit rather than a stale
         reuse)."""
+        # Overlapped tp collectives (ISSUE 20): the reduce hint rides the
+        # same lifecycle as the decode kernel — mesh-derived, rebuilt on
+        # placement and degraded shrink, identity is an executable cache
+        # key. Built before the paged early-return: overlap applies to
+        # every decode backend, not just paged.
+        self._reduce_fn = tp_serving.overlap_reduce_fn(
+            mesh, self.cfg, label=self._label, emit=self._emit,
+        )
         if self._decode_attn != BACKEND_PAGED:
             self._decode_kernel = None
             return
@@ -2743,6 +2872,19 @@ class GenerationServer:
             "decode_steps": self._decode_steps,
             "fused_enabled": int(self._fused_ok),
             "fused_admissions": self._fused_admissions,
+        })
+        # Persistent decode (ISSUE 20): ALWAYS present — zeros/False on
+        # non-persistent servers, the same no-schema-branch contract.
+        # delivered_steps is the LAST round's count (the heartbeat
+        # mirrors it); the exits dict partitions persistent_rounds by
+        # exit reason, mirroring the persistent_exit event stream.
+        out.update({
+            "persistent": int(self._persistent),
+            "persistent_cap": self._persistent_cap,
+            "persistent_rounds": self._persistent_rounds,
+            "delivered_steps": self._last_delivered,
+            "delivered_steps_total": self._delivered_total,
+            "persistent_exits": dict(self._persistent_exits),
         })
         # Steady-state tripwire (ISSUE 19): ALWAYS present — zeros with
         # the tripwire off or before the second run() — same
@@ -3968,7 +4110,15 @@ class GenerationServer:
         # 13: the reservation must cover every token one dispatch can
         # write; the on-device budget mask bounds the tail at each
         # request's own cap, which the ``cap`` term below already is).
-        lookahead = self._dispatch_steps * (2 if self.overlap else 1)
+        lookahead = (
+            # Persistent rounds (ISSUE 20) reserve the WHOLE while_loop
+            # window up front — the loop bump-allocates against the
+            # reservation on device and exits early (reason "window")
+            # when a live lane would outrun it; no mid-round host
+            # allocation exists to grow a table.
+            self._persistent_cap if self._persistent
+            else self._dispatch_steps * (2 if self.overlap else 1)
+        )
         lanes = sorted(
             (b for b in range(self.max_batch)
              if self._slot_req[b] is not None),
@@ -4089,7 +4239,12 @@ class GenerationServer:
         return alive
 
     def _step_inner(self) -> bool:
-        if self.overlap and not self.speculative_k:
+        # Persistent rounds run lock-step (ISSUE 20): the host must read
+        # the delivered count at the fence before it can schedule the
+        # next round — there is no fixed-shape in-flight state to
+        # pipeline against, and the while_loop already keeps the device
+        # busy for the whole round the overlap would have covered.
+        if self.overlap and not self.speculative_k and not self._persistent:
             if self.strict:
                 with jaxapi.strict_mode(scope="serving.decode_dispatch"):
                     return self._step_overlapped()
@@ -4635,6 +4790,11 @@ class GenerationServer:
         self._fuse_pending = False
         self._fused_ret = None
         self._fused_blame = None
+        # A persistent round's delivered future dies with its dispatch:
+        # the donated partial is discarded and the round replays
+        # strict-FIFO at dispatch granularity, same as multi-step
+        # (ISSUE 20 — recovery stays dispatch-boundary-granular).
+        self._persistent_fut = None
         self._admitting = []
         self._admit_current = []
 
@@ -4724,20 +4884,24 @@ class GenerationServer:
         )
         self._drain_done = True
 
-    def _note_round(self, dur_s: float, busy: int) -> None:
+    def _note_round(self, dur_s: float, busy: int,
+                    steps: Optional[int] = None) -> None:
         """Feed one decode-round cadence to the scheduler's estimator —
         with the round's ACTUAL delivered steps, so the per-token EWMA
         stays honest under multi-step decode and fused rounds (ISSUE 13
         satellite); an SLO-violating round (slo_chunked only) counts and
         events — the measured ground truth the deadline-driven admission
-        steers by."""
-        if self._sched.note_round(dur_s, steps=self._dispatch_steps):
+        steers by. ``steps`` overrides the static dispatch multiplier
+        for rounds whose step count is data-dependent — persistent
+        rounds (ISSUE 20) pass the while_loop's DELIVERED count."""
+        steps = self._dispatch_steps if steps is None else max(steps, 1)
+        if self._sched.note_round(dur_s, steps=steps):
             self._c_slo.inc()
             self._emit(
                 "slo_violation", round_s=round(dur_s, 6),
                 # The per-token figure actually compared to slo_ms (the
                 # round cadence over its delivered steps).
-                itl_s=round(dur_s / self._dispatch_steps, 6),
+                itl_s=round(dur_s / steps, 6),
                 slo_ms=self._sched.slo_ms, slots_busy=busy,
             )
 
@@ -4763,8 +4927,10 @@ class GenerationServer:
         token counts, so under overlap it over-estimates by at most the
         in-flight chunk — the mask freezes LATE (trimmed garbage), never
         early (which would drop real tokens). Dead lanes get 0 and
-        freeze from step one: their stale rows stop being scribbled."""
-        if self._decode_steps <= 1:
+        freeze from step one: their stale rows stop being scribbled.
+        Persistent rounds (ISSUE 20) ALWAYS arm the mask — the
+        while_loop's exit conditions read it."""
+        if self._decode_steps <= 1 and not self._persistent:
             return None
         b = np.zeros(self.max_batch, np.int32)
         for i in range(self.max_batch):
@@ -4882,6 +5048,7 @@ class GenerationServer:
                         block_tables=jnp.asarray(self._bt_host),
                         block_size=self.kv_block, paged_len=self.max_len,
                         decode_kernel_fn=self._decode_kernel, eos_id=eos,
+                        reduce_fn=self._reduce_fn,
                     )
                     self._devledger.on_dispatch(
                         ("fused", True, steps, width, eos is None,
@@ -4902,6 +5069,7 @@ class GenerationServer:
                     fkw = dict(
                         top_p=self.top_p,
                         decode_kernel_fn=self._decode_kernel, eos_id=eos,
+                        reduce_fn=self._reduce_fn,
                     )
                     self._devledger.on_dispatch(
                         ("fused", False, steps, width, eos is None,
@@ -4924,6 +5092,51 @@ class GenerationServer:
             # leave the slice's request unimplicated.
             self._fused_blame = None
             return toks, new_last, new_pos
+        if self._persistent:
+            # PERSISTENT round (ISSUE 20): one while_loop dispatch that
+            # decodes until the heartbeat-cadence cap, a lane freeze, or
+            # a live lane's pre-reserved window end — greedy, with the
+            # PR 13 on-device freeze mask bounding every lane (budget is
+            # always armed here, see _decode_budget). The window vector
+            # is each lane's write bound: the reserved block-table span
+            # when paged (bump-allocated on device against it), the
+            # dense arena length when slotted. ``delivered`` rides back
+            # as a future; the retire side fences it and accounts by it.
+            cap = self._persistent_cap
+            window = np.full(self.max_batch, self.max_len, np.int32)
+            if self.paged:
+                for b in range(self.max_batch):
+                    if self._slot_req[b] is not None:
+                        window[b] = min(
+                            len(self._lane_blocks[b]) * self.kv_block,
+                            self.max_len,
+                        )
+            arena = self.kv_pool.arena if self.paged else self.arena
+            fargs = (
+                self.params, arena, last, pos, budget,
+                jnp.asarray(window), self.cfg, cap,
+            )
+            fkw = dict(
+                decode_kernel_fn=self._decode_kernel, eos_id=eos,
+                reduce_fn=self._reduce_fn,
+            )
+            if self.paged:
+                fkw.update(
+                    block_tables=jnp.asarray(self._bt_host),
+                    block_size=self.kv_block, paged_len=self.max_len,
+                )
+            self._devledger.on_dispatch(
+                ("persistent", self.paged, cap, eos is None),
+                _persistent_serve_decode, fargs, fkw, loop_cap=cap,
+            )
+            toks, caches, new_last, new_pos, delivered = (
+                _persistent_serve_decode(*fargs, **fkw))
+            if self.paged:
+                self.kv_pool.arena = caches
+            else:
+                self.arena = caches
+            self._persistent_fut = (delivered, window)
+            return toks, new_last, new_pos
         if self.paged:
             fargs = (
                 self.params, self.kv_pool.arena, last, pos, self.cfg,
@@ -4934,7 +5147,7 @@ class GenerationServer:
                 block_tables=jnp.asarray(self._bt_host),
                 block_size=self.kv_block, paged_len=self.max_len,
                 decode_kernel_fn=self._decode_kernel, eos_id=eos,
-                budget=budget,
+                budget=budget, reduce_fn=self._reduce_fn,
             )
             self._devledger.on_dispatch(
                 ("plain", True, steps, eos is None, budget is None),
@@ -4950,7 +5163,7 @@ class GenerationServer:
             fkw = dict(
                 top_p=self.top_p, ring=self.ring_kv,
                 decode_kernel_fn=self._decode_kernel, eos_id=eos,
-                budget=budget,
+                budget=budget, reduce_fn=self._reduce_fn,
             )
             self._devledger.on_dispatch(
                 ("plain", False, steps, eos is None, budget is None),
@@ -5027,17 +5240,30 @@ class GenerationServer:
                 toks = self._fence_wait(lambda: np.asarray(toks))  # lock-step round fence — the transfer IS the chunk boundary
             finally:
                 self._clock.pop()
+        # Persistent retire (ISSUE 20): the delivered step count rides
+        # the round's fence as a sibling future — the token transfer
+        # above already synchronized the executable, so this read is a
+        # landed-buffer copy, not a second wait. Every accounting line
+        # below divides by DELIVERED steps, not the static cap: a round
+        # that exited early on a freeze or a window edge must not
+        # flatter the per-token latency.
+        fut, self._persistent_fut = self._persistent_fut, None
+        delivered: Optional[int] = None
+        if fut is not None:
+            delivered = int(np.asarray(fut[0]))  # jaxguard: allow(JG101) persistent round fence — the delivered count IS the round boundary read
+            toks = toks[:, :delivered]
         # Ledger retire stamp AFTER the span closed, so the RETIRE pop's
         # fence time is already accrued and the clock snapshot taken here
         # keeps it out of the next retire→dispatch gap window.
-        self._devledger.note_retire()
+        self._devledger.note_retire(delivered_steps=delivered)
         # Per-token decode latency as a client sees it: dispatch wall
         # time over its delivered steps (each step yields one token per
         # slot) — STAYS per-token however large decode_steps is.
-        tok_lat = sp.duration_s / self._dispatch_steps
+        steps_done = self._dispatch_steps if delivered is None else delivered
+        tok_lat = sp.duration_s / max(steps_done, 1)
         self._tok_lat.observe(tok_lat)
         self._h_tok_lat.observe(tok_lat)
-        self._note_round(sp.duration_s, len(active))
+        self._note_round(sp.duration_s, len(active), steps=delivered)
         # np.array (not asarray): device arrays convert read-only, and
         # _fill_slot writes these rows in place on refill.
         self._last = np.array(last)  # jaxguard: allow(JG101) lock-step fence (writable host copy for refill)
@@ -5048,6 +5274,28 @@ class GenerationServer:
             self._slot_req[b].out.extend(new)
             self._emitted += len(new)
             self._maybe_finish(b, new)
+        if fut is not None:
+            # Exit attribution, host-side from the fenced carry: the cap
+            # was consumed ("cap"); else an UNFINISHED lane sits at its
+            # reserved window edge ("window" — _maybe_finish just freed
+            # every lane that froze on eos/budget, so survivors at the
+            # edge are the ones the loop stopped for); else a freeze
+            # needed host service ("done").
+            cap = self._persistent_cap
+            window = fut[1]
+            if delivered >= cap:
+                reason = "cap"
+            elif any(self._slot_req[b] is not None
+                     and self._pos[b] >= window[b] for b in active):  # jaxguard: allow(JG101) host-side numpy — _pos was rebound via np.array at the fence above, window is the dispatch's np reservation vector
+                reason = "window"
+            else:
+                reason = "done"
+            self._persistent_rounds += 1
+            self._last_delivered = delivered
+            self._delivered_total += delivered
+            self._persistent_exits[reason] += 1
+            self._emit("persistent_exit", reason=reason,
+                       delivered=delivered, cap=cap)
         # An admission slice that rode this dispatch (ISSUE 13) lands
         # after the decode tokens, mirroring the overlapped retire order.
         fc, self._fused_ret = self._fused_ret, None

@@ -61,6 +61,20 @@ KV_LAYOUT_HEADS = "heads"
 KV_LAYOUT_BLOCKS = "blocks"
 KV_LAYOUTS = (KV_LAYOUT_HEADS, KV_LAYOUT_BLOCKS)
 
+# Overlapped tp collectives (ISSUE 20): under tensor-parallel serving
+# the two per-layer row-parallel projections (wo, w_down) each carry one
+# model-axis psum that GSPMD serializes against the surrounding matmuls.
+# KATA_TPU_TP_OVERLAP (guest-side, env-only — like KATA_TPU_DEGRADED)
+# keeps the overlap DECOMPOSITION armed by default: the server resolves
+# one static ``overlap_reduce_fn`` per mesh and the transformer applies
+# it at both sites, splitting each psum into reduce-scatter +
+# all-gather so the collective phases pipeline against compute.
+# Numerics are exactly the psum's (same shard partials, same summation
+# axis order — tested bit-identical at tp=2); "0" restores the single
+# fused psum, malformed values degrade with a ``tp_overlap_disabled``
+# event.
+ENV_TP_OVERLAP = "KATA_TPU_TP_OVERLAP"
+
 # Degraded-mode knobs (ISSUE 10, docs/resilience.md "Degraded mode"):
 # the floor of the elastic mesh-shrink ladder a permanent chip fault
 # walks (daemon-injectable, cdi.constants.ENV_SERVING_TP_MIN), and the
@@ -209,6 +223,61 @@ def serving_mesh(tp: int, devices: Optional[Sequence] = None):
     return build_mesh(
         {AXIS_DATA: 1, AXIS_FSDP: 1, AXIS_MODEL: tp}, devices=devices[:tp]
     )
+
+
+def overlap_reduce_fn(mesh, cfg, *, label: str = "",
+                      emit=None):
+    """The per-mesh STATIC overlap hint for the transformer's two
+    row-parallel reduce sites (ISSUE 20): a callable applied to the
+    ``wo`` / ``w_down`` projection outputs that re-constrains the
+    pending model-axis psum into a reduce-scatter over the hidden axis
+    followed by an all-gather, which XLA's latency-hiding scheduler can
+    pipeline against the adjacent matmuls (the ICI-adjacent collective
+    overlap "Exploration of TPUs for AI Applications" documents).
+    Resolved ONCE per server per mesh — the function's identity is part
+    of every decode executable's cache key, exactly like the decode
+    kernel callable, so a mesh change can never reuse a stale overlap
+    form.
+
+    Returns ``None`` (the single fused psum) when the knob is off
+    (``KATA_TPU_TP_OVERLAP=0``), there is no model-parallel mesh, or
+    ``cfg.d_model`` does not divide the degree (a ragged hidden shard
+    cannot reduce-scatter); malformed knob values degrade with one
+    ``tp_overlap_disabled`` event, never a crash."""
+    raw = os.environ.get(ENV_TP_OVERLAP, "").strip()
+    if raw and raw not in ("0", "1"):
+        if emit is not None:
+            emit("tp_overlap_disabled", reason=f"bad_env:{raw[:32]}")
+        else:
+            obs.emit(
+                "serving", "tp_overlap_disabled",
+                server=label, reason=f"bad_env:{raw[:32]}",
+            )
+        raw = ""
+    if raw == "0" or mesh is None:
+        return None
+    from ..parallel.mesh import AXIS_MODEL
+
+    tp = dict(mesh.shape).get(AXIS_MODEL, 1)
+    if tp <= 1 or cfg.d_model % tp:
+        return None
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ..compat.jaxapi import P
+
+    scattered = NamedSharding(mesh, P(None, None, AXIS_MODEL))
+    gathered = NamedSharding(mesh, P(None, None, None))
+
+    def _overlap_reduce(x):
+        # Constraint pair: land the partial-sum reduction SHARDED over
+        # the hidden axis (GSPMD lowers the pending psum to
+        # reduce-scatter), then replicate (all-gather) — two pipelined
+        # collective phases computing exactly the psum's value.
+        x = jax.lax.with_sharding_constraint(x, scattered)
+        return jax.lax.with_sharding_constraint(x, gathered)
+
+    return _overlap_reduce
 
 
 def kv_heads_shardable(cfg, tp: int) -> bool:

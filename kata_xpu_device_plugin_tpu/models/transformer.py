@@ -480,6 +480,7 @@ def _layer(
     block_size: int = 0,
     paged_len: int = 0,
     decode_kernel_fn=None,
+    reduce_fn=None,
 ):
     """One decoder block. x: [B, S, D]. Returns (x, new_kv, aux) where aux
     is the layer's MoE load-balancing loss (0.0 for dense layers).
@@ -490,7 +491,16 @@ def _layer(
     the server, see ``ops.attention.make_decode_attn_fn``) routes the
     single-token ragged decode branches (paged AND slotted) through the
     paged-native pallas kernel instead of the gather + XLA path; None
-    keeps the XLA path."""
+    keeps the XLA path. ``reduce_fn`` (STATIC, resolved once per server
+    — ISSUE 20) wraps the two ROW-PARALLEL projection outputs (after
+    ``wo`` and after ``w_down``): under tensor-parallel serving those
+    partial sums carry the layer's pending model-axis psum, and the
+    server's overlap hint (``tp_serving.overlap_reduce_fn``) decomposes
+    it into reduce-scatter + all-gather so the collective pipelines
+    against the surrounding matmuls. Summation order per output element
+    is unchanged (the same shard partials add in the same axis order),
+    so greedy outputs are bit-identical with it on or off; None keeps
+    the single fused psum."""
     B, S, _ = x.shape
     eff_window = cfg.sliding_window if window is None else window
     # Sliding window rides as a kwarg only when configured, so custom
@@ -731,6 +741,8 @@ def _layer(
 
     attn_out = attn_out.reshape(B, S, cfg.q_dim)
     attn_proj = weight_matmul(attn_out, layer["wo"])
+    if reduce_fn is not None:  # overlap hint on the row-parallel reduce
+        attn_proj = reduce_fn(attn_proj)
     if "post_attn_norm" in layer:  # Gemma-2: norm the sublayer OUTPUT too
         attn_proj = rms_norm(attn_proj, layer["post_attn_norm"], cfg.norm_eps)
     x = x + attn_proj
@@ -762,6 +774,10 @@ def _layer(
         up = weight_matmul(h, layer["w_up"])
         mlp_out = weight_matmul(gate * up, layer["w_down"])
         aux = jnp.float32(0.0)
+    if reduce_fn is not None and not cfg.moe:
+        # The second row-parallel site (w_down): same overlap hint; MoE
+        # outputs reduce inside their own dispatch machinery.
+        mlp_out = reduce_fn(mlp_out)
     if "post_mlp_norm" in layer:
         mlp_out = rms_norm(mlp_out, layer["post_mlp_norm"], cfg.norm_eps)
     x = x + mlp_out
@@ -785,6 +801,7 @@ def forward(
     block_size: int = 0,
     paged_len: int = 0,
     decode_kernel_fn=None,
+    reduce_fn=None,
 ):
     """Full forward. tokens: [B, S] int32 → logits [B, S, vocab].
 
@@ -859,6 +876,7 @@ def forward(
             window=w, rope_theta=theta, rope_linear=linear,
             block_tables=block_tables, block_size=block_size,
             paged_len=paged_len, decode_kernel_fn=decode_kernel_fn,
+            reduce_fn=reduce_fn,
         )
 
     def body(carry, group_and_cache):
@@ -1302,7 +1320,8 @@ def prefill_batch(params: Params, prompts: jax.Array, cfg: DecoderConfig,
 @partial(jax.jit, static_argnames=("cfg", "steps", "attn_fn", "do_sample",
                                    "top_k", "top_p", "return_state", "ring",
                                    "block_size", "paged_len",
-                                   "decode_kernel_fn", "eos_id"))
+                                   "decode_kernel_fn", "eos_id",
+                                   "reduce_fn"))
 def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
                  cfg: DecoderConfig, steps: int, attn_fn: Optional[AttnFn],
                  do_sample: bool, top_k: int, temperature, key: jax.Array,
@@ -1311,7 +1330,7 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
                  block_tables: Optional[jax.Array] = None,
                  block_size: int = 0, paged_len: int = 0,
                  decode_kernel_fn=None, eos_id: Optional[int] = None,
-                 budget: Optional[jax.Array] = None):
+                 budget: Optional[jax.Array] = None, reduce_fn=None):
     """``budget`` ([B] int32, ragged callers only — ISSUE 13) arms the
     ON-DEVICE EOS/BUDGET MASK for multi-step dispatches: a lane that has
     emitted ``budget[b]`` tokens (or the static ``eos_id``) FREEZES — its
@@ -1348,6 +1367,7 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
             kv_caches=caches, cache_offset=pos, ring=ring,
             block_tables=block_tables, block_size=block_size,
             paged_len=paged_len, decode_kernel_fn=decode_kernel_fn,
+            reduce_fn=reduce_fn,
         )
         nxt = _next_token(logits[:, -1, :], step_key, do_sample, temperature,
                           top_k, top_p)
@@ -1366,6 +1386,90 @@ def _decode_scan(params: Params, caches, tok: jax.Array, pos: jax.Array,
     carry, out = lax.scan(step, init, jax.random.split(key, steps))
     caches, tok, pos = carry[0], carry[1], carry[2]
     return (out.T, caches, tok, pos) if return_state else out.T
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_steps", "attn_fn", "ring",
+                                   "block_size", "paged_len",
+                                   "decode_kernel_fn", "eos_id",
+                                   "reduce_fn"))
+def _decode_while(params: Params, caches, tok: jax.Array, pos: jax.Array,
+                  budget: jax.Array, window_end: jax.Array,
+                  cfg: DecoderConfig, max_steps: int,
+                  attn_fn: Optional[AttnFn], ring: bool = False,
+                  block_tables: Optional[jax.Array] = None,
+                  block_size: int = 0, paged_len: int = 0,
+                  decode_kernel_fn=None, eos_id: Optional[int] = None,
+                  reduce_fn=None):
+    """PERSISTENT decode rounds (ISSUE 20): a ``lax.while_loop`` whose
+    body is EXACTLY :func:`_decode_scan`'s masked greedy step — same
+    ``forward`` call, same :func:`greedy_token`, same frozen-lane
+    tok/pos pinning (PR 13's idempotent-rewrite argument carries over
+    verbatim: a frozen lane rewrites the SAME k/v at the SAME position,
+    a value-identical no-op) — so each DELIVERED step is bit-identical
+    to the equivalent fixed-``steps`` scan, and hence to lock-step K=1.
+    The loop keeps decoding on device, host untouched, until one of
+    three EXIT CONDITIONS ends the round:
+
+    - **cap** — ``max_steps`` (static: the server's heartbeat-cadence
+      step cap) delivered; the host fence is also the heartbeat/obs
+      flush point, so telemetry cadence bounds device residency.
+    - **done** — a lane FROZE (eos emitted or per-lane ``budget``
+      spent): the lane needs host service (retire its request, refill
+      the slot), so the loop returns rather than burn steps rewriting
+      frozen k/v.
+    - **window** — a live lane's next write position reached its
+      ``window_end`` (the block-table window ``_ensure_blocks``
+      pre-reserved for the whole persistent round): exit BEFORE the
+      write, host re-reserves (or preempts) and re-enters.
+
+    ``budget`` [B] int32 is REQUIRED (it is the freeze mask — lanes
+    with 0 are dead slots and never gate the loop); greedy only (the
+    sampling key schedule of a data-dependent step count cannot match
+    the scan's pre-split keys, so persistent servers pin greedy — the
+    server raises/degrades on conflict). Returns
+    ``(out [B, max_steps], caches, tok, pos, delivered)`` — the host
+    slices ``out[:, :delivered]`` at the fence and divides its ITL /
+    ledger accounting by ``delivered``, never by the cap."""
+    if attn_fn is None:
+        from ..ops.attention import flash_attention
+
+        attn_fn = flash_attention
+    B = tok.shape[0]
+    rem0 = jnp.asarray(budget, jnp.int32)
+    window = jnp.asarray(window_end, jnp.int32)
+    alive0 = rem0 > 0
+
+    def cond(carry):
+        _caches, _tok, pos, rem, _out, i = carry
+        alive = rem > 0
+        any_alive = jnp.any(alive)
+        none_froze = jnp.all(~alive0 | alive)   # a freeze needs host service
+        fits = ~jnp.any(alive & (pos >= window))  # next write must fit
+        return (i < max_steps) & any_alive & none_froze & fits
+
+    def body(carry):
+        caches, tok, pos, rem, out, i = carry
+        alive = rem > 0
+        logits, caches = forward(
+            params, tok[:, None], cfg, attn_fn=attn_fn,
+            positions=pos[:, None], kv_caches=caches, cache_offset=pos,
+            ring=ring, block_tables=block_tables, block_size=block_size,
+            paged_len=paged_len, decode_kernel_fn=decode_kernel_fn,
+            reduce_fn=reduce_fn,
+        )
+        nxt = greedy_token(logits[:, -1, :])
+        nxt = jnp.where(alive, nxt, tok)          # frozen: pin the token
+        new_pos = jnp.where(alive, pos + 1, pos)  # frozen: pin the slot
+        rem = jnp.where(alive, rem - 1, rem)
+        if eos_id is not None:
+            rem = jnp.where(alive & (nxt == eos_id), 0, rem)
+        out = lax.dynamic_update_slice_in_dim(out, nxt[:, None], i, axis=1)
+        return (caches, nxt, new_pos, rem, out, i + 1)
+
+    init = (caches, tok, jnp.asarray(pos, jnp.int32), rem0,
+            jnp.zeros((B, max_steps), jnp.int32), jnp.int32(0))
+    caches, tok, pos, _rem, out, delivered = lax.while_loop(cond, body, init)
+    return out, caches, tok, pos, delivered
 
 
 def decode(params: Params, caches, tok: jax.Array, pos: jax.Array,

@@ -274,12 +274,19 @@ class DeviceLedger:
     # ----- the dispatch-site hooks -----------------------------------------
 
     def on_dispatch(self, key: tuple, fn, args: tuple,
-                    kwargs: dict) -> None:
+                    kwargs: dict, loop_cap: Optional[int] = None) -> None:
         """Called by the ONE dispatch site right before the decode
         executable call: captures the signature's cost on first sight,
         then stamps the dispatch and attributes the retire→dispatch
         host gap to the phase clock's deltas (residual → ``other``;
-        shares rescaled so they sum to the gap exactly)."""
+        shares rescaled so they sum to the gap exactly).
+
+        ``loop_cap`` (ISSUE 20): the persistent executable's static
+        while_loop step cap. ``cost_analysis`` on a while_loop body
+        reports the WHOLE loop's FLOPs at the cap (trip count assumed =
+        the bound), so the retire side must rescale by the round's
+        actually-delivered steps — the cap rides the pending entry so
+        :meth:`note_retire` can do that without re-deriving the static."""
         if not self.armed:
             return
         if key not in self._costs:
@@ -313,20 +320,28 @@ class DeviceLedger:
             ga["other"] += max(gap - total, 0.0)
         if len(self._pending) >= _MAX_PENDING:
             self._pending.popleft()  # abandoned by a raising dispatch
-        self._pending.append((key, now))
+        self._pending.append((key, now, loop_cap))
         self._dispatches += 1
         self._i["dispatches"] += 1
 
-    def note_retire(self, now: Optional[float] = None) -> None:
+    def note_retire(self, now: Optional[float] = None,
+                    delivered_steps: Optional[int] = None) -> None:
         """Called at the retire fence: accumulates the chunk's busy time
         (retire→retire cadence at steady state — the same ``round_s``
         convention the latency metrics use) and its signature's FLOPs,
-        and snapshots the phase clock as the next gap's baseline."""
+        and snapshots the phase clock as the next gap's baseline.
+
+        ``delivered_steps`` (ISSUE 20): the persistent round's fenced
+        step count. When the popped dispatch carried a ``loop_cap``, the
+        signature's cached FLOPs describe a FULL ``cap``-step loop —
+        credit ``delivered/cap`` of them, so an early-exit round does
+        not double-count work the device never did (and MFU stays
+        honest). Ignored for fixed-step dispatches."""
         if not self.armed or not self._pending:
             return
         if now is None:
             now = time.perf_counter()
-        key, t_dispatch = self._pending.popleft()
+        key, t_dispatch, loop_cap = self._pending.popleft()
         anchor = (
             t_dispatch if self._t_last_retire is None
             else max(t_dispatch, self._t_last_retire)
@@ -337,7 +352,10 @@ class DeviceLedger:
             self._snap_retire = self._clock.snapshot()
         cost = self._costs.get(key)
         if cost:
-            self._i["flops"] += cost["flops"]
+            flops = cost["flops"]
+            if loop_cap and delivered_steps is not None:
+                flops *= min(max(delivered_steps, 0), loop_cap) / loop_cap
+            self._i["flops"] += flops
         self._i["busy_s"] += busy
         self._retired += 1
         self._i["retires"] += 1

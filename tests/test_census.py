@@ -128,6 +128,11 @@ _TIER1_CONFIGS = [
      "strict-fused-k4"),
     (dict(tp=2, kv_pool_tokens=256, kv_block_size=8, kv_layout="blocks"),
      "paged-tp2-blocks"),
+    # ISSUE 20: the persistent while_loop executable is ONE dispatch
+    # signature — steady drains must stay compile-free across rounds
+    # whose DELIVERED step counts differ (the count is a loop carry,
+    # never a static).
+    (dict(persistent=True, decode_steps=2), "persistent-k2"),
 ]
 
 

@@ -119,6 +119,45 @@ def test_cost_captured_once_per_signature():
     assert not [e for e in evs if e["name"] == "cost_unavailable"]
 
 
+def test_persistent_flops_scaled_by_delivered_steps():
+    # ISSUE 20: cost_analysis on the while_loop executable reports the
+    # WHOLE loop's FLOPs at the static cap — a round that exited after
+    # ``delivered`` of ``cap`` steps must credit delivered/cap of them,
+    # never the full loop (which would double-count work the device
+    # never did and flatter MFU).
+    evs = []
+    led = _ledger(evs)
+    fn = _FakeFn({"flops": 8.0e9})
+    led.on_dispatch(("persistent", True, 8), fn, (), {}, loop_cap=8)
+    led.note_retire(delivered_steps=2)           # early exit: 2/8 of the loop
+    st = led.stats_fields()["devledger"]
+    fields = led.heartbeat_fields(interval_s=1.0)
+    assert fields["mfu"] == round(8.0e9 * (2 / 8) / (1.0 * 0.1e12), 6)
+    assert st["cost_signatures"] == 1
+    # Full-cap round credits the full loop; out-of-range delivered
+    # counts clamp to [0, cap].
+    led.on_dispatch(("persistent", True, 8), fn, (), {}, loop_cap=8)
+    led.note_retire(delivered_steps=8)
+    led.on_dispatch(("persistent", True, 8), fn, (), {}, loop_cap=8)
+    led.note_retire(delivered_steps=99)
+    fields = led.heartbeat_fields(interval_s=1.0)
+    assert fields["mfu"] == round(2 * 8.0e9 / (1.0 * 0.1e12), 6)
+    assert fn.lowered == 1                       # one signature, one lowering
+
+
+def test_fixed_step_dispatch_ignores_delivered_steps():
+    # A fixed-step dispatch (no loop_cap) keeps whole-signature credit
+    # even if a caller passes delivered_steps — the scale rides ONLY on
+    # pending entries that declared a cap.
+    evs = []
+    led = _ledger(evs)
+    fn = _FakeFn({"flops": 3.0e9})
+    led.on_dispatch(("plain", True, 2), fn, (), {})
+    led.note_retire(delivered_steps=1)
+    fields = led.heartbeat_fields(interval_s=1.0)
+    assert fields["mfu"] == round(3.0e9 / (1.0 * 0.1e12), 6)
+
+
 def test_cost_unavailable_degrades_once_per_signature():
     evs = []
     led = _ledger(evs)

@@ -408,6 +408,59 @@ class GenerationServer:
     assert "loop variable 'b'" in findings[0].message
 
 
+def test_jg401_while_reassigned_static_varies():
+    # ISSUE 20: a host `while` is a loop scope too — a name the body
+    # REASSIGNS varies per iteration, so feeding it to a jit static is
+    # the same unbounded-census hazard as a `for` target.
+    src = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("k",))
+def decode(caches, k):
+    return caches
+
+class GenerationServer:
+    def step(self):
+        k = 1
+        while self.busy():
+            out = decode(self.arena, k=k)
+            k = k + 1
+        return out
+'''
+    findings = analyze_source(src, GUEST, rules=["JG401"])
+    assert rules_of(findings) == ["JG401"]
+    assert "loop variable 'k'" in findings[0].message
+
+
+def test_jg401_while_bounded_static_is_one_signature():
+    # The persistent-decode form (ISSUE 20): the `lax.while_loop` lives
+    # INSIDE the traced executable, and the host-side statics feeding it
+    # (the per-server cap) are bounded attrs that no while body
+    # reassigns — ONE dispatch signature, no finding, even when the
+    # dispatch itself sits under a host `while` round loop.
+    src = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("cap",))
+def persistent_decode(caches, tok, cap):
+    def cond(c):
+        return c[1] < cap
+    def body(c):
+        return (c[0], c[1] + 1)
+    return jax.lax.while_loop(cond, body, (caches, tok))
+
+class GenerationServer:
+    def step(self):
+        while self.busy():
+            out = persistent_decode(self.arena, self.last,
+                                    cap=self.persistent_cap)
+        return out
+'''
+    assert analyze_source(src, GUEST, rules=["JG401"]) == []
+
+
 def test_jg401_unbounded_host_source():
     src = '''
 import jax

@@ -1,0 +1,385 @@
+"""Persistent on-device decode rounds + overlapped tp collectives
+(ISSUE 20).
+
+Oracle — THE WHILE_LOOP IS INVISIBLE IN THE OUTPUT: each delivered step
+of the persistent executable is exactly the masked scan step PR 13
+proved value-identical (greedy argmax, per-lane EOS/budget freeze as an
+idempotent rewrite), so greedy outputs must be BIT-IDENTICAL to the
+lock-step K=1 baseline across persistent on/off × tp{1,2} ×
+paged/slotted × tp-overlap × prefix-hit × fused × seeded fault schedules
+(± ``KATA_TPU_STRICT=1`` via ``make persistent``). The visible surfaces
+are pinned separately: the loop's exit conditions (cap / done /
+window — early exit when a live lane reaches its pre-reserved window),
+dispatch-boundary-granular recovery, the env-degrade/explicit-raise knob
+contract (``persistent_disabled``, never a crashed guest), the
+always-present stats/heartbeat schema (``persistent`` /
+``delivered_steps``), and the psum-scatter + all_gather decomposition's
+exact numerics at tp=2.
+
+Under ``make chaos`` this file also runs with
+``KATA_TPU_FAULTS=decode_dispatch:4,sched_tick:3`` and a node-injected
+``KATA_TPU_PERSISTENT=1`` — faults land MID-persistent-round and
+recovery must stay invisible in every assertion below (tests pinning
+the persistent default monkeypatch the env off).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kata_xpu_device_plugin_tpu.guest import tp_serving
+from kata_xpu_device_plugin_tpu.guest.resilience import (
+    FaultInjector,
+    FaultSpec,
+)
+from kata_xpu_device_plugin_tpu.guest.serving import (
+    ENV_PERSISTENT,
+    GenerationServer,
+    _persistent_serve_decode,
+)
+from kata_xpu_device_plugin_tpu.guest.tp_serving import (
+    ENV_TP_OVERLAP,
+    overlap_reduce_fn,
+)
+from kata_xpu_device_plugin_tpu.models import tiny_test_config
+from kata_xpu_device_plugin_tpu.models.transformer import (
+    init_params,
+    prefill,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_test_config(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _prompts(cfg, lengths, seed=3):
+    key = jax.random.PRNGKey(seed)
+    return [
+        np.asarray(
+            jax.random.randint(jax.random.fold_in(key, i), (n,), 0,
+                               cfg.vocab_size),
+            np.int32,
+        )
+        for i, n in enumerate(lengths)
+    ]
+
+
+# Staggered budgets (the fused-suite precedent): equal ones synchronize
+# lane finishes, so freezes would never land mid-persistent-round.
+_LENS = [14, 9, 12, 7, 15, 11]
+_BUDGETS = [6, 12, 9, 5, 11, 7]
+
+
+def _serve(params, cfg, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("prefill_buckets", (16,))
+    kw.setdefault("recovery_backoff_s", 0.0)
+    if kw.pop("tp", 1) > 1:
+        kw["mesh"] = tp_serving.serving_mesh(2)
+    srv = GenerationServer(params, cfg, **kw)
+    prompts = _prompts(cfg, _LENS)
+    rids = [srv.submit(p, m) for p, m in zip(prompts, _BUDGETS)]
+    res = srv.run()
+    return [res[r] for r in rids], srv
+
+
+# ----- bit-identity matrix ---------------------------------------------------
+
+
+_MATRIX = [
+    (dict(persistent=True), "slotted"),
+    (dict(persistent=True, overlap=False), "slotted-lockstep"),
+    (dict(persistent=True, decode_steps=4), "slotted-k4"),
+    (dict(persistent=True, kv_pool_tokens=512, kv_block_size=8,
+          kv_layout="blocks"), "paged"),
+    (dict(persistent=True, strict=True), "strict"),
+    (dict(persistent=True, tp=2), "tp2"),
+]
+
+
+@pytest.mark.parametrize(
+    "kw", [c for c, _ in _MATRIX], ids=[i for _, i in _MATRIX]
+)
+def test_persistent_bit_identity(model, monkeypatch, kw):
+    monkeypatch.delenv(ENV_PERSISTENT, raising=False)
+    cfg, params = model
+    base, _ = _serve(params, cfg)
+    out, srv = _serve(params, cfg, **kw)
+    for a, b in zip(base, out):
+        np.testing.assert_array_equal(a, b)
+    st = srv.stats()
+    assert st["persistent"] == 1
+    assert st["persistent_rounds"] > 0
+    assert st["delivered_steps_total"] > 0
+
+
+def test_persistent_bit_identity_tp2_overlap(model, monkeypatch):
+    # The full tentpole cross: persistent while_loop × tp=2 × the
+    # psum-scatter/all_gather overlap hint. The decomposition reduces
+    # the SAME partials in the same order, so greedy outputs stay
+    # bit-identical to the single-chip baseline.
+    monkeypatch.setenv(ENV_TP_OVERLAP, "1")
+    cfg, params = model
+    base, _ = _serve(params, cfg)
+    out, _ = _serve(params, cfg, persistent=True, tp=2)
+    for a, b in zip(base, out):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_persistent_with_fused_admissions(model):
+    # ISSUE 20 + ISSUE 13: a round with a pending admission slice runs
+    # the fused fixed-K dispatch, the others run persistent — one call
+    # site, outputs identical to the unfused K=1 baseline.
+    cfg, params = model
+    base, _ = _serve(params, cfg)
+    out, srv = _serve(params, cfg, persistent=True, fused=True,
+                      sched_policy="slo_chunked", prefill_chunk=4,
+                      itl_slo_ms=0.0)
+    for a, b in zip(base, out):
+        np.testing.assert_array_equal(a, b)
+    assert srv.stats()["persistent_rounds"] > 0
+
+
+# ----- exit conditions -------------------------------------------------------
+
+
+def test_window_exhaustion_exits_early(model):
+    # The loop's third exit: a live lane's next write would cross its
+    # pre-reserved window — the executable must stop AT the window edge
+    # (delivered < budget) instead of scribbling past the reservation.
+    cfg, params = model
+    B, max_len = 2, 32
+    prompt = _prompts(cfg, [6])[0]
+    caches, tok, _pos0 = prefill(
+        params, jnp.asarray(np.stack([prompt, prompt])), cfg, max_len,
+    )
+    tok = jnp.asarray(tok, jnp.int32).reshape(B)
+    pos = jnp.full((B,), len(prompt), jnp.int32)
+    budget = jnp.asarray([20, 20], jnp.int32)
+    # Lane 1's window ends 4 tokens ahead; lane 0's is ample.
+    window = jnp.asarray([max_len, len(prompt) + 4], jnp.int32)
+    out, _caches, _tok, new_pos, delivered = _persistent_serve_decode(
+        params, caches, tok, pos, budget, window, cfg, 16,
+    )
+    assert int(delivered) == 4          # stopped at lane 1's window edge
+    assert int(new_pos[1]) == len(prompt) + 4
+    assert out.shape == (B, 16)         # dense carry stays cap-shaped
+
+
+def test_cap_exit_bounds_the_round(model):
+    # The heartbeat-cadence cap is a hard bound: budgets larger than the
+    # static max_steps deliver exactly max_steps.
+    cfg, params = model
+    B, max_len = 2, 32
+    prompt = _prompts(cfg, [6])[0]
+    caches, tok, _pos0 = prefill(
+        params, jnp.asarray(np.stack([prompt, prompt])), cfg, max_len,
+    )
+    tok = jnp.asarray(tok, jnp.int32).reshape(B)
+    pos = jnp.full((B,), len(prompt), jnp.int32)
+    out, _c, _t, _p, delivered = _persistent_serve_decode(
+        params, caches, tok, pos, jnp.asarray([20, 20], jnp.int32),
+        jnp.asarray([max_len, max_len], jnp.int32), cfg, 5,
+    )
+    assert int(delivered) == 5
+
+
+def test_persistent_under_pool_pressure(model):
+    # _ensure_blocks reserves the WHOLE persistent window up front, so a
+    # tight pool preempts youngest-first at reservation time — outputs
+    # must stay bit-identical through the spill/resume cycles.
+    cfg, params = model
+    base, _ = _serve(params, cfg)
+    out, srv = _serve(params, cfg, persistent=True, kv_pool_tokens=128,
+                      kv_block_size=8, kv_layout="blocks")
+    for a, b in zip(base, out):
+        np.testing.assert_array_equal(a, b)
+    assert srv.stats()["persistent_rounds"] > 0
+
+
+def test_exit_reasons_partition_rounds(model, capture_events):
+    cfg, params = model
+
+    def run():
+        return _serve(params, cfg, persistent=True)
+
+    (_, srv), events = capture_events(run)
+    st = srv.stats()
+    exits = st["persistent_exits"]
+    assert set(exits) == {"cap", "done", "window"}
+    assert sum(exits.values()) == st["persistent_rounds"]
+    evs = [e for e in events if e.get("name") == "persistent_exit"]
+    assert len(evs) == st["persistent_rounds"]
+    for e in evs:
+        assert e["reason"] in exits
+        assert 0 <= e["delivered"] <= e["cap"]
+    assert st["delivered_steps_total"] == sum(e["delivered"] for e in evs)
+
+
+# ----- recovery --------------------------------------------------------------
+
+
+def test_persistent_recovery_identity(model):
+    # A decode_dispatch fault interrupting a persistent round: the
+    # donated partial dies with the failed dispatch, lanes replay
+    # strict-FIFO from their prompts, and recovered greedy outputs stay
+    # bit-identical — recovery is dispatch-boundary-granular, a
+    # mid-while_loop fault never yields a half-applied round.
+    cfg, params = model
+    base, _ = _serve(params, cfg)
+    inj = FaultInjector(schedule=(
+        FaultSpec(seam="decode_dispatch", round=3),
+        FaultSpec(seam="sched_tick", round=2),
+    ), seed=7)
+    out, srv = _serve(params, cfg, persistent=True, fault_injector=inj,
+                      checkpoint_rounds=0)
+    for a, b in zip(base, out):
+        np.testing.assert_array_equal(a, b)
+    assert srv.stats()["recoveries"] >= 1
+    assert not srv.failures()
+
+
+# ----- knob contract ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("kw,needle", [
+    (dict(speculative_k=2), "speculative"),
+    (dict(ring_kv=True), "ring_kv"),
+    (dict(temperature=0.8), "sampling"),
+])
+def test_explicit_persistent_conflict_raises(model, kw, needle):
+    cfg, params = model
+    if "ring_kv" in kw:
+        cfg = tiny_test_config(dtype=jnp.float32, sliding_window=8)
+        params = init_params(jax.random.PRNGKey(0), cfg,
+                             dtype=jnp.float32)
+    with pytest.raises(ValueError, match=needle):
+        GenerationServer(params, cfg, max_batch=2, max_len=64,
+                         persistent=True, **kw)
+
+
+def test_env_persistent_conflict_degrades(model, monkeypatch,
+                                          capture_events):
+    # The daemon-injected env must never crash a guest whose config it
+    # conflicts with: degrade with a persistent_disabled event.
+    monkeypatch.setenv(ENV_PERSISTENT, "1")
+    cfg, params = model
+
+    def run():
+        return GenerationServer(params, cfg, max_batch=2, max_len=64,
+                                temperature=0.8)
+
+    srv, events = capture_events(run)
+    assert srv.stats()["persistent"] == 0
+    evs = [e for e in events if e.get("name") == "persistent_disabled"]
+    assert evs and evs[0]["reason"] == "sampling"
+
+
+def test_env_persistent_malformed_degrades(model, monkeypatch,
+                                           capture_events):
+    monkeypatch.setenv(ENV_PERSISTENT, "maybe")
+    cfg, params = model
+
+    def run():
+        return GenerationServer(params, cfg, max_batch=2, max_len=64)
+
+    srv, events = capture_events(run)
+    assert srv.stats()["persistent"] == 0
+    evs = [e for e in events if e.get("name") == "persistent_disabled"]
+    assert evs and evs[0]["reason"].startswith("bad_env")
+
+
+def test_env_persistent_enables(model, monkeypatch):
+    monkeypatch.setenv(ENV_PERSISTENT, "1")
+    cfg, params = model
+    base, _ = _serve(params, cfg, persistent=False)
+    out, srv = _serve(params, cfg)          # env-enabled
+    assert srv.stats()["persistent"] == 1
+    for a, b in zip(base, out):
+        np.testing.assert_array_equal(a, b)
+
+
+# ----- stats / heartbeat schema ----------------------------------------------
+
+
+def test_stats_schema_always_present(model, monkeypatch):
+    # The no-schema-branch contract: every persistent field exists (as
+    # zeros) on a server that never enables the loop.
+    monkeypatch.delenv(ENV_PERSISTENT, raising=False)
+    cfg, params = model
+    _, srv = _serve(params, cfg)
+    st = srv.stats()
+    assert st["persistent"] == 0
+    assert st["persistent_cap"] == 0
+    assert st["persistent_rounds"] == 0
+    assert st["delivered_steps"] == 0
+    assert st["delivered_steps_total"] == 0
+    assert st["persistent_exits"] == {"cap": 0, "done": 0, "window": 0}
+
+
+def test_heartbeat_carries_persistent_fields(model, capture_events):
+    cfg, params = model
+
+    def run():
+        return _serve(params, cfg, persistent=True, heartbeat_rounds=2)
+
+    (_, srv), events = capture_events(run)
+    hbs = [e for e in events if e.get("name") == "serving_heartbeat"]
+    assert hbs
+    for hb in hbs:
+        assert hb["persistent"] == 1
+        assert hb["delivered_steps"] >= 0
+    assert any(hb["delivered_steps"] > 0 for hb in hbs)
+    cfg_evs = [e for e in events if e.get("name") == "serving_config"]
+    assert cfg_evs and cfg_evs[0]["persistent"] == 1
+    assert cfg_evs[0]["persistent_cap"] == srv.stats()["persistent_cap"]
+
+
+# ----- tp collective overlap -------------------------------------------------
+
+
+def test_overlap_reduce_fn_gating(model, monkeypatch, capture_events):
+    cfg, _ = model
+    mesh = tp_serving.serving_mesh(2)
+    monkeypatch.delenv(ENV_TP_OVERLAP, raising=False)
+    # Default ON: the hint computes exactly the psum's value, so only
+    # the explicit "0" kill switch (or an ineligible mesh/config)
+    # forfeits the overlap.
+    assert overlap_reduce_fn(mesh, cfg) is not None
+    monkeypatch.setenv(ENV_TP_OVERLAP, "0")
+    assert overlap_reduce_fn(mesh, cfg) is None
+    monkeypatch.setenv(ENV_TP_OVERLAP, "1")
+    assert overlap_reduce_fn(None, cfg) is None      # no mesh → no tp
+    assert overlap_reduce_fn(mesh, cfg) is not None
+    monkeypatch.setenv(ENV_TP_OVERLAP, "banana")
+
+    def run():
+        return overlap_reduce_fn(mesh, cfg)
+
+    fn, events = capture_events(run)
+    # Malformed values degrade to the DEFAULT (on) after one event —
+    # a typo must not silently forfeit the overlap.
+    assert fn is not None
+    assert any(e.get("name") == "tp_overlap_disabled"
+               and e["reason"].startswith("bad_env") for e in events)
+
+
+def test_overlap_numerics_exact_at_tp2(model, monkeypatch):
+    # The decomposed reduce (reduce-scatter + all-gather via the
+    # sharding-constraint pair) sums the same per-shard partials in the
+    # same order as the plain psum — greedy serving outputs at tp=2 must
+    # be BIT-identical with the hint on vs off, fused and persistent
+    # included.
+    cfg, params = model
+    monkeypatch.setenv(ENV_TP_OVERLAP, "0")
+    plain, _ = _serve(params, cfg, tp=2)
+    monkeypatch.setenv(ENV_TP_OVERLAP, "1")
+    hinted, srv = _serve(params, cfg, tp=2)
+    for a, b in zip(plain, hinted):
+        np.testing.assert_array_equal(a, b)
+    assert srv.stats()["steady_state_compiles"] == 0

@@ -539,6 +539,34 @@ class _SiteWalk(ast.NodeVisitor):
 
     visit_AsyncFor = visit_For
 
+    def visit_While(self, node: ast.While) -> None:
+        # ISSUE 20: a host `while` is a loop scope like `for` — any name
+        # REASSIGNED inside the body varies per iteration, so feeding it
+        # to a jit static from inside the loop is the same
+        # unbounded-signature hazard JG401 flags for `for` targets. (The
+        # persistent decode executable itself is the converse case: its
+        # `lax.while_loop` is a TRACED callee — `analyze_dispatch`
+        # skips traced bodies — and counts as ONE dispatch signature.)
+        scope = set()
+        for child in node.body:
+            for n in ast.walk(child):
+                if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    tgts = (
+                        n.targets if isinstance(n, ast.Assign)
+                        else [n.target]
+                    )
+                    for t in tgts:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Name):
+                                scope.add(leaf.id)
+        self.visit(node.test)
+        self.loop_vars.append(scope)
+        for child in node.body:
+            self.visit(child)
+        self.loop_vars.pop()
+        for child in node.orelse:
+            self.visit(child)
+
     def visit_Delete(self, node: ast.Delete) -> None:
         for tgt in node.targets:
             self._clear_watch(dotted(tgt))

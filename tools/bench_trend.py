@@ -64,6 +64,10 @@ HEADLINE_METRICS = (
     # rounds (ISSUE 17): the device-side "are the chips actually
     # working" headline the ledger exists to move.
     "serving_device_busy_frac",
+    # Persistent while_loop decode serving tok/s (ISSUE 20): the
+    # host-round-trip-amortization number the persistent executable
+    # exists to move.
+    "serving_persistent_tok_per_s",
 )
 
 # Lower-is-better INFO metrics (ISSUE 17): direction-aware statuses
